@@ -1,0 +1,31 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+Built from scratch on JAX/XLA/Pallas (see SURVEY.md for the blueprint):
+XLA replaces the dependency engine + graph executor + memory planner of the
+reference (yjxiong/mxnet), Pallas kernels replace CUDA/cuDNN ops, and
+ICI/DCN collectives replace the NCCL/ps-lite KVStore backends.
+
+Import as ``import mxnet_tpu as mx`` — the public surface mirrors the
+reference's ``mx.*`` namespaces.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus
+from . import base
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import gluon
+from . import test_utils

@@ -1,0 +1,88 @@
+"""Global RNG state bridging MXNet's seeded-global-RNG model onto jax PRNG keys.
+
+Reference: per-device RNG resources handed to ops via ResourceManager
+(``include/mxnet/resource.h:42`` kRandom, ``src/resource.cc``), seeded by
+``mx.random.seed``.  jax PRNG is explicit-key; we keep a process-global key
+that eager random ops split from, and a *provider stack* so that traced code
+(hybridized CachedOp, Symbol executors) draws subkeys deterministically from a
+key that is threaded in as a real argument — keeping the trace pure while
+every call still sees fresh randomness.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+def _providers():
+    if not hasattr(_state, "stack"):
+        _state.stack = [EagerKeyProvider(np.random.randint(0, 2**31))]
+    return _state.stack
+
+
+class EagerKeyProvider:
+    """Splits a concrete global key; used outside any trace."""
+
+    def __init__(self, seed):
+        self.seed(seed)
+
+    def seed(self, seed):
+        self._key = jax.random.PRNGKey(seed)
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+class TraceKeyProvider:
+    """Derives subkeys from a (possibly traced) base key with a fold counter.
+
+    Pushed while tracing a CachedOp / Symbol executor so that random ops
+    become pure functions of the key argument.
+    """
+
+    def __init__(self, base_key):
+        self._base = base_key
+        self._n = 0
+
+    def next_key(self):
+        self._n += 1
+        return jax.random.fold_in(self._base, self._n)
+
+    @property
+    def used(self):
+        return self._n > 0
+
+
+def next_key():
+    return _providers()[-1].next_key()
+
+
+def seed(seed_val):
+    """mx.random.seed equivalent (reference: python/mxnet/random.py)."""
+    _providers()[0].seed(int(seed_val))
+    np.random.seed(int(seed_val))
+
+
+def push_provider(p):
+    _providers().append(p)
+
+
+def pop_provider():
+    return _providers().pop()
+
+
+class trace_scope:
+    def __init__(self, base_key):
+        self.provider = TraceKeyProvider(base_key)
+
+    def __enter__(self):
+        push_provider(self.provider)
+        return self.provider
+
+    def __exit__(self, *exc):
+        pop_provider()

@@ -1,0 +1,367 @@
+"""Imperative autograd: record/pause scopes + a tape over jax.vjp.
+
+Reference: ``src/imperative/imperative.cc`` (RecordOp:183 builds an nnvm graph
+on NDArray ``entry_``; Backward runs pass::Gradient) and the Python surface
+``python/mxnet/autograd.py:122-181,243,270``.  Here each recorded op call runs
+through ``jax.vjp`` once — forward result plus a vjp closure — so the "tape"
+is a DAG of vjp closures; Backward is a reverse-topological sweep feeding
+cotangents through them.  ``create_graph=True`` re-records the vjp calls
+themselves (vjp-of-vjp), giving higher-order gradients where the reference
+re-runs pass::Gradient on the backward graph.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode", "is_recording",
+    "is_training", "set_recording", "set_training", "mark_variables",
+    "backward", "grad", "get_symbol", "Function",
+]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    prev = _st().recording
+    _st().recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    prev = _st().training
+    _st().training = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_record = is_record
+        self._enter_train = train_mode_
+        self._prev = None
+
+    def __enter__(self):
+        s = _st()
+        self._prev = (s.recording, s.training)
+        if self._enter_record is not None:
+            s.recording = self._enter_record
+        if self._enter_train is not None:
+            s.training = self._enter_train
+        return self
+
+    def __exit__(self, *exc):
+        s = _st()
+        s.recording, s.training = self._prev
+
+
+def record(train_mode=True):
+    """Scope: operations are recorded for gradient (autograd.py:122)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+class Node:
+    """One recorded op: a vjp closure plus its input NDArrays.
+
+    fwd_fn/in_raw/fwd_multi are kept so create_graph=True can re-derive the
+    vjp *as a function of the primals* (higher-order grads); a vjp closure
+    alone treats the primals as constants.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "id", "fwd_fn", "in_raw",
+                 "fwd_multi")
+    _counter = [0]
+
+    def __init__(self, vjp_fn, inputs, out_avals, fwd_fn=None, in_raw=None,
+                 fwd_multi=False):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list of NDArray (differentiable inputs)
+        self.out_avals = out_avals    # [(shape, dtype)] for zero-cotangent fill
+        self.fwd_fn = fwd_fn
+        self.in_raw = in_raw
+        self.fwd_multi = fwd_multi
+        Node._counter[0] += 1
+        self.id = Node._counter[0]
+
+
+def record_op(vjp_fn, inputs, out_arrays, fwd_fn=None, in_raw=None,
+              fwd_multi=False):
+    avals = [(o.shape, o.dtype) for o in out_arrays]
+    return Node(vjp_fn, list(inputs), avals, fwd_fn, in_raw, fwd_multi)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Mark NDArrays as autograd leaves (reference: imperative.h:121)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._mark = req != "null"
+        v._grad_req = req
+        v._grad = g
+        v._entry = None
+
+
+def _toposort(head_nodes):
+    order = []
+    seen = set()
+    stack = [(n, False) for n in head_nodes]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if node.id in seen:
+            continue
+        seen.add(node.id)
+        stack.append((node, True))
+        for inp in node.inputs:
+            ent = inp._entry
+            if ent is not None and ent[0].id not in seen:
+                stack.append((ent[0], False))
+    return order  # children before parents (reverse-topo for backward)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
+    """Compute gradients of heads w.r.t. marked variables
+    (reference: python/mxnet/autograd.py:243 + imperative.cc Backward)."""
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    from .ndarray import NDArray
+
+    # cotangent buckets: node.id -> [cotangent or None per output].
+    # Under create_graph the cotangents are NDArrays so the chain of backward
+    # computations stays recorded (needed for higher-order grads).
+    buckets = {}
+    leaf_acc = {}  # id(leaf) -> [leaf, summed grad (NDArray when create_graph)]
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        g = hg._data if hasattr(hg, "_data") else (
+            jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg))
+        if create_graph:
+            g = NDArray(g)
+        if h._entry is None:
+            if getattr(h, "_mark", False):
+                _leaf_add(leaf_acc, h, g)
+            continue
+        node, idx = h._entry
+        head_nodes.append(node)
+        slot = buckets.setdefault(node.id, [None] * len(node.out_avals))
+        slot[idx] = g if slot[idx] is None else slot[idx] + g
+
+    order = _toposort(head_nodes) if head_nodes else []
+    for node in reversed(order):
+        cots = buckets.pop(node.id, None)
+        if cots is None:
+            continue
+        if create_graph:
+            cot_nds = [
+                c if c is not None else NDArray(jnp.zeros(shape, dtype))
+                for c, (shape, dtype) in zip(cots, node.out_avals)
+            ]
+            in_grads = _recorded_vjp(node, cot_nds)
+        else:
+            full = tuple(
+                c if c is not None else jnp.zeros(shape, dtype)
+                for c, (shape, dtype) in zip(cots, node.out_avals)
+            )
+            in_grads = node.vjp_fn(full)
+        for inp, ig in zip(node.inputs, in_grads):
+            if ig is None:
+                continue
+            ent = inp._entry
+            if ent is not None:
+                pnode, pidx = ent
+                slot = buckets.setdefault(pnode.id, [None] * len(pnode.out_avals))
+                if not create_graph and hasattr(ig, "_data"):
+                    ig = ig._data
+                slot[pidx] = ig if slot[pidx] is None else slot[pidx] + ig
+            elif getattr(inp, "_mark", False):
+                _leaf_add(leaf_acc, inp, ig)
+
+    for leaf, g in leaf_acc.values():
+        _write_leaf_grad(leaf, g)
+
+
+def _leaf_add(acc, leaf, g):
+    key = id(leaf)
+    if key in acc:
+        prev = acc[key][1]
+        acc[key][1] = prev + g  # NDArray + NDArray stays recorded if create_graph
+    else:
+        acc[key] = [leaf, g]
+
+
+def _write_leaf_grad(leaf, g):
+    from .ndarray import NDArray
+
+    is_nd = isinstance(g, NDArray)
+    raw = g._data if is_nd else g
+    if leaf._grad is None:
+        leaf._grad = NDArray(jnp.zeros_like(leaf._data))
+    if leaf._grad_req == "add":
+        leaf._grad._set_data(leaf._grad._data + raw)
+    elif is_nd and g._entry is not None:
+        # create_graph path: keep the recorded entry on the grad array
+        leaf._grad._data = raw
+        leaf._grad._entry = g._entry
+    else:
+        leaf._grad._set_data(raw)
+
+
+def _recorded_vjp(node, cot_nds):
+    """Apply the node's backward while recording it as new graph nodes, so the
+    produced gradients are themselves differentiable (higher-order)."""
+    from .ndarray import NDArray
+
+    cotangents = tuple(c._data for c in cot_nds)
+    if node.fwd_fn is None:
+        # custom Function — backward not re-differentiable (as in reference)
+        return node.vjp_fn(cotangents)
+
+    n_in = len(node.in_raw)
+    fwd_fn, fwd_multi = node.fwd_fn, node.fwd_multi
+
+    def gfn(*args):
+        prim, cots = args[:n_in], args[n_in:]
+        _, vjp = jax.vjp(fwd_fn, *prim)
+        return tuple(vjp(tuple(cots) if fwd_multi else cots[0]))
+
+    all_raw = tuple(node.in_raw) + tuple(cotangents)
+    outs, vjp2 = jax.vjp(gfn, *all_raw)
+    new_inputs = list(node.inputs) + cot_nds
+    new_node = record_op(vjp2, new_inputs, list(outs), gfn, list(all_raw), True)
+    out_nds = []
+    for i, o in enumerate(outs):
+        nd_ = NDArray(o)
+        nd_._entry = (new_node, i)
+        out_nds.append(nd_)
+    return out_nds
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Like backward but returns grads of `variables` instead of writing .grad
+    (reference: autograd.py:270)."""
+    from .ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        single = True
+    else:
+        single = False
+    saved = [(v._mark, v._grad_req, v._grad, v._entry) for v in variables]
+    for v in variables:
+        v._mark = True
+        v._grad_req = "write"
+        v._grad = None
+        # keep entry: interior nodes allowed for grad()
+    prev_rec = set_recording(True) if create_graph else None
+    try:
+        backward(heads, head_grads, retain_graph=True, train_mode=train_mode,
+                 create_graph=create_graph)
+    finally:
+        if prev_rec is not None:
+            set_recording(prev_rec)
+    outs = []
+    for v, (m, gr, og, ent) in zip(variables, saved):
+        if v._grad is None:
+            raise ValueError("some variables do not participate in the graph")
+        outs.append(v._grad)
+        v._mark, v._grad_req, v._grad, v._entry = m, gr, og, ent
+    return outs[0] if single else outs
+
+
+def get_symbol(x):
+    """Reference autograd.get_symbol returns the recorded graph as a Symbol.
+    We return None placeholder symbol support lives in mxnet_tpu.symbol."""
+    raise NotImplementedError("use mxnet_tpu.symbol to build symbolic graphs")
+
+
+# ---------------------------------------------------------------------------
+# Custom differentiable Function (reference: autograd.py:363 class Function)
+# ---------------------------------------------------------------------------
+class Function:
+    """User-defined differentiable function with explicit forward/backward.
+
+    Subclass and implement forward(self, *inputs) and backward(self, *ograds),
+    both over NDArrays, as in the reference API.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            fn = self
+
+            def vjp_fn(cotangents):
+                with pause():
+                    grads = fn.backward(*[NDArray(c) for c in cotangents])
+                if not isinstance(grads, (list, tuple)):
+                    grads = [grads]
+                return [g._data if hasattr(g, "_data") else g for g in grads]
+
+            diff_inputs = [i for i in inputs if isinstance(i, NDArray)]
+            node = record_op(vjp_fn, diff_inputs, [o._data for o in outs])
+            for i, o in enumerate(outs):
+                o._entry = (node, i)
+        return outs[0] if single else outs

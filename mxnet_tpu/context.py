@@ -1,0 +1,130 @@
+"""Device contexts mapped onto jax devices.
+
+Reference: ``python/mxnet/context.py:29`` (Context with devtype ids
+cpu/gpu/cpu_pinned/cpu_shared).  Here the accelerator is the TPU: ``mx.tpu(i)``
+is the native device, ``mx.gpu(i)`` is kept as a compatibility alias so
+reference scripts run unchanged, and ``cpu_pinned``/``cpu_shared`` collapse to
+host memory (XLA manages transfer pinning itself).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context", "num_gpus", "num_tpus"]
+
+
+class Context:
+    """A device context.
+
+    Usable as ``with mx.tpu(0):`` to set the default device for array
+    creation, matching reference semantics (context.py:119 ``__enter__``).
+    """
+
+    _default_ctx = threading.local()
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 5: "cpu_shared"}
+    devstr2type = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, "value", None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        Context._default_ctx.value = self._old_ctx
+
+    # -- jax integration ---------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            devs = _backend_devices("cpu")
+        else:
+            devs = _accelerator_devices()
+        if not devs:
+            raise RuntimeError("no %s devices available" % self.device_type)
+        return devs[self.device_id % len(devs)]
+
+    def empty_cache(self):
+        """Release cached device memory (reference frees the GPU pool)."""
+        # XLA owns the HBM allocator; nothing to do but keep the API.
+        return None
+
+
+def _backend_devices(platform):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return []
+
+
+_ACCEL_CACHE = None
+
+
+def _accelerator_devices():
+    """All non-CPU jax devices; falls back to CPU if none (host testing)."""
+    global _ACCEL_CACHE
+    if _ACCEL_CACHE is None:
+        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        _ACCEL_CACHE = devs if devs else _backend_devices("cpu")
+    return _ACCEL_CACHE
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias: reference scripts use mx.gpu(); maps to the TPU."""
+    return Context("tpu", device_id)
+
+
+def num_tpus():
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return len(devs)
+
+
+def num_gpus():
+    return num_tpus()
+
+
+def current_context():
+    cur = getattr(Context._default_ctx, "value", None)
+    return cur if cur is not None else Context("cpu", 0)

@@ -1,0 +1,7 @@
+"""Gluon: the imperative high-level API (reference: python/mxnet/gluon/)."""
+from . import nn
+from . import loss
+from . import utils
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Parameter, ParameterDict, Constant
+from .trainer import Trainer

@@ -1,0 +1,301 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: ``python/mxnet/gluon/parameter.py`` — deferred initialization,
+grad_req handling, per-context replication.  TPU-native difference: a
+parameter holds ONE jax array (possibly sharded across the mesh by
+``mxnet_tpu.parallel``) instead of the reference's per-GPU copies; Trainer's
+allreduce collapses to XLA collectives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import autograd, initializer
+from .. import ndarray as nd
+from ..base import MXNetError, np_dtype
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype=np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None
+        self._grad = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req if differentiable else "null"
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._ctx = None
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self._shape,
+                                                      self.dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(
+            s1 in (0, s2) for s1, s2 in zip(self._shape, new_shape)
+        ) and len(self._shape) == len(new_shape)
+        if not unknown_ok:
+            raise AssertionError(
+                "cannot reset shape %s -> %s for %s" % (self._shape, new_shape,
+                                                        self.name))
+        self._shape = tuple(new_shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # single logical device; sharding handles the rest
+        self._ctx = ctx
+        if self._shape is None or 0 in self._shape:
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                "cannot initialize %s: shape unknown %s" % (self.name, self._shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = nd.empty(self._shape, ctx=ctx, dtype=self.dtype)
+        chosen = init or self.init or default_init
+        initializer.create(chosen)(initializer.InitDesc(self.name), data)
+        self._init_impl(data)
+
+    def _init_impl(self, data):
+        self._data = data
+        self._deferred_init = ()
+        if self.grad_req != "null":
+            self._data.attach_grad(self.grad_req)
+            self._grad = self._data._grad
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init = self._deferred_init
+        if self._shape is None or 0 in self._shape:
+            raise DeferredInitializationError(
+                "parameter %s has unknown shape %s" % (self.name, self._shape))
+        self._finish_init(init, ctx, default_init)
+
+    def _check_init(self):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    "parameter %s deferred; run a forward pass first" % self.name)
+            raise RuntimeError(
+                "parameter %s not initialized; call initialize()" % self.name)
+
+    def data(self, ctx=None):
+        self._check_init()
+        return self._data
+
+    def list_data(self):
+        self._check_init()
+        return [self._data]
+
+    def grad(self, ctx=None):
+        self._check_init()
+        if self._data._grad is None:
+            raise RuntimeError("parameter %s has grad_req=null" % self.name)
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init:
+            return [self._deferred_init[1]]
+        self._check_init()
+        return [self._data.context]
+
+    def set_data(self, data):
+        if not isinstance(data, NDArray):
+            data = nd.array(data, dtype=self.dtype)
+        if self._data is None:
+            # loading into an uninitialized parameter: adopt the value
+            # (reference allows load_parameters before initialize when
+            # shapes are known)
+            self.shape = tuple(data.shape)
+            self._deferred_init = ()
+            self._init_impl(nd.array(data, dtype=self.dtype))
+            return
+        self._data._set_data(data._data.astype(np_dtype(self.dtype)))
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            g = self._data._grad
+            g._set_data(g._data * 0)
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype)
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data._set_data(self._data._data.astype(self.dtype))
+            if had_grad:
+                self._data.attach_grad(self.grad_req)
+                self._grad = self._data._grad
+
+    def var(self):
+        from .. import symbol
+        if self._var is None:
+            self._var = symbol.var(self.name, shape=self._shape,
+                                   dtype=self.dtype, lr_mult=self.lr_mult,
+                                   wd_mult=self.wd_mult, init=self.init)
+        return self._var
+
+    def reset_ctx(self, ctx):
+        self._ctx = ctx
+        if self._data is not None:
+            moved = self._data.as_in_context(ctx if not isinstance(ctx, (list, tuple)) else ctx[0])
+            self._data._set_data(moved._data)
+
+
+class Constant(Parameter):
+    """Non-updating parameter (reference: gluon/parameter.py Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class _Init(initializer.Initializer):
+            def _init_weight(self_, _, arr):
+                arr[:] = value.asnumpy()
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_Init())
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        return "ParameterDict(%s)" % ", ".join(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None and param.shape is not None:
+                    param.shape = v
+                elif getattr(param, k, None) is None and v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError("duplicate parameter %s" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        init = init or initializer.Uniform()
+        for v in self.values():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            block = param.list_data()
+            weight = sum(w.copyto(cpu()) for w in block) / len(block)
+            if not param.name.startswith(strip_prefix):
+                raise ValueError("prefix %s not in param name %s"
+                                 % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        arg_dict = {(restore_prefix + k): v for k, v in nd.load(filename).items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise IOError("parameter %s missing in file %s"
+                                  % (name, filename))
+        for name, val in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise IOError("unknown parameter %s in file %s"
+                                  % (name, filename))
+                continue
+            self[name].set_data(val)

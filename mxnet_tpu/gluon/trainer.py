@@ -1,0 +1,124 @@
+"""Gluon Trainer (reference: ``python/mxnet/gluon/trainer.py:27`` —
+_init_kvstore:153, step:217, allreduce_grads:245)."""
+from __future__ import annotations
+
+from .. import kvstore as kvs
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)) or hasattr(params, "values"):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a ParameterDict or list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError("invalid parameter %r" % (param,))
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_type = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params and set(optimizer_params) != {"rescale_grad"}:
+                raise ValueError(
+                    "optimizer_params must be None when optimizer is an instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = opt.get_updater(self._optimizer)
+
+    def _init_kvstore(self):
+        """Reference: trainer.py:153 — decide kvstore + update placement."""
+        if self._kv_type is None:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            kv = kvs.create(self._kv_type) if isinstance(self._kv_type, str) \
+                else self._kv_type
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
+            if self._update_on_kvstore is None:
+                # single-copy parameters: local update is the fast path on TPU
+                self._update_on_kvstore = False
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    kv.init(i, param.data())
+            if self._update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            self._kvstore = kv
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr_scheduler(self._optimizer.num_update) \
+            if self._optimizer.lr_scheduler else self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + update (reference: trainer.py:217)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        """Reference: trainer.py:245 — push(grad); pull(grad).  On one
+        process this is the identity (one grad copy already); across hosts
+        the kvstore lowers to a DCN psum."""
+        if self._kvstore is None or self._kvstore.num_workers == 1:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._kvstore.push(i, param.grad(), priority=-i)
+                if not self._update_on_kvstore:
+                    self._kvstore.pull(i, param.grad(), priority=-i)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._update_on_kvstore and self._kvstore is not None:
+                self._kvstore.push(i, param.grad(), priority=-i)
+                self._kvstore.pull(i, param.data(), priority=-i)
+            else:
+                self._updaters(i, param.grad(), param.data())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Manual update after a custom allreduce (reference: trainer.py update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters.get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters.set_states(f.read())

@@ -1,0 +1,84 @@
+"""Gluon utilities (reference: ``python/mxnet/gluon/utils.py``)."""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into num_slice chunks (reference: utils.py:31)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "batch size %d cannot be evenly split into %d slices"
+            % (size, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data and load each slice onto a context (reference: utils.py:81).
+    On a TPU mesh the physical split happens via sharding; this keeps API
+    parity for multi-context scripts."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """Rescale arrays so total L2 norm <= max_norm (reference: utils.py:118)."""
+    total = 0.0
+    for arr in arrays:
+        n = float(arr.norm().asscalar())
+        total += n * n
+    total = math.sqrt(total)
+    if not np.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf in gradient norm")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Download helper (reference: utils.py download).  This environment has
+    no egress; only file:// and existing local paths are honored."""
+    fname = url.split("/")[-1] if path is None else path
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite:
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise IOError(
+        "cannot download %r: no network egress in this environment; place the "
+        "file at %r manually" % (url, fname))

@@ -1,0 +1,549 @@
+"""NDArray: the imperative tensor, backed by an immutable jax.Array.
+
+Reference: ``include/mxnet/ndarray.h:82`` / ``src/ndarray/ndarray.cc``.
+The reference NDArray is a ref-counted chunk with *lazy async* semantics —
+every op is pushed to the dependency engine and reads block at
+``wait_to_read``.  On TPU, jax's async dispatch gives exactly those
+semantics for free: ops return immediately with futures, ``.asnumpy()``
+blocks.  Mutation (``a += b``, ``a[:] = x``, optimizer updates) is expressed
+as handle rebinding: the Python ``NDArray`` object is a mutable handle whose
+``_data`` is swapped for a new functional value — the analogue of the
+reference's var-version chain (threaded_engine.h:115).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import np_dtype
+from ..context import Context, current_context
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "array", "empty", "concatenate", "invoke", "imperative_invoke"]
+
+
+# stack of mutation trackers used by CachedOp tracing (gluon/block.py)
+_MUTATION_TRACKERS = []
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_entry", "_mark", "_grad", "_grad_req",
+                 "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        self._data = data
+        self._ctx = ctx
+        self._entry = None
+        self._mark = False
+        self._grad = None
+        self._grad_req = "write"
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._data.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = next(iter(self._data.devices()))
+            kind = "cpu" if dev.platform == "cpu" else "tpu"
+            self._ctx = Context(kind, dev.id)
+        except (AttributeError, TypeError):  # tracer
+            return current_context()
+        return self._ctx
+
+    ctx = context
+
+    def __len__(self):
+        return self._data.shape[0]
+
+    def __repr__(self):
+        try:
+            arr = _np.asarray(self._data)
+            return "%s\n<NDArray %s @%s>" % (
+                arr, "x".join(str(s) for s in self.shape), self.context)
+        except Exception:
+            return "<NDArray %s (traced)>" % (self._data,)
+
+    # -- data access -------------------------------------------------------
+    def asnumpy(self):
+        """Blocking copy to host (reference: WaitForVar then copy)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+
+    def _set_data(self, new_data):
+        """Rebind the handle to a new value (in-place mutation analogue)."""
+        for tracker in _MUTATION_TRACKERS:
+            tracker(self, new_data)
+        self._data = new_data
+        self._ctx = None
+
+    def astype(self, dtype, copy=True):
+        return invoke(_reg.get("Cast"), (self,), {"dtype": _np.dtype(dtype).name})
+
+    def copy(self):
+        return NDArray(self._data + 0 if False else jnp.asarray(self._data))
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data, other.context.jax_device())
+                            if other._ctx is not None else self._data)
+            return other
+        if isinstance(other, Context):
+            return self.as_in_context(other)
+        raise TypeError(type(other))
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device()), ctx)
+
+    as_in_ctx = as_in_context
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate grad buffer and mark as leaf (reference ndarray.py:2167)."""
+        self._entry = None
+        self._mark = grad_req != "null"
+        self._grad_req = grad_req
+        self._grad = NDArray(jnp.zeros_like(self._data)) if self._mark else None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops ---------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if kwargs.get("shape"):
+            shape = tuple(kwargs["shape"])
+        return invoke(_reg.get("Reshape"), (self,),
+                      {"shape": shape, "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return invoke(_reg.get("reshape_like"), (self, other), {})
+
+    def expand_dims(self, axis):
+        return invoke(_reg.get("expand_dims"), (self,), {"axis": axis})
+
+    def flatten(self):
+        return invoke(_reg.get("Flatten"), (self,), {})
+
+    def squeeze(self, axis=None):
+        return invoke(_reg.get("squeeze"), (self,), {"axis": axis})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return invoke(_reg.get("transpose"), (self,), {"axes": axes or None})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def swapaxes(self, dim1, dim2):
+        return invoke(_reg.get("swapaxes"), (self,), {"dim1": dim1, "dim2": dim2})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke(_reg.get("SliceChannel"), (self,),
+                      {"num_outputs": num_outputs, "axis": axis,
+                       "squeeze_axis": squeeze_axis})
+
+    def slice(self, begin, end, step=()):
+        return invoke(_reg.get("slice"), (self,),
+                      {"begin": begin, "end": end, "step": step})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke(_reg.get("slice_axis"), (self,),
+                      {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke(_reg.get("take"), (self, indices), {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return invoke(_reg.get("one_hot"), (self,), dict(depth=depth, **kw))
+
+    def tile(self, reps):
+        return invoke(_reg.get("tile"), (self,), {"reps": reps})
+
+    def broadcast_to(self, shape):
+        return invoke(_reg.get("broadcast_to"), (self,), {"shape": shape})
+
+    def broadcast_like(self, other):
+        return invoke(_reg.get("broadcast_like"), (self, other), {})
+
+    def pad(self, mode, pad_width, constant_value=0.0):
+        return invoke(_reg.get("Pad"), (self,),
+                      {"mode": mode, "pad_width": pad_width,
+                       "constant_value": constant_value})
+
+    # -- reductions / math methods (subset used pervasively) ---------------
+    def _r(self, name, **kw):
+        return invoke(_reg.get(name), (self,), kw)
+
+    def sum(self, axis=None, keepdims=False):
+        return self._r("sum", axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._r("mean", axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._r("prod", axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return self._r("max", axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._r("min", axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return self._r("norm", ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return self._r("argmax", axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return self._r("argmin", axis=axis, keepdims=keepdims)
+
+    def abs(self):
+        return self._r("abs")
+
+    def sqrt(self):
+        return self._r("sqrt")
+
+    def square(self):
+        return self._r("square")
+
+    def exp(self):
+        return self._r("exp")
+
+    def log(self):
+        return self._r("log")
+
+    def clip(self, a_min, a_max):
+        return self._r("clip", a_min=a_min, a_max=a_max)
+
+    def sign(self):
+        return self._r("sign")
+
+    def round(self):
+        return self._r("round")
+
+    def sigmoid(self):
+        return self._r("sigmoid")
+
+    def relu(self):
+        return self._r("relu")
+
+    def tanh(self):
+        return self._r("tanh")
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke(_reg.get("dot"), (self, other),
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    # -- python operators --------------------------------------------------
+    def _binop(self, name, sname, other, swap=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if swap else (self, other)
+            return invoke(_reg.get(name), (a, b), {})
+        return invoke(_reg.get(sname), (self,), {"scalar": float(other)})
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", "_plus_scalar", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", "_minus_scalar", o)
+
+    def __rsub__(self, o):
+        if isinstance(o, NDArray):
+            return o.__sub__(self)
+        return invoke(_reg.get("_rminus_scalar"), (self,), {"scalar": float(o)})
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", "_mul_scalar", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", "_div_scalar", o)
+
+    def __rtruediv__(self, o):
+        if isinstance(o, NDArray):
+            return o.__truediv__(self)
+        return invoke(_reg.get("_rdiv_scalar"), (self,), {"scalar": float(o)})
+
+    def __mod__(self, o):
+        return self._binop("broadcast_mod", "_mod_scalar", o)
+
+    def __rmod__(self, o):
+        if isinstance(o, NDArray):
+            return o.__mod__(self)
+        return invoke(_reg.get("_rmod_scalar"), (self,), {"scalar": float(o)})
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", "_power_scalar", o)
+
+    def __rpow__(self, o):
+        return invoke(_reg.get("_rpower_scalar"), (self,), {"scalar": float(o)})
+
+    def __neg__(self):
+        return invoke(_reg.get("negative"), (self,), {})
+
+    def __abs__(self):
+        return invoke(_reg.get("abs"), (self,), {})
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binop("broadcast_equal", "_equal_scalar", o)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binop("broadcast_not_equal", "_not_equal_scalar", o)
+
+    def __gt__(self, o):
+        return self._binop("broadcast_greater", "_greater_scalar", o)
+
+    def __ge__(self, o):
+        return self._binop("broadcast_greater_equal", "_greater_equal_scalar", o)
+
+    def __lt__(self, o):
+        return self._binop("broadcast_lesser", "_lesser_scalar", o)
+
+    def __le__(self, o):
+        return self._binop("broadcast_lesser_equal", "_lesser_equal_scalar", o)
+
+    __hash__ = object.__hash__
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    # in-place forms rebind the handle (engine-write analogue)
+    def __iadd__(self, o):
+        self._set_data((self + o)._data)
+        return self
+
+    def __isub__(self, o):
+        self._set_data((self - o)._data)
+        return self
+
+    def __imul__(self, o):
+        self._set_data((self * o)._data)
+        return self
+
+    def __itruediv__(self, o):
+        self._set_data((self / o)._data)
+        return self
+
+    # -- indexing ----------------------------------------------------------
+    def _clean_index(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype(jnp.int32)
+        if isinstance(key, tuple):
+            return tuple(self._clean_index(k) for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._clean_index(key)
+        op = _reg.get("_getitem")
+        return invoke(op, (self,), {"_key": key})
+
+    def __setitem__(self, key, value):
+        key = self._clean_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        if key is None or key == slice(None):
+            new = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype), self.shape)
+        else:
+            new = self._data.at[key].set(jnp.asarray(value, dtype=self.dtype))
+        self._set_data(new)
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+
+@_reg.register("_getitem")
+def _getitem_op(data, _key=None):
+    return data[_key]
+
+
+# ---------------------------------------------------------------------------
+# Central op dispatcher (reference: MXImperativeInvokeImpl, c_api_ndarray.cc:81
+# → Imperative::Invoke, imperative.cc:87)
+# ---------------------------------------------------------------------------
+def invoke(op, args, kwargs, out=None):
+    params = _reg.canonicalize_kwargs(kwargs)
+    params.pop("name", None)
+    out = params.pop("out", out)
+
+    # assemble ordered tensor inputs
+    inputs = [a for a in args]
+    if op.arg_names != ["args"]:
+        names = list(op.arg_names)
+        for idx, aux_name in sorted(op.aux.items()):
+            names.append(aux_name)
+        for name in names[len(inputs):]:
+            if name in params and isinstance(params[name], (NDArray, jnp.ndarray, _np.ndarray)):
+                inputs.append(params.pop(name))
+            elif name in params and params[name] is None:
+                params.pop(name)
+    # convert
+    nd_inputs = []
+    for a in inputs:
+        if isinstance(a, NDArray):
+            nd_inputs.append(a)
+        elif a is None:
+            continue
+        else:
+            nd_inputs.append(NDArray(jnp.asarray(a)))
+
+    raw = [a._data for a in nd_inputs]
+    if op.needs_train:
+        params = dict(params)
+        params["_train"] = autograd.is_training()
+
+    n_aux = len(op.aux)
+    n_diff = len(raw) - n_aux if n_aux else len(raw)
+
+    tracked = (
+        autograd.is_recording() and op.differentiable
+        and any(a._entry is not None or a._mark for a in nd_inputs[:n_diff])
+    )
+
+    if tracked:
+        aux_raw = raw[n_diff:]
+
+        def fwd(*xs):
+            return op.fn(*(list(xs) + aux_raw), **params)
+
+        outs, vjp_fn = jax.vjp(fwd, *raw[:n_diff])
+        fwd_multi = isinstance(outs, tuple)
+        if not fwd_multi:
+            vjp_fn = (lambda _v: lambda cts: _v(cts[0]))(vjp_fn)
+    else:
+        outs = op.fn(*raw, **params)
+        vjp_fn = None
+
+    outs_tuple = outs if isinstance(outs, tuple) else (outs,)
+
+    # aux-state mutation under training (reference: FMutateInputs)
+    if op.aux_update is not None and params.get("_train") and not params.get("use_global_stats"):
+        updates = op.aux_update(raw, outs_tuple, params)
+        for idx, val in updates.items():
+            nd_inputs[idx]._set_data(val)
+
+    n_public = op.n_outputs(params)
+    out_nds = [NDArray(o) for o in outs_tuple[:n_public]]
+
+    if tracked:
+        node = autograd.record_op(vjp_fn, nd_inputs[:n_diff], list(outs_tuple),
+                                  fwd, list(raw[:n_diff]), fwd_multi)
+        for i, o in enumerate(out_nds):
+            o._entry = (node, i)
+
+    if out is not None:
+        if isinstance(out, (list, tuple)):
+            for o_dst, o_src in zip(out, out_nds):
+                o_dst._set_data(o_src._data)
+                o_dst._entry = o_src._entry
+            return out if len(out) > 1 else out[0]
+        out._set_data(out_nds[0]._data)
+        out._entry = out_nds[0]._entry
+        return out
+    if len(out_nds) == 1:
+        return out_nds[0]
+    return out_nds
+
+
+def imperative_invoke(op_name, *args, **kwargs):
+    """Invoke a registered op by name (the C API MXImperativeInvoke analogue)."""
+    return invoke(_reg.get(op_name), args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# creation helpers
+# ---------------------------------------------------------------------------
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray.  dtype defaults to source.dtype for NDArray sources
+    and float32 otherwise, matching the reference (ndarray/ndarray.py array)."""
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+        if dtype is not None:
+            data = data.astype(np_dtype(dtype))
+    else:
+        np_arr = _np.asarray(source_array)
+        data = np_arr.astype(np_dtype(dtype) if dtype is not None else _np.float32)
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(jnp.asarray(data), ctx.jax_device()), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(
+        jnp.zeros(shape, dtype=np_dtype(dtype or "float32")), ctx.jax_device()), ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke(_reg.get("Concat"), tuple(arrays), {"dim": axis})

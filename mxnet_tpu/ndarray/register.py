@@ -1,0 +1,44 @@
+"""Code-generates the ``nd.*`` function namespace from the op registry.
+
+Reference: ``python/mxnet/ndarray/register.py:156`` — at import time the
+reference lists ops through the C API and synthesizes Python wrappers that
+marshal string kwargs into MXImperativeInvoke.  Here the wrapper closes over
+the registered Op and calls the in-process dispatcher directly.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..context import current_context
+from ..ops import registry as _reg
+from .ndarray import NDArray, invoke
+
+
+def _make_op_func(op, name):
+    def fn(*args, **kwargs):
+        ctx = kwargs.pop("ctx", None)
+        out = invoke(op, args, kwargs)
+        if ctx is not None and isinstance(out, NDArray):
+            dev = ctx.jax_device()
+            out = NDArray(jax.device_put(out._data, dev), ctx)
+        return out
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = op.doc or ("%s operator (TPU-native)." % name)
+    return fn
+
+
+def populate(target_module, internal_module=None):
+    """Install a function per registered op; _-prefixed go to _internal."""
+    for name in _reg.list_ops():
+        op = _reg.get(name)
+        f = _make_op_func(op, name)
+        if name.startswith("_"):
+            if internal_module is not None:
+                setattr(internal_module, name, f)
+        else:
+            if not hasattr(target_module, name):
+                setattr(target_module, name, f)
+        if internal_module is not None and not hasattr(internal_module, name):
+            setattr(internal_module, name, f)

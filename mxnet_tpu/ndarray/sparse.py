@@ -1,0 +1,247 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Reference: ``include/mxnet/ndarray.h:61-66`` (storage types),
+``python/mxnet/ndarray/sparse.py``.  On TPU there is no cuSPARSE analogue;
+row_sparse is (indices, values) pairs — the natural output of embedding
+gradients — and csr is (indptr, indices, data).  Dense fallback is via
+``todense``; ops keep sparsity only where it pays (sparse dot, retain,
+optimizer row updates).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "zeros", "cast_storage", "retain", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: values for a subset of rows (indices sorted ascending)."""
+
+    __slots__ = ("data", "indices", "_shape")
+
+    def __init__(self, data, indices, shape):
+        super().__init__(None)
+        self.data = data            # NDArray (nnz_rows, *row_shape)
+        self.indices = indices      # NDArray (nnz_rows,) int64
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    ctx = context
+
+    def __repr__(self):
+        return "<RowSparseNDArray %s @%s>" % ("x".join(map(str, self._shape)),
+                                              self.context)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self):
+        out = jnp.zeros(self._shape, dtype=self.data._data.dtype)
+        out = out.at[self.indices._data.astype(jnp.int32)].set(self.data._data)
+        return NDArray(out)
+
+    tostype = NDArray.tostype
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other.data = self.data.copy()
+            other.indices = self.indices.copy()
+            other._shape = self._shape
+            return other
+        return self.todense().copyto(other)
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return add_rsp(self, other)
+        return self.todense() + other
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ("data", "indices", "indptr", "_shape")
+
+    def __init__(self, data, indices, indptr, shape):
+        super().__init__(None)
+        self.data = data
+        self.indices = indices
+        self.indptr = indptr
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    ctx = context
+
+    def __repr__(self):
+        return "<CSRNDArray %s @%s>" % ("x".join(map(str, self._shape)),
+                                        self.context)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self):
+        m, n = self._shape
+        indptr = self.indptr._data.astype(jnp.int32)
+        cols = self.indices._data.astype(jnp.int32)
+        vals = self.data._data
+        # row id per nnz via searchsorted on indptr
+        nnz = vals.shape[0]
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        out = jnp.zeros((m, n), dtype=vals.dtype)
+        return NDArray(out.at[rows, cols].add(vals))
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data if isinstance(data, NDArray) else _dense_array(data, ctx, dtype)
+        indices = indices if isinstance(indices, NDArray) else _dense_array(
+            indices, ctx, "int64")
+        if shape is None:
+            raise ValueError("shape required")
+        return RowSparseNDArray(data, indices, shape)
+    # dense source
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    nz = _np.where(_np.abs(dense).reshape(dense.shape[0], -1).sum(-1) > 0)[0]
+    return RowSparseNDArray(
+        _dense_array(dense[nz], ctx, dtype or dense.dtype),
+        _dense_array(nz, ctx, "int64"), dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data if isinstance(data, NDArray) else _dense_array(data, ctx, dtype)
+        indices = indices if isinstance(indices, NDArray) else _dense_array(
+            indices, ctx, "int64")
+        indptr = indptr if isinstance(indptr, NDArray) else _dense_array(
+            indptr, ctx, "int64")
+        return CSRNDArray(data, indices, indptr, shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    m, n = dense.shape
+    indptr = [0]
+    cols = []
+    vals = []
+    for i in range(m):
+        nz = _np.where(dense[i] != 0)[0]
+        cols.extend(nz.tolist())
+        vals.extend(dense[i][nz].tolist())
+        indptr.append(len(cols))
+    return CSRNDArray(
+        _dense_array(_np.asarray(vals, dtype=dense.dtype), ctx, dtype or dense.dtype),
+        _dense_array(cols, ctx, "int64"), _dense_array(indptr, ctx, "int64"),
+        dense.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = np_dtype(dtype or "float32")
+    if stype == "row_sparse":
+        row_shape = tuple(shape[1:])
+        return RowSparseNDArray(
+            _dense_array(_np.zeros((0,) + row_shape, dtype), ctx),
+            _dense_array(_np.zeros((0,), _np.int64), ctx, "int64"), shape)
+    if stype == "csr":
+        return CSRNDArray(
+            _dense_array(_np.zeros((0,), dtype), ctx),
+            _dense_array(_np.zeros((0,), _np.int64), ctx, "int64"),
+            _dense_array(_np.zeros((shape[0] + 1,), _np.int64), ctx, "int64"),
+            shape)
+    if stype == "default":
+        from . import zeros as dzeros
+        return dzeros(shape, ctx=ctx, dtype=dtype)
+    raise ValueError(stype)
+
+
+def cast_storage(arr, stype):
+    """Reference: src/operator/tensor/cast_storage.cc."""
+    if stype == arr.stype:
+        return arr
+    if stype == "default":
+        return arr.todense()
+    if stype == "row_sparse":
+        dense = arr.asnumpy() if not isinstance(arr, NDArray) else arr.asnumpy()
+        return row_sparse_array(dense)
+    if stype == "csr":
+        return csr_matrix(arr.asnumpy())
+    raise ValueError(stype)
+
+
+def retain(rsp, indices):
+    """sparse_retain: keep only given rows (reference: sparse_retain.cc)."""
+    idx_keep = indices._data.astype(jnp.int64) if isinstance(indices, NDArray) \
+        else jnp.asarray(indices, jnp.int64)
+    cur = rsp.indices._data
+    mask = jnp.isin(cur, idx_keep)
+    keep_pos = _np.where(_np.asarray(mask))[0]
+    return RowSparseNDArray(
+        NDArray(rsp.data._data[keep_pos]),
+        NDArray(cur[keep_pos]), rsp.shape)
+
+
+def add_rsp(a, b):
+    idx = _np.union1d(_np.asarray(a.indices._data), _np.asarray(b.indices._data))
+    n = len(idx)
+    row_shape = a.data.shape[1:]
+    out = jnp.zeros((n,) + tuple(row_shape), a.data._data.dtype)
+    pos_a = _np.searchsorted(idx, _np.asarray(a.indices._data))
+    pos_b = _np.searchsorted(idx, _np.asarray(b.indices._data))
+    out = out.at[pos_a].add(a.data._data)
+    out = out.at[pos_b].add(b.data._data)
+    return RowSparseNDArray(NDArray(out), NDArray(jnp.asarray(idx, jnp.int64)),
+                            a.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot (reference: src/operator/tensor/dot.cc sparse paths)."""
+    if isinstance(lhs, CSRNDArray):
+        dense = lhs.todense()
+        from .ndarray import invoke
+        from ..ops import registry as _reg
+        return invoke(_reg.get("dot"), (dense, rhs),
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+    raise TypeError("sparse dot expects CSR lhs")

@@ -1,0 +1,11 @@
+"""Operator library (see registry.py).  Importing this package registers all ops."""
+from . import registry  # noqa: F401
+from . import elemwise  # noqa: F401
+from . import matrix  # noqa: F401
+from . import reduce  # noqa: F401
+from . import indexing  # noqa: F401
+from . import init  # noqa: F401
+from . import random  # noqa: F401
+from . import nn  # noqa: F401
+
+from .registry import register, get, list_ops  # noqa: F401

@@ -1,0 +1,188 @@
+"""Elementwise, scalar, and broadcast operator families.
+
+Reference: ``src/operator/tensor/elemwise_binary_op*.{cc,cu}``,
+``elemwise_unary_op*``, ``elemwise_binary_scalar_op*``,
+``elemwise_binary_broadcast_op*`` — hand-written mshadow/CUDA kernel
+instantiations per (op, dtype, device).  Here each is one jax.numpy call;
+XLA fuses chains of them into single VPU loops, which replaces the
+reference's manual kernel fusion ("bulking", threaded_engine.h:469).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_f = jnp.asarray
+
+
+def _binary(name, fn, aliases=()):
+    register(name, arg_names=["lhs", "rhs"], aliases=aliases)(fn)
+
+
+def _unary(name, fn, aliases=(), differentiable=True):
+    register(name, arg_names=["data"], aliases=aliases,
+             differentiable=differentiable)(fn)
+
+
+def _scalar_op(name, fn, aliases=()):
+    register(name, arg_names=["data"], scalar_args=("scalar",),
+             aliases=aliases)(fn)
+
+
+# -- elementwise binary (same-shape in the reference; we allow broadcasting
+#    as a superset, matching numpy semantics) -------------------------------
+_binary("elemwise_add", lambda l, r: l + r, aliases=("_plus", "_add"))
+_binary("elemwise_sub", lambda l, r: l - r, aliases=("_minus", "_sub"))
+_binary("elemwise_mul", lambda l, r: l * r, aliases=("_mul",))
+_binary("elemwise_div", lambda l, r: l / r, aliases=("_div",))
+_binary("_maximum", jnp.maximum)
+_binary("_minimum", jnp.minimum)
+_binary("_power", lambda l, r: jnp.power(l, r), aliases=("_Power",))
+_binary("_mod", jnp.mod)
+_binary("_hypot", jnp.hypot)
+_binary("_equal", lambda l, r: (l == r).astype(l.dtype))
+_binary("_not_equal", lambda l, r: (l != r).astype(l.dtype))
+_binary("_greater", lambda l, r: (l > r).astype(l.dtype))
+_binary("_greater_equal", lambda l, r: (l >= r).astype(l.dtype))
+_binary("_lesser", lambda l, r: (l < r).astype(l.dtype))
+_binary("_lesser_equal", lambda l, r: (l <= r).astype(l.dtype))
+_binary("_logical_and", lambda l, r: jnp.logical_and(l, r).astype(l.dtype))
+_binary("_logical_or", lambda l, r: jnp.logical_or(l, r).astype(l.dtype))
+_binary("_logical_xor", lambda l, r: jnp.logical_xor(l, r).astype(l.dtype))
+
+
+# -- broadcast binary -------------------------------------------------------
+for _name, _impl in [
+    ("broadcast_add", lambda l, r: l + r),
+    ("broadcast_sub", lambda l, r: l - r),
+    ("broadcast_mul", lambda l, r: l * r),
+    ("broadcast_div", lambda l, r: l / r),
+    ("broadcast_mod", jnp.mod),
+    ("broadcast_power", jnp.power),
+    ("broadcast_maximum", jnp.maximum),
+    ("broadcast_minimum", jnp.minimum),
+    ("broadcast_hypot", jnp.hypot),
+]:
+    _binary(_name, _impl)
+
+for _name, _impl in [
+    ("broadcast_equal", jnp.equal),
+    ("broadcast_not_equal", jnp.not_equal),
+    ("broadcast_greater", jnp.greater),
+    ("broadcast_greater_equal", jnp.greater_equal),
+    ("broadcast_lesser", jnp.less),
+    ("broadcast_lesser_equal", jnp.less_equal),
+    ("broadcast_logical_and", jnp.logical_and),
+    ("broadcast_logical_or", jnp.logical_or),
+    ("broadcast_logical_xor", jnp.logical_xor),
+]:
+    _binary(_name, (lambda f: lambda l, r: f(l, r).astype(l.dtype))(_impl))
+
+
+# -- scalar ops -------------------------------------------------------------
+_scalar_op("_plus_scalar", lambda d, scalar=0.0: d + scalar)
+_scalar_op("_minus_scalar", lambda d, scalar=0.0: d - scalar)
+_scalar_op("_rminus_scalar", lambda d, scalar=0.0: scalar - d)
+_scalar_op("_mul_scalar", lambda d, scalar=1.0: d * scalar)
+_scalar_op("_div_scalar", lambda d, scalar=1.0: d / scalar)
+_scalar_op("_rdiv_scalar", lambda d, scalar=1.0: scalar / d)
+_scalar_op("_power_scalar", lambda d, scalar=1.0: jnp.power(d, scalar))
+_scalar_op("_rpower_scalar", lambda d, scalar=1.0: jnp.power(scalar, d))
+_scalar_op("_mod_scalar", lambda d, scalar=1.0: jnp.mod(d, scalar))
+_scalar_op("_rmod_scalar", lambda d, scalar=1.0: jnp.mod(scalar, d))
+_scalar_op("_maximum_scalar", lambda d, scalar=0.0: jnp.maximum(d, scalar))
+_scalar_op("_minimum_scalar", lambda d, scalar=0.0: jnp.minimum(d, scalar))
+_scalar_op("_hypot_scalar", lambda d, scalar=0.0: jnp.hypot(d, _f(scalar).astype(d.dtype)))
+_scalar_op("_equal_scalar", lambda d, scalar=0.0: (d == scalar).astype(d.dtype))
+_scalar_op("_not_equal_scalar", lambda d, scalar=0.0: (d != scalar).astype(d.dtype))
+_scalar_op("_greater_scalar", lambda d, scalar=0.0: (d > scalar).astype(d.dtype))
+_scalar_op("_greater_equal_scalar", lambda d, scalar=0.0: (d >= scalar).astype(d.dtype))
+_scalar_op("_lesser_scalar", lambda d, scalar=0.0: (d < scalar).astype(d.dtype))
+_scalar_op("_lesser_equal_scalar", lambda d, scalar=0.0: (d <= scalar).astype(d.dtype))
+_scalar_op("_logical_and_scalar", lambda d, scalar=0.0: jnp.logical_and(d, scalar).astype(d.dtype))
+_scalar_op("_logical_or_scalar", lambda d, scalar=0.0: jnp.logical_or(d, scalar).astype(d.dtype))
+_scalar_op("_logical_xor_scalar", lambda d, scalar=0.0: jnp.logical_xor(d, scalar).astype(d.dtype))
+register("smooth_l1", scalar_args=("scalar",))(
+    lambda data, scalar=1.0: jnp.where(
+        jnp.abs(data) < 1.0 / (scalar * scalar),
+        0.5 * (scalar * data) ** 2,
+        jnp.abs(data) - 0.5 / (scalar * scalar),
+    )
+)
+
+
+# -- unary math -------------------------------------------------------------
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("rint", jnp.rint, differentiable=False)
+_unary("round", jnp.round, differentiable=False)
+_unary("ceil", jnp.ceil, differentiable=False)
+_unary("floor", jnp.floor, differentiable=False)
+_unary("trunc", jnp.trunc, differentiable=False)
+_unary("fix", jnp.trunc, differentiable=False)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("reciprocal", jnp.reciprocal)
+_unary("negative", jnp.negative, aliases=("_neg",))
+_unary("logical_not", lambda x: jnp.logical_not(x).astype(x.dtype))
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("identity", lambda x: x, aliases=("_copy",))
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data):
+    """Stops gradient flow (reference: src/operator/tensor/elemwise_unary_op_basic.cc BlockGrad)."""
+    return lax.stop_gradient(data)
+
+
+@register("Cast", aliases=("cast",), scalar_args=("dtype",))
+def cast(data, dtype="float32"):
+    import numpy as np
+    from ..base import np_dtype
+    return data.astype(np_dtype(dtype))
+
+
+@register("clip", scalar_args=("a_min", "a_max"))
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("add_n", arg_names=["args"], aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    """Sum of N arrays (reference: src/ndarray/ndarray.cc:1243 ElementwiseSum)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

@@ -1,0 +1,124 @@
+"""Indexing ops: Embedding/take/gather/scatter/one_hot/pick/where.
+
+Reference: ``src/operator/tensor/indexing_op.{h,cc,cu}``.  These are
+gather/scatter lowered to XLA; the Embedding op's backward (scatter-add of
+output grads into the weight) is what the reference implements with
+AddTakeGrad CUDA kernels — jax.vjp of jnp.take generates the same
+scatter-add for us.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("Embedding", arg_names=["data", "weight"])
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("take", arg_names=["a", "indices"])
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", arg_names=["a", "indices"])
+def batch_take(a, indices):
+    return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("pick", arg_names=["data", "index"])
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    idxe = jnp.expand_dims(idx, axis if axis >= 0 else data.ndim + axis)
+    out = jnp.take_along_axis(data, idxe, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot", differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import np_dtype
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth))
+    out = oh * (on_value - off_value) + off_value
+    return out.astype(np_dtype(dtype))
+
+
+@register("gather_nd", arg_names=["data", "indices"])
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd", arg_names=["data", "indices"])
+def scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd", arg_names=["lhs", "rhs", "indices"])
+def scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register("where", arg_names=["condition", "x", "y"])
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("SequenceMask", arg_names=["data", "sequence_length"])
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    """Reference: src/operator/sequence_mask.cc — data is (seq, batch, ...) for axis=0."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    seq_len = data.shape[axis]
+    pos = jnp.arange(seq_len)
+    mask = pos[:, None] < sequence_length[None, :].astype(jnp.int32)  # (seq, batch)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", arg_names=["data", "sequence_length"])
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, -1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)  # (batch,)
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+        )[0]
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+    )[:, 0]
+
+
+@register("SequenceReverse", arg_names=["data", "sequence_length"])
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    seq_len = data.shape[0]
+    pos = jnp.arange(seq_len)[:, None]
+    sl = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(pos < sl, sl - 1 - pos, pos)  # (seq, batch)
+    return jnp.take_along_axis(
+        data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=0
+    )
+
+
+@register("sparse_retain", arg_names=["data", "indices"])
+def sparse_retain_dense(data, indices):
+    mask = jnp.zeros((data.shape[0],), dtype=bool).at[indices.astype(jnp.int32)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)

@@ -1,0 +1,42 @@
+"""Creation ops (reference: ``src/operator/tensor/init_op.cc``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register
+
+
+@register("_zeros", arg_names=[], differentiable=False)
+def zeros(shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(shape, dtype=np_dtype(dtype or "float32"))
+
+
+@register("_ones", arg_names=[], differentiable=False)
+def ones(shape=(), dtype="float32", ctx=None):
+    return jnp.ones(shape, dtype=np_dtype(dtype or "float32"))
+
+
+@register("_full", arg_names=[], differentiable=False)
+def full(shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(shape, value, dtype=np_dtype(dtype or "float32"))
+
+
+@register("_arange", arg_names=[], differentiable=False)
+def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None,
+           infer_range=False):
+    out = jnp.arange(start, stop, step, dtype=np_dtype(dtype or "float32"))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", arg_names=[], differentiable=False)
+def linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", ctx=None):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=np_dtype(dtype or "float32"))
+
+
+@register("_eye", arg_names=[], differentiable=False)
+def eye(N=0, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(int(N), int(M) or None, int(k), dtype=np_dtype(dtype or "float32"))

@@ -1,0 +1,263 @@
+"""Shape-manipulation and linear-algebra-entry ops.
+
+Reference: ``src/operator/tensor/matrix_op.cc`` (Reshape/transpose/slice/
+concat/stack/tile/repeat/pad/...), ``src/operator/tensor/dot.cc``.
+All become jnp/lax calls; XLA's layout assignment replaces the reference's
+hand-tuned transpose kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("Reshape", aliases=("reshape",))
+def reshape(data, shape=None, reverse=False):
+    """MXNet reshape with special codes 0 (keep), -1 (infer), -2 (copy rest),
+    -3 (merge two), -4 (split) — reference matrix_op.cc ReshapeShape."""
+    if shape is None:
+        return data
+    src = list(data.shape)
+    if reverse:
+        src = src[::-1]
+        shape = list(shape)[::-1]
+    out = []
+    i = 0
+    shape = list(shape)
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            elif b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(data, tuple(out))
+
+
+@register("reshape_like", arg_names=["lhs", "rhs"])
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("flip", aliases=("reverse",))
+def flip(data, axis=None):
+    return jnp.flip(data, axis)
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis)
+
+
+@register("Pad", aliases=("pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise ValueError("unknown pad mode %r" % mode)
+
+
+@register("Concat", arg_names=["args"], aliases=("concat",))
+def concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack", arg_names=["args"])
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_num_outputs(params):
+    n = params.get("num_outputs")
+    if n is None:
+        raise ValueError("split requires num_outputs")
+    return int(n) if not params.get("squeeze_axis") or True else int(n)
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=_split_num_outputs)
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, int(num_outputs), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    if int(num_outputs) == 1:
+        return parts[0]
+    return tuple(parts)
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    ndim = data.ndim
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step) + [None] * (ndim - len(step)) if step else [None] * ndim
+    idx = tuple(
+        slice(b, e, s if s != 0 else None)
+        for b, e, s in zip(begin, end, step)
+    )
+    return data[idx]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like", arg_names=["data", "shape_like"])
+def slice_like(data, shape_like, axes=()):
+    axes = axes or tuple(range(data.ndim))
+    idx = [slice(None)] * data.ndim
+    for ax in axes:
+        idx[ax] = slice(0, shape_like.shape[ax])
+    return data[tuple(idx)]
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like", arg_names=["lhs", "rhs"])
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("dot", arg_names=["lhs", "rhs"])
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Matrix/tensor product (reference: src/operator/tensor/dot.cc).
+
+    MXNet dot on >2d contracts last axis of lhs with first of rhs.
+    Lowered straight to the MXU via lax.dot_general / jnp.tensordot.
+    """
+    if transpose_a:
+        lhs = jnp.transpose(lhs)
+    if transpose_b:
+        rhs = jnp.transpose(rhs)
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=1)
+
+
+@register("batch_dot", arg_names=["lhs", "rhs"])
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=1):
+    n, c, h, w = data.shape
+    b = block_size
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("shape_array", differentiable=False)
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int32)
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)

@@ -1,0 +1,661 @@
+"""Neural-network operators lowered onto XLA's conv/reduce-window/dot HLOs.
+
+Reference: ``src/operator/nn/`` — Convolution (convolution-inl.h + cudnn
+wrappers), FullyConnected, Pooling (pool.cuh), BatchNorm, LayerNorm, Dropout,
+activation/softmax families, plus spatial ops from ``src/operator/``.
+Where the reference dispatches to cuDNN with an algo-autotune registry
+(cudnn_algoreg-inl.h), we emit a single lax.conv_general_dilated and let XLA
+pick MXU tilings — convs and FC land on the MXU in bf16/fp32 per input dtype.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import _rng
+from .registry import register
+
+
+def _tup(v, n):
+    if v is None or v == ():
+        return (1,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+@register("FullyConnected", arg_names=["data", "weight", "bias"])
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    """Reference: src/operator/nn/fully_connected.cc.  weight is
+    (num_hidden, input_dim) as in the reference; lowers to one MXU matmul."""
+    if flatten and data.ndim > 2:
+        data = jnp.reshape(data, (data.shape[0], -1))
+    out = lax.dot_general(
+        data, weight,
+        dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+_CONV_DIMNUM = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+                3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register("Convolution", arg_names=["data", "weight", "bias"])
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, workspace=1024,
+                no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
+    """Reference: src/operator/nn/convolution.cc; weight layout
+    (num_filter, C/group, *kernel) identical to the reference."""
+    nsp = len(kernel) if kernel else data.ndim - 2
+    stride = _tup(stride, nsp)
+    dilate = _tup(dilate, nsp)
+    pad = _tup(pad, nsp) if pad else (0,) * nsp
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMNUM[nsp])
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + jnp.reshape(bias, (1, -1) + (1,) * nsp)
+    return out
+
+
+@register("Deconvolution", arg_names=["data", "weight", "bias"])
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
+                  workspace=512, no_bias=True, cudnn_tune=None, cudnn_off=False,
+                  layout=None):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
+    Weight layout (C_in, C_out/group, *kernel); implemented as an
+    input-dilated forward conv, which XLA lowers to the same MXU program it
+    uses for conv backward-data."""
+    nsp = len(kernel)
+    stride = _tup(stride, nsp)
+    dilate = _tup(dilate, nsp)
+    pad = _tup(pad, nsp) if pad else (0,) * nsp
+    adj = _tup(adj, nsp) if adj else (0,) * nsp
+    g = int(num_group)
+    cin = weight.shape[0]
+    cog = weight.shape[1]
+    # (C_in, C_out/g, *k) -> (C_out, C_in/g, *k), spatially flipped
+    w = jnp.reshape(weight, (g, cin // g, cog) + weight.shape[2:])
+    w = jnp.swapaxes(w, 1, 2)
+    w = jnp.reshape(w, (g * cog, cin // g) + weight.shape[2:])
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nsp)))
+    eff_k = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    padding = [(ek - 1 - p, ek - 1 - p + a) for ek, p, a in zip(eff_k, pad, adj)]
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, _CONV_DIMNUM[nsp])
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nsp, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=g,
+    ).astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + jnp.reshape(bias, (1, -1) + (1,) * nsp)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+@register("Pooling", arg_names=["data"])
+def pooling(data, kernel=(), pool_type="max", global_pool=False, cudnn_off=False,
+            pooling_convention="valid", stride=(), pad=(), count_include_pad=True):
+    """Reference: src/operator/nn/pooling.cc (+ pool.cuh kernels).
+    max/avg/sum over reduce_window; 'full' convention (ceil) adds high-side
+    padding exactly as the reference's pooling shape rule."""
+    nsp = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nsp
+        pad = (0,) * nsp
+    kernel = _tup(kernel, nsp)
+    stride = _tup(stride, nsp) if stride else (1,) * nsp
+    pad = _tup(pad, nsp) if pad else (0,) * nsp
+    extra = [0] * nsp
+    if pooling_convention == "full" and not global_pool:
+        for i in range(nsp):
+            insz = data.shape[2 + i]
+            out_sz = int(np.ceil((insz + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - (insz + 2 * pad[i])
+            extra[i] = max(0, need)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)] + [(p, p + e) for p, e in zip(pad, extra)]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                 window, strides, padding)
+    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                               window, strides, padding)
+    if pool_type == "sum":
+        return summed
+    if pool_type == "avg":
+        if count_include_pad:
+            denom = float(np.prod(kernel))
+            return summed / jnp.asarray(denom, data.dtype)
+        ones = jnp.ones(data.shape, data.dtype)
+        counts = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+                                   window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        p = 2.0
+        pw = lax.reduce_window(jnp.abs(data) ** p, jnp.asarray(0, data.dtype),
+                               lax.add, window, strides, padding)
+        return pw ** (1.0 / p)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+def _bn_moving_update(inputs, outputs, params):
+    momentum = params.get("momentum", 0.9)
+    _, _, _, mmean, mvar = inputs[:5]
+    _, bmean, bvar = outputs[:3]
+    return {
+        3: momentum * mmean + (1 - momentum) * bmean,
+        4: momentum * mvar + (1 - momentum) * bvar,
+    }
+
+
+@register("BatchNorm", arg_names=["data", "gamma", "beta"],
+          aux={3: "moving_mean", 4: "moving_var"}, aux_update=_bn_moving_update,
+          num_outputs=lambda p: 3 if p.get("output_mean_var") else 1,
+          needs_train=True)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, _train=False):
+    """Reference: src/operator/nn/batch_norm.cc.  Under training uses batch
+    stats (moving stats updated via aux_update); under inference uses the
+    moving stats.  fix_gamma pins gamma to 1 as the reference does."""
+    axis = axis % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    if _train and not use_global_stats:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+    else:
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    inv = lax.rsqrt(var + eps)
+    out = (data.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    out = out * g.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    out = out.astype(data.dtype)
+    if output_mean_var:
+        return out, mean.astype(data.dtype), var.astype(data.dtype)
+    return out, mean.astype(data.dtype), var.astype(data.dtype)
+
+
+@register("LayerNorm", arg_names=["data", "gamma", "beta"])
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Reference: src/operator/nn/layer_norm.cc."""
+    axis = axis % data.ndim
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = out * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register("InstanceNorm", arg_names=["data", "gamma", "beta"])
+def instance_norm(data, gamma, beta, eps=1e-3):
+    """Reference: src/operator/instance_norm.cc — normalize over spatial dims
+    per (N, C)."""
+    red = tuple(range(2, data.ndim))
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=red, keepdims=True)
+    var = jnp.var(x32, axis=red, keepdims=True)
+    out = (x32 - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    out = out * gamma.reshape(shape) + beta.reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    """Reference: src/operator/l2_normalization.cc."""
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        kd = True
+    elif mode == "channel":
+        red = (1,)
+        kd = True
+    elif mode == "spatial":
+        red = tuple(range(2, data.ndim))
+        kd = True
+    else:
+        raise ValueError(mode)
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=kd) + eps)
+    return data / nrm
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (reference: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (data.ndim - 2)
+    sqp = jnp.pad(sq, pad)
+    acc = sum(
+        lax.slice_in_dim(sqp, i, i + data.shape[1], axis=1) for i in range(nsize)
+    )
+    return data / jnp.power(knorm + alpha / nsize * acc, beta)
+
+
+# ---------------------------------------------------------------------------
+# Activations / softmax
+# ---------------------------------------------------------------------------
+@register("Activation")
+def activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", arg_names=["data", "gamma"], needs_train=True)
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334, _train=False):
+    """Reference: src/operator/leaky_relu.cc (leaky/prelu/elu/selu/rrelu)."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and g.ndim == 1:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data > 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data > 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data)
+    if act_type == "rrelu":
+        if _train:
+            u = jax.random.uniform(_rng.next_key(), data.shape, data.dtype,
+                                   lower_bound, upper_bound)
+            return jnp.where(data > 0, data, u * data)
+        s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, s * data)
+    raise ValueError(act_type)
+
+
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data / temperature if temperature else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+# -- output heads with custom backward semantics ---------------------------
+@jax.custom_vjp
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         multi_output, normalization_valid, smooth_alpha):
+    axis = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization_valid, smooth_alpha):
+    out = _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                               multi_output, normalization_valid, smooth_alpha)
+    return out, (out, label, grad_scale, ignore_label, use_ignore, multi_output,
+                 normalization_valid, smooth_alpha)
+
+
+def _softmax_output_bwd(res, g):
+    (out, label, grad_scale, ignore_label, use_ignore, multi_output,
+     normalization_valid, smooth_alpha) = res
+    axis = 1 if multi_output else -1
+    nclass = out.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, nclass, axis=axis, dtype=out.dtype)
+    if smooth_alpha:
+        onehot = onehot * (1 - smooth_alpha) + smooth_alpha / (nclass - 1) * (1 - onehot)
+    grad = out - onehot
+    scale = grad_scale
+    if use_ignore:
+        keep = (lab != int(ignore_label)).astype(out.dtype)
+        grad = grad * jnp.expand_dims(keep, axis)
+        if normalization_valid:
+            scale = scale * lab.size / jnp.maximum(jnp.sum(keep), 1.0)
+    elif normalization_valid:
+        scale = scale / lab.size * out.shape[0]  # 'valid' == batch when no ignore
+    grad = grad * scale
+    if out.ndim > 2 and not multi_output:
+        pass
+    return (grad, jnp.zeros_like(label), None, None, None, None, None, None)
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", arg_names=["data", "label"], aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Softmax forward whose *backward* is (p - onehot(label)) — the
+    reference's fused classification head (src/operator/softmax_output.cc)."""
+    return _softmax_output_core(
+        data, label, grad_scale, ignore_label, use_ignore, multi_output,
+        normalization == "valid", smooth_alpha)
+
+
+def _regression_output(transform, grad_fn):
+    @jax.custom_vjp
+    def core(data, label, grad_scale):
+        return transform(data)
+
+    def fwd(data, label, grad_scale):
+        return core(data, label, grad_scale), (transform(data), label, grad_scale)
+
+    def bwd(res, g):
+        out, label, grad_scale = res
+        num_out = out.size // out.shape[0]
+        grad = grad_fn(out, label.reshape(out.shape)) * grad_scale / num_out
+        return grad, jnp.zeros_like(label), None
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_linear_reg = _regression_output(lambda x: x, lambda o, l: o - l)
+_mae_reg = _regression_output(lambda x: x, lambda o, l: jnp.sign(o - l))
+_logistic_reg = _regression_output(jax.nn.sigmoid, lambda o, l: o - l)
+
+
+@register("LinearRegressionOutput", arg_names=["data", "label"])
+def linear_regression_output(data, label, grad_scale=1.0):
+    return _linear_reg(data, label, grad_scale)
+
+
+@register("MAERegressionOutput", arg_names=["data", "label"])
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _mae_reg(data, label, grad_scale)
+
+
+@register("LogisticRegressionOutput", arg_names=["data", "label"])
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _logistic_reg(data, label, grad_scale)
+
+
+@jax.custom_vjp
+def _make_loss_core(data, grad_scale):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale):
+    return data, (data.shape, data.dtype, grad_scale)
+
+
+def _make_loss_bwd(res, g):
+    shape, dtype, grad_scale = res
+    return jnp.full(shape, grad_scale, dtype), None
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Forward identity; backward is grad_scale regardless of head grad
+    (reference: src/operator/make_loss.cc)."""
+    scale = grad_scale
+    if normalization == "batch":
+        scale = grad_scale / data.shape[0]
+    return _make_loss_core(data, scale)
+
+
+@register("softmax_cross_entropy", arg_names=["data", "label"])
+def softmax_cross_entropy(data, label):
+    lp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(lp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked)
+
+
+@register("Dropout", needs_train=True)
+def dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, _train=False):
+    """Reference: src/operator/nn/dropout.cc — inverted dropout."""
+    if (not _train and mode != "always") or p == 0:
+        return data
+    shape = list(data.shape)
+    if axes:
+        for i in range(len(shape)):
+            if i not in axes:
+                shape[i] = 1 if False else shape[i]
+        shape = [1 if i in axes else s for i, s in enumerate(data.shape)]
+    mask = jax.random.bernoulli(_rng.next_key(), 1.0 - p, tuple(shape))
+    return jnp.where(mask, data / (1.0 - p), jnp.zeros_like(data))
+
+
+# ---------------------------------------------------------------------------
+# Spatial ops
+# ---------------------------------------------------------------------------
+@register("UpSampling", arg_names=["args"])
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+               multi_input_mode="concat", workspace=512):
+    """Reference: src/operator/upsampling.cc."""
+    outs = []
+    for data in args:
+        if sample_type == "nearest":
+            out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        else:
+            n, c, h, w = data.shape
+            out = jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        return sum(outs)
+    return jnp.concatenate(outs, axis=1)
+
+
+@register("Crop", arg_names=["args"], aliases=())
+def crop_sym(*args, num_args=1, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    """Reference: src/operator/crop.cc."""
+    data = args[0]
+    if num_args == 2 or len(args) == 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = h_w
+    if center_crop:
+        oh = (data.shape[2] - th) // 2
+        ow = (data.shape[3] - tw) // 2
+    else:
+        oh, ow = offset
+    return data[:, :, oh:oh + th, ow:ow + tw]
+
+
+@register("GridGenerator", arg_names=["data"])
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Reference: src/operator/grid_generator.cc — outputs (N, 2, H, W) grid
+    in [-1, 1] coords (x, y)."""
+    if transform_type == "affine":
+        n = data.shape[0]
+        h, w = target_shape
+        theta = data.reshape(n, 2, 3)
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)  # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, HW)
+        return out.reshape(n, 2, h, w)
+    if transform_type == "warp":
+        flow = data  # (N, 2, H, W) pixel offsets
+        n, _, h, w = flow.shape
+        gy, gx = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+        x = (gx[None] + flow[:, 0]) * 2.0 / jnp.maximum(w - 1, 1) - 1.0
+        y = (gy[None] + flow[:, 1]) * 2.0 / jnp.maximum(h - 1, 1) - 1.0
+        return jnp.stack([x, y], axis=1)
+    raise ValueError(transform_type)
+
+
+def _bilinear_gather(data, x, y):
+    """Sample data (N,C,H,W) at float pixel coords x,y (N,Ho,Wo)."""
+    n, c, h, w = data.shape
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+    out = 0
+    for dy, dx in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        xi = x0 + dx
+        yi = y0 + dy
+        wgt = (wx if dx else 1 - wx) * (wy if dy else 1 - wy)
+        valid = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        vals = data[jnp.arange(n)[:, None, None], :, yi_c, xi_c]  # (N,Ho,Wo,C)
+        out = out + vals * (wgt * valid)[..., None]
+    return jnp.moveaxis(out, -1, 1)
+
+
+@register("BilinearSampler", arg_names=["data", "grid"])
+def bilinear_sampler(data, grid, cudnn_off=False):
+    """Reference: src/operator/bilinear_sampler.cc — grid (N,2,Ho,Wo) in [-1,1]."""
+    n, c, h, w = data.shape
+    x = (grid[:, 0] + 1) * (w - 1) / 2.0
+    y = (grid[:, 1] + 1) * (h - 1) / 2.0
+    return _bilinear_gather(data, x, y)
+
+
+@register("SpatialTransformer", arg_names=["data", "loc"])
+def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("ROIPooling", arg_names=["data", "rois"])
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """Reference: src/operator/roi_pooling.cc.  rois (R,5) = (batch, x1,y1,x2,y2).
+    Max-pools each quantized bin; bins sampled on a dense sub-grid (4x4 per
+    bin) — TPU-friendly gather formulation instead of the reference's per-bin
+    scalar loops."""
+    ph, pw = pooled_size
+    nsamp = 4
+    bidx = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1] * spatial_scale)
+    y1 = jnp.round(rois[:, 2] * spatial_scale)
+    x2 = jnp.round(rois[:, 3] * spatial_scale)
+    y2 = jnp.round(rois[:, 4] * spatial_scale)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+    def one_roi(b, xx1, yy1, wdt, hgt):
+        iy = yy1 + (jnp.arange(ph * nsamp) + 0.5) * hgt / (ph * nsamp)
+        ix = xx1 + (jnp.arange(pw * nsamp) + 0.5) * wdt / (pw * nsamp)
+        yi = jnp.clip(jnp.floor(iy), 0, data.shape[2] - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.floor(ix), 0, data.shape[3] - 1).astype(jnp.int32)
+        patch = data[b][:, yi][:, :, xi]  # (C, ph*ns, pw*ns)
+        c = patch.shape[0]
+        patch = patch.reshape(c, ph, nsamp, pw, nsamp)
+        return jnp.max(patch, axis=(2, 4))
+
+    return jax.vmap(one_roi)(bidx, x1, y1, rw, rh)
+
+
+@register("SVMOutput", arg_names=["data", "label"])
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Reference: src/operator/svm_output.cc — forward is identity over scores."""
+    return _svm_core(data, label, margin, regularization_coefficient, use_linear)
+
+
+@jax.custom_vjp
+def _svm_core(data, label, margin, reg, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label, margin, reg, use_linear)
+
+
+def _svm_bwd(res, g):
+    data, label, margin, reg, use_linear = res
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, data.shape[-1], dtype=data.dtype)
+    score_y = jnp.take_along_axis(data, lab[:, None], axis=-1)
+    viol = (data - score_y + margin > 0).astype(data.dtype) * (1 - onehot)
+    if use_linear:
+        grad = viol - onehot * jnp.sum(viol, axis=-1, keepdims=True)
+    else:
+        m = data - score_y + margin
+        grad = 2 * jnp.maximum(m, 0) * (1 - onehot)
+        grad = grad - onehot * jnp.sum(grad, axis=-1, keepdims=True)
+    return grad * reg, jnp.zeros_like(label), None, None, None
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("Correlation", arg_names=["data1", "data2"], num_outputs=2)
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Reference: src/operator/correlation.cc (FlowNet correlation layer)."""
+    n, c, h, w = data1.shape
+    d = int(max_displacement)
+    p = int(pad_size)
+    a = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    b = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    outs = []
+    rng = range(-d, d + 1, int(stride2))
+    for dy in rng:
+        for dx in rng:
+            shifted = jnp.roll(b, (-dy, -dx), axis=(2, 3))
+            if is_multiply:
+                corr = jnp.mean(a * shifted, axis=1)
+            else:
+                corr = jnp.mean(jnp.abs(a - shifted), axis=1)
+            outs.append(corr)
+    out = jnp.stack(outs, axis=1)[:, :, p:p + h, p:p + w]
+    return out, jnp.zeros_like(data1)

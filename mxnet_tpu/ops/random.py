@@ -1,0 +1,128 @@
+"""Sampling ops over the global/traced PRNG (see mxnet_tpu/_rng.py).
+
+Reference: ``src/operator/random/sample_op.cc`` (uniform/normal/gamma/
+exponential/poisson/negative binomial/multinomial), ``shuffle_op.cc``;
+per-device RNG via ResourceRequest::kRandom/kParallelRandom
+(include/mxnet/resource.h:42-46).  jax's counter-based PRNG replaces the
+reference's per-GPU curand states and is reproducible across replicas by
+construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .. import _rng
+from .registry import register
+
+
+def _dt(dtype):
+    return np_dtype(dtype or "float32")
+
+
+@register("_random_uniform", arg_names=[], differentiable=False,
+          aliases=("uniform", "random_uniform"))
+def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.uniform(_rng.next_key(), tuple(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", arg_names=[], differentiable=False,
+          aliases=("normal", "random_normal"))
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.normal(_rng.next_key(), tuple(shape), _dt(dtype)) * scale + loc
+
+
+@register("_random_gamma", arg_names=[], differentiable=False, aliases=("random_gamma",))
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.gamma(_rng.next_key(), alpha, tuple(shape), _dt(dtype)) * beta
+
+
+@register("_random_exponential", arg_names=[], differentiable=False,
+          aliases=("random_exponential",))
+def random_exponential(lam=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.exponential(_rng.next_key(), tuple(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", arg_names=[], differentiable=False,
+          aliases=("random_poisson",))
+def random_poisson(lam=1.0, shape=(), dtype="float32", ctx=None):
+    return jax.random.poisson(_rng.next_key(), lam, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_negative_binomial", arg_names=[], differentiable=False,
+          aliases=("random_negative_binomial",))
+def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None):
+    g = jax.random.gamma(_rng.next_key(), float(k), tuple(shape)) * ((1 - p) / p)
+    return jax.random.poisson(_rng.next_key(), g, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial", arg_names=[], differentiable=False,
+          aliases=("random_generalized_negative_binomial",))
+def random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None):
+    if alpha == 0:
+        return jax.random.poisson(_rng.next_key(), mu, tuple(shape)).astype(_dt(dtype))
+    r = 1.0 / alpha
+    g = jax.random.gamma(_rng.next_key(), r, tuple(shape)) * (mu * alpha)
+    return jax.random.poisson(_rng.next_key(), g, tuple(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", arg_names=[], differentiable=False, aliases=("random_randint",))
+def random_randint(low=0, high=1, shape=(), dtype="int32", ctx=None):
+    return jax.random.randint(_rng.next_key(), tuple(shape), int(low), int(high),
+                              _dt(dtype or "int32"))
+
+
+@register("_sample_multinomial", differentiable=False,
+          aliases=("sample_multinomial",),
+          num_outputs=lambda p: 2 if p.get("get_prob") else 1)
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    n = 1
+    if shape:
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        n = 1
+        for s in shape:
+            n *= s
+    else:
+        shape = ()
+    logits = jnp.log(jnp.clip(data, 1e-30, None))
+    samp = jax.random.categorical(_rng.next_key(), logits, axis=-1,
+                                  shape=(n,) + logits.shape[:-1])
+    samp = jnp.moveaxis(samp, 0, -1)
+    out_shape = logits.shape[:-1] + shape
+    samp = samp.reshape(out_shape) if shape else samp.reshape(logits.shape[:-1])
+    samp = samp.astype(_dt(dtype or "int32"))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.clip(data, 1e-30, None)),
+            samp.reshape(logits.shape[:-1] + (-1,)).astype(jnp.int32), axis=-1
+        ).reshape(samp.shape)
+        return samp, lp
+    return samp
+
+
+def _elem_sample(name, draw):
+    @register(name, arg_names=["low", "high"], differentiable=False)
+    def fn(a, b, shape=(), dtype=None, __draw=draw):
+        s = tuple(shape) if shape else ()
+        return __draw(a, b, a.shape + s)
+    return fn
+
+
+_elem_sample("_sample_uniform",
+             lambda lo, hi, s: jax.random.uniform(_rng.next_key(), s) *
+             (_bshape(hi, s) - _bshape(lo, s)) + _bshape(lo, s))
+_elem_sample("_sample_normal",
+             lambda mu, sig, s: jax.random.normal(_rng.next_key(), s) *
+             _bshape(sig, s) + _bshape(mu, s))
+_elem_sample("_sample_gamma",
+             lambda a, b, s: jax.random.gamma(_rng.next_key(), _bshape(a, s)) * _bshape(b, s))
+
+
+def _bshape(x, shape):
+    return jnp.broadcast_to(jnp.reshape(x, x.shape + (1,) * (len(shape) - x.ndim)), shape)
+
+
+@register("_shuffle", differentiable=False, aliases=("shuffle",))
+def shuffle(data):
+    return jax.random.permutation(_rng.next_key(), data, axis=0)

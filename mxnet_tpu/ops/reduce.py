@@ -1,0 +1,137 @@
+"""Reduction and ordering ops.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op*`` (sum/mean/prod/norm
+with keepdims/exclude), ``src/operator/tensor/ordering_op*`` (topk/sort/
+argsort).  jnp reductions lower to XLA reduce; safe accumulation (the
+reference's MXNET_SAFE_ACCUMULATION) maps to accumulating low-precision
+inputs in float32.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _axes(data, axis, exclude=False):
+    if axis is None or axis == ():
+        ax = tuple(range(data.ndim))
+    elif isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(axis)
+    ax = tuple(a % data.ndim for a in ax)
+    if exclude:
+        ax = tuple(i for i in range(data.ndim) if i not in ax)
+    return ax
+
+
+def _reduce(name, jfn):
+    @register(name)
+    def fn(data, axis=None, keepdims=False, exclude=False, __jfn=jfn):
+        return __jfn(data, axis=_axes(data, axis, exclude), keepdims=keepdims)
+    fn.__name__ = name
+    return fn
+
+
+_reduce("sum", jnp.sum)
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max)
+_reduce("min", jnp.min)
+
+from .registry import alias
+alias("sum", "sum_axis")
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    ax = None if axis is None or axis == () else axis
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    acc = data.astype(jnp.float32) if data.dtype in (jnp.float16, jnp.bfloat16) else data
+    out = jnp.sqrt(jnp.sum(jnp.square(acc), axis=ax, keepdims=keepdims))
+    return out.astype(data.dtype)
+
+
+@register("argmax", differentiable=False)
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis).astype(jnp.float32)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+@register("argmin", differentiable=False)
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis).astype(jnp.float32)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+@register("argmax_channel", differentiable=False)
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("topk", differentiable=False,
+          num_outputs=lambda p: 2 if p.get("ret_typ", "indices") == "both" else 1)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference: src/operator/tensor/ordering_op.cc TopK."""
+    from ..base import np_dtype
+    x = jnp.moveaxis(data, axis, -1)
+    if is_ascend:
+        vals, idx = jax._topk_neg(x, k) if False else _topk_ascend(x, k)
+    else:
+        import jax.lax as lax
+        vals, idx = lax.top_k(x, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(np_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        x2 = jnp.moveaxis(jnp.zeros_like(data), axis, -1)
+        ii = jnp.moveaxis(idx, axis, -1).astype(jnp.int32)
+        mask = jnp.take_along_axis(x2, ii, axis=-1) * 0 + 1  # placeholder
+        out = jnp.zeros_like(x2).at[..., :].set(0)
+        out = jnp.put_along_axis(out, ii, 1.0, axis=-1, inplace=False) if hasattr(jnp, "put_along_axis") else _scatter_mask(out, ii)
+        return jnp.moveaxis(out, -1, axis)
+    raise ValueError(ret_typ)
+
+
+def _topk_ascend(x, k):
+    import jax.lax as lax
+    vals, idx = lax.top_k(-x, k)
+    return -vals, idx
+
+
+def _scatter_mask(zeros, idx):
+    oh = jnp.sum(jax.nn.one_hot(idx, zeros.shape[-1], dtype=zeros.dtype), axis=-2)
+    return jnp.clip(oh, 0, 1)
+
+
+import jax  # noqa: E402  (used by topk mask path)
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort", differentiable=False)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import np_dtype
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(np_dtype(dtype))

@@ -1,0 +1,113 @@
+"""Operator registry — the TPU-native analogue of the nnvm op registry.
+
+Reference: ops are registered via NNVM_REGISTER_OP with attributes
+(FCompute, FGradient, FInferShape/Type/StorageType, FMutateInputs —
+``include/mxnet/op_attr_types.h``).  Here an op is a *pure jax function*
+``fn(*arrays, **params) -> array | tuple``; gradients come from ``jax.vjp``
+(replacing hand-written FGradient), shape/dtype inference from
+``jax.eval_shape`` (replacing the fixpoint passes in
+``src/executor/infer_graph_attr_pass.cc``), and XLA replaces FCompute
+scheduling.  Metadata kept per-op:
+
+- ``arg_names``: ordered tensor-input names (for Symbol binding / list_arguments)
+- ``aux``: mapping input-index -> aux-state name (BatchNorm moving stats);
+  aux inputs are excluded from gradients and mutated in place under training
+  (reference: FMutateInputs, op_attr_types.h)
+- ``aux_update``: fn(inputs, outputs, params) -> {input_idx: new_value}
+- ``num_outputs``: int or callable(params)->int
+- ``differentiable``: False for integer/ordering ops
+"""
+from __future__ import annotations
+
+import ast
+
+__all__ = ["Op", "register", "get", "list_ops", "alias"]
+
+_OPS: dict[str, "Op"] = {}
+
+
+class Op:
+    __slots__ = (
+        "name", "fn", "arg_names", "aux", "aux_update", "num_outputs",
+        "differentiable", "scalar_args", "doc", "needs_train",
+    )
+
+    def __init__(self, name, fn, arg_names=None, aux=None, aux_update=None,
+                 num_outputs=1, differentiable=True, scalar_args=(),
+                 needs_train=False):
+        self.name = name
+        self.fn = fn
+        self.arg_names = list(arg_names) if arg_names else ["data"]
+        self.aux = dict(aux) if aux else {}
+        self.aux_update = aux_update
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.scalar_args = tuple(scalar_args)
+        self.needs_train = needs_train
+        self.doc = fn.__doc__ or ""
+
+    def n_outputs(self, params):
+        if callable(self.num_outputs):
+            return self.num_outputs(params)
+        return self.num_outputs
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+def register(name, *, arg_names=None, aux=None, aux_update=None, num_outputs=1,
+             differentiable=True, scalar_args=(), aliases=(), needs_train=False):
+    """Decorator registering a pure jax function as an operator."""
+
+    def deco(fn):
+        op = Op(name, fn, arg_names, aux, aux_update, num_outputs,
+                differentiable, scalar_args, needs_train)
+        _OPS[name] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fn
+
+    return deco
+
+
+def alias(name, *extra):
+    op = _OPS[name]
+    for a in extra:
+        _OPS[a] = op
+
+
+def get(name) -> Op:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise KeyError("operator %r is not registered (have %d ops)" % (name, len(_OPS)))
+
+
+def exists(name) -> bool:
+    return name in _OPS
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+# ---------------------------------------------------------------------------
+# kwarg canonicalization.  The reference crosses the C ABI with string kwargs
+# ("(2, 2)", "True"); accept those transparently for script parity.
+# ---------------------------------------------------------------------------
+_BOOL = {"true": True, "false": False, "True": True, "False": False}
+
+
+def canonicalize(value):
+    if isinstance(value, str):
+        if value in _BOOL:
+            return _BOOL[value]
+        try:
+            return ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            return value
+    return value
+
+
+def canonicalize_kwargs(kwargs):
+    return {k: canonicalize(v) for k, v in kwargs.items()}

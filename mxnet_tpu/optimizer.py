@@ -1,0 +1,615 @@
+"""Optimizers (reference: ``python/mxnet/optimizer.py:445-1447`` — SGD with
+multi-precision, Signum, FTML, LBSGD, DCASGD, NAG, SGLD, Adam, AdaGrad,
+RMSProp, AdaDelta, Ftrl, Adamax, Nadam, Test; plus the ``Updater`` wrapper
+with state (de)serialization used by KVStore servers).
+
+Design: every optimizer exposes a *pure functional core*
+``_apply(weight, grad, states, lr, wd) -> (new_weight, new_states)`` over raw
+jax arrays — so the same update lowers into jitted/pjit training steps (the
+TPU analogue of the reference's fused optimizer_op-inl.h kernels) — plus the
+reference's imperative ``update(index, weight, grad, state)`` API on top.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from .base import Registry
+from .ndarray import NDArray
+from . import ndarray as nd
+
+_REG = Registry("optimizer")
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, momentum=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self._extra = kwargs
+
+    @staticmethod
+    def register(klass):
+        _REG.register(klass)
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return _REG.create(name, **kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            master, inner = state
+            g32 = grad.astype("float32")
+            self.update(index, master, g32, inner)
+            weight._set_data(master._data.astype(jnp.float16))
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("lr_scheduler is set; use scheduler to change lr")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _prep_grad(self, grad):
+        g = grad * self.rescale_grad if self.rescale_grad != 1.0 else grad
+        if self.clip_gradient is not None:
+            c = self.clip_gradient
+            g = jnp.clip(g, -c, c)
+        return g
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum + optional fp16 master weights
+    (reference: optimizer.py SGD, src/operator/optimizer_op-inl.h sgd_update)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return None
+
+    def _apply(self, w, g, mom, lr, wd):
+        g = self._prep_grad(g) + wd * w
+        if mom is None:
+            return w - lr * g, None
+        new_mom = self.momentum * mom - lr * g
+        return w + new_mom, new_mom
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            _sparse_sgd_update(self, weight, grad, state, lr, wd)
+            return
+        new_w, new_m = self._apply(_raw(weight), _raw(grad),
+                                   _raw(state) if state is not None else None,
+                                   lr, wd)
+        weight._set_data(new_w)
+        if state is not None:
+            state._set_data(new_m)
+
+
+def _sparse_sgd_update(opt, weight, grad, state, lr, wd):
+    """Row-sparse SGD: only touched rows updated (reference:
+    optimizer_op-inl.h SGDUpdateRspRspImpl, 'lazy update')."""
+    idx = grad.indices._data.astype(jnp.int32)
+    gval = opt._prep_grad(grad.data._data)
+    w = _raw(weight)
+    rows = w[idx]
+    upd = gval + wd * rows
+    if state is not None:
+        m = _raw(state)
+        new_m_rows = opt.momentum * m[idx] - lr * upd
+        state._set_data(m.at[idx].set(new_m_rows))
+        weight._set_data(w.at[idx].add(new_m_rows))
+    else:
+        weight._set_data(w.at[idx].add(-lr * upd))
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w, g = _raw(weight), self._prep_grad(_raw(grad))
+        if state is not None:
+            m = self.momentum * _raw(state) - (1 - self.momentum) * (g + wd * w)
+            new_w = (1 - lr * self.wd_lh) * w + lr * jnp.sign(m)
+            state._set_data(m)
+        else:
+            new_w = (1 - lr * self.wd_lh) * w - lr * jnp.sign(g + wd * w)
+        weight._set_data(new_w)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        v = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        d = nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (d, v, z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        d, v, z = state
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        v_t = b2 * _raw(v) + (1 - b2) * g * g
+        d_t = (1 - b1 ** t) / lr * (jnp.sqrt(v_t / (1 - b2 ** t)) + eps)
+        sigma = d_t - b1 * _raw(d)
+        z_t = b1 * _raw(z) + (1 - b1) * g - sigma * w
+        new_w = -z_t / d_t
+        d._set_data(d_t); v._set_data(v_t); z._set_data(z_t)
+        weight._set_data(new_w)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling (reference LBSGD)."""
+
+    def __init__(self, momentum=0.9, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        w, g = _raw(weight), _raw(grad)
+        wnorm = jnp.linalg.norm(w)
+        gnorm = jnp.linalg.norm(g * self.rescale_grad)
+        lars = jnp.where(gnorm > 0, wnorm / (gnorm + self.wd * wnorm + 1e-9), 1.0)
+        lars = jnp.clip(lars, 0.0, 10.0)
+        saved_lr = self.lr
+        try:
+            self.lr = float(saved_lr)  # lars folded via grad scale below
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            new_w, new_m = self._apply(
+                w, g * lars, _raw(state) if state is not None else None, lr, wd)
+            weight._set_data(new_w)
+            if state is not None:
+                state._set_data(new_m)
+        finally:
+            self.lr = saved_lr
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        mom, prev = state
+        comp = g + self.lamda * g * g * (w - _raw(prev))
+        if mom is not None:
+            m = self.momentum * _raw(mom) - lr * comp
+            mom._set_data(m)
+            new_w = w + m
+        else:
+            new_w = w - lr * comp
+        prev._set_data(w)
+        weight._set_data(new_w)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        if state is not None:
+            m = self.momentum * _raw(state) + g
+            state._set_data(m)
+            new_w = w - lr * (g + self.momentum * m)
+        else:
+            new_w = w - lr * g
+        weight._set_data(new_w)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        from . import _rng
+        import jax
+        noise = jax.random.normal(_rng.next_key(), w.shape, w.dtype) * jnp.sqrt(lr)
+        weight._set_data(w - lr / 2 * g + noise)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def _apply(self, w, g, m, v, lr, wd, t):
+        g = self._prep_grad(g) + wd * w
+        b1, b2 = self.beta1, self.beta2
+        coef1 = 1.0 - b1 ** t
+        coef2 = 1.0 - b2 ** t
+        lr_t = lr * (coef2 ** 0.5) / coef1
+        new_m = b1 * m + (1 - b1) * g
+        new_v = b2 * v + (1 - b2) * g * g
+        new_w = w - lr_t * new_m / (jnp.sqrt(new_v) + self.epsilon)
+        return new_w, new_m, new_v
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        m, v = state
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            idx = grad.indices._data.astype(jnp.int32)
+            w = _raw(weight)
+            gval = self._prep_grad(grad.data._data) + wd * w[idx]
+            b1, b2 = self.beta1, self.beta2
+            lr_t = lr * ((1 - b2 ** t) ** 0.5) / (1 - b1 ** t)
+            m_rows = b1 * _raw(m)[idx] + (1 - b1) * gval
+            v_rows = b2 * _raw(v)[idx] + (1 - b2) * gval * gval
+            m._set_data(_raw(m).at[idx].set(m_rows))
+            v._set_data(_raw(v).at[idx].set(v_rows))
+            weight._set_data(w.at[idx].add(-lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)))
+            return
+        new_w, new_m, new_v = self._apply(_raw(weight), _raw(grad), _raw(m),
+                                          _raw(v), lr, wd, t)
+        m._set_data(new_m)
+        v._set_data(new_v)
+        weight._set_data(new_w)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        hist = _raw(state) + g * g
+        state._set_data(hist)
+        weight._set_data(w - lr * g / (jnp.sqrt(hist) + self.float_stable_eps))
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        zeros = lambda: nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        if self.centered:
+            return (zeros(), zeros(), zeros())  # n, g, delta
+        return (zeros(),)  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        g1 = self.gamma1
+        if self.centered:
+            n, mean_g, delta = state
+            n_t = g1 * _raw(n) + (1 - g1) * g * g
+            mg_t = g1 * _raw(mean_g) + (1 - g1) * g
+            d_t = self.gamma2 * _raw(delta) - lr * g / jnp.sqrt(
+                n_t - mg_t * mg_t + self.epsilon)
+            n._set_data(n_t); mean_g._set_data(mg_t); delta._set_data(d_t)
+            new_w = w + d_t
+        else:
+            (n,) = state
+            n_t = g1 * _raw(n) + (1 - g1) * g * g
+            n._set_data(n_t)
+            new_w = w - lr * g / jnp.sqrt(n_t + self.epsilon)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        weight._set_data(new_w)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        acc_g, acc_delta = state
+        ag = self.rho * _raw(acc_g) + (1 - self.rho) * g * g
+        delta = jnp.sqrt(_raw(acc_delta) + self.epsilon) / jnp.sqrt(
+            ag + self.epsilon) * g
+        ad = self.rho * _raw(acc_delta) + (1 - self.rho) * delta * delta
+        acc_g._set_data(ag); acc_delta._set_data(ad)
+        weight._set_data(w - delta)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),  # z
+                nd.zeros(weight.shape, ctx=weight.context))  # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad))
+        z, n = state
+        n_old = _raw(n)
+        n_t = n_old + g * g
+        sigma = (jnp.sqrt(n_t) - jnp.sqrt(n_old)) / lr
+        z_t = _raw(z) + g - sigma * w
+        new_w = jnp.where(
+            jnp.abs(z_t) <= self.lamda1,
+            jnp.zeros_like(w),
+            (jnp.sign(z_t) * self.lamda1 - z_t) /
+            ((self.beta + jnp.sqrt(n_t)) / lr + wd),
+        )
+        z._set_data(z_t); n._set_data(n_t)
+        weight._set_data(new_w)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        lr_t = lr / (1 - self.beta1 ** t)
+        m, u = state
+        m_t = self.beta1 * _raw(m) + (1 - self.beta1) * g
+        u_t = jnp.maximum(self.beta2 * _raw(u), jnp.abs(g))
+        m._set_data(m_t); u._set_data(u_t)
+        weight._set_data(w - lr_t * m_t / (u_t + 1e-8))
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, ctx=weight.context),
+                nd.zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        w = _raw(weight)
+        g = self._prep_grad(_raw(grad)) + wd * w
+        mom_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mom_tp1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mom_t
+        sched_next = self.m_schedule * mom_tp1
+        m, v = state
+        g_prime = g / (1.0 - self.m_schedule)
+        m_t = self.beta1 * _raw(m) + (1 - self.beta1) * g
+        v_t = self.beta2 * _raw(v) + (1 - self.beta2) * g * g
+        m_prime = m_t / (1.0 - sched_next)
+        v_prime = v_t / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - mom_t) * g_prime + mom_tp1 * m_prime
+        m._set_data(m_t); v._set_data(v_t)
+        weight._set_data(w - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon))
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        w = _raw(weight)
+        weight._set_data(w + _raw(grad) * self.rescale_grad)
+        state._set_data(_raw(weight))
+
+
+# aliases like the reference
+ccSGD = SGD
+
+
+class Updater:
+    """Wraps an optimizer for KVStore use; serializable states
+    (reference: optimizer.py:1460 get_updater, :1498-1507)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def to_np(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(to_np(x) for x in s)
+            return s.asnumpy() if isinstance(s, NDArray) else s
+        states = {k: to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        loaded = pickle.loads(states)
+        if isinstance(loaded, tuple) and len(loaded) == 2 and isinstance(
+                loaded[1], Optimizer):
+            states, self.optimizer = loaded
+        else:
+            states = loaded
+
+        def to_nd(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(to_nd(x) for x in s)
+            return nd.array(s, dtype=s.dtype) if isinstance(s, np.ndarray) else s
+        self.states = {k: to_nd(v) for k, v in states.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

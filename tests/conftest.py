@@ -1,0 +1,25 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's approach of testing distributed semantics without a
+cluster (SURVEY.md §4: launch.py --launcher local); here
+xla_force_host_platform_device_count gives 8 virtual devices so sharding /
+collective paths compile and execute single-process.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fixed_seed():
+    """Fixed seeds per test (reference: tests/python/unittest/common.py with_seed)."""
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,173 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * np.array([1, 2, 3]) + 2)
+
+
+def test_chain_and_branches():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y * x + y  # z = 2x^2 + 2x
+        loss = z.sum()
+    loss.backward()
+    assert_almost_equal(x.grad, 4 * x.asnumpy() + 2)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 20.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 60.0]))
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad, np.array([6.0, 6.0]))
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([4.0]))  # only d(y_const * x)/dx = y = 4
+
+    x2 = nd.array([3.0])
+    x2.attach_grad()
+    with autograd.record():
+        z2 = nd.BlockGrad(x2 * x2) * x2
+    z2.backward()
+    assert_almost_equal(x2.grad, np.array([9.0]))
+
+
+def test_training_and_recording_state():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_pause_no_graph():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        with autograd.pause():
+            y = x * 2
+    assert y._entry is None
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2.0, 4.0]))
+
+
+def test_grad_function():
+    x = nd.array([2.0, 3.0])
+    out = autograd.grad(_f(x), [x])
+    # grad computed on fresh graph
+
+def _f(x):
+    x.attach_grad()
+    with autograd.record():
+        return (x * x).sum()
+
+
+def test_grad_api():
+    x = nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+    (gx,) = autograd.grad(y, [x]),
+    assert_almost_equal(gx[0], 3 * np.array([4.0, 9.0]))
+
+
+def test_higher_order_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        gx = autograd.grad(y, [x], create_graph=True)[0]
+        z = gx.sum()
+    z.backward()
+    # d/dx (3x^2) = 6x = 12
+    assert_almost_equal(x.grad, np.array([12.0]))
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.5, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5)
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.random.rand(2, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=3, axis=1)
+        loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+    loss.backward()
+    ref = np.concatenate([np.full((2, 2), i, np.float32) for i in (1, 2, 3)], axis=1)
+    assert_almost_equal(x.grad, ref)
+
+
+def test_softmax_output_backward():
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0.0, 1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label)
+    out.backward()
+    p = np.exp(x.asnumpy() - x.asnumpy().max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    assert_almost_equal(x.grad, p - onehot, rtol=1e-5)

@@ -1,0 +1,217 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense_shapes_and_forward():
+    layer = nn.Dense(8, in_units=4)
+    layer.initialize()
+    x = nd.random.uniform(shape=(2, 4))
+    out = layer(x)
+    assert out.shape == (2, 8)
+    ref = x.asnumpy() @ layer.weight.data().asnumpy().T + layer.bias.data().asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-5)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(5)
+    layer.initialize()
+    x = nd.random.uniform(shape=(3, 7))
+    out = layer(x)
+    assert out.shape == (3, 5)
+    assert layer.weight.shape == (5, 7)
+
+
+def test_sequential_and_children():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dropout(0.5))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 8))
+    out = net(x)
+    assert out.shape == (2, 4)
+    params = net.collect_params()
+    assert len(params.keys()) == 4  # 2 weights + 2 biases
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+        net.add(nn.MaxPool2D(2))
+        net.add(nn.BatchNorm())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(10))
+    net.initialize()
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    out = net(x)
+    assert out.shape == (2, 10)
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = nd.random.uniform(shape=(3, 5))
+    out_eager = net(x).asnumpy()
+    net.hybridize()
+    out_hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(out_eager, out_hybrid, rtol=1e-5)
+    # second call hits the jit cache
+    out2 = net(x).asnumpy()
+    np.testing.assert_allclose(out_hybrid, out2, rtol=1e-6)
+
+
+def test_hybridize_batchnorm_state_update():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.BatchNorm(in_channels=3))
+    net.initialize()
+    net.hybridize()
+    bn = list(net._children.values())[0]
+    x = nd.random.uniform(shape=(4, 3, 2, 2))
+    rm_before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm_after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm_before, rm_after), "moving mean not updated in hybrid mode"
+
+
+def test_trainer_sgd_training():
+    np.random.seed(0)
+    X = np.random.randn(64, 10).astype(np.float32)
+    w_true = np.random.randn(10, 1).astype(np.float32)
+    y = X @ w_true
+
+    net = nn.Dense(1, in_units=10)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.L2Loss()
+    xb, yb = nd.array(X), nd.array(y)
+    first = None
+    for i in range(50):
+        with autograd.record():
+            loss = loss_fn(net(xb), yb)
+        loss.backward()
+        trainer.step(batch_size=64)
+        if first is None:
+            first = float(loss.mean().asscalar())
+    final = float(loss.mean().asscalar())
+    assert final < 0.05 * first, "did not converge: %f -> %f" % (first, final)
+
+
+def test_trainer_hybrid_training_adam():
+    np.random.seed(1)
+    X = np.random.randn(128, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xb, yb = nd.array(X), nd.array(y)
+    for _ in range(60):
+        with autograd.record():
+            loss = loss_fn(net(xb), yb)
+        loss.backward()
+        trainer.step(batch_size=128)
+    acc = float((nd.argmax(net(xb), axis=1) == yb).mean().asscalar())
+    assert acc > 0.95, "accuracy %f" % acc
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+        net.add(nn.Dense(2, in_units=4))
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 3))
+    ref = net(x).asnumpy()
+    fname = str(tmp_path / "model.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=3))
+        net2.add(nn.Dense(2, in_units=4))
+    net2.load_parameters(fname)
+    out = net2(x).asnumpy()
+    np.testing.assert_allclose(ref, out, rtol=1e-6)
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([1.0, 0.0, 3.0, 2.0])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    lp = pred.asnumpy() - pred.asnumpy().max(-1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+    ref = -lp[np.arange(4), label.asnumpy().astype(int)]
+    assert_almost_equal(l, ref, rtol=1e-5)
+
+    p2 = nd.array(np.random.randn(6).astype(np.float32))
+    t2 = nd.array(np.random.randn(6).astype(np.float32))
+    l2 = gluon.loss.L2Loss()(p2, t2)
+    assert_almost_equal(l2, 0.5 * (p2.asnumpy() - t2.asnumpy()) ** 2, rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(p2, t2)
+    assert_almost_equal(l1, np.abs(p2.asnumpy() - t2.asnumpy()), rtol=1e-5)
+
+    logits = nd.array(np.random.randn(8).astype(np.float32))
+    bin_label = nd.array((np.random.rand(8) > 0.5).astype(np.float32))
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(logits, bin_label)
+    x = logits.asnumpy()
+    ref_bce = np.maximum(x, 0) - x * bin_label.asnumpy() + np.log1p(np.exp(-np.abs(x)))
+    assert_almost_equal(bce, ref_bce, rtol=1e-4)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = nd.array([1.0, 5.0, 9.0])
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    assert_almost_equal(out, emb.weight.data().asnumpy()[[1, 5, 9]])
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.random.uniform(shape=(4, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer.load_states(fname)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(new_total - 1.0) < 1e-4
+    assert total > 1.0
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape((6, 2))
+    parts = gluon.utils.split_data(data, 3)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    loaded = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert loaded[0].shape == (6, 2)

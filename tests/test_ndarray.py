@@ -1,0 +1,172 @@
+"""NDArray core tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.size == 4
+    assert_almost_equal(a, np.array([[1, 2], [3, 4]]))
+
+    z = nd.zeros((3, 4))
+    assert_almost_equal(z, np.zeros((3, 4)))
+    o = nd.ones((2, 3), dtype="float16")
+    assert o.dtype == np.float16
+    f = nd.full((2, 2), 7.5)
+    assert_almost_equal(f, np.full((2, 2), 7.5))
+    ar = nd.arange(0, 10, 2)
+    assert_almost_equal(ar, np.arange(0, 10, 2, dtype=np.float32))
+    e = nd.eye(3)
+    assert_almost_equal(e, np.eye(3))
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, np.array([[6, 8], [10, 12]]))
+    assert_almost_equal(a - b, np.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal(a * b, np.array([[5, 12], [21, 32]]))
+    assert_almost_equal(b / a, np.array([[5, 3], [7 / 3, 2]]), rtol=1e-6)
+    assert_almost_equal(a + 1, np.array([[2, 3], [4, 5]]))
+    assert_almost_equal(2 * a, np.array([[2, 4], [6, 8]]))
+    assert_almost_equal(1 - a, np.array([[0, -1], [-2, -3]]))
+    assert_almost_equal(8 / b, 8 / np.array([[5.0, 6, 7, 8]]).reshape(2, 2))
+    assert_almost_equal(a ** 2, np.array([[1, 4], [9, 16]]))
+    assert_almost_equal(-a, -np.array([[1, 2], [3, 4]]))
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    assert_almost_equal(a, 2 * np.ones((2, 2)))
+    a *= 3
+    assert_almost_equal(a, 6 * np.ones((2, 2)))
+    a /= 2
+    assert_almost_equal(a, 3 * np.ones((2, 2)))
+    a -= 1
+    assert_almost_equal(a, 2 * np.ones((2, 2)))
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[0], np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1, 2], np.array([20, 21, 22, 23]))
+    assert_almost_equal(a[:, 1:3], np.arange(24).reshape(2, 3, 4)[:, 1:3])
+    a[0] = 0
+    npver = np.arange(24).reshape(2, 3, 4)
+    npver[0] = 0
+    assert_almost_equal(a, npver)
+    a[:] = 1
+    assert_almost_equal(a, np.ones((2, 3, 4)))
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 0, 4)).shape == (1, 2, 3, 4)
+    assert a.reshape((2, -1)).shape == (2, 12)
+
+
+def test_methods():
+    x = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum(), rtol=1e-5)
+    assert_almost_equal(a.sum(axis=1), x.sum(axis=1), rtol=1e-5)
+    assert_almost_equal(a.mean(axis=0), x.mean(axis=0), rtol=1e-5)
+    assert_almost_equal(a.max(), x.max())
+    assert_almost_equal(a.min(axis=1, keepdims=True), x.min(axis=1, keepdims=True))
+    assert_almost_equal(a.T, x.T)
+    assert_almost_equal(a.abs(), np.abs(x))
+    assert_almost_equal(a.clip(-0.5, 0.5), np.clip(x, -0.5, 0.5))
+    assert a.flatten().shape == (3, 4)
+    assert a.expand_dims(0).shape == (1, 3, 4)
+    b = nd.array(np.random.uniform(size=(4, 5)).astype(np.float32))
+    assert_almost_equal(a.dot(b), x.dot(b.asnumpy()), rtol=1e-5)
+
+
+def test_dtype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype(np.float16)
+    assert c.dtype == np.float16
+
+
+def test_copy_context():
+    a = nd.array([1, 2, 3])
+    b = a.copy()
+    b += 1
+    assert_almost_equal(a, np.array([1, 2, 3]))
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context.device_type == "cpu"
+    d = nd.zeros((3,))
+    a.copyto(d)
+    assert_almost_equal(d, np.array([1, 2, 3]))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "test.params")
+    d = {"w": nd.array([[1, 2], [3, 4]]), "b": nd.arange(0, 5)}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"])
+    assert_almost_equal(loaded["b"], d["b"])
+    lst = [nd.ones((2,)), nd.zeros((3, 3))]
+    nd.save(fname, lst)
+    l2 = nd.load(fname)
+    assert isinstance(l2, list) and len(l2) == 2
+    assert_almost_equal(l2[1], np.zeros((3, 3)))
+
+
+def test_comparison_ops():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal(a == b, np.array([0.0, 1.0, 0.0]))
+    assert_almost_equal(a > b, np.array([0.0, 0.0, 1.0]))
+    assert_almost_equal(a <= b, np.array([1.0, 1.0, 0.0]))
+    assert_almost_equal(a != 2, np.array([1.0, 0.0, 1.0]))
+
+
+def test_random_basic():
+    u = nd.random.uniform(0, 1, shape=(100,))
+    arr = u.asnumpy()
+    assert arr.min() >= 0 and arr.max() <= 1
+    n = nd.random.normal(0, 1, shape=(500,))
+    assert abs(float(n.mean().asscalar())) < 0.2
+    mx.random.seed(42)
+    a1 = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    a2 = nd.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_array_equal(a1, a2)
+
+
+def test_concat_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_wait_and_iter():
+    a = nd.array([[1, 2], [3, 4]])
+    a.wait_to_read()
+    nd.waitall()
+    rows = list(a)
+    assert len(rows) == 2
+    assert_almost_equal(rows[1], np.array([3, 4]))
+    assert float(a[0, 1].asscalar()) == 2.0

@@ -1,0 +1,315 @@
+"""Operator correctness vs the NumPy oracle
+(reference: tests/python/unittest/test_operator.py, 6973 LoC)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+def test_unary_family():
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    for name, ref in [
+        ("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+        ("square", np.square), ("abs", np.abs), ("sign", np.sign),
+        ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+        ("floor", np.floor), ("ceil", np.ceil), ("log1p", np.log1p),
+        ("expm1", np.expm1), ("reciprocal", np.reciprocal),
+        ("rsqrt", lambda v: 1 / np.sqrt(v)), ("cbrt", np.cbrt),
+    ]:
+        assert_almost_equal(getattr(nd, name)(a), ref(x), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(nd.relu(nd.array(x - 1)), np.maximum(x - 1, 0))
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-5)
+
+
+def test_broadcast_family():
+    x = _rand(2, 3, 4)
+    y = _rand(1, 3, 1)
+    a, b = nd.array(x), nd.array(y)
+    assert_almost_equal(nd.broadcast_add(a, b), x + y, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_mul(a, b), x * y, rtol=1e-6)
+    assert_almost_equal(nd.broadcast_maximum(a, b), np.maximum(x, y))
+    assert_almost_equal(nd.broadcast_greater(a, b), (x > y).astype(np.float32))
+    assert_almost_equal(nd.broadcast_to(nd.array(y), shape=(2, 3, 4)),
+                        np.broadcast_to(y, (2, 3, 4)))
+
+
+def test_reductions():
+    x = _rand(2, 3, 4)
+    a = nd.array(x)
+    assert_almost_equal(nd.sum(a, axis=(0, 2)), x.sum(axis=(0, 2)), rtol=1e-5)
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True), x.sum(axis=(0, 2)), rtol=1e-5)
+    assert_almost_equal(nd.mean(a, axis=1, keepdims=True), x.mean(axis=1, keepdims=True), rtol=1e-5)
+    assert_almost_equal(nd.norm(a), np.sqrt((x ** 2).sum()), rtol=1e-5)
+    assert_almost_equal(nd.argmax(a, axis=2), x.argmax(axis=2).astype(np.float32))
+    assert_almost_equal(nd.prod(a, axis=0), x.prod(axis=0), rtol=1e-5)
+
+
+def test_dot():
+    x, y = _rand(4, 5), _rand(5, 6)
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-5)
+    assert_almost_equal(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True), x @ y, rtol=1e-5)
+    bx, by = _rand(3, 4, 5), _rand(3, 5, 2)
+    assert_almost_equal(nd.batch_dot(nd.array(bx), nd.array(by)), bx @ by, rtol=1e-5)
+
+
+def test_fully_connected():
+    x, w, b = _rand(2, 3, 4), _rand(8, 12), _rand(8)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=8)
+    ref = x.reshape(2, 12) @ w.T + b
+    assert_almost_equal(out, ref, rtol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w.reshape(8, 12)), no_bias=True,
+                             num_hidden=8, flatten=True)
+    assert_almost_equal(out2, x.reshape(2, 12) @ w.T, rtol=1e-5)
+
+
+def test_convolution_vs_oracle():
+    import torch
+    import torch.nn.functional as F
+    x, w, b = _rand(2, 3, 8, 8), _rand(5, 3, 3, 3), _rand(5)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b), kernel=(3, 3),
+                         num_filter=5, stride=(2, 2), pad=(1, 1))
+    ref = F.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                   stride=2, padding=1).numpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    # grouped
+    xg, wg = _rand(1, 4, 6, 6), _rand(6, 2, 3, 3)
+    outg = nd.Convolution(nd.array(xg), nd.array(wg), kernel=(3, 3), num_filter=6,
+                          num_group=2, no_bias=True)
+    refg = F.conv2d(torch.tensor(xg), torch.tensor(wg), groups=2).numpy()
+    assert_almost_equal(outg, refg, rtol=1e-4, atol=1e-5)
+    # dilated 1d
+    x1, w1 = _rand(2, 3, 10), _rand(4, 3, 3)
+    out1 = nd.Convolution(nd.array(x1), nd.array(w1), kernel=(3,), num_filter=4,
+                          dilate=(2,), no_bias=True)
+    ref1 = F.conv1d(torch.tensor(x1), torch.tensor(w1), dilation=2).numpy()
+    assert_almost_equal(out1, ref1, rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_vs_oracle():
+    import torch
+    import torch.nn.functional as F
+    x, w = _rand(2, 4, 5, 5), _rand(4, 3, 3, 3)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=3,
+                           stride=(2, 2), pad=(1, 1), adj=(1, 1), no_bias=True)
+    ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                             padding=1, output_padding=1).numpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling_vs_oracle():
+    import torch
+    import torch.nn.functional as F
+    x = _rand(2, 3, 8, 8)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = F.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    assert_almost_equal(out, ref)
+    out = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type="avg")
+    ref = F.avg_pool2d(torch.tensor(x), 3, 2, padding=1).numpy()
+    assert_almost_equal(out, ref, rtol=1e-5)
+    out = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type="avg", count_include_pad=False)
+    ref = F.avg_pool2d(torch.tensor(x), 3, 2, padding=1,
+                       count_include_pad=False).numpy()
+    assert_almost_equal(out, ref, rtol=1e-5)
+    out = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg", kernel=(1, 1))
+    assert_almost_equal(out, x.mean(axis=(2, 3), keepdims=True), rtol=1e-5)
+    # ceil ('full') convention
+    x2 = _rand(1, 1, 7, 7)
+    out = nd.Pooling(nd.array(x2), kernel=(3, 3), stride=(2, 2), pool_type="max",
+                     pooling_convention="full")
+    ref = F.max_pool2d(torch.tensor(x2), 3, 2, ceil_mode=True).numpy()
+    assert_almost_equal(out, ref)
+
+
+def test_batchnorm_train_and_inference():
+    x = _rand(4, 3, 5, 5)
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mmean, mvar = np.zeros(3, np.float32), np.ones(3, np.float32)
+    g, b = nd.array(gamma), nd.array(beta)
+    mm, mv = nd.array(mmean), nd.array(mvar)
+    with mx.autograd.train_mode():
+        out = nd.BatchNorm(nd.array(x), g, b, mm, mv, fix_gamma=False, eps=1e-5,
+                           momentum=0.9)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    ref = (x - bm[None, :, None, None]) / np.sqrt(bv[None, :, None, None] + 1e-5)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    # moving stats updated
+    assert_almost_equal(mm, 0.1 * bm, rtol=1e-4, atol=1e-6)
+    assert_almost_equal(mv, 0.9 * 1.0 + 0.1 * bv, rtol=1e-4)
+    # inference uses moving stats
+    out_inf = nd.BatchNorm(nd.array(x), g, b, mm, mv, fix_gamma=False, eps=1e-5)
+    ref_inf = (x - mm.asnumpy()[None, :, None, None]) / np.sqrt(
+        mv.asnumpy()[None, :, None, None] + 1e-5)
+    assert_almost_equal(out_inf, ref_inf, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_family():
+    x = _rand(3, 5)
+    a = nd.array(x)
+    ex = np.exp(x - x.max(axis=-1, keepdims=True))
+    sm = ex / ex.sum(axis=-1, keepdims=True)
+    assert_almost_equal(nd.softmax(a), sm, rtol=1e-5)
+    assert_almost_equal(nd.log_softmax(a), np.log(sm), rtol=1e-4)
+    assert_almost_equal(nd.softmax(a, axis=0),
+                        np.exp(x - x.max(0)) / np.exp(x - x.max(0)).sum(0), rtol=1e-5)
+
+
+def test_take_embedding_onehot_pick():
+    w = _rand(10, 4)
+    idx = np.array([[1, 3], [2, 9]], dtype=np.float32)
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[idx.astype(int)])
+    t = nd.take(nd.array(w), nd.array([0.0, 5.0]))
+    assert_almost_equal(t, w[[0, 5]])
+    oh = nd.one_hot(nd.array([0.0, 2.0]), depth=4)
+    assert_almost_equal(oh, np.eye(4, dtype=np.float32)[[0, 2]])
+    x = _rand(3, 5)
+    p = nd.pick(nd.array(x), nd.array([0.0, 2.0, 4.0]), axis=1)
+    assert_almost_equal(p, x[np.arange(3), [0, 2, 4]])
+
+
+def test_shape_ops():
+    x = _rand(2, 3, 4)
+    a = nd.array(x)
+    assert_almost_equal(nd.transpose(a, axes=(2, 0, 1)), x.transpose(2, 0, 1))
+    assert_almost_equal(nd.flip(a, axis=1), np.flip(x, 1))
+    assert_almost_equal(nd.tile(a, reps=(2, 1, 1)), np.tile(x, (2, 1, 1)))
+    assert_almost_equal(nd.repeat(a, repeats=2, axis=1), np.repeat(x, 2, 1))
+    parts = nd.split(a, num_outputs=3, axis=1)
+    assert len(parts) == 3
+    assert_almost_equal(parts[1], x[:, 1:2])
+    sq = nd.split(a, num_outputs=3, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2, 4)
+    s = nd.slice(a, begin=(0, 1), end=(2, 3))
+    assert_almost_equal(s, x[0:2, 1:3])
+    sa = nd.slice_axis(a, axis=2, begin=1, end=3)
+    assert_almost_equal(sa, x[:, :, 1:3])
+    assert_almost_equal(nd.where(nd.array((x > 0).astype(np.float32)), a, -a),
+                        np.where(x > 0, x, -x))
+    p = nd.Pad(a.reshape((2, 3, 4, 1)).transpose((0, 3, 1, 2)), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=5)
+    assert p.shape == (2, 1, 5, 8)
+
+
+def test_topk_sort():
+    x = _rand(3, 6)
+    a = nd.array(x)
+    v = nd.topk(a, k=2, ret_typ="value")
+    ref = -np.sort(-x, axis=-1)[:, :2]
+    assert_almost_equal(v, ref)
+    idx = nd.topk(a, k=2, ret_typ="indices")
+    assert_almost_equal(idx, np.argsort(-x, axis=-1)[:, :2].astype(np.float32))
+    assert_almost_equal(nd.sort(a), np.sort(x, -1))
+    assert_almost_equal(nd.argsort(a), np.argsort(x, -1).astype(np.float32))
+
+
+def test_activation_leakyrelu():
+    x = _rand(3, 4) * 2
+    a = nd.array(x)
+    assert_almost_equal(nd.Activation(a, act_type="softrelu"),
+                        np.log1p(np.exp(x)), rtol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="elu", slope=1.0),
+                        np.where(x > 0, x, np.expm1(x)), rtol=1e-5)
+
+
+def test_norm_ops():
+    x = _rand(2, 4, 3, 3)
+    g, b = np.ones(4, np.float32) * 1.5, np.ones(4, np.float32) * 0.5
+    out = nd.LayerNorm(nd.array(x), nd.array(np.ones(3, np.float32)),
+                       nd.array(np.zeros(3, np.float32)), axis=-1)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    assert_almost_equal(out, (x - mean) / np.sqrt(var + 1e-5), rtol=1e-4, atol=1e-5)
+    out = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-3)
+    m = x.mean(axis=(2, 3), keepdims=True)
+    v = x.var(axis=(2, 3), keepdims=True)
+    ref = (x - m) / np.sqrt(v + 1e-3) * g[None, :, None, None] + b[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    out = nd.L2Normalization(nd.array(x), mode="instance")
+    ref = x / np.sqrt((x.reshape(2, -1) ** 2).sum(-1) + 1e-10)[:, None, None, None]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_modes():
+    x = np.ones((100, 100), np.float32)
+    a = nd.array(x)
+    out = nd.Dropout(a, p=0.5)  # inference: identity
+    assert_almost_equal(out, x)
+    with mx.autograd.train_mode():
+        out = nd.Dropout(a, p=0.5)
+    arr = out.asnumpy()
+    frac = (arr == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = arr[arr != 0]
+    assert_almost_equal(kept, np.full_like(kept, 2.0))
+
+
+def test_sequence_ops():
+    x = np.arange(24, dtype=np.float32).reshape(4, 2, 3)  # (seq, batch, feat)
+    sl = np.array([2, 3], dtype=np.float32)
+    out = nd.SequenceMask(nd.array(x), nd.array(sl), use_sequence_length=True,
+                          value=-1.0)
+    ref = x.copy()
+    ref[2:, 0] = -1
+    ref[3:, 1] = -1
+    assert_almost_equal(out, ref)
+    last = nd.SequenceLast(nd.array(x), nd.array(sl), use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[2, 1]]))
+    rev = nd.SequenceReverse(nd.array(x), nd.array(sl), use_sequence_length=True)
+    ref = x.copy()
+    ref[:2, 0] = x[:2, 0][::-1]
+    ref[:3, 1] = x[:3, 1][::-1]
+    assert_almost_equal(rev, ref)
+
+
+def test_upsampling_spatial():
+    x = _rand(1, 2, 3, 3)
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    assert_almost_equal(out, x.repeat(2, 2).repeat(2, 3))
+    # bilinear grid sample identity
+    n, c, h, w = 1, 1, 4, 4
+    xx = _rand(n, c, h, w)
+    ys = np.linspace(-1, 1, h, dtype=np.float32)
+    xs = np.linspace(-1, 1, w, dtype=np.float32)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    grid = np.stack([gx, gy])[None]
+    out = nd.BilinearSampler(nd.array(xx), nd.array(grid))
+    assert_almost_equal(out, xx, rtol=1e-5, atol=1e-6)
+
+
+def test_cast_clip_misc():
+    x = _rand(3, 3) * 3
+    assert_almost_equal(nd.clip(nd.array(x), a_min=-1, a_max=1), np.clip(x, -1, 1))
+    c = nd.Cast(nd.array(x), dtype="float16")
+    assert c.dtype == np.float16
+    assert_almost_equal(nd.add_n(nd.array(x), nd.array(x), nd.array(x)), 3 * x, rtol=1e-6)
+
+
+def test_grad_simple_ops():
+    check_numeric_gradient(lambda a: (a * a + a).sum(), [np.random.rand(3, 4)])
+    check_numeric_gradient(lambda a, b: nd.dot(a, b).sum(),
+                           [np.random.rand(3, 4), np.random.rand(4, 2)])
+    check_numeric_gradient(lambda a: nd.sigmoid(a).sum(), [np.random.rand(3, 3)])
+    check_numeric_gradient(
+        lambda a: nd.FullyConnected(a, w_const, num_hidden=3, no_bias=True).sum(),
+        [np.random.rand(2, 5)])
+
+
+w_const = None
+
+
+def setup_module():
+    global w_const
+    w_const = nd.array(np.random.rand(3, 5).astype(np.float32))
