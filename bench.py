@@ -1,0 +1,63 @@
+"""North-star benchmark: ResNet-50 training throughput, img/s per chip.
+
+Baseline (BASELINE.md / docs/faq/perf.md:214 in the reference): 298.51 img/s
+on V100 fp32, bs=32 — MXNet 1.2 `train_imagenet.py`.  Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 298.51
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
+    n_dev = jax.local_device_count()
+    # keep the per-chip metric honest: batch is per chip
+    devices = jax.devices()
+    mesh = make_mesh((n_dev,), ("data",), devices)
+    global_batch = batch * n_dev
+
+    net = vision.resnet50_v1()
+    net.initialize(mx.init.Xavier())
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(global_batch, 3, 224, 224).astype(np.float32))
+    y = mx.nd.array((rng.rand(global_batch) * 1000).astype(np.int64))
+
+    # warmup (compile)
+    for _ in range(3):
+        trainer.step(x, y).asscalar()
+
+    iters = int(os.environ.get("MXTPU_BENCH_ITERS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(x, y)
+    loss.asscalar()  # sync
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec_per_chip = global_batch * iters / dt / n_dev
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec_per_chip, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(imgs_per_sec_per_chip / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,4 +28,5 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import gluon
+from . import parallel
 from . import test_utils

@@ -32,6 +32,7 @@ from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 class _BlockScope:
     _current = None
+    _global_counter = {}
 
     def __init__(self, block):
         self._block = block
@@ -43,7 +44,10 @@ class _BlockScope:
         current = _BlockScope._current
         if current is None:
             if prefix is None:
-                prefix = hint + "0_" if hint else ""
+                # global NameManager analogue (reference: python/mxnet/name.py)
+                count = _BlockScope._global_counter.get(hint, 0)
+                _BlockScope._global_counter[hint] = count + 1
+                prefix = "%s%d_" % (hint, count) if hint else ""
             if params is None:
                 params = ParameterDict(prefix)
             else:

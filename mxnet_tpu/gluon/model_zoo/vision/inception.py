@@ -4,7 +4,7 @@ from __future__ import annotations
 from ....context import cpu
 from ...block import HybridBlock
 from ... import nn
-from .squeezenet import HybridConcurrent
+from ...nn import HybridConcurrent
 
 __all__ = ["Inception3", "inception_v3"]
 

@@ -4,6 +4,7 @@ from __future__ import annotations
 from ....context import cpu
 from ...block import HybridBlock
 from ... import nn
+from ...nn import HybridConcurrent
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1", "get_squeezenet"]
 
@@ -23,22 +24,6 @@ def _make_fire_conv(channels, kernel_size, padding=0):
     out.add(nn.Conv2D(channels, kernel_size, padding=padding))
     out.add(nn.Activation("relu"))
     return out
-
-
-class HybridConcurrent(HybridBlock):
-    """Run children on the same input and concat outputs on ``axis``
-    (reference: gluon/contrib/nn/basic_layers.py HybridConcurrent)."""
-
-    def __init__(self, axis=1, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self.axis = axis
-
-    def add(self, block):
-        self.register_child(block)
-
-    def hybrid_forward(self, F, x):
-        out = [block(x) for block in self._children.values()]
-        return F.concat(*out, dim=self.axis)
 
 
 class SqueezeNet(HybridBlock):

@@ -2,7 +2,7 @@
 from .basic_layers import (
     Sequential, HybridSequential, Dense, Activation, LeakyReLU, PReLU, ELU,
     SELU, Swish, GELU, Dropout, Flatten, BatchNorm, InstanceNorm, LayerNorm,
-    Embedding, Lambda, HybridLambda,
+    Embedding, Lambda, HybridLambda, HybridConcurrent,
 )
 from .conv_layers import (
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,

@@ -316,3 +316,19 @@ class HybridLambda(HybridBlock):
 
     def hybrid_forward(self, F, x, *args):
         return self._func(F, x, *args)
+
+
+class HybridConcurrent(HybridBlock):
+    """Run children on the same input and concat outputs on ``axis``
+    (reference: gluon/contrib/nn/basic_layers.py HybridConcurrent)."""
+
+    def __init__(self, axis=1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, block):
+        self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)

@@ -1,0 +1,30 @@
+"""TPU-native parallelism: device meshes + GSPMD-sharded training.
+
+This package is the TPU-first replacement for the reference's entire
+distribution stack (SURVEY.md §2.2):
+
+- ``DataParallelExecutorGroup`` batch slicing
+  (``python/mxnet/module/executor_group.py:143``) → a ``jax.sharding.Mesh``
+  with the batch sharded over the ``data`` axis; XLA's SPMD partitioner
+  inserts the gradient ``psum`` over ICI automatically.
+- ``KVStoreNCCL`` / ``Comm`` device reduce (``src/kvstore/kvstore_nccl.h``,
+  ``src/kvstore/comm.h:451``) → the same psum; no user-visible allreduce.
+- ``group2ctx`` model parallelism (``src/executor/graph_executor.cc:408``)
+  → named mesh axes + per-parameter ``PartitionSpec`` rules; cross-device
+  copies are implicit in GSPMD.
+- ps-lite ``dist_sync`` (``src/kvstore/kvstore_dist.h``) → multi-host jax
+  (``jax.distributed``) with the same mesh spanning DCN.
+
+New-capability axes the reference lacks (documented in SURVEY.md §2.2):
+tensor parallelism (shard params on a ``model`` axis) and sequence
+parallelism / ring attention (see ``mxnet_tpu.ops.nn`` ring attention).
+"""
+from .mesh import make_mesh, data_parallel_mesh, local_device_count
+from .trainer import DataParallelTrainer
+from .functional import functionalize_forward, functional_optimizer_update
+
+__all__ = [
+    "make_mesh", "data_parallel_mesh", "local_device_count",
+    "DataParallelTrainer", "functionalize_forward",
+    "functional_optimizer_update",
+]

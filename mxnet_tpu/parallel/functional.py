@@ -1,0 +1,150 @@
+"""Functionalization bridge: stateful Gluon blocks / MXNet optimizers → pure
+jax functions suitable for ``jax.jit`` over a sharded mesh.
+
+The reference never needs this layer because its executors mutate buffers in
+place under the dependency engine (``src/executor/graph_executor.cc``,
+``src/operator/optimizer_op-inl.h``); XLA instead wants a pure
+``(params, batch) -> (loss, new_params)`` program so it can plan buffers,
+donate inputs, and insert collectives.  The same Python ``Optimizer.update``
+code that drives the eager path is traced here with its NDArray mutations
+captured — one numerics codebase for both paths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd, _rng
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _ndmod
+
+__all__ = ["functionalize_forward", "functional_optimizer_update",
+           "state_to_raw", "tree_raw"]
+
+
+def tree_raw(x):
+    """Recursively unwrap NDArrays in a None/NDArray/tuple/list/dict pytree."""
+    if x is None:
+        return None
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (tuple, list)):
+        return tuple(tree_raw(v) for v in x)
+    if isinstance(x, dict):
+        return {k: tree_raw(v) for k, v in x.items()}
+    return x
+
+
+def _tree_wrap(x):
+    if x is None:
+        return None
+    if isinstance(x, (tuple, list)):
+        return tuple(_tree_wrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _tree_wrap(v) for k, v in x.items()}
+    return NDArray(x)
+
+
+def _tree_unwrap_updated(wrapped):
+    """Read back possibly-mutated NDArray handles into raw values."""
+    if wrapped is None:
+        return None
+    if isinstance(wrapped, (tuple, list)):
+        return tuple(_tree_unwrap_updated(v) for v in wrapped)
+    if isinstance(wrapped, dict):
+        return {k: _tree_unwrap_updated(v) for k, v in wrapped.items()}
+    return wrapped._data
+
+
+state_to_raw = tree_raw
+
+
+def functionalize_forward(run, params_by_name, train_names, aux_names,
+                          train=True):
+    """Build a pure fn ``(train_vals, aux_vals, input_vals, key) ->
+    (output_vals, mut_aux_vals)`` from an eager callable ``run(*inputs)``
+    that reads the given Parameters.
+
+    ``run`` is executed with the parameters' backing arrays swapped for
+    tracers and NDArray mutations captured — the functional analogue of
+    FMutateInputs (``include/mxnet/op_attr_types.h``), used for BatchNorm
+    moving stats.  The mutated-aux name list is recorded on the returned
+    function as ``.mut_names`` at first trace.
+    """
+    all_names = list(train_names) + list(aux_names)
+
+    def pure(train_vals, aux_vals, input_vals, rng_key):
+        vals = list(train_vals) + list(aux_vals)
+        mutations = []
+        _ndmod._MUTATION_TRACKERS.append(
+            lambda obj, val: mutations.append((obj, val)))
+        prev_rec = autograd.set_recording(False)
+        prev_train = autograd.set_training(train)
+        saved = {}
+        try:
+            with _rng.trace_scope(rng_key):
+                for name, val in zip(all_names, vals):
+                    saved[name] = params_by_name[name]._data._data
+                    params_by_name[name]._data._data = val
+                try:
+                    wrapped = [NDArray(v) for v in input_vals]
+                    out = run(*wrapped)
+                finally:
+                    mut_names, mut_vals = [], []
+                    for obj, new_val in mutations:
+                        for name in all_names:
+                            if params_by_name[name]._data is obj:
+                                mut_names.append(name)
+                                mut_vals.append(new_val)
+                                break
+                    for name in all_names:
+                        params_by_name[name]._data._data = saved[name]
+        finally:
+            _ndmod._MUTATION_TRACKERS.pop()
+            autograd.set_recording(prev_rec)
+            autograd.set_training(prev_train)
+        single = not isinstance(out, (list, tuple))
+        outs = [out] if single else list(out)
+        pure.mut_names = mut_names
+        pure.single = single
+        return tuple(o._data for o in outs), tuple(mut_vals)
+
+    pure.mut_names = None
+    pure.single = True
+    return pure
+
+
+def functional_optimizer_update(opt, index, weight_val, grad_val, state_raw,
+                                lr_val, t_val):
+    """Trace one ``Optimizer.update`` call as a pure function.
+
+    ``lr_val`` (learning rate, host-computed — schedulers use Python control
+    flow) and ``t_val`` (update count, for Adam-style bias correction) enter
+    as traced scalars so one compiled program serves every step; the
+    reference instead re-reads these host-side each iteration
+    (``python/mxnet/optimizer.py`` ``_get_lr``/``_update_count``).
+    Returns ``(new_weight_val, new_state_raw)``.
+    """
+    w = NDArray(weight_val)
+    g = NDArray(grad_val)
+    state = _tree_wrap(state_raw)
+
+    saved = (opt.lr, opt.lr_scheduler, opt._index_update_count.get(index),
+             opt.num_update)
+    opt.lr = lr_val
+    opt.lr_scheduler = None
+    # _update_count would do python `max` on tracers; pin counts directly.
+    opt._index_update_count[index] = t_val
+    saved_uc = opt._update_count
+    opt._update_count = lambda _idx: None
+    try:
+        opt.update_multi_precision(index, w, g, state)
+    finally:
+        opt._update_count = saved_uc
+        opt.lr, opt.lr_scheduler = saved[0], saved[1]
+        if saved[2] is None:
+            opt._index_update_count.pop(index, None)
+        else:
+            opt._index_update_count[index] = saved[2]
+        opt.num_update = saved[3]
+    return w._data, _tree_unwrap_updated(state)

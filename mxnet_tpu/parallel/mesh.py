@@ -1,0 +1,53 @@
+"""Device-mesh construction helpers.
+
+The mesh is the TPU analogue of the reference's context list
+(``ctx=[mx.gpu(i) for i in ...]`` handed to Module/Trainer): instead of one
+executor per device with explicit gradient reduction, every jitted program
+spans the whole mesh and XLA lowers the sharding annotations to ICI/DCN
+collectives.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "data_parallel_mesh", "local_device_count",
+           "replicated", "batch_sharded", "Mesh", "NamedSharding",
+           "PartitionSpec"]
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def make_mesh(shape=None, axis_names=("data",), devices=None):
+    """Build a Mesh.  ``shape`` is a tuple matching ``axis_names``;
+    default: all devices on one ``data`` axis (pure DP)."""
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError("mesh shape %r needs %d devices, have %d"
+                         % (shape, n, len(devices)))
+    arr = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def data_parallel_mesh(num=None):
+    devices = jax.devices()
+    if num is not None:
+        devices = devices[:num]
+    return make_mesh((len(devices),), ("data",), devices)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh, axis="data", ndim=None):
+    """Sharding for a batch tensor: leading dim split on ``axis``."""
+    return NamedSharding(mesh, PartitionSpec(axis))

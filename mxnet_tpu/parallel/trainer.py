@@ -1,0 +1,174 @@
+"""DataParallelTrainer: one jitted SPMD program = forward + backward +
+optimizer step over a device mesh.
+
+The reference splits this across four subsystems — per-device executors
+(``module/executor_group.py:143``), KVStore push/pull
+(``src/kvstore/comm.h:451``), the updater loop (``python/mxnet/model.py:157``)
+and the dependency engine ordering it all.  On TPU the whole iteration is a
+single XLA program: batch sharded over the ``data`` mesh axis, parameters
+replicated (or sharded over a ``model`` axis for tensor parallelism — a new
+capability, SURVEY.md §2.2), gradients reduced by compiler-inserted psum over
+ICI, parameters donated so updates happen in place in HBM.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import autograd
+from ..ndarray import NDArray
+from . import mesh as mesh_mod
+from .functional import (functionalize_forward, functional_optimizer_update,
+                         tree_raw)
+
+__all__ = ["DataParallelTrainer"]
+
+
+class DataParallelTrainer:
+    """Train a Gluon block data-parallel (optionally tensor-parallel) on a mesh.
+
+    Parameters
+    ----------
+    block : gluon.Block — the model; will be run in train mode.
+    loss : gluon.loss.Loss or callable(pred, label)->NDArray.
+    optimizer : str or Optimizer (same registry as the eager path).
+    mesh : jax.sharding.Mesh, default = all devices on one ``data`` axis.
+    param_spec_fn : callable(name, shape)->PartitionSpec for tensor
+        parallelism; default replicates every parameter.
+    data_axis : mesh axis name the batch is sharded over.
+    """
+
+    def __init__(self, block, loss, optimizer, optimizer_params=None,
+                 mesh=None, param_spec_fn=None, data_axis="data"):
+        from .. import optimizer as opt_mod
+        self._block = block
+        self._loss = loss
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._opt = optimizer
+        self._mesh = mesh if mesh is not None else mesh_mod.data_parallel_mesh()
+        self._param_spec_fn = param_spec_fn or (lambda name, shape:
+                                                PartitionSpec())
+        self._data_axis = data_axis
+        self._ready = False
+        self._jit_cache = {}
+        self._step_count = 0
+
+    # -- setup -------------------------------------------------------------
+    def _setup(self, data, label):
+        block, mesh = self._block, self._mesh
+        if any(p._deferred_init
+               for p in block.collect_params().values()):
+            x0 = (data if isinstance(data, NDArray)
+                  else NDArray(jnp.asarray(np.asarray(data))))
+            with autograd.pause():
+                block(x0[:1])
+        params = block.collect_params()
+        self._params_by_name = dict(params.items())
+        self._train_names = [n for n, p in params.items()
+                             if p.grad_req != "null"]
+        self._aux_names = [n for n, p in params.items() if p.grad_req == "null"]
+
+        # place every param on the mesh per its PartitionSpec
+        self._param_shardings = {}
+        for name, p in params.items():
+            spec = self._param_spec_fn(name, p.shape)
+            sh = NamedSharding(mesh, spec)
+            self._param_shardings[name] = sh
+            p._data._set_data(jax.device_put(p.data()._data, sh))
+
+        # optimizer states live next to their (possibly sharded) param
+        self._states_raw = []
+        for i, name in enumerate(self._train_names):
+            p = self._params_by_name[name]
+            state = self._opt.create_state_multi_precision(i, p.data())
+            raw = tree_raw(state)
+            sh = self._param_shardings[name]
+            self._states_raw.append(jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, sh), raw))
+            if p.lr_mult != 1.0:
+                self._opt.lr_mult.setdefault(i, p.lr_mult)
+            if p.wd_mult != 1.0:
+                self._opt.wd_mult.setdefault(i, p.wd_mult)
+
+        def run(x, y):
+            out = block(x)
+            l = self._loss(out, y)
+            return l.mean() if hasattr(l, "mean") else l
+
+        self._fwd = functionalize_forward(
+            run, self._params_by_name, self._train_names, self._aux_names,
+            train=True)
+        self._ready = True
+
+    # -- the compiled step -------------------------------------------------
+    def _build_step(self):
+        fwd, opt = self._fwd, self._opt
+        n_train = len(self._train_names)
+
+        def pure_step(train_vals, states, aux_vals, x, y, key, lr, t):
+            def loss_of(tv):
+                outs, muts = fwd(tv, aux_vals, (x, y), key)
+                return outs[0], muts
+
+            (loss_val, muts), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            new_vals, new_states = [], []
+            for i in range(n_train):
+                nw, ns = functional_optimizer_update(
+                    opt, i, train_vals[i], grads[i], states[i], lr, t)
+                new_vals.append(nw)
+                new_states.append(ns)
+            return loss_val, tuple(new_vals), tuple(new_states), muts
+
+        return jax.jit(pure_step, donate_argnums=(0, 1))
+
+    # -- public API --------------------------------------------------------
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def step(self, data, label):
+        """Run one training step; returns the (scalar) loss NDArray."""
+        from .. import _rng
+        if not self._ready:
+            self._setup(data, label)
+
+        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        y = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        batch_sh = NamedSharding(self._mesh, PartitionSpec(self._data_axis))
+        x = jax.device_put(x, batch_sh)
+        y = jax.device_put(y, batch_sh)
+
+        key = (tuple(x.shape), str(x.dtype), tuple(y.shape), str(y.dtype))
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            jitted = self._build_step()
+            self._jit_cache[key] = jitted
+
+        self._step_count += 1
+        self._opt.num_update = self._step_count
+        lr_host = (self._opt.lr_scheduler(self._step_count)
+                   if self._opt.lr_scheduler else self._opt.lr)
+        train_vals = tuple(self._params_by_name[n].data()._data
+                           for n in self._train_names)
+        aux_vals = tuple(self._params_by_name[n].data()._data
+                         for n in self._aux_names)
+        rng = _rng.next_key()
+
+        loss_val, new_vals, new_states, muts = jitted(
+            train_vals, tuple(self._states_raw), aux_vals, x, y, rng,
+            jnp.float32(lr_host), jnp.int32(self._step_count))
+
+        for name, val in zip(self._train_names, new_vals):
+            self._params_by_name[name]._data._set_data(val)
+        self._states_raw = list(new_states)
+        for name, val in zip(self._fwd.mut_names or (), muts):
+            self._params_by_name[name]._data._set_data(val)
+        return NDArray(loss_val)
+
+    def set_learning_rate(self, lr):
+        self._opt.set_learning_rate(lr)

@@ -63,9 +63,12 @@ def test_thumbnail_resnet_train_smoke():
                             {"learning_rate": 0.1})
     x = mx.nd.array(np.random.rand(4, 3, 32, 32).astype("float32"))
     y = mx.nd.array(np.array([0, 1, 2, 3], dtype="float32"))
-    for _ in range(2):
+    losses = []
+    for _ in range(12):
         with autograd.record():
             loss = loss_fn(net(x), y)
         loss.backward()
         trainer.step(4)
-    assert np.isfinite(loss.asnumpy()).all()
+        losses.append(float(loss.mean().asscalar()))
+    # loss must actually drop — finite-but-flat means broken grads
+    assert losses[-1] < losses[0] * 0.5, losses
