@@ -23,9 +23,10 @@ def main():
     from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
 
     batch = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
-    n_dev = jax.local_device_count()
-    # keep the per-chip metric honest: batch is per chip
+    # keep the per-chip metric honest: batch is per chip, and the device
+    # count matches the mesh the trainer actually spans
     devices = jax.devices()
+    n_dev = len(devices)
     mesh = make_mesh((n_dev,), ("data",), devices)
     global_batch = batch * n_dev
 

@@ -18,6 +18,11 @@ from . import base
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
 from . import autograd
 from . import random
 from . import initializer
@@ -27,6 +32,15 @@ from . import lr_scheduler
 from . import metric
 from . import kvstore
 from . import kvstore as kv
+from . import io
+from . import model
+from . import module
+from . import module as mod
+from . import callback
+from . import monitor
+from .monitor import Monitor
+from . import visualization
+from . import visualization as viz
 from . import gluon
 from . import parallel
 from . import test_utils

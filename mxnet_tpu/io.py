@@ -1,0 +1,401 @@
+"""Data iterators: the `mx.io` namespace.
+
+Reference: ``python/mxnet/io.py`` (DataIter ``:182``, DataBatch ``:118``,
+NDArrayIter ``:546``, MXDataIter ``:766`` wrapping the 8 C++ iterators
+registered in ``src/io/*.cc``).
+
+TPU-native design: iterators are plain Python producing host numpy batches;
+``jax`` overlaps the host→HBM transfer with compute via async dispatch (the
+reference needed a dedicated PrefetcherIter thread + pinned memory for the
+same overlap).  A thread-backed ``PrefetchingIter`` is still provided for
+expensive decode pipelines (the dmlc::ThreadedIter analogue).
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import threading
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, array as _nd_array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Name/shape/dtype/layout of one input (reference: io.py:DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, tuple(shape))
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One batch: lists of data/label arrays plus bookkeeping
+    (reference: io.py:118)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [getattr(d, "shape", None) for d in (self.data or [])]
+        return "DataBatch: data shapes %s" % (shapes,)
+
+
+class DataIter:
+    """Iterator base (reference: io.py:182)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data/label argument into list of (name, numpy) pairs."""
+    if data is None:
+        return []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise ValueError("empty data")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("cannot interpret data: %r" % type(data))
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, _np.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py:546).  Supports
+    shuffle, pad/discard/roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 shuffle_seed=None,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        if shuffle:
+            rng = _np.random.RandomState(shuffle_seed)
+            idx = rng.permutation(self.num_data)
+            self.data = [(k, v[idx]) for k, v in self.data]
+            self.label = [(k, v[idx]) for k, v in self.label]
+        self.last_batch_handle = last_batch_handle
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+        self.cursor = -1
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and self.cursor >= self.num_batches:
+            self.cursor = -1 - (self.num_batches * self.batch_size - self.num_data)
+        else:
+            self.cursor = -1
+
+    def iter_next(self):
+        self.cursor += 1
+        return self.cursor < self.num_batches
+
+    def _take(self, arrays):
+        start = self.cursor * self.batch_size
+        out = []
+        for _, v in arrays:
+            chunk = v[start:start + self.batch_size]
+            if chunk.shape[0] < self.batch_size:
+                # pad by wrapping (reference pads from the beginning)
+                pad = self.batch_size - chunk.shape[0]
+                chunk = _np.concatenate([chunk, v[:pad]], axis=0)
+            out.append(_nd_array(chunk, dtype=chunk.dtype))
+        return out
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = (self.cursor + 1) * self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+    def getindex(self):
+        start = self.cursor * self.batch_size
+        return _np.arange(start, start + self.batch_size) % self.num_data
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches per epoch
+    (reference: io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Producer-thread prefetch over one or more iterators (reference:
+    io.py PrefetchingIter / src/io/iter_prefetcher.h:47)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    def _start(self):
+        def run():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches)
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                     for d in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=self._queue.maxsize)
+        self._start()
+
+    def next(self):
+        batches = self._queue.get()
+        if batches is None:
+            raise StopIteration
+        b = batches[0]
+        if len(batches) > 1:
+            data = sum([list(x.data) for x in batches], [])
+            label = sum([list(x.label or []) for x in batches], [])
+            return DataBatch(data, label or None, pad=b.pad, index=b.index)
+        return b
+
+    def iter_next(self):
+        raise NotImplementedError("use next()")
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (reference: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = _np.loadtxt(data_csv, delimiter=",", ndmin=2, dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", ndmin=2,
+                                dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[-1] == 1:
+                label = label.reshape(label.shape[:-1])
+        else:
+            label = _np.zeros((data.shape[0],), dtype=_np.float32)
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="roll_over" if round_batch else "pad")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (reference: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 seed=0, silent=False, input_shape=None, **kwargs):
+        super().__init__(batch_size)
+        import gzip
+        import struct
+
+        def _open(path):
+            return gzip.open(path, "rb") if str(path).endswith(".gz") else \
+                open(path, "rb")
+
+        with _open(image) as f:
+            magic, n, h, w = struct.unpack(">IIII", f.read(16))
+            imgs = _np.frombuffer(f.read(), dtype=_np.uint8).reshape(n, h, w)
+        with _open(label) as f:
+            magic, n2 = struct.unpack(">II", f.read(8))
+            labs = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.float32)
+        imgs = imgs.astype(_np.float32) / 255.0
+        if flat or (input_shape and len(input_shape) == 1):
+            imgs = imgs.reshape(n, h * w)
+        else:
+            imgs = imgs.reshape(n, 1, h, w)
+        self._inner = NDArrayIter(imgs, labs, batch_size, shuffle=shuffle,
+                                  shuffle_seed=seed,
+                                  last_batch_handle="discard")
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()

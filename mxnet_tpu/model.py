@@ -1,0 +1,189 @@
+"""Legacy model API: checkpointing + FeedForward.
+
+Reference: ``python/mxnet/model.py`` — ``save_checkpoint:384`` /
+``load_checkpoint:414`` (prefix-symbol.json + prefix-####.params with
+arg:/aux: key prefixes), ``_create_kvstore:77`` (decides update_on_kvstore),
+``FeedForward:452`` (pre-Module training class, kept for script parity).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from . import ndarray as nd
+from . import symbol as sym
+from .base import MXNetError
+from .io import DataBatch, NDArrayIter
+from .ndarray import NDArray
+from .serialization import load_ndarrays, save_ndarrays
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params", "save_params",
+           "FeedForward", "BatchEndParam"]
+
+from .module.base_module import BatchEndParam  # noqa: F401  (re-export)
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Decide (kvstore, update_on_kvstore) — reference: model.py:77.
+
+    On TPU a single jitted program already aggregates gradients across the
+    mesh (GSPMD psum), so a kvstore is only created when explicitly
+    requested or when running multi-host."""
+    from . import kvstore as kvs
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(int(_np.prod(p.shape)) for p in
+                               arg_params.values()) if arg_params else 0
+                if max_size > 1024 * 1024:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def save_params(fname, arg_params, aux_params=None):
+    data = {"arg:%s" % k: v for k, v in (arg_params or {}).items()}
+    data.update({"aux:%s" % k: v for k, v in (aux_params or {}).items()})
+    save_ndarrays(fname, data)
+
+
+def load_params(fname):
+    loaded = load_ndarrays(fname)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write prefix-symbol.json + prefix-####.params
+    (reference: model.py:384)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_params("%s-%04d.params" % (prefix, epoch), arg_params, aux_params)
+    logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) — reference: model.py:414."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params("%s-%04d.params" % (prefix, epoch))
+    return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy training class (reference: model.py:452) — a thin veneer over
+    Module kept so pre-Module reference scripts run."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        if initializer is None:
+            from .initializer import Uniform
+            initializer = Uniform(0.01)
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    def _as_iter(self, X, y=None, batch_size=None, shuffle=False):
+        from .io import DataIter
+        if isinstance(X, DataIter):
+            return X
+        return NDArrayIter(X, y, batch_size or self.numpy_batch_size,
+                           shuffle=shuffle)
+
+    def _label_names(self, train_data):
+        if getattr(train_data, "provide_label", None):
+            return [d.name for d in train_data.provide_label]
+        return ["softmax_label"]
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        from .module.module import Module
+        train_data = self._as_iter(X, y, shuffle=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        mod = Module(self.symbol,
+                     data_names=[d.name for d in train_data.provide_data],
+                     label_names=self._label_names(train_data),
+                     context=self.ctx)
+        self._module = mod
+        opt_params = {k: v for k, v in self.kwargs.items()}
+        opt_params.setdefault("learning_rate", 0.01)
+        mod.fit(train_data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer, optimizer_params=opt_params,
+                initializer=self.initializer, arg_params=self.arg_params,
+                aux_params=self.aux_params, allow_missing=True,
+                begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._as_iter(X)
+        from .module.module import Module
+        if self._module is None:
+            mod = Module(self.symbol,
+                         data_names=[d.name for d in data.provide_data],
+                         label_names=None, context=self.ctx)
+            mod.bind(data.provide_data, for_training=False)
+            mod.init_params(arg_params=self.arg_params,
+                            aux_params=self.aux_params, allow_missing=False)
+            self._module = mod
+        out = self._module.predict(data, num_batch=num_batch, reset=reset)
+        if isinstance(out, list):
+            return [o.asnumpy() for o in out]
+        return out.asnumpy()
+
+    def score(self, X, eval_metric="acc", num_batch=None):
+        data = self._as_iter(X)
+        from .module.module import Module
+        mod = Module(self.symbol,
+                     data_names=[d.name for d in data.provide_data],
+                     label_names=self._label_names(data), context=self.ctx)
+        mod.bind(data.provide_data, data.provide_label, for_training=False)
+        mod.init_params(arg_params=self.arg_params, aux_params=self.aux_params)
+        res = mod.score(data, eval_metric, num_batch=num_batch)
+        return res[0][1]

@@ -1,0 +1,8 @@
+"""`mx.mod` — the Module training API (reference: python/mxnet/module/)."""
+from .base_module import BaseModule, BatchEndParam
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule", "SequentialModule",
+           "BatchEndParam"]

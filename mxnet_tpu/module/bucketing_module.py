@@ -1,0 +1,170 @@
+"""BucketingModule: variable-length sequence training via per-bucket
+executors sharing parameters.
+
+Reference: ``python/mxnet/module/bucketing_module.py`` — one Module per
+bucket key, memory shared with the largest bucket; used by the RNN/speech
+examples (``stt_bucketing_module.py``) and ``docs/faq/bucketing.md``.
+
+TPU-native: each bucket is a separate jit specialization (XLA compiles per
+shape and caches), while parameter NDArrays are *shared handles* across
+bucket Modules — so there is no copying on bucket switch, exactly like the
+reference's shared memory pool but without the manual pooling.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        if default_bucket_key is None:
+            raise ValueError("default_bucket_key must be given")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._state_names = state_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._params_dirty = False
+
+    @property
+    def symbol(self):
+        return self._curr_module._symbol if self._curr_module else None
+
+    @property
+    def data_names(self):
+        return self._curr_module.data_names
+
+    @property
+    def output_names(self):
+        return self._curr_module.output_names
+
+    @property
+    def data_shapes(self):
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._curr_module.output_shapes
+
+    # ------------------------------------------------------------------
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names, label_names, logger=self.logger,
+                      context=self._context,
+                      fixed_param_names=self._fixed_param_names,
+                      state_names=self._state_names)
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        if bucket_key not in self._buckets:
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes,
+                        for_training=self.for_training,
+                        shared_module=self._buckets.get(
+                            self._default_bucket_key))
+            if self._buckets.get(self._default_bucket_key) is not None and \
+                    self._buckets[self._default_bucket_key].params_initialized:
+                module.params_initialized = True
+                opt_mod = self._buckets[self._default_bucket_key]
+                if opt_mod.optimizer_initialized:
+                    module._optimizer = opt_mod._optimizer
+                    module._updater = opt_mod._updater
+                    module._kvstore = opt_mod._kvstore
+                    module._update_on_kvstore = opt_mod._update_on_kvstore
+                    module.optimizer_initialized = True
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.switch_bucket(self._default_bucket_key, data_shapes, label_shapes)
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        self._buckets[self._default_bucket_key].init_params(
+            initializer=initializer, arg_params=arg_params,
+            aux_params=aux_params, allow_missing=allow_missing,
+            force_init=force_init, allow_extra=allow_extra)
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key:
+                mod.params_initialized = True
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._buckets[self._default_bucket_key].init_optimizer(
+            kvstore=kvstore, optimizer=optimizer,
+            optimizer_params=optimizer_params, force_init=force_init)
+        default = self._buckets[self._default_bucket_key]
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key:
+                mod._optimizer = default._optimizer
+                mod._updater = default._updater
+                mod._kvstore = default._kvstore
+                mod._update_on_kvstore = default._update_on_kvstore
+                mod.optimizer_initialized = True
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        data_shapes = data_batch.provide_data or \
+            [(n, a.shape) for n, a in zip(
+                self._buckets[self._default_bucket_key].data_names,
+                data_batch.data)]
+        label_shapes = data_batch.provide_label
+        if label_shapes is None and data_batch.label:
+            label_shapes = [(n, a.shape) for n, a in zip(
+                self._buckets[self._default_bucket_key].label_names,
+                data_batch.label)]
+        self.switch_bucket(data_batch.bucket_key, data_shapes, label_shapes)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._buckets[self._default_bucket_key].save_checkpoint(
+            prefix, epoch, save_optimizer_states)

@@ -1,0 +1,304 @@
+"""Module: symbol + executor + optimizer, the workhorse training API.
+
+Reference: ``python/mxnet/module/module.py`` (bind ``:364``,
+init_optimizer ``:473``, update ``:643``) over DataParallelExecutorGroup
+(``executor_group.py:143``).
+
+TPU-native: one Executor spanning all requested devices — binding over a
+context *list* builds a jax Mesh and GSPMD shards the batch across it, so
+the executor-group/KVStore-'device' machinery of the reference collapses
+into compiler-inserted ICI collectives.  The KVStore path is kept for
+``update_on_kvstore`` semantics (server-side optimizer parity) and for
+multi-host (`dist_*`) training.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import cpu
+from ..executor import Executor
+from ..initializer import InitDesc
+from ..io import DataDesc
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names) if data_names else []
+        self._label_names = list(label_names) if label_names else []
+        self._context = context if context is not None else cpu()
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + self._state_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+        self._exec = None
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._updater = None
+        self._preload_opt_states = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._monitor = None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create from a saved checkpoint (reference: module.py Module.load)."""
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params_cache = (args, auxs)
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        if not self.binded:
+            raise MXNetError("module not bound")
+        return list(zip(self._output_names,
+                        [o.shape for o in self._exec.outputs]))
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                       for d in data_shapes]
+        label_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                        for d in (label_shapes or [])]
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+
+        shapes = {d.name: d.shape for d in data_shapes + label_shapes}
+        req = {}
+        for n in self._symbol.list_arguments():
+            if n in self._param_names and n not in self._fixed_param_names:
+                req[n] = grad_req if for_training else "null"
+            elif inputs_need_grad and n in self._data_names:
+                req[n] = grad_req
+            else:
+                req[n] = "null"
+        type_dict = {d.name: d.dtype for d in data_shapes + label_shapes}
+        self._exec = Executor.simple_bind(
+            self._symbol, self._context, grad_req=req, type_dict=type_dict,
+            shapes=shapes,
+            data_names=self._data_names + self._label_names + self._state_names)
+        if shared_module is not None and shared_module._exec is not None:
+            # share parameter arrays (BucketingModule memory sharing)
+            for n in self._param_names:
+                if n in shared_module._exec.arg_dict:
+                    self._exec.arg_dict[n] = shared_module._exec.arg_dict[n]
+            for n in self._aux_names:
+                if n in shared_module._exec.aux_dict:
+                    self._exec.aux_dict[n] = shared_module._exec.aux_dict[n]
+        self.binded = True
+        cached = getattr(self, "_arg_params_cache", None)
+        if cached is not None:
+            self.set_params(*cached)
+            self._arg_params_cache = None
+
+    # ------------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        attr_dict = self._symbol.attr_dict()
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                src = arg_params[name]
+                arr._set_data(nd.array(src.asnumpy() if isinstance(src, NDArray)
+                                       else src)._data.astype(arr.dtype))
+            elif initializer is not None:
+                desc = InitDesc(name, attr_dict.get(name))
+                initializer(desc, arr)
+            elif not allow_missing and arg_params is not None:
+                raise MXNetError("missing parameter %r" % name)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                src = aux_params[name]
+                arr._set_data(nd.array(src.asnumpy() if isinstance(src, NDArray)
+                                       else src)._data.astype(arr.dtype))
+            elif initializer is not None:
+                desc = InitDesc(name, attr_dict.get(name))
+                initializer(desc, arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        if not self.binded:
+            raise MXNetError("module not bound")
+        arg_params = {n: self._exec.arg_dict[n].copy()
+                      for n in self._param_names}
+        aux_params = {n: self._exec.aux_dict[n].copy()
+                      for n in self._aux_names}
+        return arg_params, aux_params
+
+    # ------------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if not self.params_initialized:
+            raise MXNetError("init_params before init_optimizer")
+        from ..model import _create_kvstore
+        kvstore, update_on_kvstore = _create_kvstore(
+            kvstore, 1, {n: self._exec.arg_dict[n] for n in self._param_names})
+        if isinstance(optimizer, str):
+            batch_size = self._data_shapes[0].shape[0] if self._data_shapes \
+                else 1
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            # reference module.py:497 — default grad rescale by batch size
+            optimizer_params.setdefault("rescale_grad", 1.0 / max(batch_size, 1))
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore and kvstore is not None
+        if kvstore is not None:
+            # init kv with parameter arrays keyed by index
+            for i, n in enumerate(self._param_names):
+                kvstore.init(i, self._exec.arg_dict[n])
+            if self._update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+        if not self._update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for desc, arr in zip(self._data_names, data_batch.data):
+            feeds[desc] = arr
+        if self._label_names and data_batch.label is not None:
+            for desc, arr in zip(self._label_names, data_batch.label):
+                feeds[desc] = arr
+        # shape change (last batch / bucketing) → jit recompile is cached
+        self._exec.forward(is_train=is_train, **feeds)
+        if self._monitor is not None:
+            self._monitor.observe(self)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply gradients (reference: module.py:643 →
+        model.py _update_params(_on_kvstore))."""
+        if not self.optimizer_initialized:
+            raise MXNetError("init_optimizer before update")
+        if self._kvstore is not None:
+            for i, n in enumerate(self._param_names):
+                g = self._exec.grad_dict.get(n)
+                if g is None:
+                    continue
+                self._kvstore.push(i, g)
+                if self._update_on_kvstore:
+                    self._kvstore.pull(i, self._exec.arg_dict[n])
+                else:
+                    self._kvstore.pull(i, g)
+            if self._update_on_kvstore:
+                return
+        for i, n in enumerate(self._param_names):
+            g = self._exec.grad_dict.get(n)
+            if g is None:
+                continue
+            self._updater(i, g, self._exec.arg_dict[n])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True")
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # ------------------------------------------------------------------
+    def save_optimizer_states(self, fname):
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def install_monitor(self, mon):
+        self._monitor = mon
+        mon.install(self)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                             for d in data_shapes]
+        if label_shapes is not None:
+            self._label_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                                  for d in label_shapes]
+        # jit recompiles per shape automatically; nothing to do eagerly

@@ -1,0 +1,124 @@
+"""SequentialModule: chain modules, feeding outputs to the next
+(reference: python/mxnet/module/sequential_module.py)."""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..io import DataDesc
+from .base_module import BaseModule
+
+
+class SequentialModule(BaseModule):
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+
+    def add(self, module, **kwargs):
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        assert shared_module is None
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._label_shapes = label_shapes
+        cur_shapes = data_shapes
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            need_labels = meta.get(self.META_TAKE_LABELS, False)
+            lbl = label_shapes if need_labels else None
+            grad = True if i > 0 else inputs_need_grad
+            module.bind(cur_shapes, lbl, for_training=for_training,
+                        inputs_need_grad=grad, force_rebind=force_rebind,
+                        grad_req=grad_req)
+            if meta.get(self.META_AUTO_WIRING, True) and \
+                    i + 1 < len(self._modules):
+                nxt = self._modules[i + 1].data_names
+                cur_shapes = [DataDesc(n, s) for n, s in
+                              zip(nxt, [o[1] for o in module.output_shapes])]
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        for module in self._modules:
+            module.init_params(initializer=initializer, arg_params=arg_params,
+                               aux_params=aux_params, allow_missing=True,
+                               force_init=force_init, allow_extra=True)
+        self.params_initialized = True
+
+    def get_params(self):
+        arg_params, aux_params = {}, {}
+        for module in self._modules:
+            a, x = module.get_params()
+            arg_params.update(a)
+            aux_params.update(x)
+        return arg_params, aux_params
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        from ..io import DataBatch
+        batch = data_batch
+        for i, (module, meta) in enumerate(zip(self._modules, self._metas)):
+            module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._modules):
+                break
+            outs = module.get_outputs()
+            label = data_batch.label if \
+                self._metas[i + 1].get(self.META_TAKE_LABELS, False) else None
+            batch = DataBatch(outs, label, pad=data_batch.pad,
+                              index=data_batch.index)
+
+    def backward(self, out_grads=None):
+        for i in range(len(self._modules) - 1, -1, -1):
+            module = self._modules[i]
+            module.backward(out_grads=out_grads)
+            if i == 0:
+                break
+            out_grads = module.get_input_grads()
+
+    def update(self):
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        for module, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for module in self._modules:
+            module.install_monitor(mon)

@@ -1,0 +1,76 @@
+"""Monitor: per-op output statistics during training.
+
+Reference: ``python/mxnet/monitor.py`` — taps every op output via
+MXExecutorSetMonitorCallback (c_api.h:1720).  TPU-native: a monitored
+module evaluates the symbol's *internals* group on demand (one extra jitted
+program that returns every intermediate) — no executor hook needed, and
+XLA dead-code-eliminates it when not installed.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as _np
+
+from .ndarray import NDArray
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return _np.abs(x).mean()
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, module):
+        """Attach to a module (reference installs a C callback on the
+        executor; here the module calls ``observe`` after each forward)."""
+        self.exes.append(module)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def observe(self, module):
+        if not self.activated:
+            return
+        exe = module._exec
+        internals = module._symbol.get_internals()
+        names = internals.list_outputs()
+        from .symbol.symbol import make_graph_fn
+        from . import _rng
+        import jax
+        fn = jax.jit(make_graph_fn(internals, train=False))
+        arg_vals = {n: a._data for n, a in exe.arg_dict.items()}
+        aux_vals = {n: a._data for n, a in exe.aux_dict.items()}
+        outs, _ = fn(arg_vals, aux_vals, _rng.next_key())
+        for name, value in zip(names, outs):
+            if self.re_prog.match(name):
+                self.queue.append((self.step, name,
+                                   self.stat_func(_np.asarray(value))))
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = [(n, k, str(v)) for n, k, v in self.queue]
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

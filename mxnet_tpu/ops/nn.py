@@ -336,7 +336,10 @@ def softmax_activation(data, mode="instance"):
 
 
 # -- output heads with custom backward semantics ---------------------------
-@jax.custom_vjp
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
 def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
                          multi_output, normalization_valid, smooth_alpha):
     axis = 1 if multi_output else -1
@@ -347,13 +350,12 @@ def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
                         multi_output, normalization_valid, smooth_alpha):
     out = _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
                                multi_output, normalization_valid, smooth_alpha)
-    return out, (out, label, grad_scale, ignore_label, use_ignore, multi_output,
-                 normalization_valid, smooth_alpha)
+    return out, (out, label)
 
 
-def _softmax_output_bwd(res, g):
-    (out, label, grad_scale, ignore_label, use_ignore, multi_output,
-     normalization_valid, smooth_alpha) = res
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, multi_output,
+                        normalization_valid, smooth_alpha, res, g):
+    out, label = res
     axis = 1 if multi_output else -1
     nclass = out.shape[axis]
     lab = label.astype(jnp.int32)
@@ -370,9 +372,7 @@ def _softmax_output_bwd(res, g):
     elif normalization_valid:
         scale = scale / lab.size * out.shape[0]  # 'valid' == batch when no ignore
     grad = grad * scale
-    if out.ndim > 2 and not multi_output:
-        pass
-    return (grad, jnp.zeros_like(label), None, None, None, None, None, None)
+    return (grad, jnp.zeros_like(label))
 
 
 _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
@@ -390,18 +390,18 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
 
 def _regression_output(transform, grad_fn):
-    @jax.custom_vjp
+    @_partial(jax.custom_vjp, nondiff_argnums=(2,))
     def core(data, label, grad_scale):
         return transform(data)
 
     def fwd(data, label, grad_scale):
-        return core(data, label, grad_scale), (transform(data), label, grad_scale)
+        return core(data, label, grad_scale), (transform(data), label)
 
-    def bwd(res, g):
-        out, label, grad_scale = res
+    def bwd(grad_scale, res, g):
+        out, label = res
         num_out = out.size // out.shape[0]
         grad = grad_fn(out, label.reshape(out.shape)) * grad_scale / num_out
-        return grad, jnp.zeros_like(label), None
+        return grad, jnp.zeros_like(label)
 
     core.defvjp(fwd, bwd)
     return core
@@ -427,18 +427,17 @@ def logistic_regression_output(data, label, grad_scale=1.0):
     return _logistic_reg(data, label, grad_scale)
 
 
-@jax.custom_vjp
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _make_loss_core(data, grad_scale):
     return data
 
 
 def _make_loss_fwd(data, grad_scale):
-    return data, (data.shape, data.dtype, grad_scale)
+    return data, None
 
 
-def _make_loss_bwd(res, g):
-    shape, dtype, grad_scale = res
-    return jnp.full(shape, grad_scale, dtype), None
+def _make_loss_bwd(grad_scale, res, g):
+    return (jnp.full(g.shape, grad_scale, g.dtype),)
 
 
 _make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
@@ -611,17 +610,17 @@ def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
     return _svm_core(data, label, margin, regularization_coefficient, use_linear)
 
 
-@jax.custom_vjp
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def _svm_core(data, label, margin, reg, use_linear):
     return data
 
 
 def _svm_fwd(data, label, margin, reg, use_linear):
-    return data, (data, label, margin, reg, use_linear)
+    return data, (data, label)
 
 
-def _svm_bwd(res, g):
-    data, label, margin, reg, use_linear = res
+def _svm_bwd(margin, reg, use_linear, res, g):
+    data, label = res
     lab = label.astype(jnp.int32)
     onehot = jax.nn.one_hot(lab, data.shape[-1], dtype=data.dtype)
     score_y = jnp.take_along_axis(data, lab[:, None], axis=-1)
@@ -632,7 +631,7 @@ def _svm_bwd(res, g):
         m = data - score_y + margin
         grad = 2 * jnp.maximum(m, 0) * (1 - onehot)
         grad = grad - onehot * jnp.sum(grad, axis=-1, keepdims=True)
-    return grad * reg, jnp.zeros_like(label), None, None, None
+    return grad * reg, jnp.zeros_like(label)
 
 
 _svm_core.defvjp(_svm_fwd, _svm_bwd)

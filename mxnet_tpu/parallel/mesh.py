@@ -48,6 +48,6 @@ def replicated(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
-def batch_sharded(mesh, axis="data", ndim=None):
+def batch_sharded(mesh, axis="data"):
     """Sharding for a batch tensor: leading dim split on ``axis``."""
     return NamedSharding(mesh, PartitionSpec(axis))

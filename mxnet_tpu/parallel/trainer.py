@@ -54,7 +54,7 @@ class DataParallelTrainer:
                                                 PartitionSpec())
         self._data_axis = data_axis
         self._ready = False
-        self._jit_cache = {}
+        self._step_fn = None
         self._step_count = 0
 
     # -- setup -------------------------------------------------------------
@@ -143,11 +143,10 @@ class DataParallelTrainer:
         x = jax.device_put(x, batch_sh)
         y = jax.device_put(y, batch_sh)
 
-        key = (tuple(x.shape), str(x.dtype), tuple(y.shape), str(y.dtype))
-        jitted = self._jit_cache.get(key)
-        if jitted is None:
-            jitted = self._build_step()
-            self._jit_cache[key] = jitted
+        # jax.jit itself retraces and caches per input shape/dtype
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        jitted = self._step_fn
 
         self._step_count += 1
         self._opt.num_update = self._step_count

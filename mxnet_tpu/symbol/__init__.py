@@ -1,0 +1,67 @@
+"""`mx.sym` — symbolic graph composition namespace.
+
+The op namespace is code-generated from the same registry as `mx.nd`
+(reference: python/mxnet/symbol/register.py), so every imperative op has a
+symbolic twin.
+"""
+from __future__ import annotations
+
+import sys as _sys
+import types as _types
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     AttrScope, NameManager, _sym_invoke)
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+def _make_sym_func(op, name):
+    def fn(*args, **kwargs):
+        return _sym_invoke(op, name, args, kwargs)
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = op.doc or ("%s symbol (TPU-native)." % name)
+    return fn
+
+
+_internal = _types.ModuleType(__name__ + "._internal")
+contrib = _types.ModuleType(__name__ + ".contrib")
+linalg = _types.ModuleType(__name__ + ".linalg")
+random = _types.ModuleType(__name__ + ".random")
+_this = _sys.modules[__name__]
+
+for _name in _reg.list_ops():
+    _op = _reg.get(_name)
+    _f = _make_sym_func(_op, _name)
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):], _f)
+    elif _name.startswith("_linalg_"):
+        setattr(linalg, _name[len("_linalg_"):], _f)
+    elif _name.startswith("_random_"):
+        setattr(random, _name[len("_random_"):], _f)
+    if _name.startswith("_"):
+        setattr(_internal, _name, _f)
+    elif not hasattr(_this, _name):
+        setattr(_this, _name, _f)
+    if not hasattr(_internal, _name):
+        setattr(_internal, _name, _f)
+
+_sys.modules[__name__ + "._internal"] = _internal
+_sys.modules[__name__ + ".contrib"] = contrib
+_sys.modules[__name__ + ".linalg"] = linalg
+_sys.modules[__name__ + ".random"] = random
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return getattr(_internal, "_zeros")(shape=shape, dtype=dtype or "float32", **kwargs)
+
+
+def ones(shape, dtype=None, **kwargs):
+    return getattr(_internal, "_ones")(shape=shape, dtype=dtype or "float32", **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    return getattr(_internal, "_arange")(start=start, stop=stop, step=step,
+                                         repeat=repeat,
+                                         dtype=dtype or "float32", **kwargs)

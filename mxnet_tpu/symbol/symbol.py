@@ -1,0 +1,810 @@
+"""Symbol: declarative graph composition, the TPU-native `mx.sym`.
+
+Reference: ``python/mxnet/symbol/symbol.py`` (3.9k LoC) over the nnvm graph
+IR.  The reference Symbol is a handle to an nnvm node DAG; binding runs the
+GraphExecutor (``src/executor/graph_executor.cc:514``) which builds the
+backward graph, plans memory and attaches engine ops.  Here the DAG is a
+tiny Python node list evaluated as a pure jax function — ``jax.jit`` is the
+memory planner/executor, ``jax.vjp`` is the ``pass::Gradient`` analogue and
+``jax.eval_shape`` replaces the shape/type fixpoint passes
+(``src/executor/infer_graph_attr_pass.cc``).
+
+JSON serialization keeps the reference's on-disk schema
+(nodes/arg_nodes/heads, ``save``/``load``) so checkpoints remain
+tool-compatible.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+# ---------------------------------------------------------------------------
+# Name manager: default names conv0, conv1, ... per op family
+# (reference: python/mxnet/name.py NameManager)
+# ---------------------------------------------------------------------------
+class NameManager:
+    _current = None
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        hint = hint.lower().lstrip("_")
+        i = self._counter.get(hint, 0)
+        self._counter[hint] = i + 1
+        return "%s%d" % (hint, i)
+
+
+NameManager._current = NameManager()
+
+
+class AttrScope:
+    """Scoped symbol attributes; carries ``ctx_group`` / ``__layout__`` etc.
+    (reference: python/mxnet/attribute.py — used for group2ctx model
+    parallelism; here ctx_group maps to sharding annotations)."""
+    _current = None
+
+    def __init__(self, **attrs):
+        self._attrs = {k: str(v) for k, v in attrs.items()}
+        self._old = None
+
+    def get(self, user_attrs):
+        out = dict(self._attrs)
+        if user_attrs:
+            out.update(user_attrs)
+        return out
+
+    def __enter__(self):
+        self._old = AttrScope._current
+        merged = dict(self._old._attrs) if self._old else {}
+        merged.update(self._attrs)
+        self._attrs = merged
+        AttrScope._current = self
+        return self
+
+    def __exit__(self, *a):
+        AttrScope._current = self._old
+
+
+AttrScope._current = AttrScope()
+
+
+class _Node:
+    """One graph node.  ``op is None`` → variable (nnvm "null" op)."""
+    __slots__ = ("op", "name", "attrs", "inputs", "_is_aux")
+
+    def __init__(self, op, name, attrs=None, inputs=(), is_aux=False):
+        self.op = op
+        self.name = name
+        self.attrs = dict(attrs) if attrs else {}
+        self.inputs = list(inputs)   # list of (node, out_index)
+        self._is_aux = is_aux
+
+    def __repr__(self):
+        return "_Node(%s, %s)" % (self.op or "null", self.name)
+
+
+def _topo(heads):
+    """Post-order DFS over (node) from head entries."""
+    seen = set()
+    order = []
+    stack = [e[0] for e in heads]
+    path = []
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        # iterative post-order
+        path.append((node, False))
+        while path:
+            n, expanded = path.pop()
+            if id(n) in seen:
+                continue
+            if expanded:
+                seen.add(id(n))
+                order.append(n)
+            else:
+                path.append((n, True))
+                for (child, _) in reversed(n.inputs):
+                    if id(child) not in seen:
+                        path.append((child, False))
+    return order
+
+
+# Shape rules for ops whose parameter shapes must be inferred from the data
+# shape (the reference runs a bidirectional fixpoint; forward + these local
+# rules covers every bind scenario in practice).
+def _conv_param_shapes(attrs, dshape):
+    kernel = attrs.get("kernel", ())
+    num_filter = int(attrs.get("num_filter"))
+    num_group = int(attrs.get("num_group", 1))
+    w = (num_filter, dshape[1] // num_group) + tuple(kernel)
+    shapes = {"weight": w}
+    if not attrs.get("no_bias", False):
+        shapes["bias"] = (num_filter,)
+    return shapes
+
+
+def _deconv_param_shapes(attrs, dshape):
+    kernel = attrs.get("kernel", ())
+    num_filter = int(attrs.get("num_filter"))
+    num_group = int(attrs.get("num_group", 1))
+    w = (dshape[1], num_filter // num_group) + tuple(kernel)
+    shapes = {"weight": w}
+    if not attrs.get("no_bias", True):
+        shapes["bias"] = (num_filter,)
+    return shapes
+
+
+def _fc_param_shapes(attrs, dshape):
+    num_hidden = int(attrs.get("num_hidden"))
+    flatten = attrs.get("flatten", True)
+    in_dim = 1
+    if flatten:
+        for d in dshape[1:]:
+            in_dim *= d
+    else:
+        in_dim = dshape[-1]
+    shapes = {"weight": (num_hidden, in_dim)}
+    if not attrs.get("no_bias", False):
+        shapes["bias"] = (num_hidden,)
+    return shapes
+
+
+def _bn_param_shapes(attrs, dshape):
+    axis = int(attrs.get("axis", 1))
+    c = dshape[axis]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _in_param_shapes(attrs, dshape):
+    c = dshape[1]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _ln_param_shapes(attrs, dshape):
+    axis = int(attrs.get("axis", -1))
+    c = dshape[axis]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _embed_param_shapes(attrs, dshape):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+def _rnn_param_shapes(attrs, dshape):
+    # dshape: (seq_len, batch, input_size); single flat parameter vector,
+    # layout matching ops/rnn.py pack order (reference: rnn-inl.h:49).
+    from ..ops import rnn as _rnn_ops
+    return {"parameters": (_rnn_ops.rnn_param_size(
+        int(attrs["state_size"]), dshape[2], int(attrs.get("num_layers", 1)),
+        attrs.get("mode", "lstm"), attrs.get("bidirectional", False)),),
+        "state": _rnn_ops.rnn_state_shape(attrs, dshape),
+        "state_cell": _rnn_ops.rnn_state_shape(attrs, dshape)}
+
+
+# label-shape rules: MXNet's bidirectional fixpoint infers label shapes from
+# the data input of output heads; these local rules cover that direction.
+def _softmax_label_shape(attrs, dshape):
+    if _reg.canonicalize(attrs.get("multi_output", False)):
+        return (dshape[0],) + tuple(dshape[2:])
+    return tuple(dshape[:-1])
+
+
+_LABEL_SHAPE_RULES = {
+    "SoftmaxOutput": _softmax_label_shape,
+    "SVMOutput": lambda attrs, d: (d[0],),
+    "LinearRegressionOutput": lambda attrs, d: tuple(d),
+    "MAERegressionOutput": lambda attrs, d: tuple(d),
+    "LogisticRegressionOutput": lambda attrs, d: tuple(d),
+}
+
+_PARAM_SHAPE_RULES = {
+    "Convolution": _conv_param_shapes,
+    "Deconvolution": _deconv_param_shapes,
+    "FullyConnected": _fc_param_shapes,
+    "BatchNorm": _bn_param_shapes,
+    "InstanceNorm": _in_param_shapes,
+    "LayerNorm": _ln_param_shapes,
+    "Embedding": _embed_param_shapes,
+    "RNN": _rnn_param_shapes,
+}
+
+
+class Symbol:
+    """Immutable handle to a list of output entries of a graph."""
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def _nodes(self):
+        return _topo(self._outputs)
+
+    def list_arguments(self):
+        return [n.name for n in self._nodes() if n.op is None and not n._is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._nodes() if n.op is None and n._is_aux]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.op is None:
+                out.append(node.name)
+                continue
+            op = _reg.get(node.op)
+            n = op.n_outputs(_attr_params(op, node.attrs))
+            out.append("%s_output" % node.name if n == 1
+                       else "%s_output%d" % (node.name, idx))
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._nodes() if n.op is None]
+
+    def get_internals(self):
+        entries = []
+        for n in self._nodes():
+            if n.op is None:
+                entries.append((n, 0))
+            else:
+                op = _reg.get(n.op)
+                for i in range(op.n_outputs(_attr_params(op, n.attrs))):
+                    entries.append((n, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        kids = []
+        for node, _ in self._outputs:
+            kids.extend(node.inputs)
+        return Symbol(kids) if kids else None
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            matches = [i for i, n in enumerate(names)
+                       if n == index or n.rsplit("_output", 1)[0] == index]
+            if len(matches) != 1:
+                raise ValueError("cannot resolve output %r (candidates %r)"
+                                 % (index, names))
+            index = matches[0]
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (Symbol([e]) for e in self._outputs)
+
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].attrs.get(key)
+        return None
+
+    def attr_dict(self):
+        out = {}
+        for n in self._nodes():
+            if n.attrs:
+                out[n.name] = {k: str(v) for k, v in n.attrs.items()
+                               if not k.startswith("__param")}
+        return out
+
+    def _set_attr(self, **kwargs):
+        for n in self._outputs:
+            n[0].attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else
+                                ", ".join(self.list_outputs()))
+
+    # -- composition -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: replace free variables of this symbol with other symbols
+        (reference: symbol.py Symbol.__call__/_compose)."""
+        if args:
+            raise TypeError("composition supports keyword arguments only")
+        mapping = {}
+        for name, s in kwargs.items():
+            if not isinstance(s, Symbol):
+                raise TypeError("can only compose with Symbols")
+            mapping[name] = s._outputs[0]
+        memo = {}
+
+        def clone(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            if node.op is None and node.name in mapping:
+                sub = mapping[node.name][0]
+                memo[id(node)] = sub
+                return sub
+            new = _Node(node.op, node.name, node.attrs,
+                        [(clone(c), i) for c, i in node.inputs], node._is_aux)
+            memo[id(node)] = new
+            return new
+
+        return Symbol([(clone(n), i) for n, i in self._outputs])
+
+    # -- arithmetic sugar --------------------------------------------------
+    def __add__(self, o):
+        return _binary(self, o, "_plus", "_plus_scalar")
+
+    def __radd__(self, o):
+        return self.__add__(o)
+
+    def __sub__(self, o):
+        return _binary(self, o, "_minus", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return _binary(self, o, None, "_rminus_scalar")
+
+    def __mul__(self, o):
+        return _binary(self, o, "_mul", "_mul_scalar")
+
+    def __rmul__(self, o):
+        return self.__mul__(o)
+
+    def __truediv__(self, o):
+        return _binary(self, o, "_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return _binary(self, o, None, "_rdiv_scalar")
+
+    def __pow__(self, o):
+        return _binary(self, o, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("_neg", [self], {}, None)
+
+    def __eq__(self, o):  # noqa: matching reference semantics
+        if isinstance(o, (Symbol, int, float)):
+            return _binary(self, o, "_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            return _binary(self, o, "_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, o):
+        return _binary(self, o, "_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return _binary(self, o, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return _binary(self, o, "_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return _binary(self, o, "_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __getattr__(self, name):
+        # method-style op calls: sym.reshape(...), sym.sum(...) — resolved
+        # from the registry like the reference's generated methods.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if _reg.exists(name):
+            def method(*args, **kwargs):
+                return _sym_invoke(_reg.get(name), name, (self,) + args, kwargs)
+            return method
+        raise AttributeError("Symbol has no attribute %r" % name)
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(
+            False, *args, **kwargs)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        if args:
+            kwargs = dict(zip(self.list_arguments(), args), **kwargs)
+        known = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+        shapes, ok = _infer_entry_shapes(self._outputs, known, {})
+        arg_shapes, aux_shapes = [], []
+        for n in self._nodes():
+            if n.op is None:
+                s = shapes.get((id(n), 0))
+                s = tuple(s.shape) if s is not None else None
+                (aux_shapes if n._is_aux else arg_shapes).append(s)
+        out_shapes = []
+        for e in self._outputs:
+            s = shapes.get((id(e[0]), e[1]))
+            out_shapes.append(tuple(s.shape) if s is not None else None)
+        if not ok and not partial:
+            return None, None, None
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Dtype propagation: result_type promotion per node, Cast/argmax
+        overriding (the reference runs an nnvm fixpoint; promotion matches
+        its rules for every registered op)."""
+        if args:
+            kwargs = dict(zip(self.list_arguments(), args), **kwargs)
+        dtypes = {k: _np.dtype(np_dtype(v)) for k, v in kwargs.items()
+                  if v is not None}
+        _INT_OUT = {"argmax", "argmin", "argsort", "topk", "one_hot",
+                    "shape_array", "size_array"}
+        env = {}
+        for n in self._nodes():
+            if n.op is None:
+                dt = dtypes.get(n.name)
+                if dt is None and "__dtype__" in n.attrs:
+                    dt = _np.dtype(n.attrs["__dtype__"])
+                env[id(n)] = dt if dt is not None else _np.dtype(_np.float32)
+                continue
+            if n.op == "Cast" or n.op == "cast":
+                env[id(n)] = _np.dtype(np_dtype(
+                    _reg.canonicalize(n.attrs.get("dtype", "float32"))))
+                continue
+            ins = [env.get(id(c)) for c, _ in n.inputs]
+            ins = [d for d in ins if d is not None]
+            env[id(n)] = _np.dtype(_np.result_type(*ins)) if ins else \
+                _np.dtype(_np.float32)
+        args_t, aux_t = [], []
+        for n in self._nodes():
+            if n.op is None:
+                (aux_t if n._is_aux else args_t).append(env.get(id(n)))
+        outs_t = [env.get(id(e[0])) for e in self._outputs]
+        return args_t, outs_t, aux_t
+
+    # -- serialization (reference JSON schema) ----------------------------
+    def tojson(self):
+        nodes = self._nodes()
+        index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes, arg_nodes = [], []
+        for i, n in enumerate(nodes):
+            if n.op is None:
+                arg_nodes.append(i)
+            jnodes.append({
+                "op": n.op if n.op else "null",
+                "name": n.name,
+                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "inputs": [[index[id(c)], oi, 0] for c, oi in n.inputs],
+            })
+        heads = [[index[id(n)], oi, 0] for n, oi in self._outputs]
+        return json.dumps({
+            "nodes": jnodes, "arg_nodes": arg_nodes, "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10300],
+                      "framework": ["str", "mxnet_tpu"]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- evaluation / binding ---------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor(self, ctx, args=args, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    shared_arg_names=None, shared_exec=None,
+                    shared_buffer=None, **kwargs):
+        """Allocate argument/grad/aux arrays from inferred shapes and bind
+        (reference: symbol.py:1289 → MXExecutorSimpleBindEx)."""
+        from ..executor import Executor
+        return Executor.simple_bind(self, ctx, grad_req=grad_req,
+                                    type_dict=type_dict, shapes=kwargs)
+
+    # gradient of this symbol's outputs — handled inside Executor via vjp
+    def grad(self, wrt):
+        raise NotImplementedError(
+            "symbolic grad graphs are implicit: bind() compiles the vjp")
+
+
+# ---------------------------------------------------------------------------
+# shape/type propagation over the DAG using jax.eval_shape per node
+# ---------------------------------------------------------------------------
+def _attr_params(op, attrs):
+    params = {k: _reg.canonicalize(v) for k, v in attrs.items()
+              if not k.startswith("__")}
+    if op is not None and op.needs_train:
+        params["_train"] = False
+    return params
+
+
+def _infer_entry_shapes(heads, known_shapes, known_dtypes, need_shapes=True):
+    """Forward shape/dtype propagation.  Returns ({(node_id,out_idx):
+    ShapeDtypeStruct}, fully_known)."""
+    shapes = {}
+    ok = True
+    order = _topo(heads)
+    node_by_name = {n.name: n for n in order if n.op is None}
+    for n in order:
+        if n.op is None:
+            shp = known_shapes.get(n.name)
+            dt = known_dtypes.get(n.name, _np.float32)
+            if shp is None and "__shape__" in n.attrs:
+                shp = tuple(_reg.canonicalize(n.attrs["__shape__"]))
+            if shp is None and need_shapes:
+                continue
+            shapes[(id(n), 0)] = jax.ShapeDtypeStruct(
+                tuple(shp) if shp else (), _np.dtype(dt))
+            continue
+        op = _reg.get(n.op)
+        params = _attr_params(op, n.attrs)
+        # derive missing parameter-variable shapes from the data input
+        rule = _PARAM_SHAPE_RULES.get(n.op)
+        if rule is not None:
+            d0 = shapes.get((id(n.inputs[0][0]), n.inputs[0][1]))
+            if d0 is not None:
+                try:
+                    derived = rule(params, tuple(d0.shape))
+                except (KeyError, TypeError, IndexError):
+                    derived = {}
+                for (child, _) in n.inputs[1:]:
+                    if child.op is None and (id(child), 0) not in shapes:
+                        suffix = child.name.rsplit("_", 1)[-1]
+                        # match by arg suffix: conv0_weight → weight
+                        for pname, pshape in derived.items():
+                            if suffix == pname or child.name.endswith(pname):
+                                if pshape is not None:
+                                    shapes[(id(child), 0)] = jax.ShapeDtypeStruct(
+                                        tuple(pshape), _np.float32)
+                                break
+        lrule = _LABEL_SHAPE_RULES.get(n.op)
+        if lrule is not None and len(n.inputs) > 1:
+            d0 = shapes.get((id(n.inputs[0][0]), n.inputs[0][1]))
+            lab = n.inputs[1][0]
+            if d0 is not None and lab.op is None and (id(lab), 0) not in shapes:
+                shapes[(id(lab), 0)] = jax.ShapeDtypeStruct(
+                    lrule(n.attrs, tuple(d0.shape)), _np.float32)
+        in_structs = []
+        missing = False
+        for (child, oi) in n.inputs:
+            s = shapes.get((id(child), oi))
+            if s is None:
+                missing = True
+                break
+            in_structs.append(s)
+        if missing:
+            ok = False
+            continue
+        try:
+            out = jax.eval_shape(lambda *xs: op.fn(*xs, **params), *in_structs)
+        except Exception:
+            ok = False
+            continue
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for i, o in enumerate(outs):
+            shapes[(id(n), i)] = o
+    if need_shapes:
+        for n in order:
+            if n.op is None and (id(n), 0) not in shapes:
+                ok = False
+    return shapes, ok
+
+
+# ---------------------------------------------------------------------------
+# graph evaluation — shared by Executor and Module
+# ---------------------------------------------------------------------------
+def make_graph_fn(symbol, train):
+    """Build fn(arg_dict, aux_dict) -> (list outputs, new_aux_dict) — a pure
+    jax function over the DAG, suitable for jit/vjp.  The reference analogue
+    is GraphExecutor::RunOps over cached engine ops; XLA compiles the whole
+    thing into one program instead."""
+    order = symbol._nodes()
+    heads = symbol._outputs
+
+    def graph_fn(arg_dict, aux_dict, rng_key):
+        """rng_key: PRNG key threaded as a real argument so stochastic ops
+        (Dropout, random samplers) stay pure under jit (see _rng.py)."""
+        from .. import _rng
+        with _rng.trace_scope(rng_key):
+            return _graph_eval(arg_dict, aux_dict)
+
+    def _graph_eval(arg_dict, aux_dict):
+        env = {}
+        new_aux = dict(aux_dict)
+        for n in order:
+            if n.op is None:
+                if n._is_aux:
+                    env[(id(n), 0)] = new_aux[n.name]
+                else:
+                    env[(id(n), 0)] = arg_dict[n.name]
+                continue
+            op = _reg.get(n.op)
+            params = {k: _reg.canonicalize(v) for k, v in n.attrs.items()
+                      if not k.startswith("__")}
+            if op.needs_train:
+                params["_train"] = train
+            ins = [env[(id(c), oi)] for c, oi in n.inputs]
+            out = op.fn(*ins, **params)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for i, o in enumerate(outs):
+                env[(id(n), i)] = o
+            if train and op.aux_update is not None and not params.get("use_global_stats"):
+                updates = op.aux_update(ins, outs, params)
+                for idx, val in updates.items():
+                    child = n.inputs[idx][0]
+                    if child.op is None and child._is_aux:
+                        new_aux[child.name] = val
+        return [env[(id(n), oi)] for n, oi in heads], new_aux
+
+    return graph_fn
+
+
+# ---------------------------------------------------------------------------
+# symbol-side op invocation (the generated sym.* functions)
+# ---------------------------------------------------------------------------
+def _sym_invoke(op, op_name, args, kwargs):
+    name = kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+    name = NameManager._current.get(name, op_name)
+
+    sym_inputs = []   # (argname_or_None, Symbol)
+    params = {}
+
+    if op.arg_names == ["args"]:
+        # variadic (Concat / add_n / ...)
+        flat = []
+        for a in args:
+            if isinstance(a, (list, tuple)):
+                flat.extend(a)
+            else:
+                flat.append(a)
+        for a in flat:
+            if not isinstance(a, Symbol):
+                raise TypeError("%s expects Symbols, got %r" % (op_name, type(a)))
+            sym_inputs.append((None, a))
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_inputs.append((k, v))
+            else:
+                params[k] = v
+        entries = [s._outputs[0] for _, s in sym_inputs]
+    else:
+        names = list(op.arg_names)
+        for idx, aux_name in sorted(op.aux.items()):
+            names.append(aux_name)
+        slots = {}
+        for i, a in enumerate(args):
+            if isinstance(a, Symbol):
+                slots[names[i]] = a
+            else:
+                params[names[i]] = a
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                slots[k] = v
+            else:
+                params[k] = v
+        aux_names = set(op.aux.values())
+        entries = []
+        no_bias = params.get("no_bias", _reg.canonicalize(params.get("no_bias", False)))
+        for an in names:
+            if an in slots:
+                entries.append(slots[an]._outputs[0])
+            else:
+                if an == "bias" and _reg.canonicalize(no_bias):
+                    continue
+                if an in ("label",) and an not in slots:
+                    # SoftmaxOutput etc: auto label variable named <name>_label
+                    vnode = _Node(None, "%s_%s" % (name, an))
+                    entries.append((vnode, 0))
+                    continue
+                # auto-create parameter/aux variable <name>_<argname>
+                if an == names[0]:
+                    vnode = _Node(None, "%s_%s" % (name, an))
+                else:
+                    vnode = _Node(None, "%s_%s" % (name, an),
+                                  is_aux=an in aux_names)
+                entries.append((vnode, 0))
+
+    attrs = AttrScope._current.get(attr or {})
+    for k, v in params.items():
+        if v is not None:
+            attrs[k] = v
+    node = _Node(op_name, name, attrs, entries)
+    n_out = op.n_outputs(_attr_params(op, attrs))
+    if n_out == 1:
+        return Symbol([(node, 0)])
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _binary(lhs, rhs, op_name, scalar_op_name):
+    if isinstance(rhs, Symbol):
+        if op_name is None:
+            raise TypeError("unsupported operand order")
+        return _create(op_name, [lhs, rhs], {}, None)
+    return _create(scalar_op_name, [lhs], {"scalar": float(rhs)}, None)
+
+
+def _create(op_name, sym_args, params, name):
+    op = _reg.get(op_name)
+    kwargs = dict(params)
+    if name is not None:
+        kwargs["name"] = name
+    return _sym_invoke(op, op_name, tuple(sym_args), kwargs)
+
+
+# ---------------------------------------------------------------------------
+# public constructors
+# ---------------------------------------------------------------------------
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    if not isinstance(name, str):
+        raise TypeError("Variable name must be a string")
+    attrs = AttrScope._current.get(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = _np.dtype(np_dtype(dtype)).name
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    if init is not None:
+        attrs["__init__"] = init.dumps() if hasattr(init, "dumps") else str(init)
+    attrs.update({k: str(v) for k, v in kwargs.items()})
+    return Symbol([(_Node(None, name, attrs), 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        if not isinstance(s, Symbol):
+            raise TypeError("Group expects Symbols")
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def load_json(json_str):
+    g = json.loads(json_str)
+    nodes = []
+    aux_names = set()
+    # first pass: find aux slots from op metadata
+    for jn in g["nodes"]:
+        if jn["op"] != "null":
+            op = _reg.get(jn["op"])
+            for pos, aux_name in op.aux.items():
+                if pos < len(jn["inputs"]):
+                    aux_names.add(jn["inputs"][pos][0])
+    for i, jn in enumerate(g["nodes"]):
+        attrs = jn.get("attrs") or jn.get("param") or {}
+        node = _Node(None if jn["op"] == "null" else jn["op"],
+                     jn["name"], attrs,
+                     [(nodes[ci], oi) for ci, oi, _ in jn["inputs"]],
+                     is_aux=i in aux_names)
+        nodes.append(node)
+    return Symbol([(nodes[ni], oi) for ni, oi, _ in g["heads"]])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

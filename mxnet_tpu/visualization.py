@@ -1,0 +1,129 @@
+"""Network visualization: print_summary / plot_network.
+
+Reference: ``python/mxnet/visualization.py`` — tabular summary with
+parameter counts, and a graphviz dot graph (rendered only if graphviz is
+installed; gated import since it is not a baked-in dependency).
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Print a layer table with output shapes and parameter counts
+    (reference: visualization.py print_summary)."""
+    if not isinstance(shape, dict) and shape is not None:
+        raise ValueError("shape must be a dict of name->shape")
+    show_shape = shape is not None
+    shape_dict = {}
+    if show_shape:
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape_partial(**shape)
+        if out_shapes is None:
+            raise MXNetError("cannot infer shapes")
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {e[0] for e in conf["heads"]}
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"],
+              positions)
+    print("=" * line_length)
+    total_params = 0
+
+    def print_layer_summary(node, out_shape):
+        nonlocal total_params
+        op = node["op"]
+        name = node["name"]
+        pre_nodes = [nodes[i[0]]["name"] for i in node["inputs"]
+                     if nodes[i[0]]["op"] != "null"]
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        for i in node["inputs"]:
+            inode = nodes[i[0]]
+            if inode["op"] == "null" and ("weight" in inode["name"] or
+                                          "bias" in inode["name"] or
+                                          "gamma" in inode["name"] or
+                                          "beta" in inode["name"]):
+                s = shape_dict.get(inode["name"])
+                if s:
+                    p = 1
+                    for d in s:
+                        p *= d
+                    cur_param += p
+        first = "%s(%s)" % (name, op)
+        print_row([first, out_shape or "", cur_param,
+                   ",".join(pre_nodes[:1])], positions)
+        total_params += cur_param
+
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            if show_shape and i in heads:
+                pass
+            continue
+        key = node["name"] + "_output"
+        out_shape = shape_dict.get(key, shape_dict.get(node["name"]))
+        print_layer_summary(node, out_shape)
+        print("_" * line_length)
+    print("Total params: %s" % total_params)
+    print("_" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the symbol (reference: visualization.py
+    plot_network).  Requires the optional `graphviz` package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the optional graphviz package") from e
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    if node_attrs:
+        node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden = set()
+    for i, node in enumerate(nodes):
+        name = node["name"]
+        op = node["op"]
+        if op == "null":
+            if hide_weights and any(s in name for s in
+                                    ("weight", "bias", "gamma", "beta",
+                                     "moving_mean", "moving_var")):
+                hidden.add(i)
+                continue
+            dot.node(name=name, label=name, fillcolor="#8dd3c7")
+        else:
+            label = "%s\n%s" % (op, name)
+            color = {"Convolution": "#fb8072", "FullyConnected": "#fb8072",
+                     "BatchNorm": "#bebada", "Activation": "#ffffb3",
+                     "Pooling": "#80b1d3", "Concat": "#fdb462",
+                     "Flatten": "#fdb462", "Reshape": "#fdb462",
+                     "Softmax": "#fccde5", "SoftmaxOutput": "#fccde5",
+                     }.get(op, "#b3de69")
+            dot.node(name=name, label=label, fillcolor=color)
+    for i, node in enumerate(nodes):
+        if node["op"] == "null":
+            continue
+        for j in node["inputs"]:
+            if j[0] in hidden:
+                continue
+            src = nodes[j[0]]["name"]
+            dot.edge(tail_name=src, head_name=node["name"])
+    return dot

@@ -1,0 +1,150 @@
+"""Module API tests (reference: tests/python/unittest/test_module.py,
+tests/python/train/test_mlp.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _toy_data(n=400, d=16, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, k).astype(np.float32) * 2
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return X, y
+
+
+def _mlp(k=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=k, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_accuracy():
+    """Real small training with accuracy assert (reference:
+    tests/python/train/test_mlp.py)."""
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X[:300], y[:300], batch_size=50, shuffle=True,
+                              shuffle_seed=7)
+    val = mx.io.NDArrayIter(X[300:], y[300:], batch_size=50)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=12)
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.8, "val acc %.3f too low" % acc
+
+
+def test_module_predict_shapes():
+    X, y = _toy_data(120)
+    it = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params(mx.init.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (120, 3)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _toy_data(100)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "chk")
+    mod.save_checkpoint(prefix, 3)
+    mod2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    p1 = mod.predict(it).asnumpy()
+    it.reset()
+    p2 = mod2.predict(it).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_module_get_set_params():
+    X, y = _toy_data(60)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    assert set(arg) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    arg["fc1_bias"] = mx.nd.ones(arg["fc1_bias"].shape)
+    mod.set_params(arg, aux)
+    a2, _ = mod.get_params()
+    np.testing.assert_allclose(a2["fc1_bias"].asnumpy(), 1.0)
+
+
+def test_module_input_grads():
+    X, y = _toy_data(40)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=True,
+             inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    (gin,) = mod.get_input_grads()
+    assert gin.shape == (20, 16)
+    assert np.abs(gin.asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    """Variable-length inputs via buckets sharing parameters (reference:
+    tests/python/train/test_bucketing.py)."""
+    rng = np.random.RandomState(0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=12,
+                                 context=mx.cpu())
+    mod.bind([("data", (10, 12))], [("softmax_label", (10,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for key in (12, 12, 12):
+        batch = mx.io.DataBatch(
+            [mx.nd.array(rng.randn(10, key))],
+            [mx.nd.array(rng.randint(0, 8, (10,)).astype(np.float32))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", (10, key))],
+            provide_label=[mx.io.DataDesc("softmax_label", (10,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # parameters are shared handles across buckets
+    default_mod = mod._buckets[12]
+    assert default_mod._exec.arg_dict["fc_shared_weight"] is \
+        mod._curr_module._exec.arg_dict["fc_shared_weight"]
+
+
+def test_feedforward_legacy():
+    """Legacy FeedForward API (reference: model.py:452)."""
+    X, y = _toy_data(200, d=8, k=2)
+    model = mx.model.FeedForward(_mlp(k=2), ctx=mx.cpu(), num_epoch=12,
+                                 learning_rate=0.5, momentum=0.9,
+                                 numpy_batch_size=50)
+    model.fit(X, y)
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=50))
+    assert acc > 0.8
+
+
+def test_module_monitor():
+    X, y = _toy_data(40)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mon = mx.Monitor(interval=1, pattern=".*fc1.*")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=False)
+    stats = mon.toc()
+    assert any("fc1" in name for _, name, _ in stats)

@@ -1,0 +1,146 @@
+"""Symbol API tests (reference: tests/python/unittest/test_symbol.py,
+test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_list_arguments():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    args, outs, auxs = net.infer_shape(data=(8, 30))
+    assert args == [(8, 30), (16, 30), (16,), (4, 16), (4,), (8,)]
+    assert outs == [(8, 4)]
+
+
+def test_infer_shape_conv_bn():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             name="conv0")
+    net = mx.sym.BatchNorm(net, name="bn0")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    args, outs, auxs = net.infer_shape(data=(2, 3, 8, 8))
+    assert args[1] == (8, 3, 3, 3)          # conv weight
+    assert outs == [(2, 8, 4, 4)]
+    assert net.list_auxiliary_states() == ["bn0_moving_mean", "bn0_moving_var"]
+    assert auxs == [(8,), (8,)]
+
+
+def test_infer_type():
+    net = _mlp()
+    args, outs, auxs = net.infer_type(data="float32")
+    assert outs[0] == np.float32
+
+
+def test_json_roundtrip(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net2 = mx.sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    a1, o1, _ = net.infer_shape(data=(4, 10))
+    a2, o2, _ = net2.infer_shape(data=(4, 10))
+    assert o1 == o2 and a1 == a2
+
+
+def test_symbol_compose():
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net2 = mx.sym.Variable("in2")
+    net2 = mx.sym.FullyConnected(net2, num_hidden=4, name="fc2")
+    composed = net2(in2=net1)
+    assert "fc1_weight" in composed.list_arguments()
+    _, outs, _ = composed.infer_shape(data=(2, 10))
+    assert outs == [(2, 4)]
+
+
+def test_symbol_arithmetic_eval():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = 2.0 * a + b ** 2
+    ex = c.bind(args={"a": mx.nd.array([1.0, 2.0]),
+                      "b": mx.nd.array([3.0, 4.0])})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [11.0, 20.0], rtol=1e-6)
+
+
+def test_group_and_internals():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    grp = mx.sym.Group([fc1, act])
+    assert len(grp.list_outputs()) == 2
+    internals = act.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    sub = internals["fc1_output"]
+    _, outs, _ = sub.infer_shape(data=(2, 4))
+    assert outs == [(2, 8)]
+
+
+def test_executor_forward_backward():
+    net = _mlp()
+    ex = net.simple_bind(grad_req="write", data=(8, 30))
+    rng = np.random.RandomState(0)
+    for name in ("fc1_weight", "fc2_weight"):
+        arr = ex.arg_dict[name]
+        arr._set_data(mx.nd.array(rng.randn(*arr.shape) * 0.1)._data)
+    out = ex.forward(is_train=True,
+                     data=rng.randn(8, 30).astype(np.float32),
+                     softmax_label=rng.randint(0, 4, (8,)).astype(np.float32))
+    assert out[0].shape == (8, 4)
+    np.testing.assert_allclose(out[0].asnumpy().sum(), 8.0, rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["fc1_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_executor_grad_matches_autograd():
+    """Executor vjp == imperative autograd on the same computation."""
+    rng = np.random.RandomState(3)
+    w = rng.randn(5, 7).astype(np.float32)
+    x = rng.randn(4, 7).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, no_bias=True, name="fc")
+    loss = mx.sym.MakeLoss(mx.sym.sum(fc * fc))
+    ex = loss.bind(args={"data": mx.nd.array(x), "fc_weight": mx.nd.array(w)},
+                   grad_req={"data": "null", "fc_weight": "write"})
+    ex.forward(is_train=True)
+    ex.backward()
+    g_sym = ex.grad_dict["fc_weight"].asnumpy()
+
+    wn = mx.nd.array(w)
+    wn.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.FullyConnected(mx.nd.array(x), wn, num_hidden=5,
+                                 no_bias=True)
+        l = mx.nd.sum(y * y)
+    l.backward()
+    np.testing.assert_allclose(g_sym, wn.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_eval_shape_caching_bucketing():
+    """Same symbol at several shapes — jit caches per shape (bucketing)."""
+    net = _mlp()
+    ex = net.simple_bind(data=(4, 12))
+    for t in (4, 6):
+        out = ex.forward(data=np.zeros((4, 12), np.float32),
+                         softmax_label=np.zeros((4,), np.float32))
+        assert out[0].shape == (4, 4)
