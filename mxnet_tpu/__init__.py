@@ -41,6 +41,7 @@ from . import monitor
 from .monitor import Monitor
 from . import visualization
 from . import visualization as viz
+from . import rnn
 from . import gluon
 from . import parallel
 from . import test_utils

@@ -1,0 +1,195 @@
+"""Fused recurrent layers: gluon.rnn.RNN / LSTM / GRU.
+
+Reference: ``python/mxnet/gluon/rnn/rnn_layer.py`` — thin wrappers over the
+fused ``RNN`` op (here: ops/rnn.py lax.scan kernel), keeping per-layer
+``{l}{i2h,h2h}_{weight,bias}`` parameters that are packed into the flat
+cuDNN-layout vector at forward, so parameter names and shapes match the
+reference's checkpoints.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import initializer as init_mod
+from ... import ndarray as nd
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), "invalid layout %r" % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        from ...ops.rnn import _GATES
+        self._gates = _GATES[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    self._register_param(
+                        "%s%d_i2h_weight" % (j, i), (ng * nh, ni),
+                        i2h_weight_initializer)
+                    self._register_param(
+                        "%s%d_h2h_weight" % (j, i), (ng * nh, nh),
+                        h2h_weight_initializer)
+                    self._register_param(
+                        "%s%d_i2h_bias" % (j, i), (ng * nh,),
+                        i2h_bias_initializer)
+                    self._register_param(
+                        "%s%d_h2h_bias" % (j, i), (ng * nh,),
+                        h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, initializer):
+        p = self.params.get(name, shape=shape, init=initializer,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        mapping = "{0} -> {1}".format(
+            self._input_size if self._input_size else None, self._hidden_size)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_param_shapes(self, x, *args):
+        ni = x.shape[2] if self._layout == "TNC" else x.shape[-1]
+        if self._input_size == 0:
+            self._input_size = ni
+            ng, nh = self._gates, self._hidden_size
+            for i in range(self._num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    p = getattr(self, "%s%d_i2h_weight" % (j, i))
+                    if p._deferred_init:
+                        p.shape = (ng * nh, ni)
+                ni = nh * self._dir
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent state(s) (reference: rnn_layer.py begin_state)."""
+        if func is None:
+            func = nd.zeros
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(func(shape=info["shape"], **kwargs)
+                          if "shape" in info else func(**kwargs))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+
+        flat = self._pack_params(F, params)
+        args = [inputs, flat] + list(states)
+        outs = F.RNN(*args, state_size=self._hidden_size,
+                     num_layers=self._num_layers, mode=self._mode,
+                     bidirectional=self._dir == 2, p=self._dropout,
+                     state_outputs=True)
+        if self._mode == "lstm":
+            out, h, c = outs
+            out_states = [h, c]
+        else:
+            out, h = outs
+            out_states = [h]
+        if self._layout == "NTC":
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        return out if skip_states else (out, out_states)
+
+    def _pack_params(self, F, params):
+        """Concat per-layer parameters into the cuDNN flat layout
+        (all weights layer-major, then all biases) — XLA fuses the concat
+        into the consuming matmuls."""
+        ws, bs = [], []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(F.reshape(params["%s%d_i2h_weight" % (j, i)],
+                                    shape=(-1,)))
+                ws.append(F.reshape(params["%s%d_h2h_weight" % (j, i)],
+                                    shape=(-1,)))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(params["%s%d_i2h_bias" % (j, i)])
+                bs.append(params["%s%d_h2h_bias" % (j, i)])
+        return F.concat(*(ws + bs), dim=0)
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer RNN with relu/tanh (reference: rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference: rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU, cuDNN variant (reference: rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -77,7 +77,16 @@ def where(condition, x, y):
     return jnp.where(condition.astype(bool), x, y)
 
 
-@register("SequenceMask", arg_names=["data", "sequence_length"])
+def _seq_len_optional(params):
+    """sequence_length input only exists when use_sequence_length=True
+    (reference: src/operator/sequence_last-inl.h param)."""
+    if params.get("use_sequence_length", False):
+        return ()
+    return ("sequence_length",)
+
+
+@register("SequenceMask", arg_names=["data", "sequence_length"],
+          optional_args=_seq_len_optional)
 def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
     """Reference: src/operator/sequence_mask.cc — data is (seq, batch, ...) for axis=0."""
     if not use_sequence_length or sequence_length is None:
@@ -91,7 +100,8 @@ def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0
     return jnp.where(mask, data, jnp.asarray(value, data.dtype))
 
 
-@register("SequenceLast", arg_names=["data", "sequence_length"])
+@register("SequenceLast", arg_names=["data", "sequence_length"],
+          optional_args=_seq_len_optional)
 def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
     if not use_sequence_length or sequence_length is None:
         return jnp.take(data, -1, axis=axis)
@@ -105,7 +115,8 @@ def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0)
     )[:, 0]
 
 
-@register("SequenceReverse", arg_names=["data", "sequence_length"])
+@register("SequenceReverse", arg_names=["data", "sequence_length"],
+          optional_args=_seq_len_optional)
 def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=0)

@@ -12,6 +12,17 @@ def zeros(shape=(), dtype="float32", ctx=None):
     return jnp.zeros(shape, dtype=np_dtype(dtype or "float32"))
 
 
+@register("_state_zeros_like", arg_names=["ref"], differentiable=False)
+def state_zeros_like(ref, shape=(), batch_axis=0, dtype="float32"):
+    """Zeros whose 0-dims are replaced by ref.shape[batch_axis] — resolves
+    the reference's unknown-batch (0) recurrent begin_state shapes without
+    bidirectional shape inference (symbolic RNN cells, rnn/rnn_cell.py)."""
+    import jax
+    b = ref.shape[int(batch_axis)]
+    resolved = tuple(b if d == 0 else d for d in shape)
+    return jnp.zeros(resolved, dtype=np_dtype(dtype or "float32"))
+
+
 @register("_ones", arg_names=[], differentiable=False)
 def ones(shape=(), dtype="float32", ctx=None):
     return jnp.ones(shape, dtype=np_dtype(dtype or "float32"))

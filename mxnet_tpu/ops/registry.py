@@ -30,11 +30,12 @@ class Op:
     __slots__ = (
         "name", "fn", "arg_names", "aux", "aux_update", "num_outputs",
         "differentiable", "scalar_args", "doc", "needs_train",
+        "optional_args",
     )
 
     def __init__(self, name, fn, arg_names=None, aux=None, aux_update=None,
                  num_outputs=1, differentiable=True, scalar_args=(),
-                 needs_train=False):
+                 needs_train=False, optional_args=()):
         self.name = name
         self.fn = fn
         self.arg_names = list(arg_names) if arg_names else ["data"]
@@ -44,7 +45,15 @@ class Op:
         self.differentiable = differentiable
         self.scalar_args = tuple(scalar_args)
         self.needs_train = needs_train
+        # arg names that are NOT auto-created as variables by the symbolic
+        # frontend when absent: a tuple of names, or callable(params)->names
+        self.optional_args = optional_args
         self.doc = fn.__doc__ or ""
+
+    def optional(self, params):
+        if callable(self.optional_args):
+            return set(self.optional_args(params))
+        return set(self.optional_args)
 
     def n_outputs(self, params):
         if callable(self.num_outputs):
@@ -56,12 +65,13 @@ class Op:
 
 
 def register(name, *, arg_names=None, aux=None, aux_update=None, num_outputs=1,
-             differentiable=True, scalar_args=(), aliases=(), needs_train=False):
+             differentiable=True, scalar_args=(), aliases=(), needs_train=False,
+             optional_args=()):
     """Decorator registering a pure jax function as an operator."""
 
     def deco(fn):
         op = Op(name, fn, arg_names, aux, aux_update, num_outputs,
-                differentiable, scalar_args, needs_train)
+                differentiable, scalar_args, needs_train, optional_args)
         _OPS[name] = op
         for a in aliases:
             _OPS[a] = op

@@ -704,10 +704,13 @@ def _sym_invoke(op, op_name, args, kwargs):
         aux_names = set(op.aux.values())
         entries = []
         no_bias = params.get("no_bias", _reg.canonicalize(params.get("no_bias", False)))
+        optional = op.optional(_reg.canonicalize_kwargs(params))
         for an in names:
             if an in slots:
                 entries.append(slots[an]._outputs[0])
             else:
+                if an in optional:
+                    continue
                 if an == "bias" and _reg.canonicalize(no_bias):
                     continue
                 if an in ("label",) and an not in slots:

@@ -1,0 +1,283 @@
+"""RNN stack tests: fused op vs torch oracle, gluon.rnn, legacy mx.rnn
+(reference: tests/python/unittest/test_gluon_rnn.py, test_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.ops import rnn as rnn_ops
+
+
+def _pack_torch(tnet, num_layers, bidirectional):
+    """Pack torch RNN params into the cuDNN flat layout the RNN op expects."""
+    chunks_w, chunks_b = [], []
+    sufs = ["", "_reverse"] if bidirectional else [""]
+    for layer in range(num_layers):
+        for suf in sufs:
+            chunks_w.append(getattr(
+                tnet, "weight_ih_l%d%s" % (layer, suf)).detach().numpy().ravel())
+            chunks_w.append(getattr(
+                tnet, "weight_hh_l%d%s" % (layer, suf)).detach().numpy().ravel())
+    for layer in range(num_layers):
+        for suf in sufs:
+            chunks_b.append(getattr(
+                tnet, "bias_ih_l%d%s" % (layer, suf)).detach().numpy().ravel())
+            chunks_b.append(getattr(
+                tnet, "bias_hh_l%d%s" % (layer, suf)).detach().numpy().ravel())
+    return np.concatenate(chunks_w + chunks_b).astype(np.float32)
+
+
+@pytest.mark.parametrize("mode,bidir", [
+    ("lstm", False), ("lstm", True), ("gru", True), ("rnn_tanh", True),
+    ("rnn_relu", False)])
+def test_rnn_op_vs_torch(mode, bidir):
+    """The fused RNN op matches torch's cuDNN-layout recurrences
+    (reference numerics: src/operator/rnn_impl.h)."""
+    torch = pytest.importorskip("torch")
+    T, B, I, H, L = 5, 3, 4, 6, 2
+    cls = {"lstm": torch.nn.LSTM, "gru": torch.nn.GRU,
+           "rnn_tanh": torch.nn.RNN, "rnn_relu": torch.nn.RNN}[mode]
+    kwargs = {"nonlinearity": mode[4:]} if mode.startswith("rnn_") else {}
+    torch.manual_seed(0)
+    tnet = cls(I, H, num_layers=L, bidirectional=bidir, **kwargs)
+    flat = _pack_torch(tnet, L, bidir)
+    assert flat.size == rnn_ops.rnn_param_size(H, I, L, mode, bidir)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, B, I).astype(np.float32)
+    d = 2 if bidir else 1
+    h0 = np.zeros((L * d, B, H), np.float32)
+    args = [mx.nd.array(x), mx.nd.array(flat), mx.nd.array(h0)]
+    if mode == "lstm":
+        args.append(mx.nd.array(np.zeros((L * d, B, H), np.float32)))
+    out = mx.nd.RNN(*args, state_size=H, num_layers=L, mode=mode,
+                    bidirectional=bidir)
+    tout, _ = tnet(torch.from_numpy(x))
+    np.testing.assert_allclose(out.asnumpy(), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_op_state_outputs():
+    T, B, I, H, L = 4, 2, 3, 5, 1
+    flat_size = rnn_ops.rnn_param_size(H, I, L, "lstm", False)
+    rng = np.random.RandomState(1)
+    out, h, c = mx.nd.RNN(
+        mx.nd.array(rng.randn(T, B, I).astype(np.float32)),
+        mx.nd.array(rng.randn(flat_size).astype(np.float32) * 0.1),
+        mx.nd.array(np.zeros((L, B, H), np.float32)),
+        mx.nd.array(np.zeros((L, B, H), np.float32)),
+        state_size=H, num_layers=L, mode="lstm", state_outputs=True)
+    assert out.shape == (T, B, H)
+    assert h.shape == (L, B, H) and c.shape == (L, B, H)
+    np.testing.assert_allclose(out.asnumpy()[-1], h.asnumpy()[0], rtol=1e-5)
+
+
+def test_gluon_lstm_layer_grad():
+    lstm = gluon.rnn.LSTM(8, num_layers=2, bidirectional=True, dropout=0.0)
+    lstm.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(5, 3, 4).astype(np.float32))
+    out = lstm(x)
+    assert out.shape == (5, 3, 16)
+    with mx.autograd.record():
+        y = mx.nd.sum(lstm(x))
+    y.backward()
+    params = lstm.collect_params()
+    g = params[list(params.keys())[0]].grad()
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_gluon_lstm_layer_ntc_and_states():
+    lstm = gluon.rnn.LSTM(6, layout="NTC")
+    lstm.initialize()
+    x = mx.nd.array(np.zeros((3, 5, 4), np.float32))
+    out, states = lstm(x, lstm.begin_state(3))
+    assert out.shape == (3, 5, 6)
+    assert states[0].shape == (1, 3, 6) and states[1].shape == (1, 3, 6)
+
+
+def test_gluon_cells_unroll():
+    cell = gluon.rnn.LSTMCell(6)
+    cell.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 5, 3).astype(np.float32))
+    outputs, states = cell.unroll(5, x, layout="NTC")
+    assert outputs.shape == (2, 5, 6)
+    assert len(states) == 2
+
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(4))
+    stack.add(gluon.rnn.ResidualCell(gluon.rnn.GRUCell(4)))
+    stack.initialize()
+    o, s = stack.unroll(3, mx.nd.array(np.zeros((2, 3, 4), np.float32)),
+                        layout="NTC")
+    assert o.shape == (2, 3, 4) and len(s) == 3
+
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(4),
+                                     gluon.rnn.LSTMCell(4))
+    bi.initialize()
+    o, s = bi.unroll(3, mx.nd.array(np.zeros((2, 3, 5), np.float32)),
+                     layout="NTC")
+    assert o.shape == (2, 3, 8)
+
+
+def test_symbolic_lstm_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(num_hidden=24, prefix="lstm_")
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(4, data, layout="NTC", merge_outputs=True)
+    args, outs, _ = outputs.infer_shape(data=(10, 4, 16))
+    assert outs == [(10, 4, 24)]
+
+
+def test_symbolic_fused_cell():
+    fused = mx.rnn.FusedRNNCell(12, num_layers=2, mode="gru", prefix="g_")
+    data = mx.sym.Variable("data")
+    out, _ = fused.unroll(6, data, layout="NTC")
+    _, outs, _ = out.infer_shape(data=(4, 6, 8))
+    assert outs == [(4, 6, 12)]
+
+
+def test_fused_unfuse_match():
+    """FusedRNNCell and its unfused stack produce identical outputs given
+    the same (unpacked) weights (reference: test_rnn.py test_unfuse)."""
+    T, B, I, H = 3, 2, 4, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="l_",
+                                get_next_state=True)
+    data = mx.sym.Variable("data")
+    fout, _ = fused.unroll(T, data, layout="NTC")
+    fex = fout.simple_bind(data=(B, T, I))
+    rng = np.random.RandomState(0)
+    flat = rng.randn(*fex.arg_dict["l_parameters"].shape).astype(np.float32) * 0.2
+    fex.arg_dict["l_parameters"]._set_data(mx.nd.array(flat)._data)
+    x = rng.randn(B, T, I).astype(np.float32)
+    f_res = fex.forward(data=x)[0].asnumpy()
+
+    stack = fused.unfuse()
+    sout, _ = stack.unroll(T, data, layout="NTC", merge_outputs=True)
+    sex = sout.simple_bind(data=(B, T, I))
+    args = fused.unpack_weights({"l_parameters": mx.nd.array(flat)})
+    for name, arr in args.items():
+        sex.arg_dict[name]._set_data(arr._data)
+    s_res = sex.forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(f_res, s_res, rtol=1e-4, atol=1e-5)
+
+
+def test_bucket_sentence_iter_and_lm_training():
+    """Bucketing LM converges (reference: tests/python/train/test_bucketing.py)."""
+    vocab = 16
+    rng = np.random.RandomState(2)
+    # learnable pattern: next token = (token + 1) % vocab
+    sents = []
+    for _ in range(120):
+        start = rng.randint(1, vocab)
+        ln = rng.randint(2, 8)
+        sents.append([(start + i) % vocab for i in range(ln)])
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=10, buckets=[4, 8])
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=12,
+                                 name="embed")
+        cell = mx.rnn.LSTMCell(num_hidden=16, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 16))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        return (mx.sym.SoftmaxOutput(pred, lab, name="softmax",
+                                     use_ignore=True, ignore_label=-1),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 1.0})
+    m = mx.metric.Perplexity(ignore_label=-1)
+    ppl = []
+    for epoch in range(4):
+        it.reset()
+        m.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(m, batch.label)
+        ppl.append(m.get()[1])
+    assert ppl[-1] < ppl[0] * 0.7, ppl
+
+
+def test_fused_unpack_pack_roundtrip_multilayer():
+    """pack(unpack(x)) == x for num_layers>=2 (regression: input-size
+    inference in FusedRNNCell.unpack_weights)."""
+    H, I, L = 5, 7, 2
+    for mode, bidir in [("lstm", False), ("gru", True)]:
+        fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode,
+                                    bidirectional=bidir, prefix="f_")
+        n = rnn_ops.rnn_param_size(H, I, L, mode, bidir)
+        flat = np.arange(n, dtype=np.float32)
+        args = fused.unpack_weights({"f_parameters": mx.nd.array(flat)})
+        assert args["f_l0_i2h_weight"].shape[1] == I
+        packed = fused.pack_weights(args)["f_parameters"].asnumpy()
+        np.testing.assert_array_equal(packed, flat)
+
+
+def test_rnn_interlayer_dropout_stochastic():
+    """Two training forwards must use different inter-layer dropout masks."""
+    T, B, I, H, L = 4, 3, 4, 8, 2
+    n = rnn_ops.rnn_param_size(H, I, L, "lstm", False)
+    rng = np.random.RandomState(0)
+    args = [mx.nd.array(rng.randn(T, B, I).astype(np.float32)),
+            mx.nd.array(rng.randn(n).astype(np.float32) * 0.3),
+            mx.nd.array(np.zeros((L, B, H), np.float32)),
+            mx.nd.array(np.zeros((L, B, H), np.float32))]
+    with mx.autograd.train_mode():
+        o1 = mx.nd.RNN(*args, state_size=H, num_layers=L, mode="lstm",
+                       p=0.5).asnumpy()
+        o2 = mx.nd.RNN(*args, state_size=H, num_layers=L, mode="lstm",
+                       p=0.5).asnumpy()
+    assert np.abs(o1 - o2).max() > 1e-6
+
+
+def test_bidirectional_valid_length():
+    """Reverse direction must not consume padding (regression: SequenceReverse
+    handling in gluon BidirectionalCell.unroll)."""
+    cell = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(4),
+                                       gluon.rnn.LSTMCell(4))
+    cell.initialize()
+    rng = np.random.RandomState(0)
+    x_valid = rng.randn(1, 3, 5).astype(np.float32)
+    pad = np.full((1, 2, 5), 777.0, np.float32)  # poison padding
+    x = np.concatenate([x_valid, pad], axis=1)
+    vl = mx.nd.array([3.0])
+    out, _ = cell.unroll(5, mx.nd.array(x), layout="NTC",
+                         valid_length=vl, merge_outputs=True)
+    out_short, _ = cell.unroll(3, mx.nd.array(x_valid), layout="NTC",
+                               valid_length=mx.nd.array([3.0]),
+                               merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy()[:, :3], out_short.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    # padding positions masked to zero
+    np.testing.assert_allclose(out.asnumpy()[:, 3:], 0.0, atol=1e-6)
+
+
+def test_bucket_iter_empty_bucket():
+    it = mx.rnn.BucketSentenceIter([[1, 2, 3, 4, 5]] * 20, batch_size=4,
+                                   buckets=[2, 8])
+    batches = list(it)
+    assert all(b.bucket_key == 8 for b in batches)
+
+
+def test_lstm_state_clip_per_timestep():
+    T, B, I, H = 6, 2, 3, 4
+    n = rnn_ops.rnn_param_size(H, I, 1, "lstm", False)
+    rng = np.random.RandomState(0)
+    big = mx.nd.array(rng.randn(n).astype(np.float32) * 3)
+    x = mx.nd.array(rng.randn(T, B, I).astype(np.float32) * 3)
+    z = mx.nd.array(np.zeros((1, B, H), np.float32))
+    out, h, c = mx.nd.RNN(x, big, z, z, state_size=H, num_layers=1,
+                          mode="lstm", state_outputs=True,
+                          lstm_state_clip_min=-0.01, lstm_state_clip_max=0.01)
+    assert np.abs(c.asnumpy()).max() <= 0.01 + 1e-7
+    # outputs bounded by tanh(clip): per-timestep clipping affects them
+    assert np.abs(out.asnumpy()).max() <= np.tanh(0.01) + 1e-6
