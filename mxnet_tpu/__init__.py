@@ -33,6 +33,8 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import io
+from . import recordio
+from . import image
 from . import model
 from . import module
 from . import module as mod

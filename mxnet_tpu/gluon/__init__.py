@@ -1,6 +1,7 @@
 """Gluon: the imperative high-level API (reference: python/mxnet/gluon/)."""
 from . import nn
 from . import rnn
+from . import data
 from . import loss
 from . import utils
 from . import model_zoo

@@ -1,0 +1,157 @@
+"""DataLoader: minibatch loader with multiprocessing workers.
+
+Reference: ``python/mxnet/gluon/data/dataloader.py`` — worker processes
+decode/transform samples and ship batches back through shared-memory
+NDArrays (cpu_shared_storage_manager).
+
+TPU-native design: workers produce *numpy* batches (pickled through the
+Pool pipe — host RAM is not the bottleneck; JPEG decode/augment is), and
+the main process does one ``jax.device_put`` per batch, which jax overlaps
+with TPU compute.  ``num_workers=0`` is a synchronous in-process loop.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    arr = np.asarray(data)
+    return nd.array(arr, dtype=arr.dtype)
+
+
+def _np_batchify(data):
+    """Worker-side batchify to numpy (crosses the process boundary)."""
+    if isinstance(data[0], NDArray):
+        return np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        return [_np_batchify(list(i)) for i in zip(*data)]
+    return np.asarray(data)
+
+
+default_mp_batchify_fn = _np_batchify
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples):
+    return _np_batchify([_worker_dataset[i] for i in samples])
+
+
+def _to_nd(batch):
+    if isinstance(batch, list):
+        return [_to_nd(b) for b in batch]
+    return nd.array(batch, dtype=batch.dtype)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when no batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_sampler is mutually exclusive with "
+                             "batch_size/shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._thread_pool = thread_pool
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                _worker_init(dataset)
+                self._pool = ThreadPool(self._num_workers)
+            else:
+                # forkserver: fork() from a multithreaded jax process can
+                # deadlock (the reference guards fork with engine stop/start
+                # handlers, src/initialize.cc); the forkserver parent has no
+                # jax threads, and the dataset ships to workers via pickle
+                ctx = mp.get_context("forkserver")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init,
+                                      initargs=(dataset,))
+
+    def __iter__(self):
+        if self._pool is None:
+            batchify = self._batchify_fn or default_batchify_fn
+            for batch in self._batch_sampler:
+                yield batchify([self._dataset[i] for i in batch])
+            return
+        # pipelined: keep `prefetch` batches in flight (the ThreadedIter /
+        # shared-mem pipeline analogue)
+        batchify = self._batchify_fn or _worker_fn
+        async_results = []
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                batch = next(it)
+            except StopIteration:
+                return False
+            if self._batchify_fn is not None:
+                async_results.append(self._pool.apply_async(
+                    _custom_worker_fn, (batch, self._batchify_fn)))
+            else:
+                async_results.append(self._pool.apply_async(_worker_fn,
+                                                            (batch,)))
+            return True
+
+        for _ in range(self._prefetch or 1):
+            if not submit():
+                break
+        while async_results:
+            res = async_results.pop(0).get()
+            submit()
+            yield _to_nd(res) if self._batchify_fn is None else res
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def shutdown(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+def _custom_worker_fn(samples, batchify_fn):
+    return batchify_fn([_worker_dataset[i] for i in samples])

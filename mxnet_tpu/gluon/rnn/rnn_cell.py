@@ -71,10 +71,18 @@ class RecurrentCell(Block):
             begin_state = self.begin_state(batch_size)
         states = begin_state
         outputs = []
+        all_states = []
         for i in range(length):
             out, states = self(seq[i], states)
             outputs.append(out)
+            if valid_length is not None:
+                all_states.append(states)
         if valid_length is not None:
+            # final states taken at t = valid_length-1, not at the padded end
+            # (reference: rnn_cell.py unroll SequenceLast over stacked states)
+            states = [nd.SequenceLast(nd.stack(*ele, axis=0), valid_length,
+                                      use_sequence_length=True, axis=0)
+                      for ele in zip(*all_states)]
             outputs = [nd.where(
                 nd.broadcast_lesser(nd.full((1,), i), valid_length.reshape(-1, 1)),
                 o, nd.zeros_like(o)) for i, o in enumerate(outputs)]

@@ -1,0 +1,3 @@
+"""`mx.image` namespace (reference: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from .image import ImageIter  # noqa: F401

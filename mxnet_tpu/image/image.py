@@ -1,0 +1,584 @@
+"""Image loading + augmentation pipeline (host-side).
+
+Reference: ``python/mxnet/image/image.py`` (ImageIter + augmenters) and the
+C++ ``ImageRecordIter`` (``src/io/iter_image_recordio_2.cc``, default
+augmenters ``src/io/image_aug_default.cc``).
+
+TPU-first design note: the reference augments into device NDArrays because
+its CPU context is host memory; here augmentation stays in *numpy* on the
+host worker (cv2 kernels, no per-image device dispatch) and the batch is
+shipped to HBM once — jax's async dispatch overlaps the transfer with TPU
+compute, replacing the reference's pinned-memory PrefetcherIter.
+Augmenter call signature (NDArray in/out) is preserved at the API boundary.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+
+import numpy as np
+
+from .. import io as _io
+from .. import ndarray as nd
+from .. import recordio
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["imread", "imdecode", "imresize", "scale_down", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "SequentialAug", "ResizeAug",
+           "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+           "CenterCropAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "ColorNormalizeAug", "RandomGrayAug",
+           "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter"]
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError as e:
+        raise ImportError("image ops require OpenCV (cv2)") from e
+
+
+def _as_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return np.asarray(img)
+
+
+def _out(arr, ref, dtype=None):
+    """numpy-in -> numpy-out (host pipeline stays on host: zero per-image
+    device dispatch); NDArray-in -> NDArray-out (reference API parity)."""
+    if dtype is not None:
+        arr = np.ascontiguousarray(arr, dtype=dtype)
+    if isinstance(ref, NDArray):
+        return nd.array(arr, dtype=arr.dtype)
+    return arr
+
+
+def imdecode(buf, to_rgb=True, flag=1, **kwargs):
+    """Decode an image byte buffer to HWC (RGB by default) NDArray
+    (reference: image.py imdecode via cv2)."""
+    cv2 = _cv2()
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+    if img is None:
+        raise MXNetError("cannot decode image")
+    if to_rgb and img.ndim == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return nd.array(img, dtype=np.uint8)
+
+
+def imread(filename, to_rgb=True, flag=1):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+
+
+def imresize(src, w, h, interp=1):
+    cv2 = _cv2()
+    return _out(cv2.resize(_as_np(src), (w, h), interpolation=interp), src)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit src_size (reference: image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals `size`."""
+    cv2 = _cv2()
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return _out(cv2.resize(img, (new_w, new_h), interpolation=interp), src)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = _as_np(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        cv2 = _cv2()
+        out = cv2.resize(out, size, interpolation=interp)
+    return _out(out, src)
+
+
+def random_crop(src, size, interp=2):
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    img = _as_np(src).astype(np.float32)
+    img = img - np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        img = img / np.asarray(std, dtype=np.float32)
+    return _out(img, src)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random crop with area/aspect jitter (Inception-style)."""
+    img = _as_np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = random.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(random.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+# ---------------------------------------------------------------------------
+# augmenters
+# ---------------------------------------------------------------------------
+class Augmenter:
+    """Image augmenter base (reference: image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        cv2 = _cv2()
+        return _out(cv2.resize(_as_np(src), tuple(self.size),
+                               interpolation=self.interp), src)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            img = _as_np(src)
+            return _out(np.ascontiguousarray(img[:, ::-1]), src)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return _out(_as_np(src).astype(self.typ), src)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return _out(_as_np(src).astype(np.float32) * alpha, src)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _as_np(src).astype(np.float32)
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        gray = (img * self._coef).sum(axis=2, keepdims=True).mean()
+        return _out(img * alpha + gray * (1 - alpha), src)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _as_np(src).astype(np.float32)
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return _out(img * alpha + gray * (1 - alpha), src)
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], dtype=np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], dtype=np.float32)
+
+    def __call__(self, src):
+        img = _as_np(src).astype(np.float32)
+        alpha = random.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                      dtype=np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return _out(np.dot(img, t), src)
+
+
+class ColorJitterAug(SequentialAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        random.shuffle(ts)
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, dtype=np.float32)
+        self.eigvec = np.asarray(eigvec, dtype=np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return _out(_as_np(src).astype(np.float32) + rgb, src)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = np.asarray(mean, dtype=np.float32) \
+            if mean is not None else None
+        self.std = np.asarray(std, dtype=np.float32) \
+            if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _mat = np.array([[0.21, 0.21, 0.21],
+                     [0.72, 0.72, 0.72],
+                     [0.07, 0.07, 0.07]], dtype=np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            return _out(np.dot(_as_np(src).astype(np.float32), self._mat), src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference: image.py CreateAugmenter
+    — mirrors the C++ default augmenter chain, image_aug_default.cc)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and (not isinstance(mean, np.ndarray) or mean.shape[0] in (1, 3)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Image iterator reading .rec files or image lists with augmentation
+    (reference: image.py ImageIter ≈ the C++ ImageRecordIter).
+
+    Supports distributed sharding via num_parts/part_index (the reference
+    shards the RecordIO file by worker rank)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        super().__init__(batch_size)
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        self._offsets = None
+
+        if path_imgrec:
+            idx_path = path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.isfile(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                                         "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                # no .idx sidecar: build an in-memory offset index with one
+                # sequential scan so shuffle / num_parts sharding still work
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self._offsets = []
+                while True:
+                    pos = self.imgrec.tell()
+                    if self.imgrec.read() is None:
+                        break
+                    self._offsets.append(pos)
+                self.imgrec.reset()
+                self.seq = list(range(len(self._offsets)))
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                imglist_dict = {}
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        label = np.array(parts[1:-1], dtype=np.float32)
+                        imglist_dict[int(parts[0])] = (label, parts[-1])
+            else:
+                imglist_dict = {}
+                for i, item in enumerate(imglist):
+                    imglist_dict[i] = (np.array(item[:-1], dtype=np.float32),
+                                       item[-1])
+            self.imglist = imglist_dict
+            self.path_root = path_root
+            self.seq = list(imglist_dict.keys())
+        else:
+            raise ValueError("need path_imgrec, path_imglist or imglist")
+
+        # distributed sharding (reference: kv.num_workers/rank split)
+        if num_parts > 1 and self.seq is not None:
+            n_per = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n_per:(part_index + 1) * n_per]
+
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast", "saturation",
+                         "hue", "pca_noise", "rand_gray", "inter_method")})
+        self.auglist = aug_list
+
+        self.provide_data = [_io.DataDesc(data_name,
+                                          (batch_size,) + self.data_shape,
+                                          np.dtype(dtype))]
+        if label_width > 1:
+            self.provide_label = [_io.DataDesc(label_name,
+                                               (batch_size, label_width))]
+        else:
+            self.provide_label = [_io.DataDesc(label_name, (batch_size,))]
+        self.cur = 0
+        self._allow_read = True
+        self.last_batch_handle = last_batch_handle
+        self._cache_data = None
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            random.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Read one (label, image-bytes) sample."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                if getattr(self, "_offsets", None) is not None:
+                    self.imgrec.handle.seek(self._offsets[idx])
+                    s = self.imgrec.read()
+                else:
+                    s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), dtype=np.float32)
+        lw = self.label_width
+        batch_label = np.zeros((self.batch_size, lw), dtype=np.float32)
+        decode_flag = 1 if c == 3 else 0
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, s = self.next_sample()
+                img = _cv2().imdecode(np.frombuffer(s, dtype=np.uint8),
+                                      decode_flag)
+                if img is None:
+                    raise MXNetError("cannot decode image record")
+                if decode_flag == 1:
+                    img = _cv2().cvtColor(img, _cv2().COLOR_BGR2RGB)
+                for aug in self.auglist:
+                    img = _as_np(aug(img))
+                if img.ndim == 2:
+                    img = img[:, :, None]
+                batch_data[i] = img
+                batch_label[i] = np.asarray(label, np.float32).reshape(-1)[:lw]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        if pad:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            # pad by repeating the last valid sample (reference C++ iterator
+            # behaviour); DataBatch.pad tells consumers how many to drop
+            batch_data[i:] = batch_data[i - 1]
+            batch_label[i:] = batch_label[i - 1]
+        data = nd.array(batch_data.transpose(0, 3, 1, 2), dtype=self.dtype)
+        label = nd.array(batch_label if lw > 1 else batch_label[:, 0])
+        return _io.DataBatch([data], [label], pad=pad)

@@ -22,7 +22,33 @@ from .base import MXNetError
 from .ndarray import NDArray, array as _nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+def ImageRecordIter(**kwargs):
+    """Name-parity wrapper over image.ImageIter (the C++ registered iterator
+    `ImageRecordIter`, src/io/iter_image_recordio_2.cc).  Maps the C iterator
+    kwargs (mean_r/g/b, std_r/g/b, preprocess_threads) onto the Python
+    pipeline and wraps it in a PrefetchingIter for decode/compute overlap."""
+    from .image import ImageIter
+    import numpy as _np2
+    mean = None
+    if any(k in kwargs for k in ("mean_r", "mean_g", "mean_b")):
+        mean = _np2.array([kwargs.pop("mean_r", 0.0),
+                           kwargs.pop("mean_g", 0.0),
+                           kwargs.pop("mean_b", 0.0)], dtype=_np2.float32)
+    std = None
+    if any(k in kwargs for k in ("std_r", "std_g", "std_b")):
+        std = _np2.array([kwargs.pop("std_r", 1.0),
+                          kwargs.pop("std_g", 1.0),
+                          kwargs.pop("std_b", 1.0)], dtype=_np2.float32)
+    kwargs.pop("preprocess_threads", None)
+    kwargs.pop("prefetch_buffer", None)
+    # C++ round_batch: True wraps/pads the tail batch, False emits it partial
+    if kwargs.pop("round_batch", True):
+        kwargs.setdefault("last_batch_handle", "pad")
+    inner = ImageIter(mean=mean, std=std, **kwargs)
+    return PrefetchingIter(inner)
 
 
 class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
@@ -262,6 +288,7 @@ class PrefetchingIter(DataIter):
         self._queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = None
+        self._exhausted = False
         self._start()
 
     def _start(self):
@@ -305,11 +332,15 @@ class PrefetchingIter(DataIter):
             it.reset()
         self._stop = threading.Event()
         self._queue = _queue.Queue(maxsize=self._queue.maxsize)
+        self._exhausted = False
         self._start()
 
     def next(self):
+        if self._exhausted:
+            raise StopIteration
         batches = self._queue.get()
         if batches is None:
+            self._exhausted = True
             raise StopIteration
         b = batches[0]
         if len(batches) > 1:

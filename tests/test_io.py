@@ -1,0 +1,347 @@
+"""Data I/O tests (reference: tests/python/unittest/test_io.py,
+test_recordio.py, test_image.py, test_gluon_data.py)."""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.gluon import data as gdata
+
+cv2 = pytest.importorskip("cv2")
+
+
+# ---------------------------------------------------------------------------
+# recordio
+# ---------------------------------------------------------------------------
+def test_recordio_roundtrip(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(fname, "w")
+    payloads = [b"x" * n for n in (1, 3, 4, 17, 1000)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(fname, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_binary_format(tmp_path):
+    """Verify the exact dmlc record framing: magic + len + 4-byte padding."""
+    fname = str(tmp_path / "fmt.rec")
+    w = recordio.MXRecordIO(fname, "w")
+    w.write(b"abcde")  # length 5 -> 3 pad bytes
+    w.close()
+    raw = open(fname, "rb").read()
+    magic, lrec = struct.unpack("<II", raw[:8])
+    assert magic == 0xCED7230A
+    assert lrec & ((1 << 29) - 1) == 5
+    assert raw[8:13] == b"abcde"
+    assert len(raw) == 16  # 8 header + 5 payload + 3 pad
+
+
+def test_indexed_recordio(tmp_path):
+    fname = str(tmp_path / "idx.rec")
+    idx = str(tmp_path / "idx.idx")
+    w = recordio.MXIndexedRecordIO(idx, fname, "w")
+    for i in range(10):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, fname, "r")
+    assert r.keys == list(range(10))
+    for i in (5, 0, 9, 3):
+        assert r.read_idx(i) == b"rec%d" % i
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0 and h2.id == 7
+    # array label
+    lab = np.array([1.0, 2.0, 5.0], np.float32)
+    s = recordio.pack(recordio.IRHeader(0, lab, 1, 0), b"z")
+    h3, p3 = recordio.unpack(s)
+    np.testing.assert_array_equal(h3.label, lab)
+    assert p3 == b"z"
+
+
+def _make_rec_dataset(tmp_path, n=24, size=32):
+    """Synthetic image .rec with class index encoded in the red channel."""
+    rng = np.random.RandomState(0)
+    fname = str(tmp_path / "data.rec")
+    idxname = str(tmp_path / "data.idx")
+    w = recordio.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(n):
+        label = i % 3
+        img = np.zeros((size, size, 3), np.uint8)
+        img[:, :, 2] = label * 80 + 40  # BGR: red channel
+        img += rng.randint(0, 20, img.shape).astype(np.uint8)
+        s = recordio.pack_img(recordio.IRHeader(0, float(label), i, 0), img,
+                              quality=95)
+        w.write_idx(i, s)
+    w.close()
+    return fname
+
+
+# ---------------------------------------------------------------------------
+# image
+# ---------------------------------------------------------------------------
+def test_imdecode_and_augmenters(tmp_path):
+    img = np.zeros((40, 60, 3), np.uint8)
+    img[:, :, 0] = 200
+    ret, buf = cv2.imencode(".png", img)
+    decoded = mx.image.imdecode(buf.tobytes())
+    assert decoded.shape == (40, 60, 3)
+    # to_rgb: BGR channel 0 (blue) became channel 2
+    assert decoded.asnumpy()[0, 0, 2] == 200
+
+    resized = mx.image.resize_short(decoded, 20)
+    assert min(resized.shape[:2]) == 20
+    cropped, _ = mx.image.center_crop(decoded, (30, 30))
+    assert cropped.shape == (30, 30, 3)
+    out = mx.image.color_normalize(cropped, mean=(100, 100, 100),
+                                   std=(50, 50, 50))
+    assert out.dtype == np.float32
+
+    augs = mx.image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                    rand_mirror=True, brightness=0.1,
+                                    contrast=0.1, saturation=0.1,
+                                    mean=True, std=True)
+    x = decoded
+    for aug in augs:
+        x = aug(x)
+    assert x.shape == (24, 24, 3)
+
+
+def test_image_iter_rec(tmp_path):
+    rec = _make_rec_dataset(tmp_path)
+    it = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                            path_imgrec=rec, shuffle=True, rand_crop=True,
+                            rand_mirror=True)
+    nb = 0
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 28, 28)
+        assert batch.label[0].shape == (8,)
+        nb += 1
+    assert nb == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_image_record_iter_wrapper(tmp_path):
+    rec = _make_rec_dataset(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 28, 28),
+                               batch_size=6, shuffle=False,
+                               mean_r=128, mean_g=128, mean_b=128)
+    batch = it.next()
+    assert batch.data[0].shape == (6, 3, 28, 28)
+    it.reset()
+
+
+def test_image_iter_sharding(tmp_path):
+    rec = _make_rec_dataset(tmp_path)
+    parts = []
+    for pi in range(2):
+        it = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                                path_imgrec=rec, num_parts=2, part_index=pi)
+        parts.append(sum(b.data[0].shape[0] - b.pad for b in it))
+    assert sum(parts) == 24
+
+
+# ---------------------------------------------------------------------------
+# gluon.data
+# ---------------------------------------------------------------------------
+def test_array_dataset_and_loader():
+    X = np.random.RandomState(0).randn(20, 5).astype(np.float32)
+    y = np.arange(20, dtype=np.float32)
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 20
+    x0, y0 = ds[3]
+    np.testing.assert_array_equal(x0, X[3])
+    loader = gdata.DataLoader(ds, batch_size=6, shuffle=False,
+                              last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (6, 5)
+    assert batches[-1][0].shape == (2, 5)
+    np.testing.assert_array_equal(batches[0][1].asnumpy(), y[:6])
+
+
+def test_dataloader_shuffle_and_discard():
+    ds = gdata.ArrayDataset(np.arange(17, dtype=np.float32))
+    loader = gdata.DataLoader(ds, batch_size=5, shuffle=True,
+                              last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 3
+    seen = np.concatenate([b.asnumpy() for b in batches])
+    assert len(set(seen.tolist())) == 15
+
+
+def _fill3(x):
+    # module-level so it pickles to forkserver workers
+    return np.full((3,), x, np.float32)
+
+
+def test_dataloader_multiworker():
+    ds = gdata.SimpleDataset(list(range(32))).transform(_fill3)
+    loader = gdata.DataLoader(ds, batch_size=8, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    total = np.concatenate([b.asnumpy()[:, 0] for b in batches])
+    assert sorted(total.tolist()) == list(range(32))
+
+
+def test_record_file_dataset(tmp_path):
+    rec = _make_rec_dataset(tmp_path, n=10)
+    ds = gdata.vision.ImageRecordDataset(rec)
+    assert len(ds) == 10
+    img, label = ds[4]
+    assert img.shape == (32, 32, 3)
+    assert int(label) == 4 % 3
+
+
+def test_transforms_pipeline(tmp_path):
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    rec = _make_rec_dataset(tmp_path, n=8)
+    tf = T.Compose([T.Resize(26), T.CenterCrop(24), T.ToTensor(),
+                    T.Normalize([0.5, 0.5, 0.5], [0.25, 0.25, 0.25])])
+    ds = gdata.vision.ImageRecordDataset(rec).transform_first(tf)
+    loader = gdata.DataLoader(ds, batch_size=4)
+    x, y = next(iter(loader))
+    assert x.shape == (4, 3, 24, 24)
+    assert x.dtype == np.float32
+
+
+def test_mnist_dataset(tmp_path):
+    """MNIST idx format (synthesized locally — no egress)."""
+    import gzip
+    root = tmp_path / "mnist"
+    root.mkdir()
+    n = 50
+    imgs = np.random.RandomState(0).randint(0, 255, (n, 28, 28),
+                                            dtype=np.uint8)
+    labs = (np.arange(n) % 10).astype(np.uint8)
+    with gzip.open(root / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28) + imgs.tobytes())
+    with gzip.open(root / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + labs.tobytes())
+    ds = gdata.vision.MNIST(root=str(root), train=True)
+    assert len(ds) == 50
+    img, lab = ds[7]
+    assert img.shape == (28, 28, 1)
+    assert int(lab) == 7
+
+
+def test_im2rec_tool(tmp_path):
+    """tools/im2rec.py --list + pack roundtrip (reference: tools/im2rec.py)."""
+    imgdir = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (imgdir / cls).mkdir(parents=True)
+        for i in range(3):
+            img = np.random.RandomState(i).randint(
+                0, 255, (32, 32, 3), dtype=np.uint8)
+            cv2.imwrite(str(imgdir / cls / ("%d.jpg" % i)), img)
+    prefix = str(tmp_path / "pack")
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools", "im2rec.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.check_call([sys.executable, tool, prefix, str(imgdir),
+                           "--list", "--recursive"], env=env)
+    subprocess.check_call([sys.executable, tool, prefix, str(imgdir)],
+                          env=env)
+    assert os.path.isfile(prefix + ".rec") and os.path.isfile(prefix + ".idx")
+    ds = gdata.vision.ImageRecordDataset(prefix + ".rec")
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3)
+    labels = {int(ds[i][1]) for i in range(6)}
+    assert labels == {0, 1}
+
+
+def test_image_iter_noidx_shard_and_shuffle(tmp_path):
+    """Sharding/shuffle must work without an .idx sidecar (offset scan)."""
+    rec = _make_rec_dataset(tmp_path)
+    os.remove(str(tmp_path / "data.idx"))
+    parts = []
+    for pi in range(2):
+        it = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                                path_imgrec=rec, num_parts=2, part_index=pi,
+                                shuffle=True)
+        parts.append(sum(b.data[0].shape[0] - b.pad for b in it))
+    assert sum(parts) == 24
+
+
+def test_image_iter_grayscale(tmp_path):
+    rec = _make_rec_dataset(tmp_path)
+    it = mx.image.ImageIter(batch_size=4, data_shape=(1, 28, 28),
+                            path_imgrec=rec)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 1, 28, 28)
+
+
+def test_image_iter_last_batch(tmp_path):
+    rec = _make_rec_dataset(tmp_path, n=10)
+    it = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                            path_imgrec=rec, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2 and batches[1].pad == 6
+    # padded rows repeat the last valid sample, not zeros
+    tail = batches[1].data[0].asnumpy()
+    np.testing.assert_array_equal(tail[2], tail[7])
+    it2 = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                             path_imgrec=rec, last_batch_handle="discard")
+    assert len(list(it2)) == 1
+
+
+def test_prefetching_iter_exhaustion():
+    inner = mx.io.NDArrayIter(np.zeros((8, 2), np.float32), np.zeros(8),
+                              batch_size=4)
+    pf = mx.io.PrefetchingIter(inner)
+    assert len(list(pf)) == 2
+    # further iteration raises immediately instead of hanging
+    with pytest.raises(StopIteration):
+        pf.next()
+    pf.reset()
+    assert len(list(pf)) == 2
+
+
+def test_augmenter_numpy_passthrough():
+    """Host pipeline: numpy in -> numpy out (no device bounce per image)."""
+    img = np.random.RandomState(0).randint(0, 255, (32, 32, 3), np.uint8)
+    augs = mx.image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                    rand_mirror=True, brightness=0.1,
+                                    mean=True, std=True)
+    x = img
+    for aug in augs:
+        x = aug(x)
+        assert isinstance(x, np.ndarray), type(aug).__name__
+    # NDArray in -> NDArray out (API parity)
+    from mxnet_tpu.ndarray import NDArray
+    y = mx.nd.array(img, dtype=np.uint8)
+    for aug in augs:
+        y = aug(y)
+    assert isinstance(y, NDArray)
+
+
+def test_gluon_unroll_valid_length_states():
+    """Final unroll states come from t=valid_length-1, not the padded end."""
+    from mxnet_tpu import gluon
+    cell = gluon.rnn.LSTMCell(4)
+    cell.initialize()
+    rng = np.random.RandomState(0)
+    x_valid = rng.randn(1, 3, 5).astype(np.float32)
+    pad = np.full((1, 3, 5), 99.0, np.float32)
+    x = np.concatenate([x_valid, pad], axis=1)
+    _, states_full = cell.unroll(6, mx.nd.array(x), layout="NTC",
+                                 valid_length=mx.nd.array([3.0]))
+    _, states_short = cell.unroll(3, mx.nd.array(x_valid), layout="NTC")
+    for sf, ss in zip(states_full, states_short):
+        np.testing.assert_allclose(sf.asnumpy(), ss.asnumpy(), rtol=1e-5)
