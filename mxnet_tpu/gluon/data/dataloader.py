@@ -92,7 +92,6 @@ class DataLoader:
         if self._num_workers > 0:
             if thread_pool:
                 from multiprocessing.pool import ThreadPool
-                _worker_init(dataset)
                 self._pool = ThreadPool(self._num_workers)
             else:
                 # forkserver: fork() from a multithreaded jax process can
@@ -121,7 +120,13 @@ class DataLoader:
                 batch = next(it)
             except StopIteration:
                 return False
-            if self._batchify_fn is not None:
+            if self._thread_pool:
+                # threads share this process: pass the dataset explicitly
+                # (a module global would be clobbered by a second loader)
+                async_results.append(self._pool.apply_async(
+                    _thread_worker_fn,
+                    (self._dataset, batch, self._batchify_fn)))
+            elif self._batchify_fn is not None:
                 async_results.append(self._pool.apply_async(
                     _custom_worker_fn, (batch, self._batchify_fn)))
             else:
@@ -155,3 +160,8 @@ class DataLoader:
 
 def _custom_worker_fn(samples, batchify_fn):
     return batchify_fn([_worker_dataset[i] for i in samples])
+
+
+def _thread_worker_fn(dataset, samples, batchify_fn):
+    fn = batchify_fn or _np_batchify
+    return fn([dataset[i] for i in samples])

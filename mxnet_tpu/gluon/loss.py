@@ -129,7 +129,10 @@ class CTCLoss(Loss):
             pred = F.swapaxes(pred, 0, 1)
         if self._label_layout == "TN":
             label = F.swapaxes(label, 0, 1)
-        loss = F.contrib_ctc_loss(pred, label, pred_lengths, label_lengths)
+        loss = F.ctc_loss(pred, label, pred_lengths, label_lengths,
+                          use_data_lengths=pred_lengths is not None,
+                          use_label_lengths=label_lengths is not None,
+                          blank_label="last")
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
 

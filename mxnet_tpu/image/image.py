@@ -575,10 +575,16 @@ class ImageIter(_io.DataIter):
         if pad:
             if self.last_batch_handle == "discard":
                 raise StopIteration
-            # pad by repeating the last valid sample (reference C++ iterator
-            # behaviour); DataBatch.pad tells consumers how many to drop
-            batch_data[i:] = batch_data[i - 1]
-            batch_label[i:] = batch_label[i - 1]
+            if self.last_batch_handle == "keep":
+                # emit the partial tail as-is (C++ round_batch=0)
+                batch_data = batch_data[:i]
+                batch_label = batch_label[:i]
+                pad = 0
+            else:
+                # pad by repeating the last valid sample (reference C++
+                # iterator); DataBatch.pad tells consumers how many to drop
+                batch_data[i:] = batch_data[i - 1]
+                batch_label[i:] = batch_label[i - 1]
         data = nd.array(batch_data.transpose(0, 3, 1, 2), dtype=self.dtype)
         label = nd.array(batch_label if lw > 1 else batch_label[:, 0])
         return _io.DataBatch([data], [label], pad=pad)

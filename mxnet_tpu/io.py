@@ -47,6 +47,8 @@ def ImageRecordIter(**kwargs):
     # C++ round_batch: True wraps/pads the tail batch, False emits it partial
     if kwargs.pop("round_batch", True):
         kwargs.setdefault("last_batch_handle", "pad")
+    else:
+        kwargs.setdefault("last_batch_handle", "keep")
     inner = ImageIter(mean=mean, std=std, **kwargs)
     return PrefetchingIter(inner)
 
@@ -292,11 +294,17 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def _start(self):
+        self._error = None
+
         def run():
             while not self._stop.is_set():
                 try:
                     batches = [it.next() for it in self.iters]
                 except StopIteration:
+                    self._queue.put(None)
+                    return
+                except BaseException as e:  # surface at next(), don't hang
+                    self._error = e
                     self._queue.put(None)
                     return
                 self._queue.put(batches)
@@ -341,6 +349,9 @@ class PrefetchingIter(DataIter):
         batches = self._queue.get()
         if batches is None:
             self._exhausted = True
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
             raise StopIteration
         b = batches[0]
         if len(batches) > 1:

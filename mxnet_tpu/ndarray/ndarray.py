@@ -438,8 +438,20 @@ def invoke(op, args, kwargs, out=None):
     params.pop("name", None)
     out = params.pop("out", out)
 
-    # assemble ordered tensor inputs
-    inputs = [a for a in args]
+    # assemble ordered tensor inputs; scalar positional args (ints, floats,
+    # strings, tuples — e.g. nd.swapaxes(x, 0, 1)) map onto fn's parameter
+    # names by position, matching the reference's generated signatures
+    inputs = []
+    if op.arg_names != ["args"]:
+        for i, a in enumerate(args):
+            if isinstance(a, (NDArray, jnp.ndarray, _np.ndarray)) or a is None:
+                inputs.append(a)
+            elif i < len(op.fn_params):
+                params.setdefault(op.fn_params[i], a)
+            else:
+                inputs.append(a)
+    else:
+        inputs = [a for a in args]
     if op.arg_names != ["args"]:
         names = list(op.arg_names)
         for idx, aux_name in sorted(op.aux.items()):

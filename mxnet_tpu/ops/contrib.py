@@ -1,0 +1,570 @@
+"""contrib ops: CTC loss, SSD MultiBox family, box NMS, misc.
+
+Reference: ``src/operator/contrib/`` — ``ctc_loss.cc`` (vendored warp-ctc),
+``multibox_prior.cc`` / ``multibox_target.cc`` / ``multibox_detection.cc``
+(SSD), ``bounding_box.cc`` (box_nms/box_iou), ``count_sketch.cu``,
+``fft.cu``, ``krprod.cc``, adaptive pooling / bilinear resize.
+
+TPU-native design: CTC is the log-space forward recursion under
+``lax.scan`` (the reference calls warp-ctc kernels); its gradient comes
+from jax autodiff through the recursion — exact, and XLA fuses the whole
+loss+grad into the training program.  NMS/matching are O(N²) masked tensor
+ops (no data-dependent loops) so they compile to fixed-shape XLA programs.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# CTC loss
+# ---------------------------------------------------------------------------
+def _ctc_single(log_probs, labels, data_len, label_len, blank):
+    """Negative log-likelihood for one sequence.
+    log_probs: (T, A) log-softmax; labels: (L,) int32 padded."""
+    T, A = log_probs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((S,), blank, dtype=jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    s_idx = jnp.arange(S)
+    valid_s = s_idx < (2 * label_len + 1)
+
+    # transition allowed from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((S,), _NEG_INF)
+    alpha0 = alpha0.at[0].set(log_probs[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(label_len > 0, log_probs[0, ext[1]],
+                                        _NEG_INF))
+
+    def step(alpha, t):
+        lp = log_probs[t]
+        a_prev = alpha
+        a_m1 = jnp.concatenate([jnp.array([_NEG_INF]), alpha[:-1]])
+        a_m2 = jnp.concatenate([jnp.full((2,), _NEG_INF), alpha[:-2]])
+        a_m2 = jnp.where(can_skip, a_m2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_m1), a_m2)
+        new = merged + lp[ext]
+        new = jnp.where(valid_s, new, _NEG_INF)
+        # freeze past data_len (padding timesteps)
+        new = jnp.where(t < data_len, new, alpha)
+        return new, None
+
+    alpha_T, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = alpha_T[jnp.maximum(2 * label_len, 0)]
+    end2 = jnp.where(label_len > 0,
+                     alpha_T[jnp.maximum(2 * label_len - 1, 0)], _NEG_INF)
+    ll = jnp.logaddexp(end1, end2)
+    # degenerate T=1 case: scan didn't run
+    ll = jnp.where(T > 1, ll, jnp.logaddexp(
+        alpha0[jnp.maximum(2 * label_len, 0)],
+        jnp.where(label_len > 0, alpha0[jnp.maximum(2 * label_len - 1, 0)],
+                  _NEG_INF)))
+    return -ll
+
+
+def _ctc_optional(params):
+    opt = []
+    if not params.get("use_data_lengths", False):
+        opt.append("data_lengths")
+    if not params.get("use_label_lengths", False):
+        opt.append("label_lengths")
+    return opt
+
+
+@register("_contrib_ctc_loss",
+          arg_names=["data", "label", "data_lengths", "label_lengths"],
+          aliases=("ctc_loss", "CTCLoss", "_contrib_CTCLoss"),
+          optional_args=_ctc_optional)
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """CTC loss (reference: src/operator/contrib/ctc_loss.cc).
+
+    data: (seq_len, batch, alphabet) activations (softmax applied inside,
+    warp-ctc semantics); label: (batch, label_len) padded.  Returns (batch,)
+    losses.  Gradient = autodiff through the log-space forward recursion."""
+    T, B, A = data.shape
+    log_probs = jax.nn.log_softmax(data, axis=-1)
+    labels = label.astype(jnp.int32)
+    blank = 0 if blank_label == "first" else A - 1
+
+    if use_label_lengths and label_lengths is not None:
+        lab_lens = label_lengths.astype(jnp.int32)
+    else:
+        # infer: count entries != padding (0 for 'first', -1 for 'last')
+        pad_val = 0 if blank_label == "first" else -1
+        lab_lens = jnp.sum((labels != pad_val).astype(jnp.int32), axis=-1)
+    if use_data_lengths and data_lengths is not None:
+        dat_lens = data_lengths.astype(jnp.int32)
+    else:
+        dat_lens = jnp.full((B,), T, jnp.int32)
+
+    losses = jax.vmap(_ctc_single, in_axes=(1, 0, 0, 0, None))(
+        log_probs, labels, dat_lens, lab_lens, blank)
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# box utilities
+# ---------------------------------------------------------------------------
+def _box_iou_corner(a, b):
+    """IoU between (..., 4) corner boxes a (N,4) and b (M,4) → (N, M)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(br - tl, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0, None) * \
+        jnp.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0, None) * \
+        jnp.clip(b[:, 3] - b[:, 1], 0, None)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", arg_names=["lhs", "rhs"])
+def box_iou(lhs, rhs, format="corner"):
+    """Reference: src/operator/contrib/bounding_box.cc box_iou."""
+    a, b = lhs, rhs
+    if format == "center":
+        a = jnp.concatenate([a[..., :2] - a[..., 2:] / 2,
+                             a[..., :2] + a[..., 2:] / 2], axis=-1)
+        b = jnp.concatenate([b[..., :2] - b[..., 2:] / 2,
+                             b[..., :2] + b[..., 2:] / 2], axis=-1)
+    a2 = a.reshape(-1, 4)
+    b2 = b.reshape(-1, 4)
+    out = _box_iou_corner(a2, b2)
+    return out.reshape(a.shape[:-1] + b.shape[:-1])
+
+
+def _nms_single(boxes, scores, valid, overlap_thresh, topk, class_ids=None):
+    """Greedy NMS over one image: returns keep mask (N,) bool.
+    O(N²) masked formulation — no data-dependent control flow.  With
+    ``class_ids`` only same-class pairs suppress (class-aware NMS)."""
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    valid_s = valid[order]
+    iou = _box_iou_corner(boxes_s, boxes_s)
+    if class_ids is not None:
+        same = class_ids[:, None] == class_ids[None, :]
+        iou = iou * same[order][:, order]
+
+    def body(i, keep):
+        # suppress j>i if iou(i, j) > thresh and i kept
+        sup = (iou[i] > overlap_thresh) & (jnp.arange(N) > i) & keep[i]
+        return keep & ~sup
+
+    keep0 = valid_s > 0
+    if topk > 0:
+        keep0 = keep0 & (jnp.arange(N) < topk)
+    keep = lax.fori_loop(0, N, body, keep0)
+    # unsort
+    inv = jnp.argsort(order)
+    return keep[inv]
+
+
+@register("_contrib_box_nms", arg_names=["data"], aliases=("box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Greedy box NMS (reference: bounding_box.cc BoxNMS).  Suppressed
+    entries are overwritten with -1 like the reference."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    cs = coord_start
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = batch[:, cs:cs + 4]
+        if in_format == "center":
+            boxes = jnp.concatenate([boxes[:, :2] - boxes[:, 2:] / 2,
+                                     boxes[:, :2] + boxes[:, 2:] / 2], axis=-1)
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid = valid & (batch[:, id_index] != background_id)
+        class_ids = batch[:, id_index] \
+            if (id_index >= 0 and not force_suppress) else None
+        keep = _nms_single(boxes, scores, valid, overlap_thresh, topk,
+                           class_ids=class_ids)
+        return jnp.where(keep[:, None], batch, -jnp.ones_like(batch))
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# SSD MultiBox family
+# ---------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior", arg_names=["data"],
+          aliases=("MultiBoxPrior", "_contrib_multibox_prior"))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5)):
+    """Anchor generation (reference: multibox_prior.cc).  data: (N, C, H, W);
+    returns (1, H*W*num_anchors, 4) corner boxes in [0, 1] coords."""
+    if isinstance(sizes, (int, float)):
+        sizes = (sizes,)
+    if isinstance(ratios, (int, float)):
+        ratios = (ratios,)
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (h,w,2)
+
+    # reference anchor set: (size, ratio=1) for each size + (size0, ratio)
+    # for each extra ratio — num_anchors = len(sizes) + len(ratios) - 1
+    whs = []
+    for s in sizes:
+        whs.append((s * _np.sqrt(ratios[0]), s / _np.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * _np.sqrt(r), sizes[0] / _np.sqrt(r)))
+    whs = jnp.asarray(whs, jnp.float32)  # (A, 2) = (w, h)
+
+    centers = jnp.broadcast_to(cyx[:, :, None, :],
+                               (h, w, whs.shape[0], 2))
+    half_w = whs[None, None, :, 0] / 2
+    half_h = whs[None, None, :, 1] / 2
+    xmin = centers[..., 1] - half_w
+    ymin = centers[..., 0] - half_h
+    xmax = centers[..., 1] + half_w
+    ymax = centers[..., 0] + half_h
+    out = jnp.stack([xmin, ymin, xmax, ymax], axis=-1).reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+@register("_contrib_MultiBoxTarget", arg_names=["anchor", "label", "cls_pred"],
+          aliases=("MultiBoxTarget", "_contrib_multibox_target"),
+          num_outputs=3, differentiable=False)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Anchor→GT matching + loc target encoding
+    (reference: multibox_target.cc).
+
+    anchor: (1, N, 4) corner; label: (B, M, 5) [cls, xmin, ymin, xmax, ymax]
+    padded with -1; cls_pred: (B, num_cls+1, N) (used for shape/negative
+    mining).  Returns (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N))."""
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+
+    def one(lab, cpred):
+        valid = lab[:, 0] >= 0
+        gt = lab[:, 1:5]
+        iou = _box_iou_corner(anchors, gt)            # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)             # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # bipartite: each gt claims its best anchor; invalid gts scatter to
+        # index N which mode='drop' discards (a plain set() would let an
+        # invalid gt overwrite a valid one at a duplicate index)
+        best_anchor_per_gt = jnp.argmax(iou, axis=0)  # (M,)
+        claim_idx = jnp.where(valid, best_anchor_per_gt, N)
+        forced = jnp.zeros((N,), bool).at[claim_idx].set(True, mode="drop")
+        pos = forced | (best_iou >= overlap_threshold)
+        # for forced anchors, match to the gt that claimed them
+        claim = jnp.full((N,), -1, jnp.int32).at[claim_idx].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32), mode="drop")
+        match = jnp.where(claim >= 0, claim, best_gt.astype(jnp.int32))
+
+        cls_t = jnp.where(pos, lab[match, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard-negative mining (reference: multibox_target.cc) — keep the
+            # hardest negatives (lowest background prob / IoU below the
+            # mining threshold); the rest become ignore_label
+            bg_prob = jax.nn.softmax(cpred, axis=0)[0]      # (N,)
+            neg_cand = (~pos) & (best_iou < negative_mining_thresh)
+            hardness = jnp.where(neg_cand, 1.0 - bg_prob, -1.0)
+            num_pos = jnp.sum(pos)
+            num_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            rank = jnp.argsort(jnp.argsort(-hardness))       # 0 = hardest
+            keep_neg = neg_cand & (rank < num_neg)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        g = gt[match]
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = g[:, 2] - g[:, 0]
+        gh = g[:, 3] - g[:, 1]
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        eps = 1e-8
+        tx = (gcx - acx) / jnp.maximum(aw, eps) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, eps) / variances[1]
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, eps), eps)) / variances[2]
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, eps), eps)) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(pos[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(pos[:, None], 1.0,
+                          0.0) * jnp.ones((N, 4))
+        return loc_t, loc_m.reshape(-1), cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(one)(label, cls_pred)
+    return loc_target, loc_mask, cls_target
+
+
+@register("_contrib_MultiBoxDetection",
+          arg_names=["cls_prob", "loc_pred", "anchor"],
+          aliases=("MultiBoxDetection", "_contrib_multibox_detection"),
+          differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode + NMS (reference: multibox_detection.cc).
+
+    cls_prob: (B, num_cls+1, N); loc_pred: (B, N*4); anchor: (1, N, 4).
+    Returns (B, N, 6) rows [cls_id, score, xmin, ymin, xmax, ymax], -1 pad."""
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(probs, loc):
+        loc = loc.reshape(-1, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * variances[2]) * aw
+        h = jnp.exp(loc[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([probs[:background_id],
+                              probs[background_id + 1:]], axis=0)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep_valid = score > threshold
+        rows = jnp.concatenate([cls_id[:, None], score[:, None], boxes],
+                               axis=-1)
+        rows = jnp.where(keep_valid[:, None], rows, -1.0)
+        out = box_nms(rows[None], overlap_thresh=nms_threshold,
+                      valid_thresh=threshold, topk=nms_topk, coord_start=2,
+                      score_index=1, id_index=0, background_id=-1,
+                      force_suppress=force_suppress)[0]
+        return out
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# misc contrib
+# ---------------------------------------------------------------------------
+@register("_contrib_AdaptiveAvgPooling2D", arg_names=["data"],
+          aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling2d(data, output_size=(1, 1)):
+    """Reference: contrib/adaptive_avg_pooling.cc."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    n, c, h, w = data.shape
+    oh, ow = output_size
+    # integral-image approach for exact adaptive pooling
+    out = jnp.zeros((n, c, oh, ow), data.dtype)
+    ys = [int(_np.floor(i * h / oh)) for i in range(oh)]
+    ye = [int(_np.ceil((i + 1) * h / oh)) for i in range(oh)]
+    xs = [int(_np.floor(j * w / ow)) for j in range(ow)]
+    xe = [int(_np.ceil((j + 1) * w / ow)) for j in range(ow)]
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            cols.append(jnp.mean(data[:, :, ys[i]:ye[i], xs[j]:xe[j]],
+                                 axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+@register("_contrib_BilinearResize2D", arg_names=["data"],
+          aliases=("BilinearResize2D",))
+def bilinear_resize2d(data, height=1, width=1, scale_height=None,
+                      scale_width=None):
+    """Reference: contrib/bilinear_resize.cc — align_corners=True like cuDNN."""
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(round(h * scale_height))
+        width = int(round(w * scale_width))
+    return jax.image.resize(data, (n, c, int(height), int(width)),
+                            method="linear")
+
+
+@register("_contrib_count_sketch", arg_names=["data", "h", "s"],
+          aliases=("count_sketch",))
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count sketch projection (reference: contrib/count_sketch.cu)."""
+    n, in_dim = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)[:in_dim]
+    ss = s.reshape(-1)[:in_dim]
+    out = jnp.zeros((n, int(out_dim)), data.dtype)
+    vals = data * ss[None, :]
+    return out.at[:, hh].add(vals)
+
+
+@register("_contrib_fft", arg_names=["data"], aliases=("fft",))
+def fft(data, compute_size=128):
+    """FFT returning interleaved real/imag (reference: contrib/fft.cu)."""
+    out = jnp.fft.fft(data, axis=-1)
+    inter = jnp.stack([out.real, out.imag], axis=-1)
+    return inter.reshape(data.shape[:-1] + (data.shape[-1] * 2,)) \
+        .astype(data.dtype)
+
+
+@register("_contrib_ifft", arg_names=["data"], aliases=("ifft",))
+def ifft(data, compute_size=128):
+    n = data.shape[-1] // 2
+    comp = data.reshape(data.shape[:-1] + (n, 2))
+    z = comp[..., 0] + 1j * comp[..., 1]
+    return jnp.fft.ifft(z, axis=-1).real.astype(data.dtype)
+
+
+@register("khatri_rao", arg_names=["args"])
+def khatri_rao(*args):
+    """Column-wise Khatri-Rao product (reference: contrib/krprod.cc)."""
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+@register("_contrib_getnnz", arg_names=["data"], differentiable=False)
+def getnnz(data, axis=None):
+    return jnp.sum((data != 0).astype(jnp.int64), axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign / deformable convolution
+# ---------------------------------------------------------------------------
+def _bilinear_gather(feat, y, x):
+    """feat: (C, H, W); y/x: (...) float coords.  Bilinear sample with
+    zero padding outside."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+
+    def tap(yy, xx, wgt):
+        iy = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+        ix = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+        inside = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        vals = feat[:, iy, ix]          # (C, ...)
+        return vals * (wgt * inside)[None]
+
+    return (tap(y0, x0, (1 - wy1) * (1 - wx1)) +
+            tap(y0, x0 + 1, (1 - wy1) * wx1) +
+            tap(y0 + 1, x0, wy1 * (1 - wx1)) +
+            tap(y0 + 1, x0 + 1, wy1 * wx1))
+
+
+@register("_contrib_ROIAlign", arg_names=["data", "rois"],
+          aliases=("ROIAlign",))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False):
+    """ROI Align (reference: src/operator/contrib/roi_align.cc).
+
+    data: (N, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    if isinstance(pooled_size, int):
+        pooled_size = (pooled_size, pooled_size)
+    ph, pw = pooled_size
+    sr = max(int(sample_ratio), 1)
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(ph)[:, None, None, None] * bin_h +
+              (jnp.arange(sr)[None, None, :, None] + 0.5) * bin_h / sr + y1)
+        ix = (jnp.arange(pw)[None, :, None, None] * bin_w +
+              (jnp.arange(sr)[None, None, None, :] + 0.5) * bin_w / sr + x1)
+        yy = jnp.broadcast_to(iy, (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(ix, (ph, pw, sr, sr))
+        feat = data[bidx]
+        vals = _bilinear_gather(feat, yy.reshape(-1), xx.reshape(-1))
+        vals = vals.reshape(feat.shape[0], ph, pw, sr * sr)
+        return vals.mean(axis=-1)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_DeformableConvolution",
+          arg_names=["data", "offset", "weight", "bias"],
+          aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           workspace=1024, no_bias=False, layout=None):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc).
+
+    offset: (N, 2*dg*kh*kw, OH, OW) — per-position sampling offsets; the
+    deformed im2col is a bilinear gather, then one big MXU matmul."""
+    N, C, H, W = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    OH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = num_deformable_group
+    cpg = C // dg
+
+    def one(img, off):
+        # off: (2*dg*kh*kw, OH, OW) ordered [dg, kh, kw, {y,x}]
+        off = off.reshape(dg, kh, kw, 2, OH, OW)
+        cols = []
+        for g in range(dg):
+            oy = off[g, :, :, 0]                      # (kh, kw, OH, OW)
+            ox = off[g, :, :, 1]
+            # sample coords: (kh, kw, OH, OW)
+            gy = (jnp.arange(OH) * sh - ph)[None, None, :, None] + \
+                (jnp.arange(kh) * dh)[:, None, None, None] + oy
+            gx = (jnp.arange(OW) * sw - pw)[None, None, None, :] + \
+                (jnp.arange(kw) * dw)[None, :, None, None] + ox
+            feat = img[g * cpg:(g + 1) * cpg]
+            vals = _bilinear_gather(feat, gy.reshape(-1), gx.reshape(-1))
+            cols.append(vals.reshape(cpg, kh, kw, OH, OW))
+        col = jnp.concatenate(cols, axis=0)           # (C, kh, kw, OH, OW)
+        if num_group == 1:
+            wmat = weight.reshape(num_filter, -1)
+            out = wmat @ col.reshape(C * kh * kw, OH * OW)
+        else:
+            # grouped: each filter group sees its channel slice
+            cg = C // num_group
+            fg = num_filter // num_group
+            col_g = col.reshape(num_group, cg * kh * kw, OH * OW)
+            w_g = weight.reshape(num_group, fg, cg * kh * kw)
+            out = jnp.einsum("gfk,gko->gfo", w_g, col_g) \
+                .reshape(num_filter, OH * OW)
+        return out.reshape(num_filter, OH, OW)
+
+    out = jax.vmap(one)(data, offset)
+    if bias is not None and not no_bias:
+        out = out + bias[None, :, None, None]
+    return out

@@ -20,6 +20,7 @@ scheduling.  Metadata kept per-op:
 from __future__ import annotations
 
 import ast
+import inspect
 
 __all__ = ["Op", "register", "get", "list_ops", "alias"]
 
@@ -30,7 +31,7 @@ class Op:
     __slots__ = (
         "name", "fn", "arg_names", "aux", "aux_update", "num_outputs",
         "differentiable", "scalar_args", "doc", "needs_train",
-        "optional_args",
+        "optional_args", "fn_params",
     )
 
     def __init__(self, name, fn, arg_names=None, aux=None, aux_update=None,
@@ -48,6 +49,14 @@ class Op:
         # arg names that are NOT auto-created as variables by the symbolic
         # frontend when absent: a tuple of names, or callable(params)->names
         self.optional_args = optional_args
+        try:
+            # positional parameter names of fn, so scalar positional call
+            # args (nd.swapaxes(x, 0, 1)) map onto the right kwargs
+            self.fn_params = [
+                p.name for p in inspect.signature(fn).parameters.values()
+                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+        except (TypeError, ValueError):
+            self.fn_params = list(self.arg_names)
         self.doc = fn.__doc__ or ""
 
     def optional(self, params):

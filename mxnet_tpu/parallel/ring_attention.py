@@ -1,0 +1,161 @@
+"""Long-context attention: ring attention + Ulysses sequence parallelism.
+
+The reference (2018-era MXNet) has no long-context story beyond bucketing
+(SURVEY.md §5); these are the explicitly-new TPU-side capabilities the
+rebuild adds as first-class citizens:
+
+- **Ring attention** (Liu et al. 2023): the sequence axis is sharded over a
+  mesh axis; K/V chunks rotate around the ring via ``lax.ppermute`` riding
+  ICI while each hop's partial attention is folded in with an online
+  (flash-style) softmax.  Peak memory is O(T/n) per chip and the K/V
+  transfer overlaps the matmuls.
+- **Ulysses / all-to-all sequence parallelism** (DeepSpeed-Ulysses): an
+  ``all_to_all`` swaps sequence sharding for head sharding, full attention
+  runs locally per head group, and a second all_to_all swaps back.  Cheaper
+  collectives for moderate sequence lengths; requires heads % n == 0.
+
+Both are pure jax functions usable inside ``shard_map`` (see
+``ring_attention_sharded`` for the pre-wired entry point).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention",
+           "ring_attention_sharded", "ulysses_attention_sharded"]
+
+_NEG_INF = -1e30
+
+
+def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
+                    k_offset=0):
+    """Plain attention on local chunks.  q: (B, Tq, H, D), k/v: (B, Tk, H, D).
+    Offsets give the chunks' global positions for causal masking."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Ring attention over a sharded sequence axis.
+
+    Call inside shard_map; q/k/v are the local (B, T/n, H, D) chunks of a
+    globally (B, T, H, D) tensor sharded on `axis_name`.  Returns the local
+    output chunk.  Equivalent to full softmax attention over the global
+    sequence (verified against local_attention in tests)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    qpos = idx * Tl + jnp.arange(Tl)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, hop):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - hop) % n                        # owner of current chunk
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
+        if causal:
+            kpos = src * Tl + jnp.arange(Tl)
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_hop = jnp.max(s, axis=-1)                  # (B, H, Tq)
+        m_new = jnp.maximum(m, m_hop)
+        # guard fully-masked rows (exp(-inf - -inf))
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(jnp.where(m <= _NEG_INF / 2, _NEG_INF, m) - m_safe)
+        corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        # rotate K/V to the next device over ICI; the compiler overlaps the
+        # permute with the next hop's einsum
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((B, H, Tl), _NEG_INF, q.dtype)
+    l0 = jnp.zeros((B, H, Tl), q.dtype)
+    # mark the fresh carries as device-varying so the scan carry type is
+    # consistent with the rotating k/v (shard_map vma typing)
+    try:
+        m0 = lax.pvary(m0, (axis_name,))
+        l0 = lax.pvary(l0, (axis_name,))
+    except AttributeError:
+        pass
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return o / denom
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
+    """All-to-all (Ulysses) sequence parallelism.
+
+    Local chunks (B, T/n, H, D) are re-sharded to (B, T, H/n, D) with one
+    all_to_all, attended fully per local head group, and re-sharded back.
+    Requires H % n == 0."""
+    n = lax.psum(1, axis_name)
+    B, Tl, H, D = q.shape
+
+    def seq2head(x):
+        # (B, Tl, H, D) -> (B, Tl, n, H/n, D) -> a2a over n -> (B, T, H/n, D)
+        x = x.reshape(B, Tl, n, H // n, D)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=0,
+                           tiled=False)
+        # leading axis now n × B? all_to_all with split_axis=2, concat_axis=0
+        # yields (n*B, Tl, H/n, D) — reorder to (B, n*Tl, H/n, D)
+        x = x.reshape(n, B, Tl, H // n, D)
+        x = x.transpose(1, 0, 2, 3, 4).reshape(B, n * Tl, H // n, D)
+        return x
+
+    def head2seq(x):
+        # inverse of seq2head
+        x = x.reshape(B, n, Tl, H // n, D).transpose(1, 0, 2, 3, 4)
+        x = x.reshape(n * B, Tl, H // n, D)
+        x = lax.all_to_all(x.reshape(n, B, Tl, H // n, D), axis_name,
+                           split_axis=0, concat_axis=3, tiled=False)
+        return x.reshape(B, Tl, H, D)
+
+    qg = seq2head(q)
+    kg = seq2head(k)
+    vg = seq2head(v)
+    o = local_attention(qg, kg, vg, causal=causal, scale=scale)
+    return head2seq(o)
+
+
+def _seq_sharded_spec(mesh, axis):
+    return NamedSharding(mesh, PartitionSpec(None, axis, None, None))
+
+
+def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False):
+    """jit-able global entry: q/k/v are global (B, T, H, D) arrays; the
+    function shards T over `axis` and runs ring attention."""
+    from jax.experimental.shard_map import shard_map
+    spec = PartitionSpec(None, axis, None, None)
+    fn = shard_map(partial(ring_attention, axis_name=axis, causal=causal),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, axis="sp", causal=False):
+    from jax.experimental.shard_map import shard_map
+    spec = PartitionSpec(None, axis, None, None)
+    fn = shard_map(partial(ulysses_attention, axis_name=axis, causal=causal),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,280 @@
+"""contrib op tests: CTC (torch oracle), MultiBox/SSD, NMS, spatial
+(reference: tests/python/unittest/test_contrib_operator.py, test_operator.py
+check_ctc_loss)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _invoke(name, *args, **kwargs):
+    return mx.nd.imperative_invoke(name, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    T, B, A, L = 12, 4, 6, 5
+    rng = np.random.RandomState(0)
+    logits = rng.randn(T, B, A).astype(np.float32)
+    labels = rng.randint(1, A, (B, L)).astype(np.float32)
+    lab_lens = np.array([5, 3, 4, 2], np.int32)
+    dat_lens = np.array([12, 10, 12, 8], np.int32)
+    padded = labels.copy()
+    for b in range(B):
+        padded[b, lab_lens[b]:] = 0
+    mine = _invoke("_contrib_ctc_loss", mx.nd.array(logits),
+                   mx.nd.array(padded),
+                   mx.nd.array(dat_lens.astype(np.float32)),
+                   mx.nd.array(lab_lens.astype(np.float32)),
+                   use_data_lengths=True, use_label_lengths=True).asnumpy()
+    ref = torch.nn.functional.ctc_loss(
+        torch.from_numpy(logits).log_softmax(-1),
+        torch.from_numpy(labels.astype(np.int64)),
+        torch.from_numpy(dat_lens.astype(np.int64)),
+        torch.from_numpy(lab_lens.astype(np.int64)),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(mine, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_grad_vs_torch():
+    torch = pytest.importorskip("torch")
+    T, B, A, L = 8, 2, 5, 3
+    rng = np.random.RandomState(1)
+    logits = rng.randn(T, B, A).astype(np.float32)
+    labels = rng.randint(1, A, (B, L)).astype(np.float32)
+    x = mx.nd.array(logits)
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = mx.nd.sum(_invoke("_contrib_ctc_loss", x, mx.nd.array(labels)))
+    loss.backward()
+    tx = torch.from_numpy(logits).requires_grad_()
+    tl = torch.nn.functional.ctc_loss(
+        tx.log_softmax(-1), torch.from_numpy(labels.astype(np.int64)),
+        torch.full((B,), T, dtype=torch.int64),
+        torch.full((B,), L, dtype=torch.int64), blank=0, reduction="sum")
+    tl.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_gluon_ctc_loss_blank_last():
+    """gluon.loss.CTCLoss uses blank_label='last' (reference: loss.py)."""
+    torch = pytest.importorskip("torch")
+    T, B, A = 10, 3, 7
+    rng = np.random.RandomState(2)
+    logits = rng.randn(B, T, A).astype(np.float32)  # NTC layout
+    labels = rng.randint(0, A - 1, (B, 4)).astype(np.float32)
+    loss = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    out = loss(mx.nd.array(logits), mx.nd.array(labels)).asnumpy()
+    ref = torch.nn.functional.ctc_loss(
+        torch.from_numpy(logits.transpose(1, 0, 2)).log_softmax(-1),
+        torch.from_numpy(labels.astype(np.int64)),
+        torch.full((B,), T, dtype=torch.int64),
+        torch.full((B,), 4, dtype=torch.int64),
+        blank=A - 1, reduction="none").numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# boxes
+# ---------------------------------------------------------------------------
+def test_multibox_prior():
+    x = mx.nd.zeros((1, 3, 4, 4))
+    anchors = _invoke("_contrib_MultiBoxPrior", x, sizes=(0.5, 0.25),
+                      ratios=(1, 2, 0.5))
+    assert anchors.shape == (1, 64, 4)
+    a = anchors.asnumpy()[0]
+    np.testing.assert_allclose(
+        a[0], [0.125 - 0.25, 0.125 - 0.25, 0.125 + 0.25, 0.125 + 0.25],
+        atol=1e-6)
+    # ratio-2 anchor: wider than tall
+    w2 = a[2, 2] - a[2, 0]
+    h2 = a[2, 3] - a[2, 1]
+    assert w2 > h2
+
+
+def test_multibox_target_matching():
+    x = mx.nd.zeros((1, 3, 4, 4))
+    anchors = _invoke("_contrib_MultiBoxPrior", x, sizes=(0.5, 0.25),
+                      ratios=(1,))
+    label = np.full((1, 2, 5), -1.0, np.float32)
+    label[0, 0] = [1, 0.1, 0.1, 0.4, 0.4]
+    cls_pred = mx.nd.zeros((1, 3, 32))
+    loc_t, loc_m, cls_t = _invoke("_contrib_MultiBoxTarget", anchors,
+                                  mx.nd.array(label), cls_pred)
+    ct = cls_t.asnumpy()[0]
+    assert (ct == 2.0).sum() >= 1          # class 1 → target 2 (bg=0)
+    assert (ct == 0).sum() + (ct == 2.0).sum() == 32
+    assert loc_m.asnumpy()[0].sum() == (ct > 0).sum() * 4
+    # encoded loc target finite and nonzero for positives
+    lt = loc_t.asnumpy()[0].reshape(32, 4)
+    pos = ct > 0
+    assert np.isfinite(lt).all() and np.abs(lt[pos]).sum() > 0
+
+
+def test_box_nms():
+    rows = np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                      [0, 0.8, 0.12, 0.12, 0.52, 0.52],
+                      [1, 0.85, 0.11, 0.11, 0.51, 0.51],
+                      [0, 0.7, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    out = _invoke("_contrib_box_nms", mx.nd.array(rows), overlap_thresh=0.5,
+                  coord_start=2, score_index=1, id_index=0).asnumpy()[0]
+    # class-aware: the class-1 box survives though it overlaps class-0 winner
+    assert out[0, 1] == pytest.approx(0.9)
+    assert out[2, 1] == pytest.approx(0.85)
+    assert out[1, 1] == -1                  # same-class overlap suppressed
+    assert out[3, 1] == pytest.approx(0.7)
+    # force_suppress: class ignored
+    out2 = _invoke("_contrib_box_nms", mx.nd.array(rows), overlap_thresh=0.5,
+                   coord_start=2, score_index=1, id_index=0,
+                   force_suppress=True).asnumpy()[0]
+    assert out2[2, 1] == -1
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[1, 1, 3, 3], [4, 4, 5, 5]], np.float32)
+    iou = _invoke("_contrib_box_iou", mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    np.testing.assert_allclose(iou, [[1.0 / 7.0, 0.0]], rtol=1e-5)
+
+
+def test_multibox_detection_decode():
+    x = mx.nd.zeros((1, 3, 2, 2))
+    anchors = _invoke("_contrib_MultiBoxPrior", x, sizes=(0.4,), ratios=(1,))
+    N = 4
+    cls_prob = np.zeros((1, 2, N), np.float32)
+    cls_prob[0, 1] = [0.9, 0.2, 0.8, 0.1]
+    cls_prob[0, 0] = 1 - cls_prob[0, 1]
+    loc_pred = np.zeros((1, N * 4), np.float32)
+    det = _invoke("_contrib_MultiBoxDetection", mx.nd.array(cls_prob),
+                  mx.nd.array(loc_pred), anchors,
+                  nms_threshold=0.5, threshold=0.5).asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert len(kept) >= 1
+    assert (kept[:, 1] >= 0.5).all()
+    # zero loc_pred → decoded boxes equal the anchors
+    a = anchors.asnumpy()[0]
+    best = kept[np.argmax(kept[:, 1])]
+    match = np.abs(a - best[2:]).sum(axis=1).min()
+    assert match < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# spatial / misc
+# ---------------------------------------------------------------------------
+def test_roi_align():
+    data = np.zeros((1, 2, 8, 8), np.float32)
+    data[0, 0] = 3.0
+    data[0, 1] = 7.0
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = _invoke("_contrib_ROIAlign", mx.nd.array(data), mx.nd.array(rois),
+                  pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out[0, 0], 3.0, atol=1e-5)
+    np.testing.assert_allclose(out[0, 1], 7.0, atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    off = np.zeros((2, 18, 7, 7), np.float32)
+    dout = _invoke("_contrib_DeformableConvolution", mx.nd.array(x),
+                   mx.nd.array(off), mx.nd.array(w), kernel=(3, 3),
+                   num_filter=6, no_bias=True).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=6, no_bias=True).asnumpy()
+    np.testing.assert_allclose(dout, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_shift_offset():
+    """Constant offset (0, 1) equals sampling shifted input."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 1, 8, 8).astype(np.float32)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 8, 8), np.float32)
+    off[:, 1] = 1.0  # x-offset +1
+    out = _invoke("_contrib_DeformableConvolution", mx.nd.array(x),
+                  mx.nd.array(off), mx.nd.array(w), kernel=(1, 1),
+                  num_filter=1, no_bias=True).asnumpy()
+    np.testing.assert_allclose(out[0, 0, :, :-1], x[0, 0, :, 1:], atol=1e-5)
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 16).astype(np.float32)
+    f = _invoke("_contrib_fft", mx.nd.array(x))
+    assert f.shape == (3, 32)
+    back = _invoke("_contrib_ifft", f).asnumpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_avg_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = _invoke("_contrib_AdaptiveAvgPooling2D", mx.nd.array(x),
+                  output_size=(2, 2)).asnumpy()
+    np.testing.assert_allclose(out[0, 0],
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_bilinear_resize():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = _invoke("_contrib_BilinearResize2D", mx.nd.array(x), height=4,
+                  width=4).asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    assert out[0, 0, 0, 0] == pytest.approx(0.0)
+
+
+def test_khatri_rao():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    b = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    out = _invoke("khatri_rao", mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    expect = np.array([[1, 0], [0, 2], [3, 0], [0, 4]], np.float32)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([0, 1, 0], np.float32)
+    s = np.array([1, -1, 1], np.float32)
+    out = _invoke("_contrib_count_sketch", mx.nd.array(x), mx.nd.array(h),
+                  mx.nd.array(s), out_dim=2).asnumpy()
+    np.testing.assert_allclose(out, [[4.0, -2.0]])
+
+
+def test_deformable_conv_groups():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 4, 7, 7).astype(np.float32)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 5, 5), np.float32)
+    out = _invoke("_contrib_DeformableConvolution", mx.nd.array(x),
+                  mx.nd.array(off), mx.nd.array(w), kernel=(3, 3),
+                  num_filter=4, num_group=2, no_bias=True).asnumpy()
+    ref = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=4, num_group=2,
+                            no_bias=True).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_multibox_target_negative_mining():
+    x = mx.nd.zeros((1, 3, 4, 4))
+    anchors = _invoke("_contrib_MultiBoxPrior", x, sizes=(0.5, 0.25),
+                      ratios=(1,))
+    label = np.full((1, 2, 5), -1.0, np.float32)
+    label[0, 0] = [1, 0.1, 0.1, 0.4, 0.4]
+    cls_pred = mx.nd.array(
+        np.random.RandomState(0).randn(1, 3, 32).astype(np.float32))
+    _, _, cls_t = _invoke("_contrib_MultiBoxTarget", anchors,
+                          mx.nd.array(label), cls_pred,
+                          negative_mining_ratio=3.0, ignore_label=-1.0)
+    ct = cls_t.asnumpy()[0]
+    n_pos = (ct > 0).sum()
+    n_neg = (ct == 0).sum()
+    n_ign = (ct == -1.0).sum()
+    assert n_pos >= 1
+    assert n_neg <= 3 * n_pos          # mining keeps at most ratio×pos
+    assert n_ign == 32 - n_pos - n_neg and n_ign > 0
