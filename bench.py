@@ -30,6 +30,13 @@ def main():
     mesh = make_mesh((n_dev,), ("data",), devices)
     global_batch = batch * n_dev
 
+    # bf16 MXU precision for fp32 matmuls/convs — the TPU-native analogue of
+    # the reference's fp16 multi-precision path (docs/faq/perf.md fp16 rows);
+    # weights/grads/optimizer state stay fp32.  MXTPU_BENCH_PRECISION=float32
+    # forces full precision.
+    precision = os.environ.get("MXTPU_BENCH_PRECISION", "bfloat16")
+    jax.config.update("jax_default_matmul_precision", precision)
+
     net = vision.resnet50_v1()
     net.initialize(mx.init.Xavier())
     trainer = DataParallelTrainer(

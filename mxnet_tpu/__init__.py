@@ -46,4 +46,7 @@ from . import visualization as viz
 from . import rnn
 from . import gluon
 from . import parallel
+from . import profiler
+from . import engine
+from . import rtc
 from . import test_utils

@@ -1,0 +1,100 @@
+"""ctypes bindings to the native I/O runtime (native/mxtpu_io.cc).
+
+Reference: the C++ data path (dmlc recordio + OMP JPEG decode,
+``src/io/iter_image_recordio_2.cc``).  The library is built on demand with
+g++ and cached next to the source; every entry point has a pure-Python
+fallback so the framework works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_SRC_DIR, "mxtpu_io.cc")
+_SO = os.path.join(_SRC_DIR, "libmxtpu_io.so")
+
+
+def _build():
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-o", _SO, "-ljpeg", "-lpthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            if not os.path.isfile(_SO) or \
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            lib.mxtpu_recordio_index.restype = ctypes.c_long
+            lib.mxtpu_recordio_index.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long]
+            lib.mxtpu_recordio_read.restype = ctypes.c_long
+            lib.mxtpu_recordio_read.argtypes = [
+                ctypes.c_char_p, ctypes.c_long,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_long]
+            lib.mxtpu_decode_batch.restype = ctypes.c_long
+            lib.mxtpu_decode_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int]
+            assert lib.mxtpu_version() >= 1
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def available():
+    return get_lib() is not None
+
+
+def recordio_index(path):
+    """Record offsets of a .rec file via the native scanner (fast path)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = lib.mxtpu_recordio_index(path.encode(), None, 0)
+    if n < 0:
+        return None
+    offsets = (ctypes.c_long * n)()
+    lib.mxtpu_recordio_index(path.encode(), offsets, n)
+    return list(offsets)
+
+
+def decode_batch(buffers, out_h, out_w, channels=3, resize_short=0,
+                 num_threads=0):
+    """Parallel JPEG decode+resize+crop into an (N, H, W, C) uint8 array.
+    `buffers` is a list of bytes objects.  Returns (array, n_failures) or
+    None when the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(buffers)
+    out = np.empty((n, out_h, out_w, channels), np.uint8)
+    bufs = (ctypes.c_char_p * n)(*buffers)
+    lens = (ctypes.c_long * n)(*[len(b) for b in buffers])
+    if num_threads <= 0:
+        num_threads = min(os.cpu_count() or 1, 16)
+    fails = lib.mxtpu_decode_batch(
+        bufs, lens, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out_h, out_w, channels, resize_short, num_threads)
+    return out, int(fails)

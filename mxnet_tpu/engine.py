@@ -1,0 +1,43 @@
+"""Engine control surface (reference: python/mxnet/engine.py).
+
+The reference exposes ``bulk(size)`` — batching engine ops into segments
+(``threaded_engine.h:469`` BulkAppend/BulkFlush) — and internal start/stop.
+On TPU, XLA's async dispatch queue plays the engine's role and jit tracing
+is the bulking mechanism, so these are semantic no-ops kept for script
+parity; ``bulk`` still functions as a hint boundary (it flushes pending
+async work on exit, which is the observable behaviour of a bulk segment
+boundary in the reference).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = 15
+
+
+def set_bulk_size(size):
+    """Reference: MXEngineSetBulkSize; returns the previous size."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Bulk execution scope (reference: engine.py bulk).  XLA already
+    pipelines dispatches; exiting the scope synchronizes like a segment
+    flush."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+        try:
+            jax.effects_barrier()
+        except AttributeError:
+            pass

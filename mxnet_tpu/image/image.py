@@ -500,6 +500,33 @@ class ImageIter(_io.DataIter):
                          "hue", "pca_noise", "rand_gray", "inter_method")})
         self.auglist = aug_list
 
+        # native fast path: when the spatial part of the chain is
+        # deterministic resize+center-crop, the C++ runtime decodes the
+        # whole batch in parallel (native/mxtpu_io.cc — the
+        # ImageRecordIOParser2 analogue); remaining per-pixel augs
+        # (cast/normalize) apply batched
+        self._native_resize = 0
+        self._native_tail = None
+        if kwargs.get("native_decode", True):
+            spatial, tail = [], []
+            for aug in aug_list:
+                if isinstance(aug, (ResizeAug, CenterCropAug)):
+                    spatial.append(aug)
+                else:
+                    tail.append(aug)
+            resize = next((a.size for a in spatial
+                           if isinstance(a, ResizeAug)), 0)
+            # engage only when an explicit resize precedes the center crop —
+            # the native pipeline is resize-short + crop; a crop-only python
+            # chain crops the *original* image, which is different data
+            if resize > 0 and \
+                    all(isinstance(a, (CastAug, ColorNormalizeAug))
+                        for a in tail):
+                from .. import _native
+                if _native.available():
+                    self._native_resize = resize
+                    self._native_tail = tail
+
         self.provide_data = [_io.DataDesc(data_name,
                                           (batch_size,) + self.data_shape,
                                           np.dtype(dtype))]
@@ -551,6 +578,8 @@ class ImageIter(_io.DataIter):
         lw = self.label_width
         batch_label = np.zeros((self.batch_size, lw), dtype=np.float32)
         decode_flag = 1 if c == 3 else 0
+        if self._native_tail is not None:
+            return self._next_native()
         i = 0
         try:
             while i < self.batch_size:
@@ -588,3 +617,77 @@ class ImageIter(_io.DataIter):
         data = nd.array(batch_data.transpose(0, 3, 1, 2), dtype=self.dtype)
         label = nd.array(batch_label if lw > 1 else batch_label[:, 0])
         return _io.DataBatch([data], [label], pad=pad)
+
+    def _next_native(self):
+        """Batch decode through the C++ runtime (deterministic pipelines)."""
+        from .. import _native
+        c, h, w = self.data_shape
+        lw = self.label_width
+        bufs, labels = [], []
+        try:
+            while len(bufs) < self.batch_size:
+                label, s = self.next_sample()
+                bufs.append(bytes(s))
+                labels.append(np.asarray(label, np.float32).reshape(-1)[:lw])
+        except StopIteration:
+            if not bufs:
+                raise
+        pad = self.batch_size - len(bufs)
+        if pad:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            if self.last_batch_handle != "keep":
+                bufs.extend([bufs[-1]] * pad)
+                labels.extend([labels[-1]] * pad)
+                # keep pad count; 'keep' emits partial
+        if not all(b[:2] == b"\xff\xd8" for b in bufs):
+            # non-JPEG records (e.g. PNG-packed .rec): libjpeg can't decode
+            # them — permanently fall back to the cv2 python path
+            self._native_tail = None
+            return self._decode_python_bufs(bufs, labels, pad)
+        decoded, fails = _native.decode_batch(
+            bufs, h, w, c, resize_short=self._native_resize)
+        if fails:
+            raise MXNetError("%d corrupt image records in batch" % fails)
+        batch = decoded.astype(np.float32)
+        for aug in self._native_tail:
+            if isinstance(aug, ColorNormalizeAug):
+                if aug.mean is not None:
+                    batch = batch - aug.mean
+                if aug.std is not None:
+                    batch = batch / aug.std
+            elif isinstance(aug, CastAug):
+                batch = batch.astype(aug.typ)
+        data = nd.array(batch.transpose(0, 3, 1, 2), dtype=self.dtype)
+        lab = np.stack(labels)
+        label = nd.array(lab if lw > 1 else lab[:, 0])
+        return _io.DataBatch([data], [label],
+                             pad=0 if self.last_batch_handle == "keep"
+                             else pad)
+
+    def _decode_python_bufs(self, bufs, labels, pad):
+        """cv2-decode pre-collected record buffers through the full
+        augmenter chain (fallback from the native path)."""
+        c, h, w = self.data_shape
+        lw = self.label_width
+        decode_flag = 1 if c == 3 else 0
+        cv2 = _cv2()
+        rows = []
+        for s in bufs:
+            img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), decode_flag)
+            if img is None:
+                raise MXNetError("cannot decode image record")
+            if decode_flag == 1:
+                img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+            for aug in self.auglist:
+                img = _as_np(aug(img))
+            if img.ndim == 2:
+                img = img[:, :, None]
+            rows.append(img)
+        batch = np.stack(rows).astype(np.float32)
+        data = nd.array(batch.transpose(0, 3, 1, 2), dtype=self.dtype)
+        lab = np.stack(labels)
+        label = nd.array(lab if lw > 1 else lab[:, 0])
+        return _io.DataBatch([data], [label],
+                             pad=0 if self.last_batch_handle == "keep"
+                             else pad)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import profiler as _prof
 from ..base import np_dtype
 from ..context import Context, current_context
 from ..ops import registry as _reg
@@ -484,6 +485,9 @@ def invoke(op, args, kwargs, out=None):
         and any(a._entry is not None or a._mark for a in nd_inputs[:n_diff])
     )
 
+    _prof_on = _prof._PROFILING
+    _t0 = _prof._now_us() if _prof_on else 0
+
     if tracked:
         aux_raw = raw[n_diff:]
 
@@ -499,6 +503,10 @@ def invoke(op, args, kwargs, out=None):
         vjp_fn = None
 
     outs_tuple = outs if isinstance(outs, tuple) else (outs,)
+
+    if _prof_on:
+        # dispatch-side op event (device timeline comes from jax.profiler)
+        _prof.record_event(op.name, "operator", _t0, _prof._now_us() - _t0)
 
     # aux-state mutation under training (reference: FMutateInputs)
     if op.aux_update is not None and params.get("_train") and not params.get("use_global_stats"):

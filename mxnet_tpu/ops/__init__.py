@@ -9,5 +9,6 @@ from . import random  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
+from . import pallas_kernels  # noqa: F401
 
 from .registry import register, get, list_ops  # noqa: F401

@@ -1,0 +1,185 @@
+"""Pallas TPU kernels for the hot ops XLA doesn't fuse optimally.
+
+Reference equivalence: these replace the reference's hand-written CUDA /
+cuDNN kernels (SURVEY.md §2.1 "cuDNN integration") for the memory-bound
+attention path.  Flash attention streams K/V blocks through VMEM with an
+online softmax so the (T×T) score matrix never materializes in HBM —
+the standard TPU flash pattern (see /opt/skills/guides/pallas_guide.md).
+
+On non-TPU backends the same kernel runs in Pallas interpret mode, so
+tests exercise the real kernel logic on the CPU mesh.
+
+Training: the forward is the Pallas kernel; the backward rematerializes
+attention with the jnp formulation under XLA (sound, and XLA's own fusion
+handles the backward well; a Pallas backward kernel is a later
+optimization).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _attention_reference(q, k, v, causal, scale):
+    """jnp reference: q/k/v (BH, T, D)."""
+    s = jnp.einsum("btd,bsd->bts", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(s.shape[-1])[None, :]
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bts,bsd->btd", p, v)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               causal, scale, block_q, block_k, num_k_blocks, t_k):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _step():
+        q = q_ref[0]                                   # (Bq, D)
+        k = k_ref[0]                                   # (Bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        # mask the ragged tail of the last K block (grid padding)
+        valid = kpos < t_k
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = valid & (qpos >= kpos)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                          # (Bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(jnp.where(m_prev <= _NEG_INF / 2, _NEG_INF, m_prev)
+                       - m_safe)
+        corr = jnp.where(m_prev <= _NEG_INF / 2, 0.0, corr)
+        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=-1,
+                                                     keepdims=True)
+        # zero padded V rows: p is 0 there, but 0 × garbage/NaN = NaN
+        vrow_ok = (ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < t_k
+        v_blk = jnp.where(vrow_ok, v_ref[0], 0.0)
+        pv = jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:, :1] = m_new
+
+    if causal:
+        # skip blocks strictly above the diagonal
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_attention_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                              interpret):
+    """q/k/v: (BH, T, D) → (BH, T, D)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, T, D = q.shape
+    Tk = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    nq = pl.cdiv(T, block_q)
+    nk = pl.cdiv(Tk, block_k)
+
+    kernel = functools.partial(
+        _fa_kernel, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, num_k_blocks=nk, t_k=Tk)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, D), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_core(q, k, v, causal, scale):
+    interpret = not _on_tpu()
+    return _flash_attention_fwd_impl(q, k, v, causal, scale,
+                                     block_q=128, block_k=128,
+                                     interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    return _flash_core(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v = res
+    # rematerialized XLA backward (jax.checkpoint-style trade)
+    _, vjp = jax.vjp(lambda a, b, c: _attention_reference(a, b, c, causal,
+                                                          scale), q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+@register("_contrib_flash_attention", arg_names=["query", "key", "value"],
+          aliases=("flash_attention",))
+def flash_attention(query, key, value, causal=False, scale=None):
+    """Flash attention over (B, T, H, D) tensors (Pallas TPU kernel).
+
+    Memory O(T) instead of O(T²); the per-(batch, head) score blocks live
+    only in VMEM.  Works on any backend (interpret mode off-TPU)."""
+    B, T, H, D = query.shape
+    Tk = key.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+
+    def to_bh(x, t):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, t, x.shape[-1])
+
+    out = _flash_core(to_bh(query, T), to_bh(key, Tk), to_bh(value, Tk),
+                      bool(causal), float(scale))
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

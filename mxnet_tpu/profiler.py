@@ -1,0 +1,254 @@
+"""Profiler: the `mx.profiler` namespace.
+
+Reference: ``python/mxnet/profiler.py`` (426 LoC: set_config/set_state,
+dump, scoped Task/Frame/Marker/Domain) over the native profiler
+(``src/profiler/profiler.h:256``) which records per-op events into
+chrome://tracing JSON (``DumpProfile:304``).
+
+TPU-native design: two complementary recorders —
+
+- **Device timeline**: ``jax.profiler`` traces (TensorBoard / perfetto)
+  capture the XLA/TPU side; ``set_state('run')`` starts a trace into the
+  configured directory, ``dump()``/``set_state('stop')`` ends it.
+- **Host op log**: when profiling is on, the imperative ``invoke`` path and
+  user Task/Frame/Marker scopes append events to an in-process buffer that
+  ``dumps()`` renders as chrome://tracing JSON — same file format the
+  reference emits, so existing trace-viewing workflows carry over.
+
+Zero overhead when off (a single bool check, like the reference's
+profiler hook in ThreadedEngine::ExecuteOprBlock).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Domain", "Task", "Frame", "Marker", "Counter",
+           "profiler_set_config", "profiler_set_state"]
+
+_lock = threading.Lock()
+_config = {"filename": "profile.json", "profile_all": False,
+           "profile_symbolic": True, "profile_imperative": True,
+           "profile_memory": False, "profile_api": False,
+           "aggregate_stats": False, "tensorboard_dir": None}
+_state = "stop"
+_paused = False
+# module-level flag read by the hot invoke() path: one attribute load when off
+_PROFILING = False
+_events = []
+_start_time = None
+_jax_trace_active = False
+
+
+def is_running():
+    return _PROFILING
+
+
+def set_config(**kwargs):
+    """Configure (reference: profiler.py set_config).  Recognized kwargs:
+    filename, profile_all, profile_symbolic, profile_imperative,
+    profile_memory, profile_api, aggregate_stats, tensorboard_dir."""
+    if _state == "run":
+        raise RuntimeError("cannot set_config while profiler is running")
+    for k, v in kwargs.items():
+        _config[k] = v
+
+
+profiler_set_config = set_config
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    """Start/stop profiling (reference: profiler.py set_state)."""
+    global _state, _start_time, _jax_trace_active
+    if state_name not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if state_name == "run" and _state != "run":
+        _events.clear()
+        _start_time = time.perf_counter_ns()
+        tb = _config.get("tensorboard_dir")
+        if tb:
+            import jax
+            os.makedirs(tb, exist_ok=True)
+            jax.profiler.start_trace(tb)
+            _jax_trace_active = True
+    if state_name == "stop" and _state == "run":
+        if _jax_trace_active:
+            import jax
+            jax.profiler.stop_trace()
+            _jax_trace_active = False
+    _state = state_name
+    _sync_flag()
+
+
+profiler_set_state = set_state
+
+
+def state():
+    return _state
+
+
+def _sync_flag():
+    global _PROFILING
+    _PROFILING = _state == "run" and not _paused
+
+
+def pause(profile_process="worker"):
+    global _paused
+    _paused = True
+    _sync_flag()
+
+
+def resume(profile_process="worker"):
+    global _paused
+    _paused = False
+    _sync_flag()
+
+
+def _now_us():
+    return (time.perf_counter_ns() - (_start_time or 0)) / 1000.0
+
+
+def record_event(name, category, t_start_us, dur_us, args=None):
+    if not is_running():
+        return
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "X",
+                        "ts": t_start_us, "dur": dur_us, "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000,
+                        "args": args or {}})
+
+
+def record_instant(name, category, args=None):
+    if not is_running():
+        return
+    with _lock:
+        _events.append({"name": name, "cat": category, "ph": "i",
+                        "ts": _now_us(), "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000, "s": "p",
+                        "args": args or {}})
+
+
+def dumps(reset=False):
+    """Return the chrome://tracing JSON string (reference: dumps)."""
+    with _lock:
+        out = json.dumps({"traceEvents": list(_events),
+                          "displayTimeUnit": "ms"}, indent=1)
+        if reset:
+            _events.clear()
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the trace JSON to the configured filename (reference: dump)."""
+    with open(_config["filename"], "w") as f:
+        f.write(dumps())
+    if finished and _jax_trace_active:
+        set_state("stop")
+
+
+class _Scope:
+    """Base for scoped profiler objects; also usable via start()/stop()."""
+    _category = "scope"
+
+    def __init__(self, name, domain=None):
+        self.name = name if domain is None else "%s::%s" % (domain.name, name)
+        self._t0 = None
+        self._annotation = None
+
+    def start(self):
+        self._t0 = _now_us()
+        if is_running():
+            import jax
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        return self
+
+    def stop(self):
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+        if self._t0 is not None:
+            record_event(self.name, self._category, self._t0,
+                         _now_us() - self._t0)
+            self._t0 = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Domain:
+    """Grouping namespace for tasks/counters (reference: profiler.Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return "Domain(%s)" % self.name
+
+    def new_task(self, name):
+        return Task(name, self)
+
+    def new_frame(self, name):
+        return Frame(name, self)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class Task(_Scope):
+    _category = "task"
+
+
+class Frame(_Scope):
+    _category = "frame"
+
+
+class Counter:
+    """Numeric counter series (reference: profiler.Counter)."""
+
+    def __init__(self, domain, name, value=None):
+        self.name = "%s::%s" % (domain.name, name)
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if is_running():
+            with _lock:
+                _events.append({"name": self.name, "ph": "C", "ts": _now_us(),
+                                "pid": os.getpid(),
+                                "args": {"value": value}})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Instant marker (reference: profiler.Marker)."""
+
+    def __init__(self, domain, name):
+        self.name = "%s::%s" % (domain.name, name)
+
+    def mark(self, scope="process"):
+        record_instant(self.name, "marker")

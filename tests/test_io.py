@@ -345,3 +345,96 @@ def test_gluon_unroll_valid_length_states():
     _, states_short = cell.unroll(3, mx.nd.array(x_valid), layout="NTC")
     for sf, ss in zip(states_full, states_short):
         np.testing.assert_allclose(sf.asnumpy(), ss.asnumpy(), rtol=1e-5)
+
+
+def test_native_lib_recordio_and_decode(tmp_path):
+    """C++ runtime parity: offset index matches Python; batch decode close
+    to the cv2 pipeline (native/mxtpu_io.cc)."""
+    from mxnet_tpu import _native
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    rec = _make_rec_dataset(tmp_path, n=12)
+    py = recordio.MXIndexedRecordIO(str(tmp_path / "data.idx"), rec, "r")
+    offsets = _native.recordio_index(rec)
+    assert offsets == [py.idx[k] for k in py.keys]
+
+    bufs = []
+    for k in py.keys:
+        _, img = recordio.unpack(py.read_idx(k))
+        bufs.append(bytes(img))
+    out, fails = _native.decode_batch(bufs, 28, 28, 3, resize_short=30)
+    assert fails == 0 and out.shape == (12, 28, 28, 3)
+
+
+def test_image_iter_native_fast_path(tmp_path):
+    """Deterministic pipeline routes through the native decoder and matches
+    labels/shapes of the python path."""
+    from mxnet_tpu import _native
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    rec = _make_rec_dataset(tmp_path)
+    it_native = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                                   path_imgrec=rec, resize=30,
+                                   mean=np.zeros(3), std=np.ones(3))
+    assert it_native._native_tail is not None  # fast path active
+    # crop-only chains must NOT engage (different data semantics)
+    it_croponly = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                                     path_imgrec=rec)
+    assert it_croponly._native_tail is None
+    it_py = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                               path_imgrec=rec, resize=30, mean=np.zeros(3),
+                               std=np.ones(3), native_decode=False)
+    assert it_py._native_tail is None
+    b_n = it_native.next()
+    b_p = it_py.next()
+    np.testing.assert_array_equal(b_n.label[0].asnumpy(),
+                                  b_p.label[0].asnumpy())
+    assert b_n.data[0].shape == b_p.data[0].shape
+    # same images modulo resize-convention differences
+    diff = np.abs(b_n.data[0].asnumpy() - b_p.data[0].asnumpy()).mean()
+    assert diff < 12, diff
+    # random augs disable the native path
+    it_rand = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
+                                 path_imgrec=rec, rand_mirror=True)
+    assert it_rand._native_tail is None
+
+
+def test_flash_attention_ragged_length():
+    """Non-multiple-of-128 sequence lengths must not leak grid padding."""
+    from mxnet_tpu.ops.pallas_kernels import _attention_reference
+    import jax.numpy as jnp
+    rng = np.random.RandomState(3)
+    B, T, H, D = 1, 200, 1, 32
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    for causal in (False, True):
+        out = mx.nd.flash_attention(mx.nd.array(q), mx.nd.array(k),
+                                    mx.nd.array(v), causal=causal).asnumpy()
+        qb = jnp.asarray(q.transpose(0, 2, 1, 3).reshape(B * H, T, D))
+        kb = jnp.asarray(k.transpose(0, 2, 1, 3).reshape(B * H, T, D))
+        vb = jnp.asarray(v.transpose(0, 2, 1, 3).reshape(B * H, T, D))
+        ref = np.asarray(_attention_reference(qb, kb, vb, causal, D ** -0.5))
+        ref = ref.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+        assert np.isfinite(out).all()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_image_iter_png_records_fallback(tmp_path):
+    """PNG-packed .rec must not break the (JPEG-only) native path."""
+    from mxnet_tpu import _native
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    fname = str(tmp_path / "png.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "png.idx"), fname, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (32, 32, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i, 0), img, img_fmt=".png"))
+    w.close()
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                            path_imgrec=fname, resize=30)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 28, 28)
+    assert it._native_tail is None  # permanently fell back
