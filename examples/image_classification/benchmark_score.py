@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Inference throughput benchmark (reference: example/image-classification/
+benchmark_score.py — the source of the docs/faq/perf.md numbers)."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def score(network, batch_size, image_shape, iters=20, warmup=5):
+    net = vision.get_model(network, classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(batch_size, *image_shape).astype(np.float32))
+    for _ in range(warmup):
+        net(x).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    out.wait_to_read()
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", default="resnet50_v1")
+    parser.add_argument("--batch-sizes", default="1,2,4,8,16,32")
+    parser.add_argument("--image-shape", default="3,224,224")
+    args = parser.parse_args()
+    shape = tuple(int(i) for i in args.image_shape.split(","))
+    print("network: %s (device: %s)" % (
+        args.network, "tpu" if mx.num_tpus() else "cpu"))
+    for bs in (int(b) for b in args.batch_sizes.split(",")):
+        ips = score(args.network, bs, shape)
+        print("batch size %3d, image %s, %8.1f images/sec"
+              % (bs, "x".join(map(str, shape)), ips))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""ImageNet training (reference: example/image-classification/
+train_imagenet.py + common/fit.py).
+
+Feeds ImageRecordIter (.rec packs from tools/im2rec.py) through a symbolic
+ResNet and Module.fit.  ``--ctx tpu --num-devices N`` spans a data-parallel
+mesh (GSPMD inserts the gradient allreduce — the kvstore 'device' path of
+the reference)."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from symbols import resnet
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-train", required=True,
+                        help="path to train .rec")
+    parser.add_argument("--data-val", default=None)
+    parser.add_argument("--network", default="resnet")
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--image-shape", default="3,224,224")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--lr-factor", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", default="30,60,80")
+    parser.add_argument("--num-epochs", type=int, default=90)
+    parser.add_argument("--num-examples", type=int, default=1281167)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--model-prefix", default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    parser.add_argument("--ctx", default="tpu" if mx.num_tpus() else "cpu")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    shape = tuple(int(i) for i in args.image_shape.split(","))
+    sym = resnet.get_symbol(args.num_classes, args.num_layers, shape)
+
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True, resize=256 if shape[1] >= 224 else 0,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val, data_shape=shape,
+        batch_size=args.batch_size, resize=256 if shape[1] >= 224 else 0,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94) \
+        if args.data_val else None
+
+    steps = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    epoch_size = max(args.num_examples // args.batch_size, 1)
+    scheduler = mx.lr_scheduler.MultiFactorScheduler(
+        [epoch_size * s for s in steps], factor=args.lr_factor)
+
+    ctx = mx.tpu() if args.ctx == "tpu" else mx.cpu()
+    mod = mx.mod.Module(sym, context=ctx)
+    arg_params = aux_params = None
+    begin = 0
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin = args.load_epoch
+    mod.fit(train, eval_data=val, kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4, "lr_scheduler": scheduler},
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            num_epoch=args.num_epochs, arg_params=arg_params,
+            aux_params=aux_params, begin_epoch=begin,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20),
+            epoch_end_callback=(mx.callback.do_checkpoint(args.model_prefix)
+                                if args.model_prefix else None))
+
+
+if __name__ == "__main__":
+    main()

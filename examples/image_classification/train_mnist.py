@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""MNIST training (reference: example/image-classification/train_mnist.py).
+
+Runs the Module API end to end: MNISTIter (or synthetic data when the idx
+files are absent — zero-egress environments), MLP or LeNet symbol, fit with
+Speedometer + checkpointing.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import argparse
+import logging
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_mlp():
+    data = mx.sym.Variable("data")
+    data = mx.sym.Flatten(data)
+    fc1 = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    act1 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, num_hidden=64, name="fc2")
+    act2 = mx.sym.Activation(fc2, act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def get_lenet():
+    data = mx.sym.Variable("data")
+    conv1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    tanh1 = mx.sym.Activation(conv1, act_type="tanh")
+    pool1 = mx.sym.Pooling(tanh1, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    conv2 = mx.sym.Convolution(pool1, kernel=(5, 5), num_filter=50)
+    tanh2 = mx.sym.Activation(conv2, act_type="tanh")
+    pool2 = mx.sym.Pooling(tanh2, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    flatten = mx.sym.Flatten(pool2)
+    fc1 = mx.sym.FullyConnected(flatten, num_hidden=500)
+    tanh3 = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(tanh3, num_hidden=10)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def get_iters(args):
+    img = os.path.join(args.data_dir, "train-images-idx3-ubyte.gz")
+    lab = os.path.join(args.data_dir, "train-labels-idx1-ubyte.gz")
+    flat = args.network == "mlp"
+    if os.path.isfile(img) and os.path.isfile(lab):
+        train = mx.io.MNISTIter(image=img, label=lab,
+                                batch_size=args.batch_size, flat=flat)
+        vimg = os.path.join(args.data_dir, "t10k-images-idx3-ubyte.gz")
+        vlab = os.path.join(args.data_dir, "t10k-labels-idx1-ubyte.gz")
+        val = mx.io.MNISTIter(image=vimg, label=vlab,
+                              batch_size=args.batch_size, flat=flat,
+                              shuffle=False) if os.path.isfile(vimg) else None
+        return train, val
+    logging.warning("MNIST files not found under %s — using synthetic data",
+                    args.data_dir)
+    rng = np.random.RandomState(0)
+    n = 2048
+    X = rng.rand(n, 784).astype(np.float32) if flat else \
+        rng.rand(n, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.float32)
+    split = n * 3 // 4
+    return (mx.io.NDArrayIter(X[:split], y[:split], args.batch_size,
+                              shuffle=True),
+            mx.io.NDArrayIter(X[split:], y[split:], args.batch_size))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    parser.add_argument("--data-dir", default=os.path.join(
+        "~", ".mxnet", "datasets", "mnist"))
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--model-prefix", default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    args = parser.parse_args()
+    args.data_dir = os.path.expanduser(args.data_dir)
+    logging.basicConfig(level=logging.INFO)
+
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    train, val = get_iters(args)
+
+    mod = mx.mod.Module(net, context=mx.tpu() if mx.num_tpus() else mx.cpu())
+    checkpoint = mx.callback.do_checkpoint(args.model_prefix) \
+        if args.model_prefix else None
+    arg_params = aux_params = None
+    begin = 0
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin = args.load_epoch
+    mod.fit(train, eval_data=val, kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=args.num_epochs,
+            arg_params=arg_params, aux_params=aux_params, begin_epoch=begin,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            epoch_end_callback=checkpoint)
+
+
+if __name__ == "__main__":
+    main()

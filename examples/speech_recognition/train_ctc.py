@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Speech-style CTC training (reference: example/speech_recognition/ —
+DeepSpeech-ish bi-LSTM + CTC with BucketingModule over variable lengths).
+
+Runs on synthetic spectrogram-like data so it works offline; swap
+``synthetic_batches`` for a real feature iterator."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_sym(seq_len, num_hidden, vocab):
+    data = mx.sym.Variable("data")            # (N, T, F)
+    label = mx.sym.Variable("label")          # (N, L)
+    cell = mx.rnn.FusedRNNCell(num_hidden, num_layers=2, mode="lstm",
+                               bidirectional=True, prefix="lstm_")
+    outputs, _ = cell.unroll(seq_len, data, layout="NTC")  # (N, T, 2H)
+    pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden * 2))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab + 1, name="pred")
+    pred = mx.sym.Reshape(pred, shape=(-4, -1, seq_len, 0))
+    pred = mx.sym.swapaxes(pred, dim1=0, dim2=1)  # (T, N, vocab+1)
+    loss = mx.sym.contrib.ctc_loss(pred, label)
+    return mx.sym.MakeLoss(loss), ("data",), ("label",)
+
+
+def synthetic_batches(num, batch_size, buckets, feat_dim, vocab, max_label):
+    rng = np.random.RandomState(0)
+    for _ in range(num):
+        T = buckets[rng.randint(len(buckets))]
+        x = rng.randn(batch_size, T, feat_dim).astype(np.float32)
+        lab = rng.randint(1, vocab, (batch_size, max_label)) \
+            .astype(np.float32)
+        # embed a weak signal so the loss can actually fall
+        for b in range(batch_size):
+            for j in range(min(max_label, T // 4)):
+                t = int(lab[b, j]) % feat_dim
+                x[b, j * 4:(j + 1) * 4, t] += 2.0
+        yield mx.io.DataBatch(
+            [mx.nd.array(x)], [mx.nd.array(lab)], bucket_key=T,
+            provide_data=[mx.io.DataDesc("data", (batch_size, T, feat_dim))],
+            provide_label=[mx.io.DataDesc("label", (batch_size, max_label))])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--vocab", type=int, default=28)
+    parser.add_argument("--feat-dim", type=int, default=39)
+    parser.add_argument("--buckets", default="40,80")
+    parser.add_argument("--num-batches", type=int, default=60)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    buckets = [int(b) for b in args.buckets.split(",")]
+
+    def sym_gen(seq_len):
+        return build_sym(seq_len, args.num_hidden, args.vocab)
+
+    mod = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=max(buckets),
+        context=mx.tpu() if mx.num_tpus() else mx.cpu())
+    mod.bind([("data", (args.batch_size, max(buckets), args.feat_dim))],
+             [("label", (args.batch_size, 8))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    losses = []
+    for i, batch in enumerate(synthetic_batches(
+            args.num_batches, args.batch_size, buckets, args.feat_dim,
+            args.vocab, 8)):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        loss = float(mod.get_outputs()[0].asnumpy().mean())
+        losses.append(loss)
+        if i % 10 == 0:
+            logging.info("batch %d  ctc loss %.3f", i, loss)
+    logging.info("loss %.3f -> %.3f", losses[0], losses[-1])
+
+
+if __name__ == "__main__":
+    main()

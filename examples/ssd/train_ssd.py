@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Tiny SSD training loop (reference: example/ssd/train.py +
+symbol/symbol_builder.py — MultiBoxPrior/Target at train time,
+MultiBoxDetection at inference).
+
+Synthetic colored-box dataset keeps it runnable offline; the op plumbing
+is identical to the reference's VGG16-SSD."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def build_net(num_classes, num_anchors):
+    """Backbone + class/loc heads returning (anchors, cls_preds, loc_preds)."""
+    data = mx.sym.Variable("data")
+    body = data
+    for i, nf in enumerate((16, 32, 64)):
+        body = mx.sym.Convolution(body, kernel=(3, 3), num_filter=nf,
+                                  pad=(1, 1), name="conv%d" % i)
+        body = mx.sym.Activation(body, act_type="relu")
+        body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max")
+    anchors = mx.sym.contrib.MultiBoxPrior(body, sizes=(0.3, 0.6),
+                                           ratios=(1.0, 2.0, 0.5),
+                                           name="anchors")
+    cls_pred = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=num_anchors * (num_classes + 1),
+                                  name="cls_pred")
+    cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 3, 1))
+    cls_pred = mx.sym.Reshape(cls_pred, shape=(0, -1, num_classes + 1))
+    cls_pred = mx.sym.transpose(cls_pred, axes=(0, 2, 1))
+    loc_pred = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=num_anchors * 4,
+                                  name="loc_pred")
+    loc_pred = mx.sym.transpose(loc_pred, axes=(0, 2, 3, 1))
+    loc_pred = mx.sym.Flatten(loc_pred)
+    return mx.sym.Group([anchors, cls_pred, loc_pred])
+
+
+def synthetic_batch(rng, batch_size, num_classes):
+    """Images with one colored square; label = [cls, x1, y1, x2, y2]."""
+    imgs = np.zeros((batch_size, 3, 64, 64), np.float32)
+    labels = np.full((batch_size, 1, 5), -1.0, np.float32)
+    for b in range(batch_size):
+        cls = rng.randint(num_classes)
+        cx, cy = rng.uniform(0.3, 0.7, 2)
+        s = rng.uniform(0.15, 0.3)
+        x1, y1, x2, y2 = cx - s, cy - s, cx + s, cy + s
+        xi = [int(v * 64) for v in (x1, y1, x2, y2)]
+        imgs[b, cls, xi[1]:xi[3], xi[0]:xi[2]] = 1.0
+        labels[b, 0] = [cls, x1, y1, x2, y2]
+    return mx.nd.array(imgs), mx.nd.array(labels)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-classes", type=int, default=2)
+    parser.add_argument("--num-batches", type=int, default=80)
+    parser.add_argument("--lr", type=float, default=0.05)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    num_anchors = 4  # len(sizes) + len(ratios) - 1
+
+    net = build_net(args.num_classes, num_anchors)
+    ex = net.simple_bind(data=(args.batch_size, 3, 64, 64),
+                         grad_req="write")
+    for name, arr in ex.arg_dict.items():
+        if name != "data" and name.endswith(("weight",)):
+            mx.init.Xavier()(name, arr)
+
+    import mxnet_tpu.optimizer as opt
+    updater = opt.get_updater(opt.create(
+        "sgd", learning_rate=args.lr, momentum=0.9,
+        rescale_grad=1.0 / args.batch_size))
+
+    for step in range(args.num_batches):
+        x, y = synthetic_batch(rng, args.batch_size, args.num_classes)
+        anchors, cls_pred, loc_pred = ex.forward(is_train=True, data=x)
+        loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+            anchors, y, cls_pred, negative_mining_ratio=3.0)
+        # losses computed imperatively on the executor outputs
+        cp = cls_pred._data
+        import jax.numpy as jnp
+        import jax
+        # head grads: softmax CE on cls, smooth-l1 on loc
+        def loss_fn(cp_, lp_):
+            logp = jax.nn.log_softmax(cp_, axis=1)
+            ce = -jnp.take_along_axis(
+                logp, cls_t._data.astype(jnp.int32)[:, None, :], axis=1)[:, 0]
+            valid = cls_t._data >= 0
+            ce = jnp.where(valid, ce, 0.0).sum() / jnp.maximum(
+                valid.sum(), 1)
+            diff = (lp_ - loc_t._data) * loc_mask._data
+            l1 = jnp.where(jnp.abs(diff) < 1.0, 0.5 * diff * diff,
+                           jnp.abs(diff) - 0.5).sum() / jnp.maximum(
+                loc_mask._data.sum(), 1)
+            return ce + l1
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            cls_pred._data, loc_pred._data)
+        ex.backward(out_grads=[mx.nd.zeros(anchors.shape),
+                               mx.ndarray.NDArray(grads[0]),
+                               mx.ndarray.NDArray(grads[1])])
+        for i, name in enumerate(n for n in ex.arg_dict if n != "data"):
+            g = ex.grad_dict.get(name)
+            if g is not None:
+                updater(i, g, ex.arg_dict[name])
+        if step % 10 == 0:
+            logging.info("step %d  loss %.4f", step, float(loss))
+
+    # inference: decode + NMS
+    x, y = synthetic_batch(rng, args.batch_size, args.num_classes)
+    anchors, cls_pred, loc_pred = ex.forward(is_train=False, data=x)
+    cls_prob = mx.nd.softmax(cls_pred, axis=1)
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.45, threshold=0.3)
+    kept = det.asnumpy()[0]
+    kept = kept[kept[:, 0] >= 0]
+    logging.info("image 0: %d detections after NMS", len(kept))
+
+
+if __name__ == "__main__":
+    main()

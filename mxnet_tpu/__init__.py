@@ -10,6 +10,18 @@ reference's ``mx.*`` namespaces.
 """
 from __future__ import annotations
 
+import os as _os
+
+# honor JAX_PLATFORMS even when a site plugin force-registered a hardware
+# backend through jax.config (which outranks the env var): pin it back so
+# `JAX_PLATFORMS=cpu python script.py` behaves as documented
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 __version__ = "0.1.0"
 
 from .base import MXNetError

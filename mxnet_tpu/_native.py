@@ -25,9 +25,13 @@ _SO = os.path.join(_SRC_DIR, "libmxtpu_io.so")
 
 
 def _build():
+    # compile to a temp path and rename atomically so a concurrent process
+    # never CDLLs a partially written .so
+    tmp = "%s.%d.tmp" % (_SO, os.getpid())
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-           "-o", _SO, "-ljpeg", "-lpthread"]
+           "-o", tmp, "-ljpeg", "-lpthread"]
     subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _SO)
 
 
 def get_lib():
@@ -78,6 +82,18 @@ def recordio_index(path):
     offsets = (ctypes.c_long * n)()
     lib.mxtpu_recordio_index(path.encode(), offsets, n)
     return list(offsets)
+
+
+def recordio_read(path, offset, max_len=1 << 26):
+    """Read one record payload at a byte offset via the native reader."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * max_len)()
+    n = lib.mxtpu_recordio_read(path.encode(), offset, buf, max_len)
+    if n < 0:
+        return None
+    return bytes(bytearray(buf[:n]))
 
 
 def decode_batch(buffers, out_h, out_w, channels=3, resize_short=0,

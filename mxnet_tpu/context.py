@@ -125,6 +125,24 @@ def num_gpus():
     return num_tpus()
 
 
+_IMPLICIT_DEFAULT = None
+
+
+def _implicit_default():
+    """Default context follows jax's default backend: cpu() in CPU builds,
+    tpu(0) when an accelerator owns the default device.  Keeping the two in
+    agreement avoids mixed-device programs when users never pass ctx (the
+    reference defaults to cpu() because its CPU build has no choice)."""
+    global _IMPLICIT_DEFAULT
+    if _IMPLICIT_DEFAULT is None:
+        try:
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+        _IMPLICIT_DEFAULT = Context("cpu" if platform == "cpu" else "tpu", 0)
+    return _IMPLICIT_DEFAULT
+
+
 def current_context():
     cur = getattr(Context._default_ctx, "value", None)
-    return cur if cur is not None else Context("cpu", 0)
+    return cur if cur is not None else _implicit_default()

@@ -177,7 +177,13 @@ class Executor:
         for n, v in kwargs.items():
             if n not in self.arg_dict:
                 raise MXNetError("unknown argument %r" % n)
-            self.arg_dict[n]._set_data(_raw(v))
+            raw = _raw(v)
+            # feeds land on the executor's device/sharding (async transfer
+            # overlaps with compute — the PrefetcherIter copy analogue)
+            target = self._sharding(n) or self._devices[0]
+            if not _on_device(raw, self._devices[0]) or self._mesh is not None:
+                raw = jax.device_put(raw, target)
+            self.arg_dict[n]._set_data(raw)
         arg_vals = {n: a._data for n, a in self.arg_dict.items()}
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}
         fn = self._jit_train if is_train else self._jit_infer
@@ -238,6 +244,7 @@ class Executor:
                     _raw(v).astype(self.aux_dict[n]._data.dtype))
             elif not allow_extra_params:
                 raise MXNetError("unknown aux state %r" % n)
+        self._place_arrays()
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new data shapes, keeping parameter arrays

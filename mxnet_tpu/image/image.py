@@ -572,29 +572,32 @@ class ImageIter(_io.DataIter):
         header, img = recordio.unpack(s)
         return header.label, img
 
+    def _decode_one(self, s):
+        """cv2-decode one record payload through the full augmenter chain."""
+        decode_flag = 1 if self.data_shape[0] == 3 else 0
+        img = _cv2().imdecode(np.frombuffer(s, dtype=np.uint8), decode_flag)
+        if img is None:
+            raise MXNetError("cannot decode image record")
+        if decode_flag == 1:
+            img = _cv2().cvtColor(img, _cv2().COLOR_BGR2RGB)
+        for aug in self.auglist:
+            img = _as_np(aug(img))
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img
+
     def next(self):
+        if self._native_tail is not None:
+            return self._next_native()
         c, h, w = self.data_shape
         batch_data = np.zeros((self.batch_size, h, w, c), dtype=np.float32)
         lw = self.label_width
         batch_label = np.zeros((self.batch_size, lw), dtype=np.float32)
-        decode_flag = 1 if c == 3 else 0
-        if self._native_tail is not None:
-            return self._next_native()
         i = 0
         try:
             while i < self.batch_size:
                 label, s = self.next_sample()
-                img = _cv2().imdecode(np.frombuffer(s, dtype=np.uint8),
-                                      decode_flag)
-                if img is None:
-                    raise MXNetError("cannot decode image record")
-                if decode_flag == 1:
-                    img = _cv2().cvtColor(img, _cv2().COLOR_BGR2RGB)
-                for aug in self.auglist:
-                    img = _as_np(aug(img))
-                if img.ndim == 2:
-                    img = img[:, :, None]
-                batch_data[i] = img
+                batch_data[i] = self._decode_one(s)
                 batch_label[i] = np.asarray(label, np.float32).reshape(-1)[:lw]
                 i += 1
         except StopIteration:
@@ -666,25 +669,11 @@ class ImageIter(_io.DataIter):
                              else pad)
 
     def _decode_python_bufs(self, bufs, labels, pad):
-        """cv2-decode pre-collected record buffers through the full
-        augmenter chain (fallback from the native path)."""
-        c, h, w = self.data_shape
+        """cv2-decode pre-collected record buffers (fallback from the
+        native path)."""
         lw = self.label_width
-        decode_flag = 1 if c == 3 else 0
-        cv2 = _cv2()
-        rows = []
-        for s in bufs:
-            img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), decode_flag)
-            if img is None:
-                raise MXNetError("cannot decode image record")
-            if decode_flag == 1:
-                img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
-            for aug in self.auglist:
-                img = _as_np(aug(img))
-            if img.ndim == 2:
-                img = img[:, :, None]
-            rows.append(img)
-        batch = np.stack(rows).astype(np.float32)
+        batch = np.stack([self._decode_one(s) for s in bufs]) \
+            .astype(np.float32)
         data = nd.array(batch.transpose(0, 3, 1, 2), dtype=self.dtype)
         lab = np.stack(labels)
         label = nd.array(lab if lw > 1 else lab[:, 0])

@@ -177,6 +177,8 @@ class Module(BaseModule):
             elif initializer is not None:
                 desc = InitDesc(name, attr_dict.get(name))
                 initializer(desc, arr)
+        # initializers write host-side values; restore device placement
+        self._exec._place_arrays()
         self.params_initialized = True
 
     def get_params(self):

@@ -24,6 +24,25 @@ from . import register as _register  # noqa: E402
 
 _register.populate(sys.modules[__name__], _internal)
 
+# `nd.contrib` / `nd.linalg` / `nd.random` sub-namespaces: _contrib_*-style
+# registered names exposed with the prefix stripped (reference:
+# python/mxnet/ndarray/contrib.py generated namespaces)
+contrib = types.ModuleType(__name__ + ".contrib")
+linalg = types.ModuleType(__name__ + ".linalg")
+sys.modules[contrib.__name__] = contrib
+sys.modules[linalg.__name__] = linalg
+for _name in _reg.list_ops():
+    if _name.startswith("_contrib_"):
+        setattr(contrib, _name[len("_contrib_"):],
+                getattr(_internal, _name))
+    elif _name.startswith("_linalg_"):
+        setattr(linalg, _name[len("_linalg_"):], getattr(_internal, _name))
+
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: E402
+contrib.foreach = foreach
+contrib.while_loop = while_loop
+contrib.cond = cond
+
 
 # creation helpers (reference: python/mxnet/ndarray/utils.py + ndarray.py) --
 def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):

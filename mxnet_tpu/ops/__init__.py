@@ -10,5 +10,8 @@ from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import pallas_kernels  # noqa: F401
+from . import linalg  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import quantization  # noqa: F401
 
 from .registry import register, get, list_ops  # noqa: F401

@@ -1,0 +1,164 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: ``src/operator/control_flow.cc`` (``_foreach:483``, ``_while_loop``,
+``_cond``) — subgraph ops the reference executes node-by-node.  Here they
+lower straight to ``lax.scan`` / ``lax.while_loop`` / ``lax.cond``, which is
+the whole point of building TPU-first: the loop compiles to one XLA While
+with O(1) graph size.
+
+The Python-facing API matches ``mxnet.ndarray.contrib.foreach/while_loop/
+cond``: plain Python callables over NDArrays, looped on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _raw(x):
+    from ..ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return [_raw(i) for i in x]
+    return jnp.asarray(x)
+
+
+def _wrap(x):
+    from ..ndarray import NDArray
+    if isinstance(x, (list, tuple)):
+        return [_wrap(i) for i in x]
+    return NDArray(x)
+
+
+def foreach(body, data, init_states):
+    """Scan `body(data_slice, states) -> (out, new_states)` over axis 0
+    (reference: contrib.foreach over the _foreach op).  Differentiable:
+    when autograd is recording, the whole scan is recorded as one tape node
+    whose vjp is lax.scan's own transpose."""
+    from .. import autograd
+    from ..ndarray import NDArray
+    multi_data = isinstance(data, (list, tuple))
+    multi_state = isinstance(init_states, (list, tuple))
+    data_list = list(data) if multi_data else [data]
+    state_list = list(init_states) if multi_state else [init_states]
+    n_data = len(data_list)
+    flat_nd = data_list + state_list
+    struct = {}  # filled during the traced run: out/state flattening info
+
+    def pure(*raw):
+        raw_data = list(raw[:n_data])
+        raw_states = list(raw[n_data:])
+
+        def step(states, xs):
+            with autograd.pause(train_mode=autograd.is_training()):
+                xs_nd = _wrap(xs) if multi_data else NDArray(xs[0])
+                st_nd = _wrap(states) if multi_state else NDArray(states[0])
+                out, new_states = body(xs_nd, st_nd)
+            out_list = list(out) if isinstance(out, (list, tuple)) else [out]
+            ns_list = list(new_states) \
+                if isinstance(new_states, (list, tuple)) else [new_states]
+            struct["n_out"] = len(out_list)
+            struct["multi_out"] = isinstance(out, (list, tuple))
+            return [s._data for s in ns_list], [o._data for o in out_list]
+
+        final_states, outs = lax.scan(step, raw_states, raw_data)
+        return tuple(outs) + tuple(final_states)
+
+    raw = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+           for a in flat_nd]
+    nd_inputs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+                 for a in flat_nd]
+    tracked = autograd.is_recording() and any(
+        a._entry is not None or a._mark for a in nd_inputs)
+    if tracked:
+        outs_raw, vjp_fn = jax.vjp(pure, *raw)
+    else:
+        outs_raw = pure(*raw)
+        vjp_fn = None
+
+    out_nds = [NDArray(o) for o in outs_raw]
+    if tracked:
+        node = autograd.record_op(vjp_fn, nd_inputs, list(outs_raw), pure,
+                                  raw, True)
+        for i, o in enumerate(out_nds):
+            o._entry = (node, i)
+
+    n_out = struct["n_out"]
+    outs = out_nds[:n_out] if struct["multi_out"] else out_nds[0]
+    finals = out_nds[n_out:]
+    return outs, finals if multi_state else finals[0]
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """While loop (reference: contrib.while_loop).  Unlike the reference —
+    which pads outputs to max_iterations — only the final loop_vars are
+    returned (XLA requires static shapes; use foreach for stacked outputs)."""
+    raw_vars = _raw(loop_vars)
+    multi = isinstance(loop_vars, (list, tuple))
+    it0 = jnp.zeros((), jnp.int32)
+
+    def c(carry):
+        i, vs = carry
+        v_nd = _wrap(vs) if multi else _wrap([vs])[0]
+        ok = cond_fn(v_nd)
+        ok = ok._data if hasattr(ok, "_data") else jnp.asarray(ok)
+        ok = ok.reshape(()).astype(bool)
+        if max_iterations is not None:
+            ok = ok & (i < max_iterations)
+        return ok
+
+    def b(carry):
+        i, vs = carry
+        v_nd = _wrap(vs) if multi else _wrap([vs])[0]
+        new = func(v_nd)
+        new_raw = [n._data for n in new] if isinstance(new, (list, tuple)) \
+            else new._data
+        return i + 1, new_raw
+
+    _, final = lax.while_loop(c, b, (it0, raw_vars))
+    return _wrap(final)
+
+
+def cond(pred, then_func, else_func, inputs=()):
+    """Conditional (reference: contrib.cond)."""
+    p = pred._data if hasattr(pred, "_data") else jnp.asarray(pred)
+    p = p.reshape(()).astype(bool)
+    raw = _raw(list(inputs))
+
+    def t(xs):
+        out = then_func(*_wrap(xs))
+        return [o._data for o in out] if isinstance(out, (list, tuple)) \
+            else out._data
+
+    def e(xs):
+        out = else_func(*_wrap(xs))
+        return [o._data for o in out] if isinstance(out, (list, tuple)) \
+            else out._data
+
+    return _wrap(lax.cond(p, t, e, raw))
+
+
+@register("_histogram", arg_names=["data"], differentiable=False,
+          aliases=("histogram",))
+def histogram(data, bin_cnt=10, range=None, bins=None):
+    """Reference: src/operator/tensor/histogram.cc."""
+    if range is None:
+        range = (float("-inf"), float("inf"))
+    lo, hi = range
+    counts, edges = jnp.histogram(
+        data.reshape(-1), bins=int(bin_cnt),
+        range=None if lo == float("-inf") else (lo, hi))
+    return counts
+
+
+@register("square_sum", arg_names=["data"])
+def square_sum(data, axis=None, keepdims=False):
+    """Reference: src/operator/tensor/square_sum.cc (row_sparse-aware in
+    the reference; dense math is identical)."""
+    return jnp.sum(data * data, axis=axis, keepdims=keepdims)

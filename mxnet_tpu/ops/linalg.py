@@ -1,0 +1,141 @@
+"""Linear-algebra operator family (`mx.nd.linalg_*`).
+
+Reference: ``src/operator/tensor/la_op.cc`` — gemm/gemm2, potrf/potri,
+trmm/trsm, sumlogdiag, syrk, gelqf, syevd, inverse, det, slogdet.  All map
+onto jax.numpy.linalg / lax.linalg which XLA lowers to MXU-friendly
+batched kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_linalg_gemm", arg_names=["A", "B", "C"],
+          aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """C' = alpha·op(A)·op(B) + beta·C (reference: la_op.cc gemm)."""
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (a @ b) + beta * C
+
+
+@register("_linalg_gemm2", arg_names=["A", "B"], aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * (a @ b)
+
+
+@register("_linalg_potrf", arg_names=["A"], aliases=("linalg_potrf",))
+def linalg_potrf(A):
+    """Cholesky factor (reference: la_op.cc potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", arg_names=["A"], aliases=("linalg_potri",))
+def linalg_potri(A):
+    """Inverse from a Cholesky factor: (A·Aᵀ)⁻¹ given lower A."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.swapaxes(inv_l, -1, -2) @ inv_l
+
+
+@register("_linalg_trmm", arg_names=["A", "B"], aliases=("linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    out = (B @ a) if rightside else (a @ B)
+    return alpha * out
+
+
+@register("_linalg_trsm", arg_names=["A", "B"], aliases=("linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A)·X = alpha·B (or X·op(A) = alpha·B)."""
+    import jax.scipy.linalg as jsl
+    if rightside:
+        # X·op(A) = B  ⇔  op(A)ᵀ·Xᵀ = Bᵀ
+        x = jsl.solve_triangular(A, jnp.swapaxes(B, -1, -2), lower=lower,
+                                 trans=0 if transpose else 1)
+        return alpha * jnp.swapaxes(x, -1, -2)
+    return alpha * jsl.solve_triangular(A, B, lower=lower,
+                                        trans=1 if transpose else 0)
+
+
+@register("_linalg_sumlogdiag", arg_names=["A"],
+          aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("_linalg_extractdiag", arg_names=["A"],
+          aliases=("linalg_extractdiag",))
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", arg_names=["A"], aliases=("linalg_makediag",))
+def linalg_makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    base = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if offset >= 0:
+        return base.at[..., idx, idx + offset].set(A)
+    return base.at[..., idx - offset, idx].set(A)
+
+
+@register("_linalg_extracttrian", arg_names=["A"],
+          aliases=("linalg_extracttrian",))
+def linalg_extracttrian(A, offset=0, lower=True):
+    n = A.shape[-1]
+    r = jnp.arange(n)
+    if lower:
+        mask = (r[:, None] >= r[None, :] - offset)
+    else:
+        mask = (r[:, None] <= r[None, :] - offset)
+    vals = A[..., mask]
+    return vals
+
+
+@register("_linalg_syrk", arg_names=["A"], aliases=("linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * (a @ jnp.swapaxes(a, -1, -2))
+
+
+@register("_linalg_gelqf", arg_names=["A"], num_outputs=2,
+          aliases=("linalg_gelqf",))
+def linalg_gelqf(A):
+    """LQ factorization (reference: la_op.cc gelqf): A = L·Q."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", arg_names=["A"], num_outputs=2,
+          aliases=("linalg_syevd",))
+def linalg_syevd(A):
+    w, u = jnp.linalg.eigh(A)
+    return jnp.swapaxes(u, -1, -2), w
+
+
+@register("_linalg_inverse", arg_names=["A"], aliases=("linalg_inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_det", arg_names=["A"], aliases=("linalg_det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", arg_names=["A"], num_outputs=2,
+          aliases=("linalg_slogdet",))
+def linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet

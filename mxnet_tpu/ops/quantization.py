@@ -1,0 +1,135 @@
+"""int8 quantization ops + calibration helpers.
+
+Reference: ``src/operator/quantization/`` — quantize/quantize_v2,
+dequantize, requantize, quantized_conv/fc (cuDNN int8), and the
+calibration graph pass (``quantize_graph_pass.cc``,
+``python/mxnet/contrib/quantization.py``).
+
+TPU-native: int8 matmuls hit the MXU natively; the quantized ops keep the
+reference's (data, min, max) triple ABI so calibrated models port.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_INT8_MAX = 127.0
+_UINT8_MAX = 255.0
+
+
+@register("_contrib_quantize", arg_names=["data", "min_range", "max_range"],
+          num_outputs=3, differentiable=False, aliases=("quantize",))
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine quantize to (u)int8 with explicit range
+    (reference: quantization/quantize.cc)."""
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if out_type == "uint8":
+        scale = _UINT8_MAX / (mx - mn)
+        q = jnp.clip(jnp.round((data - mn) * scale), 0, 255).astype(jnp.uint8)
+    else:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+        scale = _INT8_MAX / amax
+        q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, mn.reshape(1), mx.reshape(1)
+
+
+@register("_contrib_quantize_v2", arg_names=["data"], num_outputs=3,
+          differentiable=False, aliases=("quantize_v2",))
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Quantize with ranges from calibration or the data itself
+    (reference: quantize_v2.cc)."""
+    if min_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    return quantize(data, mn.reshape(1), mx.reshape(1), out_type=out_type)
+
+
+@register("_contrib_dequantize", arg_names=["data", "min_range", "max_range"],
+          differentiable=False, aliases=("dequantize",))
+def dequantize(data, min_range, max_range, out_type="float32"):
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    if data.dtype == jnp.uint8:
+        scale = (mx - mn) / _UINT8_MAX
+        return data.astype(jnp.float32) * scale + mn
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return data.astype(jnp.float32) * (amax / _INT8_MAX)
+
+
+@register("_contrib_requantize",
+          arg_names=["data", "min_range", "max_range"], num_outputs=3,
+          differentiable=False, aliases=("requantize",))
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None, out_type="int8"):
+    """int32 accumulator → int8 with calibrated range
+    (reference: requantize.cc)."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range.reshape(())),
+                    jnp.abs(max_range.reshape(()))) / (2.0 ** 31 - 1))
+    if min_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    q = jnp.clip(jnp.round(real * (_INT8_MAX / amax)), -127, 127) \
+        .astype(jnp.int8)
+    return q, mn.reshape(1), mx.reshape(1)
+
+
+def _qfc_optional(params):
+    if params.get("no_bias", False):
+        return ("bias", "min_bias", "max_bias")
+    return ()
+
+
+@register("_contrib_quantized_fully_connected",
+          arg_names=["data", "weight", "min_data", "max_data",
+                     "min_weight", "max_weight", "bias", "min_bias",
+                     "max_bias"],
+          num_outputs=3, differentiable=False,
+          aliases=("quantized_fully_connected",),
+          optional_args=_qfc_optional)
+def quantized_fully_connected(data, weight, min_data, max_data,
+                              min_weight, max_weight, bias=None,
+                              min_bias=None, max_bias=None,
+                              num_hidden=0, no_bias=False, flatten=True):
+    """int8×int8→int32 FC (reference: quantized_fully_connected.cc).
+    The int8 dot hits the MXU via preferred_element_type=int32."""
+    x = data.astype(jnp.int8)
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot_general(
+        x.astype(jnp.int32), weight.astype(jnp.int32).T,
+        (((1,), (0,)), ((), ())))
+    d_amax = jnp.maximum(jnp.abs(min_data.reshape(())),
+                         jnp.abs(max_data.reshape(())))
+    w_amax = jnp.maximum(jnp.abs(min_weight.reshape(())),
+                         jnp.abs(max_weight.reshape(())))
+    out_scale = (d_amax / _INT8_MAX) * (w_amax / _INT8_MAX)
+    if bias is not None and not no_bias:
+        b_amax = jnp.maximum(jnp.abs(min_bias.reshape(())),
+                             jnp.abs(max_bias.reshape(())))
+        b_real = bias.astype(jnp.float32) * (b_amax / _INT8_MAX)
+        acc = acc + jnp.round(b_real / out_scale).astype(jnp.int32)
+    out_max = out_scale * (2.0 ** 31 - 1)
+    return acc, -out_max.reshape(1), out_max.reshape(1)
+
+
+def calib_minmax(arrays):
+    """Min/max calibration over representative activations
+    (reference: contrib/quantization.py _collect_layer_output_min_max)."""
+    import numpy as np
+    mn = min(float(np.min(a.asnumpy() if hasattr(a, "asnumpy") else a))
+             for a in arrays)
+    mx = max(float(np.max(a.asnumpy() if hasattr(a, "asnumpy") else a))
+             for a in arrays)
+    return mn, mx

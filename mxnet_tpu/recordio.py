@@ -103,6 +103,12 @@ class MXRecordIO:
         magic, lrec = struct.unpack("<II", head)
         if magic != _KMAGIC:
             raise IOError("invalid record magic %x in %s" % (magic, self.uri))
+        if lrec >> _LFLAG_BITS:
+            raise IOError(
+                "continuation record (cflag=%d) in %s: the file was written "
+                "by a dmlc writer that split a payload containing the magic "
+                "word; multi-part records are not supported"
+                % (lrec >> _LFLAG_BITS, self.uri))
         length = lrec & _LENGTH_MASK
         buf = self.handle.read(length)
         pad = (4 - length % 4) % 4

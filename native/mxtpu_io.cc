@@ -116,6 +116,13 @@ long mxtpu_recordio_index(const char* path, long* offsets, long max_n) {
       std::fclose(f);
       return -1;
     }
+    // dmlc continuation records (cflag != 0) split one logical record
+    // across parts when the payload contains the magic word; refuse them
+    // rather than mis-index (the Python reader then owns the file)
+    if ((head[1] >> 29) != 0) {
+      std::fclose(f);
+      return -1;
+    }
     uint32_t len = head[1] & kLenMask;
     uint32_t pad = (4 - len % 4) % 4;
     if (n < max_n && offsets) offsets[n] = pos;
@@ -137,7 +144,8 @@ long mxtpu_recordio_read(const char* path, long offset, uint8_t* out,
     return -1;
   }
   uint32_t head[2];
-  if (std::fread(head, 4, 2, f) != 2 || head[0] != kMagic) {
+  if (std::fread(head, 4, 2, f) != 2 || head[0] != kMagic ||
+      (head[1] >> 29) != 0) {
     std::fclose(f);
     return -1;
   }

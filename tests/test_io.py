@@ -438,3 +438,18 @@ def test_image_iter_png_records_fallback(tmp_path):
     batch = it.next()
     assert batch.data[0].shape == (4, 3, 28, 28)
     assert it._native_tail is None  # permanently fell back
+
+
+def test_native_recordio_read(tmp_path):
+    from mxnet_tpu import _native
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    rec = str(tmp_path / "r.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    offs = []
+    for i in range(5):
+        offs.append(w.tell())
+        w.write(b"payload-%d" % i)
+    w.close()
+    for i, off in enumerate(offs):
+        assert _native.recordio_read(rec, off) == b"payload-%d" % i

@@ -1,0 +1,156 @@
+"""linalg / control-flow / quantization op tests
+(reference: tests/python/unittest/test_operator.py la_op tests,
+test_contrib_control_flow.py, tests/python/quantization/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_linalg_potrf_potri():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 4, 4).astype(np.float32)
+    spd = A @ A.transpose(0, 2, 1) + 4 * np.eye(4, dtype=np.float32)
+    L = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(L @ L.transpose(0, 2, 1), spd,
+                               rtol=1e-3, atol=1e-4)
+    inv = nd.linalg_potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-2, atol=1e-3)
+
+
+def test_linalg_gemm_trsm_syrk():
+    rng = np.random.RandomState(1)
+    A = rng.randn(2, 3, 3).astype(np.float32)
+    B = rng.randn(2, 3, 3).astype(np.float32)
+    C = rng.randn(2, 3, 3).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2.0 * (A @ B) + 0.5 * C, rtol=1e-5)
+
+    L = np.tril(rng.randn(3, 3).astype(np.float32)) + 3 * np.eye(
+        3, dtype=np.float32)
+    X = nd.linalg_trsm(nd.array(L[None]), nd.array(B[:1])).asnumpy()
+    np.testing.assert_allclose(L @ X[0], B[0], rtol=1e-4, atol=1e-4)
+    # rightside: X·A = B
+    Xr = nd.linalg_trsm(nd.array(L[None]), nd.array(B[:1]),
+                        rightside=True).asnumpy()
+    np.testing.assert_allclose(Xr[0] @ L, B[0], rtol=1e-4, atol=1e-4)
+
+    S = nd.linalg_syrk(nd.array(A)).asnumpy()
+    np.testing.assert_allclose(S, A @ A.transpose(0, 2, 1), rtol=1e-5)
+
+
+def test_linalg_gelqf_syevd_det():
+    rng = np.random.RandomState(2)
+    A = rng.randn(2, 3, 5).astype(np.float32)
+    L, Q = nd.linalg_gelqf(nd.array(A))
+    np.testing.assert_allclose(L.asnumpy() @ Q.asnumpy(), A,
+                               rtol=1e-4, atol=1e-4)
+    # Q orthonormal rows
+    qq = Q.asnumpy() @ Q.asnumpy().transpose(0, 2, 1)
+    np.testing.assert_allclose(qq, np.broadcast_to(np.eye(3), (2, 3, 3)),
+                               rtol=1e-4, atol=1e-4)
+
+    S = rng.randn(4, 4).astype(np.float32)
+    S = (S + S.T) / 2
+    U, w = nd.linalg_syevd(nd.array(S[None]))
+    wr, vr = np.linalg.eigh(S)
+    np.testing.assert_allclose(np.sort(w.asnumpy()[0]), np.sort(wr),
+                               rtol=1e-4, atol=1e-4)
+
+    d = nd.linalg_det(nd.array(S[None])).asnumpy()
+    np.testing.assert_allclose(d, np.linalg.det(S)[None], rtol=1e-3)
+
+
+def test_foreach_scan():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = nd.contrib.foreach(body, data, nd.zeros((3,)))
+    np.testing.assert_allclose(final.asnumpy(), data.asnumpy().sum(axis=0))
+    np.testing.assert_allclose(outs.asnumpy()[1],
+                               data.asnumpy()[:2].sum(axis=0))
+
+
+def test_foreach_multi_state():
+    data = nd.array(np.ones((5, 2), np.float32))
+
+    def body(x, states):
+        s0, s1 = states
+        return x * s1, [s0 + x, s1 * 2]
+
+    outs, (s0, s1) = nd.contrib.foreach(body, data,
+                                        [nd.zeros((2,)), nd.ones((2,))])
+    np.testing.assert_allclose(s0.asnumpy(), 5.0)
+    np.testing.assert_allclose(s1.asnumpy(), 32.0)
+    assert outs.shape == (5, 2)
+
+
+def test_while_loop_and_cond():
+    res = nd.contrib.while_loop(lambda vs: vs[0] < 10,
+                                lambda vs: [vs[0] + 3],
+                                [nd.array([0.0])], max_iterations=20)
+    assert float(res[0].asnumpy()) == 12.0
+    # max_iterations cap
+    res = nd.contrib.while_loop(lambda vs: vs[0] < 1e9,
+                                lambda vs: [vs[0] + 1],
+                                [nd.array([0.0])], max_iterations=5)
+    assert float(res[0].asnumpy()) == 5.0
+
+    r = nd.contrib.cond(nd.array([0.0]), lambda x: x * 2, lambda x: x * 3,
+                        [nd.array([5.0])])
+    assert float(r.asnumpy()) == 15.0
+
+
+def test_foreach_grad():
+    """Gradients flow through the scanned body (lax.scan autodiff)."""
+    data = nd.array(np.ones((4, 2), np.float32) * 2)
+    data.attach_grad()
+    with mx.autograd.record():
+        outs, final = nd.contrib.foreach(
+            lambda x, s: (x * s, s + x), data, nd.ones((2,)))
+        loss = nd.sum(final)
+    loss.backward()
+    np.testing.assert_allclose(data.grad.asnumpy(), 1.0)
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    q, mn, mx_ = nd.contrib.quantize_v2(nd.array(x), out_type="int8")
+    assert q.dtype == np.int8
+    back = nd.contrib.dequantize(q, mn, mx_).asnumpy()
+    assert np.abs(back - x).max() / np.abs(x).max() < 0.02
+    # uint8 path with explicit range
+    q8, mn8, mx8 = nd.contrib.quantize(
+        nd.array(x), nd.array([float(x.min())]), nd.array([float(x.max())]),
+        out_type="uint8")
+    assert q8.dtype == np.uint8
+    back8 = nd.contrib.dequantize(q8, mn8, mx8).asnumpy()
+    assert np.abs(back8 - x).max() / np.abs(x).max() < 0.02
+
+
+def test_quantized_fc_vs_float():
+    rng = np.random.RandomState(3)
+    x = rng.randn(8, 16).astype(np.float32)
+    w = rng.randn(4, 16).astype(np.float32)
+    qd, dmn, dmx = nd.contrib.quantize_v2(nd.array(x), out_type="int8")
+    qw, wmn, wmx = nd.contrib.quantize_v2(nd.array(w), out_type="int8")
+    acc, omn, omx = nd.contrib.quantized_fully_connected(
+        qd, qw, dmn, dmx, wmn, wmx, num_hidden=4, no_bias=True)
+    scale = float((np.abs(x).max() / 127) * (np.abs(w).max() / 127))
+    np.testing.assert_allclose(acc.asnumpy() * scale, x @ w.T,
+                               rtol=0.05, atol=0.1)
+
+
+def test_histogram_and_square_sum():
+    x = nd.array(np.array([0.1, 0.4, 0.6, 0.9, 0.95], np.float32))
+    counts = nd.histogram(x, bin_cnt=2, range=(0.0, 1.0)).asnumpy()
+    np.testing.assert_array_equal(counts, [2, 3])
+    s = nd.square_sum(nd.array(np.array([[1.0, 2.0], [3.0, 4.0]],
+                                        np.float32)), axis=1).asnumpy()
+    np.testing.assert_allclose(s, [5.0, 25.0])

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: tools/launch.py → dmlc tracker).
+
+The reference spawns N workers + N servers through the dmlc-core tracker
+(local/ssh/mpi/...).  Multi-host jax needs one *worker* process per host
+pointed at a coordinator — no servers (the PS collapses into mesh
+collectives).  This launcher reproduces the reference CLI for the local
+case: ``launch.py -n 4 --launcher local python train.py`` spawns 4
+processes with JAX distributed env wired, each seeing a slice of a CPU
+device mesh (the dist_sync_kvstore-test pattern, SURVEY.md §4).
+
+For real pods, GKE/metadata provides the same variables; this tool then
+only prints them (``--launcher echo``).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed training job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "echo"])
+    parser.add_argument("--env-server", default=None,
+                        help="unused; kept for reference CLI parity")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+
+    port = free_port()
+    coordinator = "127.0.0.1:%d" % port
+
+    if args.launcher == "echo":
+        for rank in range(args.num_workers):
+            print("JAX_COORDINATOR_ADDRESS=%s JAX_NUM_PROCESSES=%d "
+                  "JAX_PROCESS_ID=%d %s" % (coordinator, args.num_workers,
+                                            rank, " ".join(args.command)))
+        return
+
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            # jax.distributed.initialize() reads these
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+            "JAX_NUM_PROCESSES": str(args.num_workers),
+            "JAX_PROCESS_ID": str(rank),
+            # reference-compatible names (kvstore scripts read these)
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(args.command, env=env))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

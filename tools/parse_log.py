@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference: tools/parse_log.py).
+
+Reads fit() log lines (Epoch[..] Train-accuracy / Validation-accuracy /
+Time cost / Speedometer samples/sec) and prints tsv."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    res = {}
+    for line in lines:
+        m = re.search(r"Epoch\[(\d+)\] Train-([\w-]+)=([\d.eE+-]+)", line)
+        if m:
+            res.setdefault(int(m.group(1)), {})["train-" + m.group(2)] = \
+                float(m.group(3))
+        m = re.search(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)", line)
+        if m:
+            res.setdefault(int(m.group(1)), {})["val-" + m.group(2)] = \
+                float(m.group(3))
+        m = re.search(r"Epoch\[(\d+)\] Time cost=([\d.]+)", line)
+        if m:
+            res.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+        m = re.search(r"Epoch\[(\d+)\] Batch \[\d+\]\s+Speed: ([\d.]+)", line)
+        if m:
+            res.setdefault(int(m.group(1)), {}).setdefault(
+                "speeds", []).append(float(m.group(2)))
+    return res
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile", nargs="?", default="-")
+    args = parser.parse_args()
+    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
+    res = parse(lines)
+    if not res:
+        print("no epochs found", file=sys.stderr)
+        return
+    keys = sorted({k for v in res.values() for k in v if k != "speeds"})
+    print("\t".join(["epoch"] + keys + ["speed(avg)"]))
+    for epoch in sorted(res):
+        row = [str(epoch)]
+        for k in keys:
+            row.append("%.6g" % res[epoch].get(k, float("nan")))
+        speeds = res[epoch].get("speeds", [])
+        row.append("%.1f" % (sum(speeds) / len(speeds)) if speeds else "-")
+        print("\t".join(row))
+
+
+if __name__ == "__main__":
+    main()
