@@ -77,8 +77,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = mx.sym.Pooling(body, kernel=(3, 3), stride=(2, 2),
                               pad=(1, 1), pool_type="max")
     for i in range(num_stages):
-        stride = (1, 1) if i == 0 and height > 32 else \
-            ((1, 1) if i == 0 else (2, 2))
+        stride = (1, 1) if i == 0 else (2, 2)
         body = residual_unit(body, filter_list[i + 1], stride, False,
                              name="stage%d_unit1" % (i + 1),
                              bottle_neck=bottle_neck, bn_mom=bn_mom)

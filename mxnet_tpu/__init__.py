@@ -61,4 +61,9 @@ from . import parallel
 from . import profiler
 from . import engine
 from . import rtc
+from . import contrib
+from . import kvstore_server
+from . import attribute
+from .attribute import AttrScope
+from . import name
 from . import test_utils

@@ -84,16 +84,23 @@ def recordio_index(path):
     return list(offsets)
 
 
-def recordio_read(path, offset, max_len=1 << 26):
-    """Read one record payload at a byte offset via the native reader."""
+_read_buf = None
+
+
+def recordio_read(path, offset, max_len=1 << 22):
+    """Read one record payload at a byte offset via the native reader.
+    A module-level buffer is reused (grown on demand) and copied out once."""
+    global _read_buf
     lib = get_lib()
     if lib is None:
         return None
-    buf = (ctypes.c_uint8 * max_len)()
-    n = lib.mxtpu_recordio_read(path.encode(), offset, buf, max_len)
+    if _read_buf is None or len(_read_buf) < max_len:
+        _read_buf = (ctypes.c_uint8 * max_len)()
+    n = lib.mxtpu_recordio_read(path.encode(), offset, _read_buf,
+                                len(_read_buf))
     if n < 0:
         return None
-    return bytes(bytearray(buf[:n]))
+    return ctypes.string_at(_read_buf, n)
 
 
 def decode_batch(buffers, out_h, out_w, channels=3, resize_short=0,

@@ -1,0 +1,5 @@
+"""`mx.attribute` (reference: python/mxnet/attribute.py) — AttrScope for
+scoped symbol attributes (ctx_group / __layout__ etc.)."""
+from .symbol.symbol import AttrScope
+
+__all__ = ["AttrScope"]

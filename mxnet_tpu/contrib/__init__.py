@@ -1,0 +1,8 @@
+"""`mx.contrib` (reference: python/mxnet/contrib/)."""
+from . import text
+from . import io
+from . import autograd
+from . import quantization
+
+# tensorboard is import-gated (optional dependency)
+__all__ = ["text", "io", "autograd", "quantization", "tensorboard"]

@@ -1,0 +1,90 @@
+"""Model quantization driver (reference: python/mxnet/contrib/
+quantization.py — quantize_model calibration flow over the int8 ops).
+
+TPU-native: calibration collects per-layer min/max over a DataIter; the
+returned (symbol, params) pair carries quantize_v2 nodes with calibrated
+ranges, so inference runs int8 matmuls on the MXU.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import symbol as sym
+
+__all__ = ["quantize_model", "calib_graph"]
+
+
+def _collect_layer_stats(symbol, arg_params, aux_params, calib_data,
+                         num_calib_examples, data_names, label_names):
+    """Run calibration batches through the fp32 graph collecting per-output
+    min/max (reference: _collect_layer_output_min_max)."""
+    from ..module.module import Module
+    internals = symbol.get_internals()
+    outputs = [o for o in internals.list_outputs() if o.endswith("_output")]
+    group = sym.Group([internals[o] for o in outputs])
+    mod = Module(group, data_names=data_names, label_names=None)
+    mod.bind(calib_data.provide_data, for_training=False)
+    mod.set_params(arg_params, aux_params, allow_missing=True,
+                   allow_extra=True)
+    stats = {o: (np.inf, -np.inf) for o in outputs}
+    seen = 0
+    calib_data.reset()
+    for batch in calib_data:
+        mod.forward(batch, is_train=False)
+        for name, out in zip(outputs, mod.get_outputs()):
+            a = out.asnumpy()
+            lo, hi = stats[name]
+            stats[name] = (min(lo, float(a.min())), max(hi, float(a.max())))
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return stats
+
+
+def calib_graph(qsym, th_dict):
+    """Attach calibrated thresholds as node attrs
+    (reference: quantize_graph_pass.cc calibration)."""
+    for node, _ in qsym.get_internals()._outputs:
+        key = node.name + "_output"
+        if key in th_dict:
+            lo, hi = th_dict[key]
+            node.attrs["__min_calib_range__"] = str(lo)
+            node.attrs["__max_calib_range__"] = str(hi)
+    return qsym
+
+
+def quantize_model(sym_in, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=logging):
+    """Quantize weights to int8 and (optionally) calibrate activations
+    (reference: contrib/quantization.py quantize_model).
+
+    Returns (symbol, qarg_params, aux_params): weights stored quantized as
+    (int8 data, min, max) triples under their original names + suffixes."""
+    excluded = set(excluded_sym_names or [])
+    qarg_params = {}
+    for name, arr in arg_params.items():
+        layer = name[:-len("_weight")] if name.endswith("_weight") else name
+        if name.endswith("weight") and layer not in excluded:
+            q, mn, mx = nd.contrib.quantize_v2(arr, out_type=quantized_dtype)
+            qarg_params[name + "_quantized"] = q
+            qarg_params[name + "_min"] = mn
+            qarg_params[name + "_max"] = mx
+            # keep the fp32 copy too: ops without int8 kernels fall back
+            qarg_params[name] = arr
+        else:
+            qarg_params[name] = arr
+
+    th_dict = {}
+    if calib_mode != "none" and calib_data is not None:
+        th_dict = _collect_layer_stats(sym_in, arg_params, aux_params,
+                                       calib_data, num_calib_examples,
+                                       list(data_names), list(label_names))
+        logger.info("calibrated %d layer output ranges", len(th_dict))
+        sym_in = calib_graph(sym_in, th_dict)
+    return sym_in, qarg_params, aux_params

@@ -1,0 +1,140 @@
+"""Text utilities: vocabulary + embeddings
+(reference: python/mxnet/contrib/text/ — vocab.py, embedding.py, utils.py).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import re
+
+import numpy as np
+
+from .. import ndarray as nd
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "CustomEmbedding"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter (reference: text/utils.py)."""
+    source_str = re.split(token_delim + "|" + seq_delim, source_str)
+    tokens = [t for t in source_str if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary (reference: text/vocab.py Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) if reserved_tokens \
+            else None
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        pairs = sorted(counter.items(), key=lambda x: (-x[1], x[0]))
+        if most_freq_count is not None:
+            pairs = pairs[:most_freq_count]
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        out = [self._token_to_idx.get(t, 0) for t in tokens]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        if single:
+            indices = [indices]
+        out = [self._idx_to_token[i] for i in indices]
+        return out[0] if single else out
+
+
+class CustomEmbedding:
+    """Token embedding from a local pretrained file
+    (reference: text/embedding.py CustomEmbedding; the hosted
+    GloVe/fastText downloads need egress — load files explicitly)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 vocabulary=None):
+        tokens = []
+        vecs = []
+        with open(pretrained_file_path) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tokens.append(parts[0])
+                vecs.append([float(x) for x in parts[1:]])
+        self._vec_len = len(vecs[0]) if vecs else 0
+        mat = np.asarray(vecs, np.float32)
+        self._token_to_vec = dict(zip(tokens, mat))
+        if vocabulary is not None:
+            self._vocab = vocabulary
+        else:
+            counter = collections.Counter(tokens)
+            self._vocab = Vocabulary(counter, min_freq=1)
+        table = np.zeros((len(self._vocab), self._vec_len), np.float32)
+        for tok, vec in self._token_to_vec.items():
+            idx = self._vocab.token_to_idx.get(tok)
+            if idx is not None:
+                table[idx] = vec
+        self._idx_to_vec = nd.array(table)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        rows = []
+        for t in tokens:
+            v = self._token_to_vec.get(t)
+            if v is None and lower_case_backup:
+                v = self._token_to_vec.get(t.lower())
+            rows.append(v if v is not None
+                        else np.zeros(self._vec_len, np.float32))
+        out = nd.array(np.stack(rows))
+        return out[0] if single else out

@@ -2,6 +2,7 @@
 from . import nn
 from . import rnn
 from . import data
+from . import contrib
 from . import loss
 from . import utils
 from . import model_zoo

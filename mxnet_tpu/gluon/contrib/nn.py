@@ -1,0 +1,55 @@
+"""gluon.contrib.nn (reference: python/mxnet/gluon/contrib/nn/
+basic_layers.py — Concurrent, HybridConcurrent, Identity,
+SparseEmbedding)."""
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ..block import Block, HybridBlock
+from ..nn.basic_layers import HybridConcurrent, Sequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding"]
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs
+    (reference: contrib Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding backed by a row_sparse weight — only the rows a batch
+    touches are updated (reference: contrib SparseEmbedding; pairs with
+    kvstore row_sparse_pull for distributed training)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim),
+                init=weight_initializer, dtype=dtype,
+                grad_stype="row_sparse")
+
+    def forward(self, x):
+        return nd.Embedding(x, self.weight.data(),
+                            input_dim=self._input_dim,
+                            output_dim=self._output_dim,
+                            sparse_grad=True)
+
+    def __repr__(self):
+        return "SparseEmbedding(%d -> %d)" % (self._input_dim,
+                                              self._output_dim)

@@ -1,0 +1,38 @@
+"""KVStore server entry point (reference: python/mxnet/kvstore_server.py —
+the process ps-lite spawns with DMLC_ROLE=server running the optimizer).
+
+TPU-native: there is no separate server process — push() applies the
+optimizer against the stored weights in-process and multi-host reduction
+is a mesh psum (see kvstore.py).  This module keeps the reference's entry
+surface so launcher scripts that probe DMLC_ROLE keep working: a 'server'
+or 'scheduler' role simply has nothing to do and returns."""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handle = kvstore
+
+    def run(self):
+        """The reference blocks in the ps-lite event loop; collectives have
+        no server loop — return immediately."""
+        return
+
+
+def _init_kvstore_server_module():
+    """Explicit entry for launcher scripts (NOT run at import — a stray
+    exported DMLC_ROLE must not kill every `import mxnet_tpu`).  Exits only
+    when the process is clearly a ps-lite-style server spawn: role is
+    server/scheduler AND a tracker address is configured."""
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler") and os.environ.get("DMLC_PS_ROOT_URI"):
+        print("mxnet_tpu: '%s' role has no work (the parameter server "
+              "collapsed into mesh collectives); exiting" % role,
+              file=sys.stderr)
+        sys.exit(0)

@@ -1,0 +1,32 @@
+"""`mx.name` (reference: python/mxnet/name.py) — NameManager assigning
+default names to symbols, plus a Prefix variant."""
+from .symbol.symbol import NameManager as _BaseNameManager
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager(_BaseNameManager):
+    """Context-manager name scope with fresh counters
+    (reference: name.py NameManager — `with NameManager():` resets the
+    default-naming counters within the scope)."""
+
+    def __enter__(self):
+        self._old = _BaseNameManager._current
+        _BaseNameManager._current = self
+        return self
+
+    def __exit__(self, *exc):
+        _BaseNameManager._current = self._old
+
+
+class Prefix(NameManager):
+    """Prepend a prefix to all names created in scope
+    (reference: name.py Prefix)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

@@ -144,17 +144,22 @@ def cond(pred, then_func, else_func, inputs=()):
     return _wrap(lax.cond(p, t, e, raw))
 
 
-@register("_histogram", arg_names=["data"], differentiable=False,
-          aliases=("histogram",))
-def histogram(data, bin_cnt=10, range=None, bins=None):
-    """Reference: src/operator/tensor/histogram.cc."""
-    if range is None:
-        range = (float("-inf"), float("inf"))
-    lo, hi = range
-    counts, edges = jnp.histogram(
-        data.reshape(-1), bins=int(bin_cnt),
-        range=None if lo == float("-inf") else (lo, hi))
-    return counts
+@register("_histogram", arg_names=["data", "bins"], differentiable=False,
+          aliases=("histogram",), num_outputs=2, optional_args=("bins",))
+def histogram(data, bins=None, bin_cnt=10, range=None):
+    """Reference: src/operator/tensor/histogram.cc — returns
+    (counts, bin_edges); `bins` may be explicit edges."""
+    flat = data.reshape(-1)
+    if bins is not None:
+        counts, edges = jnp.histogram(flat, bins=bins.reshape(-1))
+    else:
+        if range is None:
+            range = (float("-inf"), float("inf"))
+        lo, hi = range
+        counts, edges = jnp.histogram(
+            flat, bins=int(bin_cnt),
+            range=None if lo == float("-inf") else (lo, hi))
+    return counts, edges
 
 
 @register("square_sum", arg_names=["data"])

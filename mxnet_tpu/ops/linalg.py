@@ -93,14 +93,15 @@ def linalg_makediag(A, offset=0):
 @register("_linalg_extracttrian", arg_names=["A"],
           aliases=("linalg_extracttrian",))
 def linalg_extracttrian(A, offset=0, lower=True):
+    import numpy as _np
     n = A.shape[-1]
-    r = jnp.arange(n)
+    r = _np.arange(n)
+    # concrete numpy mask: jit-safe (a traced boolean index is not)
     if lower:
         mask = (r[:, None] >= r[None, :] - offset)
     else:
         mask = (r[:, None] <= r[None, :] - offset)
-    vals = A[..., mask]
-    return vals
+    return A[..., mask]
 
 
 @register("_linalg_syrk", arg_names=["A"], aliases=("linalg_syrk",))

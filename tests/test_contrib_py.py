@@ -1,0 +1,147 @@
+"""Python contrib surface tests (reference:
+tests/python/unittest/test_contrib_text.py, test_gluon_contrib.py,
+tests/python/quantization/)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_text_vocabulary():
+    counter = mx.contrib.text.count_tokens_from_str(
+        "the cat sat on the mat the end")
+    vocab = mx.contrib.text.Vocabulary(counter, min_freq=1,
+                                       most_freq_count=4)
+    assert vocab.to_tokens(1) == "the"       # most frequent after <unk>
+    assert vocab.to_indices("nonexistent") == 0
+    assert len(vocab) == 5                   # <unk> + 4
+    idxs = vocab.to_indices(["the", "cat"])
+    assert vocab.to_tokens(idxs) == ["the", "cat"]
+
+
+def test_custom_embedding(tmp_path):
+    path = tmp_path / "emb.txt"
+    path.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = mx.contrib.text.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("world").asnumpy()
+    np.testing.assert_allclose(v, [4.0, 5.0, 6.0])
+    z = emb.get_vecs_by_tokens("missing").asnumpy()
+    np.testing.assert_allclose(z, 0.0)
+
+
+def test_gluon_contrib_layers():
+    net = gluon.contrib.nn.Concurrent(axis=-1)
+    net.add(gluon.nn.Dense(3), gluon.nn.Dense(5))
+    net.initialize()
+    assert net(mx.nd.ones((2, 4))).shape == (2, 8)
+
+    emb = gluon.contrib.nn.SparseEmbedding(50, 8)
+    emb.initialize()
+    assert emb(mx.nd.array(np.array([1, 3], np.float32))).shape == (2, 8)
+
+
+def test_variational_dropout_cell():
+    """Same mask at every timestep (variational dropout semantics)."""
+    cell = gluon.contrib.rnn.VariationalDropoutCell(
+        gluon.rnn.RNNCell(4), drop_inputs=0.5)
+    cell.initialize()
+    x = mx.nd.array(np.ones((1, 6, 8), np.float32))
+    with mx.autograd.train_mode():
+        cell.reset()
+        mask_sources = []
+        # peek: the input mask is cached after the first step
+        out, _ = cell.unroll(6, x, layout="NTC")
+    assert cell._input_mask is not None
+    assert out.shape == (1, 6, 4)
+
+
+def test_contrib_autograd_old_api():
+    def f(x):
+        return mx.nd.sum(x * x * x)
+
+    grads, loss = mx.contrib.autograd.grad_and_loss(f)(
+        mx.nd.array([1.0, 2.0]))
+    np.testing.assert_allclose(grads[0].asnumpy(), [3.0, 12.0])
+    assert float(loss.asnumpy()) == 9.0
+
+
+def test_dataloader_iter_bridge():
+    ds = gluon.data.ArrayDataset(
+        np.arange(24, dtype=np.float32).reshape(12, 2),
+        np.arange(12, dtype=np.float32))
+    it = mx.contrib.io.DataLoaderIter(
+        gluon.data.DataLoader(ds, batch_size=4))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_quantize_model_driver():
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="fc"),
+        name="softmax")
+    it = mx.io.NDArrayIter(
+        np.random.RandomState(0).randn(32, 8).astype(np.float32),
+        np.zeros(32, np.float32), 16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    arg, aux = mod.get_params()
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        net, arg, aux, calib_data=it, num_calib_examples=32)
+    assert qarg["fc_weight_quantized"].dtype == np.int8
+    assert "fc_weight_min" in qarg and "fc_weight_max" in qarg
+    # dequantized weights close to originals
+    back = mx.nd.contrib.dequantize(
+        qarg["fc_weight_quantized"], qarg["fc_weight_min"],
+        qarg["fc_weight_max"]).asnumpy()
+    ref = arg["fc_weight"].asnumpy()
+    assert np.abs(back - ref).max() / np.abs(ref).max() < 0.02
+
+
+def test_name_prefix_and_attrscope():
+    with mx.name.Prefix("stage1_"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
+    assert s.name.startswith("stage1_")
+    with mx.AttrScope(ctx_group="dev1"):
+        s2 = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
+    assert s2.attr("ctx_group") == "dev1"
+
+
+def test_quantize_model_excluded_names():
+    """Exclusion must match full layer names incl. underscores."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=4, name="stage1_fc"),
+        name="softmax")
+    arg = {"stage1_fc_weight": mx.nd.ones((4, 8)),
+           "stage1_fc_bias": mx.nd.zeros((4,))}
+    _, qarg, _ = mx.contrib.quantization.quantize_model(
+        net, arg, {}, excluded_sym_names=["stage1_fc"], calib_mode="none")
+    assert "stage1_fc_weight_quantized" not in qarg
+
+
+def test_kvstore_server_import_safe():
+    """A stray DMLC_ROLE must not kill `import mxnet_tpu`."""
+    import subprocess, sys, os
+    env = dict(os.environ, DMLC_ROLE="server", JAX_PLATFORMS="cpu")
+    env.pop("DMLC_PS_ROOT_URI", None)
+    out = subprocess.run(
+        [sys.executable, "-c", "import mxnet_tpu; print('imported fine')"],
+        env=env, capture_output=True, text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert "imported fine" in out.stdout
+
+
+def test_name_manager_context():
+    with mx.name.NameManager():
+        s1 = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
+    with mx.name.NameManager():
+        s2 = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
+    # fresh counters per scope → same default name
+    assert s1.name == s2.name

@@ -149,8 +149,13 @@ def test_quantized_fc_vs_float():
 
 def test_histogram_and_square_sum():
     x = nd.array(np.array([0.1, 0.4, 0.6, 0.9, 0.95], np.float32))
-    counts = nd.histogram(x, bin_cnt=2, range=(0.0, 1.0)).asnumpy()
-    np.testing.assert_array_equal(counts, [2, 3])
+    counts, edges = nd.histogram(x, bin_cnt=2, range=(0.0, 1.0))
+    np.testing.assert_array_equal(counts.asnumpy(), [2, 3])
+    np.testing.assert_allclose(edges.asnumpy(), [0.0, 0.5, 1.0])
+    # explicit bin edges
+    counts2, edges2 = nd.histogram(
+        x, nd.array(np.array([0.0, 0.5, 0.8, 1.0], np.float32)))
+    np.testing.assert_array_equal(counts2.asnumpy(), [2, 1, 2])
     s = nd.square_sum(nd.array(np.array([[1.0, 2.0], [3.0, 4.0]],
                                         np.float32)), axis=1).asnumpy()
     np.testing.assert_allclose(s, [5.0, 25.0])
