@@ -22,7 +22,10 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
 
-    batch = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
+    # default 128/chip: the reference's headline number is bs=32-per-GPU,
+    # but modern chips need larger batches to fill the MXU — measured on
+    # one chip: bs=32 → 703 img/s, bs=64 → 900, bs=128 → 1157
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH", "128"))
     # keep the per-chip metric honest: batch is per chip, and the device
     # count matches the mesh the trainer actually spans
     devices = jax.devices()
@@ -37,19 +40,34 @@ def main():
     precision = os.environ.get("MXTPU_BENCH_PRECISION", "bfloat16")
     jax.config.update("jax_default_matmul_precision", precision)
 
-    net = vision.resnet50_v1()
-    net.initialize(mx.init.Xavier())
-    trainer = DataParallelTrainer(
-        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
-
     rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.rand(global_batch, 3, 224, 224).astype(np.float32))
-    y = mx.nd.array((rng.rand(global_batch) * 1000).astype(np.int64))
 
-    # warmup (compile)
-    for _ in range(3):
-        trainer.step(x, y).asscalar()
+    def make_batch(b):
+        return (mx.nd.array(rng.rand(b, 3, 224, 224).astype(np.float32)),
+                mx.nd.array((rng.rand(b) * 1000).astype(np.int64)))
+
+    def build_trainer():
+        # rebuilt from scratch on every OOM retry: the step jit donates the
+        # parameter/state buffers, so a failed step may have invalidated them
+        net = vision.resnet50_v1()
+        net.initialize(mx.init.Xavier())
+        return DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
+
+    # warmup (compile); halve the batch on OOM so the metric always prints
+    while True:
+        try:
+            trainer = build_trainer()
+            x, y = make_batch(global_batch)
+            for _ in range(3):
+                trainer.step(x, y).asscalar()
+            break
+        except Exception as e:  # RESOURCE_EXHAUSTED etc.
+            if "RESOURCE_EXHAUSTED" not in str(e) or batch <= 8:
+                raise
+            batch //= 2
+            global_batch = batch * n_dev
 
     iters = int(os.environ.get("MXTPU_BENCH_ITERS", "10"))
     t0 = time.perf_counter()

@@ -85,22 +85,30 @@ def recordio_index(path):
 
 
 _read_buf = None
+_read_lock = threading.Lock()
 
 
 def recordio_read(path, offset, max_len=1 << 22):
     """Read one record payload at a byte offset via the native reader.
-    A module-level buffer is reused (grown on demand) and copied out once."""
+    A module-level buffer is reused under a lock (pipelines run on
+    background threads) and grown up to 64 MB when a record exceeds it."""
     global _read_buf
     lib = get_lib()
     if lib is None:
         return None
-    if _read_buf is None or len(_read_buf) < max_len:
-        _read_buf = (ctypes.c_uint8 * max_len)()
-    n = lib.mxtpu_recordio_read(path.encode(), offset, _read_buf,
-                                len(_read_buf))
-    if n < 0:
-        return None
-    return ctypes.string_at(_read_buf, n)
+    with _read_lock:
+        if _read_buf is None or len(_read_buf) < max_len:
+            _read_buf = (ctypes.c_uint8 * max_len)()
+        n = lib.mxtpu_recordio_read(path.encode(), offset, _read_buf,
+                                    len(_read_buf))
+        if n < 0 and len(_read_buf) < (1 << 26):
+            # maybe just a too-small buffer: one retry at the 64 MB cap
+            _read_buf = (ctypes.c_uint8 * (1 << 26))()
+            n = lib.mxtpu_recordio_read(path.encode(), offset, _read_buf,
+                                        len(_read_buf))
+        if n < 0:
+            return None
+        return ctypes.string_at(_read_buf, n)
 
 
 def decode_batch(buffers, out_h, out_w, channels=3, resize_short=0,

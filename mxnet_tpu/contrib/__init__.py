@@ -3,6 +3,6 @@ from . import text
 from . import io
 from . import autograd
 from . import quantization
+from . import tensorboard  # module import is safe; SummaryWriter is gated
 
-# tensorboard is import-gated (optional dependency)
 __all__ = ["text", "io", "autograd", "quantization", "tensorboard"]

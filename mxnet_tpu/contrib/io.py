@@ -11,8 +11,9 @@ __all__ = ["DataLoaderIter"]
 
 class DataLoaderIter(DataIter):
     def __init__(self, loader, data_name="data", label_name="softmax_label"):
-        super().__init__(batch_size=getattr(loader, "_batch_sampler", None)
-                         and loader._batch_sampler._batch_size or 0)
+        sampler = getattr(loader, "_batch_sampler", None)
+        super().__init__(
+            batch_size=getattr(sampler, "_batch_size", 0) if sampler else 0)
         self._loader = loader
         self._iter = iter(loader)
         self._data_name = data_name

@@ -23,7 +23,8 @@ def _collect_layer_stats(symbol, arg_params, aux_params, calib_data,
     min/max (reference: _collect_layer_output_min_max)."""
     from ..module.module import Module
     internals = symbol.get_internals()
-    outputs = [o for o in internals.list_outputs() if o.endswith("_output")]
+    outputs = [o for o in internals.list_outputs()
+               if o.endswith("_output") or o in data_names]
     group = sym.Group([internals[o] for o in outputs])
     mod = Module(group, data_names=data_names, label_names=None)
     mod.bind(calib_data.provide_data, for_training=False)
@@ -42,6 +43,75 @@ def _collect_layer_stats(symbol, arg_params, aux_params, calib_data,
         if num_calib_examples is not None and seen >= num_calib_examples:
             break
     return stats
+
+
+def _entry_range_key(entry):
+    node, _ = entry
+    return node.name if node.op is None else node.name + "_output"
+
+
+def _rewrite_int8_fc(symbol, arg_params, th_dict, excluded):
+    """Replace calibrated FullyConnected nodes with
+    quantize_v2 → quantized_fully_connected → dequantize (+ fp32 bias)
+    subgraphs — the quantize_graph_pass.cc analogue.  Layers without a
+    calibrated input range, or in `excluded`, stay fp32."""
+    from ..symbol.symbol import Symbol, _Node
+
+    memo = {}
+
+    def clone(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        new = _Node(node.op, node.name, dict(node.attrs), [], node._is_aux)
+        memo[id(node)] = new  # register before recursing into inputs
+        new.inputs = [(clone(c), i) for c, i in node.inputs]
+        if node.op != "FullyConnected" or node.name in excluded:
+            return new
+        rng = th_dict.get(_entry_range_key(node.inputs[0]))
+        wname = node.name + "_weight"
+        if rng is None or wname + "_quantized" not in arg_params:
+            return new
+        lo, hi = rng
+        data_entry = new.inputs[0]
+        has_bias = len(node.inputs) > 2
+        qdata = _Node("_contrib_quantize_v2", node.name + "_qdata",
+                      {"out_type": "int8", "min_calib_range": lo,
+                       "max_calib_range": hi}, [data_entry])
+        def qvar(suffix):
+            full = wname + suffix
+            arr = arg_params[full]
+            return _Node(None, full,
+                         {"__shape__": str(tuple(arr.shape)),
+                          "__dtype__": str(np.dtype(arr.dtype).name)})
+
+        wq = qvar("_quantized")
+        wmn = qvar("_min")
+        wmx = qvar("_max")
+        attrs = {"num_hidden": node.attrs.get("num_hidden"),
+                 "no_bias": True,
+                 "flatten": node.attrs.get("flatten", True)}
+        qfc = _Node("_contrib_quantized_fully_connected",
+                    node.name + "_int8",
+                    attrs,
+                    [(qdata, 0), (wq, 0), (qdata, 1), (qdata, 2),
+                     (wmn, 0), (wmx, 0)])
+        deq = _Node("_contrib_dequantize", node.name + "_deq",
+                    {}, [(qfc, 0), (qfc, 1), (qfc, 2)])
+        if has_bias:
+            bias_entry = new.inputs[2]
+            bname = node.name + "_bias"
+            if bias_entry[0].op is None and bname in arg_params:
+                # no FC node derives its shape anymore — pin it on the var
+                bias_entry[0].attrs.setdefault(
+                    "__shape__", str(tuple(arg_params[bname].shape)))
+            out = _Node("broadcast_add", node.name + "_addbias", {},
+                        [(deq, 0), bias_entry])
+        else:
+            out = deq
+        memo[id(node)] = out
+        return out
+
+    return Symbol([(clone(n), i) for n, i in symbol._outputs])
 
 
 def calib_graph(qsym, th_dict):
@@ -87,4 +157,6 @@ def quantize_model(sym_in, arg_params, aux_params, data_names=("data",),
                                        list(data_names), list(label_names))
         logger.info("calibrated %d layer output ranges", len(th_dict))
         sym_in = calib_graph(sym_in, th_dict)
+        # rewrite calibrated FC layers to real int8 subgraphs
+        sym_in = _rewrite_int8_fc(sym_in, qarg_params, th_dict, excluded)
     return sym_in, qarg_params, aux_params

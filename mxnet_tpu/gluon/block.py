@@ -230,6 +230,7 @@ class CachedOp:
     def __init__(self, block):
         self._block = block
         self._cache = {}
+        self._remat = bool(getattr(block, "_remat", False))
 
     def _make_body(self, params, param_names, kwargs, train):
         block = self._block
@@ -285,7 +286,8 @@ class CachedOp:
         if entry is None:
             param_names = list(params.keys())
             body = self._make_body(params, param_names, kwargs, train)
-            entry = {"body": body, "jitted": jax.jit(body),
+            fn = jax.checkpoint(body) if (self._remat and train) else body
+            entry = {"body": body, "jitted": jax.jit(fn),
                      "param_names": param_names}
             self._cache[key] = entry
 
@@ -334,9 +336,14 @@ class HybridBlock(Block):
         self._cached_op = None
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  **kwargs):
+                  remat=False, **kwargs):
+        """remat=True rematerializes this block's forward in the backward
+        pass (jax.checkpoint) — the MXNET_BACKWARD_DO_MIRROR /
+        docs/faq/env_var.md memory-mirroring analogue: sublinear activation
+        memory for extra FLOPs."""
         self._active = active
         self._cached_op = None
+        self._remat = remat
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 

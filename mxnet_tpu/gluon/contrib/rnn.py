@@ -36,6 +36,10 @@ class VariationalDropoutCell(ModifierCell):
         return nd.Dropout(nd.ones_like(like), p=p, mode="always")
 
     def hybrid_forward(self, F, inputs, states):
+        from ... import autograd
+        if not autograd.is_training():
+            # identity at inference (reference: masks only under train mode)
+            return self.base_cell(inputs, states)
         if self.drop_inputs:
             if self._input_mask is None:
                 self._input_mask = self._mask_like(self.drop_inputs, inputs)

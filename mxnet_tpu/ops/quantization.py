@@ -60,6 +60,9 @@ def dequantize(data, min_range, max_range, out_type="float32"):
         scale = (mx - mn) / _UINT8_MAX
         return data.astype(jnp.float32) * scale + mn
     amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    if data.dtype == jnp.int32:
+        # int32 accumulator from a quantized matmul
+        return data.astype(jnp.float32) * (amax / (2.0 ** 31 - 1))
     return data.astype(jnp.float32) * (amax / _INT8_MAX)
 
 

@@ -145,3 +145,45 @@ def test_name_manager_context():
         s2 = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2)
     # fresh counters per scope → same default name
     assert s1.name == s2.name
+
+
+def test_quantize_model_int8_graph_accuracy():
+    """quantize_model rewrites calibrated FCs into real int8 subgraphs whose
+    accuracy matches fp32 (reference: quantize_graph_pass.cc)."""
+    import json
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 16).astype(np.float32)
+    w = rng.randn(16, 4).astype(np.float32) * 2
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, 50, shuffle=True)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier(), num_epoch=8)
+    fp32_acc = mod.score(it, "acc")[0][1]
+    arg, aux = mod.get_params()
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        net, arg, aux, calib_data=it, num_calib_examples=200)
+    ops = [n["op"] for n in json.loads(qsym.tojson())["nodes"]]
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_quantize_v2" in ops and "_contrib_dequantize" in ops
+    qmod = mx.mod.Module(qsym, context=mx.cpu())
+    qmod.bind(it.provide_data, it.provide_label, for_training=False)
+    qmod.init_params(arg_params=qarg, aux_params=qaux)
+    q_acc = qmod.score(it, "acc")[0][1]
+    assert q_acc > fp32_acc - 0.03
+
+
+def test_variational_dropout_identity_at_eval():
+    cell = gluon.contrib.rnn.VariationalDropoutCell(
+        gluon.rnn.RNNCell(4), drop_inputs=0.9)
+    cell.initialize()
+    x = mx.nd.array(np.ones((1, 3, 5), np.float32))
+    cell.reset()
+    o1, _ = cell.unroll(3, x, layout="NTC")
+    cell.reset()
+    o2, _ = cell.unroll(3, x, layout="NTC")
+    # deterministic (no dropout) outside train mode
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy())

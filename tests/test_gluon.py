@@ -215,3 +215,37 @@ def test_split_and_load():
     assert len(parts) == 3 and parts[0].shape == (2, 2)
     loaded = gluon.utils.split_and_load(data, [mx.cpu(0)])
     assert loaded[0].shape == (6, 2)
+
+
+def test_hybridize_remat_grads_match():
+    """hybridize(remat=True) (jax.checkpoint — the BACKWARD_DO_MIRROR
+    analogue) must not change gradients."""
+    import numpy as np
+
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(8))
+        return net
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 16).astype(np.float32))
+    net1 = build()
+    net1.initialize(mx.init.Xavier())
+    net1(x)
+    net1.hybridize()
+    net2 = build()
+    net2.initialize(mx.init.Xavier())
+    net2(x)
+    net2.hybridize(remat=True)
+    p1, p2 = net1.collect_params(), net2.collect_params()
+    for (_, v1), (_, v2) in zip(p1.items(), p2.items()):
+        v2.set_data(v1.data())
+    with mx.autograd.record():
+        y1 = mx.nd.sum(net1(x))
+    y1.backward()
+    with mx.autograd.record():
+        y2 = mx.nd.sum(net2(x))
+    y2.backward()
+    for (_, v1), (_, v2) in zip(p1.items(), p2.items()):
+        np.testing.assert_allclose(v1.grad().asnumpy(),
+                                   v2.grad().asnumpy(), rtol=1e-5)
