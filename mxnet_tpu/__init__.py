@@ -22,6 +22,21 @@ if _os.environ.get("JAX_PLATFORMS"):
     except Exception:
         pass
 
+# join a jax.distributed cluster from the env tools/launch.py sets — must
+# happen before anything touches a jax backend, hence at import
+if _os.environ.get("JAX_COORDINATOR_ADDRESS") and \
+        _os.environ.get("JAX_NUM_PROCESSES") and \
+        _os.environ.get("JAX_PROCESS_ID"):
+    import jax as _jax
+    try:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=int(_os.environ["JAX_NUM_PROCESSES"]),
+            process_id=int(_os.environ["JAX_PROCESS_ID"]))
+    except Exception as _e:  # already initialized / misconfigured
+        import warnings as _warnings
+        _warnings.warn("jax.distributed.initialize failed: %s" % (_e,))
+
 __version__ = "0.1.0"
 
 from .base import MXNetError

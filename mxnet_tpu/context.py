@@ -81,20 +81,26 @@ class Context:
 
 
 def _backend_devices(platform):
+    """Addressable devices of a platform — under multi-host jax the global
+    list contains other hosts' (non-addressable) devices; placement must
+    use this process's own (reference: each worker owns its GPUs)."""
     try:
-        return jax.devices(platform)
+        devs = jax.devices(platform)
     except RuntimeError:
         return []
+    if jax.process_count() > 1:
+        devs = [d for d in devs if d.process_index == jax.process_index()]
+    return devs
 
 
 _ACCEL_CACHE = None
 
 
 def _accelerator_devices():
-    """All non-CPU jax devices; falls back to CPU if none (host testing)."""
+    """Local non-CPU jax devices; falls back to CPU if none (host testing)."""
     global _ACCEL_CACHE
     if _ACCEL_CACHE is None:
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
         _ACCEL_CACHE = devs if devs else _backend_devices("cpu")
     return _ACCEL_CACHE
 
@@ -146,3 +152,17 @@ def _implicit_default():
 def current_context():
     cur = getattr(Context._default_ctx, "value", None)
     return cur if cur is not None else _implicit_default()
+
+
+def gpu_memory_info(device_id=0):
+    """(free, total) bytes on the accelerator (reference: context.py
+    gpu_memory_info over cudaMemGetInfo; here the XLA allocator stats —
+    the Storage-manager stats facade, SURVEY.md §2.1)."""
+    devs = [d for d in _accelerator_devices() if d.platform != "cpu"]
+    if not devs:
+        raise RuntimeError("no accelerator device present")
+    d = devs[device_id % len(devs)]
+    stats = d.memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    in_use = stats.get("bytes_in_use", 0)
+    return (total - in_use, total)

@@ -41,6 +41,23 @@ __all__ = ["KVStore", "create"]
 _DIST_TYPES = ("dist_sync", "dist_device_sync", "dist_async", "tpu_dist")
 
 
+def _check_dist_env():
+    """The cluster handshake happens at `import mxnet_tpu` (it must precede
+    any backend initialization — see __init__.py).  If a launcher's env is
+    present but the cluster never formed, degrading silently to
+    rank-0-of-1 would train unsynchronized — fail loudly instead."""
+    import os
+    if jax.process_count() > 1:
+        return
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and \
+            int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
+        raise MXNetError(
+            "distributed kvstore requested with JAX_NUM_PROCESSES=%s but "
+            "the jax cluster has 1 process — the coordinator env must be "
+            "set BEFORE `import mxnet_tpu` (tools/launch.py does this)"
+            % os.environ["JAX_NUM_PROCESSES"])
+
+
 class KVStore:
     def __init__(self, kv_type="local"):
         self.type = kv_type
@@ -50,6 +67,7 @@ class KVStore:
         self._compression_residuals = {}
         self._is_dist = kv_type in _DIST_TYPES
         if self._is_dist:
+            _check_dist_env()
             self._rank = jax.process_index()
             self._num_workers = jax.process_count()
         else:
@@ -204,20 +222,46 @@ class KVStore:
 
 
 def _cross_process_sum(arr):
-    """Sum across hosts over DCN (replaces ps-lite push/pull RPC)."""
+    """Sum across hosts over DCN (replaces ps-lite push/pull RPC).
+
+    Builds a global array sharded one-slice-per-device over a ``hosts``
+    mesh axis (each process contributes its local value on its first
+    device, zeros elsewhere) and sums over that axis — XLA lowers it to a
+    cross-host all-reduce and leaves the result replicated, so every host
+    reads its own copy."""
     if jax.process_count() == 1:
         return arr
-    devs = jax.devices()
-    mesh = jax.sharding.Mesh(devs, ("hosts",))
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
-    @jax.jit
-    def allsum(x):
-        return shard_map(lambda v: jax.lax.psum(v, "hosts"), mesh=mesh,
-                         in_specs=P(), out_specs=P())(x)
+    local = arr._data
+    mesh, allsum = _allsum_program()
+    shards = []
+    for i, d in enumerate(jax.local_devices()):
+        v = local if i == 0 else jnp.zeros_like(local)
+        shards.append(jax.device_put(v[None], d))
+    global_arr = jax.make_array_from_single_device_arrays(
+        (jax.device_count(),) + tuple(local.shape),
+        NamedSharding(mesh, P("hosts")), shards)
+    summed = allsum(global_arr)
+    return NDArray(jnp.asarray(summed.addressable_data(0)))
 
-    return NDArray(allsum(arr._data))
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=1)
+def _allsum_program():
+    """One compiled cross-host reduce per cluster (a fresh lambda per push
+    would defeat the jit cache and recompile on the hottest dist path)."""
+    import numpy as _np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = jax.sharding.Mesh(_np.array(jax.devices()), ("hosts",))
+    fn = jax.jit(_sum_axis0, out_shardings=NamedSharding(mesh, P()))
+    return mesh, fn
+
+
+def _sum_axis0(a):
+    return jnp.sum(a, axis=0)
 
 
 def _key_value(key, value, allow_list_values=False):

@@ -108,3 +108,23 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
 
 def same(a, b):
     return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def list_gpus():
+    """Indices of this process's accelerator devices (reference:
+    test_utils.py list_gpus) — local, so `[mx.gpu(i) for i in list_gpus()]`
+    maps one context per addressable chip."""
+    from .context import _accelerator_devices
+    try:
+        return list(range(len([d for d in _accelerator_devices()
+                               if d.platform != "cpu"])))
+    except Exception:
+        return []
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Reference: test_utils.py download.  This environment has no network
+    egress; the function exists for API parity and raises with guidance."""
+    raise RuntimeError(
+        "no network egress in this environment — place %r locally and pass "
+        "the path instead" % url)

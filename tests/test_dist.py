@@ -1,0 +1,54 @@
+"""Distributed kvstore semantics across real processes
+(reference: tests/nightly/dist_sync_kvstore.py run via
+`tools/launch.py -n N --launcher local` — SURVEY.md §4)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, %r)
+    import mxnet_tpu as mx
+    import numpy as np
+
+    kv = mx.kv.create("dist_sync")
+    assert kv.num_workers == 2, kv.num_workers
+    # dense exact-sum: every worker pushes rank+1; pull must see the total
+    kv.init("dense", mx.nd.zeros((8, 3)))
+    kv.push("dense", mx.nd.ones((8, 3)) * (kv.rank + 1))
+    out = mx.nd.zeros((8, 3))
+    kv.pull("dense", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+    # second round on the same key accumulates through the stored value
+    kv.push("dense", mx.nd.ones((8, 3)))
+    kv.pull("dense", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)  # no updater: replace
+
+    kv.barrier()
+    print("WORKER %%d OK" %% kv.rank)
+""" % _ROOT)
+
+
+@pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
+                    reason="dist test disabled")
+def test_dist_sync_kvstore_two_processes(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    env = dict(os.environ)
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no forced 8-device mesh in workers
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(worker)],
+        env=env, capture_output=True, text=True, timeout=240)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-3000:]
