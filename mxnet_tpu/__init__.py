@@ -77,6 +77,7 @@ from . import profiler
 from . import engine
 from . import rtc
 from . import contrib
+from . import operator
 from . import kvstore_server
 from . import attribute
 from .attribute import AttrScope

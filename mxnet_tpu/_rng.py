@@ -25,17 +25,25 @@ def _providers():
 
 
 class EagerKeyProvider:
-    """Splits a concrete global key; used outside any trace."""
+    """Derives keys from numpy state; used outside any trace.
+
+    Only host-side numpy state is stored — with omnistaging, any jax op
+    executed while some trace is active yields a tracer, and storing that
+    globally (as a split-key chain would) leaks it out of the trace."""
 
     def __init__(self, seed):
         self.seed(seed)
 
     def seed(self, seed):
-        self._key = jax.random.PRNGKey(seed)
+        self._rs = np.random.RandomState(seed)
+        self._counter = 0
 
     def next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
+        # 63-bit seed + a fold-in counter: collision-free in practice
+        # (a 31-bit space would birthday-collide within a training run)
+        base = int(self._rs.randint(0, 2 ** 63, dtype=np.int64))
+        self._counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(base), self._counter)
 
 
 class TraceKeyProvider:

@@ -123,7 +123,9 @@ def gpu(device_id=0):
 
 
 def num_tpus():
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    """This process's accelerator count — consistent with Context placement
+    (jax_device/list_gpus resolve locally under multi-host)."""
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return len(devs)
 
 
@@ -161,8 +163,12 @@ def gpu_memory_info(device_id=0):
     devs = [d for d in _accelerator_devices() if d.platform != "cpu"]
     if not devs:
         raise RuntimeError("no accelerator device present")
-    d = devs[device_id % len(devs)]
-    stats = d.memory_stats() or {}
-    total = stats.get("bytes_limit", 0)
-    in_use = stats.get("bytes_in_use", 0)
-    return (total - in_use, total)
+    if device_id >= len(devs):
+        raise ValueError("device_id %d out of range (%d local accelerators)"
+                         % (device_id, len(devs)))
+    stats = devs[device_id].memory_stats() or {}
+    if "bytes_limit" not in stats:
+        raise RuntimeError("memory stats unavailable for %r"
+                           % devs[device_id])
+    total = stats["bytes_limit"]
+    return (total - stats.get("bytes_in_use", 0), total)

@@ -234,14 +234,11 @@ def _cross_process_sum(arr):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     local = arr._data
-    mesh, allsum = _allsum_program()
-    shards = []
-    for i, d in enumerate(jax.local_devices()):
-        v = local if i == 0 else jnp.zeros_like(local)
-        shards.append(jax.device_put(v[None], d))
+    mesh, my_dev, allsum = _allsum_program()
+    shard = jax.device_put(local[None], my_dev)
     global_arr = jax.make_array_from_single_device_arrays(
-        (jax.device_count(),) + tuple(local.shape),
-        NamedSharding(mesh, P("hosts")), shards)
+        (jax.process_count(),) + tuple(local.shape),
+        NamedSharding(mesh, P("hosts")), [shard])
     summed = allsum(global_arr)
     return NDArray(jnp.asarray(summed.addressable_data(0)))
 
@@ -251,13 +248,21 @@ import functools as _functools
 
 @_functools.lru_cache(maxsize=1)
 def _allsum_program():
-    """One compiled cross-host reduce per cluster (a fresh lambda per push
-    would defeat the jit cache and recompile on the hottest dist path)."""
+    """One compiled cross-host reduce per cluster, over ONE device per
+    process (zero-padding every local chip would move local_device_count x
+    more data on the hottest dist path; a fresh lambda per push would
+    defeat the jit cache)."""
     import numpy as _np
     from jax.sharding import NamedSharding, PartitionSpec as P
-    mesh = jax.sharding.Mesh(_np.array(jax.devices()), ("hosts",))
+    per_proc = {}
+    for d in jax.devices():
+        if d.process_index not in per_proc or \
+                d.id < per_proc[d.process_index].id:
+            per_proc[d.process_index] = d
+    devs = [per_proc[p] for p in sorted(per_proc)]
+    mesh = jax.sharding.Mesh(_np.array(devs), ("hosts",))
     fn = jax.jit(_sum_axis0, out_shardings=NamedSharding(mesh, P()))
-    return mesh, fn
+    return mesh, per_proc[jax.process_index()], fn
 
 
 def _sum_axis0(a):

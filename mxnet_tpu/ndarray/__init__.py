@@ -44,6 +44,13 @@ contrib.while_loop = while_loop
 contrib.cond = cond
 
 
+def Custom(*args, **kwargs):
+    """Run a Python CustomOp (reference: generated nd.Custom over
+    src/operator/custom/custom.cc; see mxnet_tpu/operator.py)."""
+    from ..operator import _custom_entry
+    return _custom_entry(*args, **kwargs)
+
+
 # creation helpers (reference: python/mxnet/ndarray/utils.py + ndarray.py) --
 def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
     if stype not in (None, "default"):

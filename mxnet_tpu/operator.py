@@ -1,0 +1,162 @@
+"""Custom operators defined in Python: `mx.operator`.
+
+Reference: ``python/mxnet/operator.py`` (1.1k LoC — CustomOp/CustomOpProp +
+``mx.operator.register``) over the C++ bridge ``src/operator/custom/
+custom-inl.h`` which runs Python callbacks on a dedicated worker thread
+with ``ExecType::kAsync``.
+
+TPU-native: the custom op runs eagerly on NDArrays (host-driven, like the
+reference's callback thread) and integrates with the autograd tape through
+a custom vjp that calls the user's ``backward``.  For jit-compiled custom
+kernels use ``mx.rtc.register_op`` instead — this API exists for parity
+with reference CustomOp code (in_data/out_data/req/assign protocol).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import autograd
+from .base import MXNetError
+from .ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for the operator implementation
+    (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write `src` into `dst` honoring the grad request
+        (reference: CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst._set_data(src._data if isinstance(src, NDArray)
+                          else nd.array(src)._data)
+        elif req == "add":
+            dst._set_data(dst._data + (src._data if isinstance(src, NDArray)
+                                       else nd.array(src)._data))
+        else:
+            raise ValueError("unknown req %r" % req)
+
+
+class CustomOpProp:
+    """Describes the operator: arity, shapes, types
+    (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under a name
+    (reference: operator.py register)."""
+
+    def deco(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered_operators():
+    return sorted(_REGISTRY)
+
+
+def _invoke_custom(op_type, inputs, kwargs):
+    """Run a registered custom op (the `mx.nd.Custom` entry).
+
+    Eager forward on NDArrays; when recording, a tape node is added whose
+    vjp calls the user's backward (reference: CustomOperator worker thread +
+    ExecType::kAsync, custom-inl.h:173)."""
+    if op_type not in _REGISTRY:
+        raise MXNetError("custom op %r not registered (have %r)"
+                         % (op_type, get_all_registered_operators()))
+    prop = _REGISTRY[op_type](**{k: str(v) for k, v in kwargs.items()})
+    args = prop.list_arguments()
+    n_in = len(args)
+    if len(inputs) != n_in:
+        raise MXNetError("%s expects %d inputs (%r), got %d"
+                         % (op_type, n_in, args, len(inputs)))
+    in_shapes = [list(a.shape) for a in inputs]
+    in_shapes2, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    in_types, out_types, _ = prop.infer_type(
+        [a.dtype for a in inputs])
+    op = prop.create_operator(None, in_shapes2, in_types)
+
+    out_data = [nd.zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    is_train = autograd.is_training()
+    recording = autograd.is_recording() and any(
+        a._entry is not None or a._mark for a in inputs)
+
+    with autograd.pause(train_mode=is_train):
+        op.forward(is_train, ["write"] * len(out_data), list(inputs),
+                   out_data, [])
+
+    if recording:
+        in_data = list(inputs)
+        captured_outs = list(out_data)
+
+        def vjp_fn(cotangents):
+            head = [NDArray(c) for c in cotangents]
+            in_grad = [nd.zeros(a.shape, dtype=a.dtype) for a in in_data]
+            with autograd.pause(train_mode=is_train):
+                op.backward(["write"] * len(in_grad), head, in_data,
+                            captured_outs, in_grad, [])
+            return tuple(g._data for g in in_grad)
+
+        node = autograd.record_op(vjp_fn, list(inputs),
+                                  [o._data for o in out_data])
+        for i, o in enumerate(out_data):
+            o._entry = (node, i)
+
+    if len(out_data) == 1:
+        return out_data[0]
+    return out_data
+
+
+def _custom_entry(*args, **kwargs):
+    """`mx.nd.Custom(*data, op_type='name', **params)`."""
+    op_type = kwargs.pop("op_type", None)
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    kwargs.pop("name", None)
+    inputs = [a if isinstance(a, NDArray) else nd.array(a) for a in args]
+    return _invoke_custom(op_type, inputs, kwargs)

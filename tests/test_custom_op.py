@@ -1,0 +1,96 @@
+"""Python CustomOp bridge tests (reference:
+tests/python/unittest/test_operator.py test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@mx.operator.register("_test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Sigmoid()
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + mx.nd.exp(-in_data[0]))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@mx.operator.register("_test_addn")
+class _AddNProp(mx.operator.CustomOpProp):
+    def __init__(self, num_args="2"):
+        super().__init__(need_top_grad=True)
+        self._num = int(num_args)
+
+    def list_arguments(self):
+        return ["arg%d" % i for i in range(self._num)]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _AddN()
+
+
+class _AddN(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        acc = in_data[0]
+        for a in in_data[1:]:
+            acc = acc + a
+        self.assign(out_data[0], req[0], acc)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        for g in in_grad:
+            self.assign(g, "write", out_grad[0])
+
+
+def test_custom_sigmoid_forward_backward():
+    x = mx.nd.array(np.array([0.0, 1.0, -2.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Custom(x, op_type="_test_sigmoid")
+        loss = mx.nd.sum(out)
+    loss.backward()
+    ref = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), ref * (1 - ref), rtol=1e-5)
+
+
+def test_custom_multi_input_with_params():
+    a = mx.nd.array(np.ones(4, np.float32))
+    b = mx.nd.array(np.full(4, 2.0, np.float32))
+    c = mx.nd.array(np.full(4, 3.0, np.float32))
+    a.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.Custom(a, b, c, op_type="_test_addn", num_args=3)
+        mx.nd.sum(out).backward()
+    np.testing.assert_allclose(out.asnumpy(), 6.0)
+    np.testing.assert_allclose(a.grad.asnumpy(), 1.0)
+
+
+def test_custom_composes_with_builtin_ops():
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        h = x * 3.0
+        s = mx.nd.Custom(h, op_type="_test_sigmoid")
+        loss = mx.nd.sum(s * s)
+    loss.backward()
+    xn = x.asnumpy()
+    sig = 1 / (1 + np.exp(-3 * xn))
+    expect = 2 * sig * sig * (1 - sig) * 3
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_custom_unknown_type_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="_no_such_op")
