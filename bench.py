@@ -22,10 +22,10 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
 
-    # default 128/chip: the reference's headline number is bs=32-per-GPU,
+    # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
-    # one chip: bs=32 → 703 img/s, bs=64 → 900, bs=128 → 1157
-    batch = int(os.environ.get("MXTPU_BENCH_BATCH", "128"))
+    # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH", "256"))
     # keep the per-chip metric honest: batch is per chip, and the device
     # count matches the mesh the trainer actually spans
     devices = jax.devices()
@@ -33,27 +33,37 @@ def main():
     mesh = make_mesh((n_dev,), ("data",), devices)
     global_batch = batch * n_dev
 
-    # bf16 MXU precision for fp32 matmuls/convs — the TPU-native analogue of
-    # the reference's fp16 multi-precision path (docs/faq/perf.md fp16 rows);
-    # weights/grads/optimizer state stay fp32.  MXTPU_BENCH_PRECISION=float32
-    # forces full precision.
+    # end-to-end bf16 training: bf16 activations/params with fp32 master
+    # weights in the optimizer (multi_precision) — the TPU-native analogue of
+    # the reference's fp16 path (docs/faq/perf.md fp16 rows).  BN statistics
+    # stay fp32 (BatchNorm.cast).  MXTPU_BENCH_DTYPE=float32 forces full
+    # precision.
+    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bfloat16")
+    # NHWC is the TPU-native conv layout (channels on the minor axis)
+    layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
+    # MXU precision for fp32 matmuls/convs; MXTPU_BENCH_PRECISION=float32
+    # (with MXTPU_BENCH_DTYPE=float32) forces a true full-precision run
     precision = os.environ.get("MXTPU_BENCH_PRECISION", "bfloat16")
     jax.config.update("jax_default_matmul_precision", precision)
 
     rng = np.random.RandomState(0)
 
     def make_batch(b):
-        return (mx.nd.array(rng.rand(b, 3, 224, 224).astype(np.float32)),
+        shape = (b, 3, 224, 224) if layout == "NCHW" else (b, 224, 224, 3)
+        x = rng.rand(*shape).astype(np.float32)
+        return (mx.nd.array(x).astype(dtype),
                 mx.nd.array((rng.rand(b) * 1000).astype(np.int64)))
 
     def build_trainer():
         # rebuilt from scratch on every OOM retry: the step jit donates the
         # parameter/state buffers, so a failed step may have invalidated them
-        net = vision.resnet50_v1()
+        net = vision.resnet50_v1(layout=layout)
         net.initialize(mx.init.Xavier())
+        net.cast(dtype)
         return DataParallelTrainer(
             net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-            {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}, mesh=mesh)
+            {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4,
+             "multi_precision": dtype != "float32"}, mesh=mesh)
 
     # warmup (compile); halve the batch on OOM so the metric always prints
     while True:

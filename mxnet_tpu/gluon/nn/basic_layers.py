@@ -217,6 +217,16 @@ class BatchNorm(HybridBlock):
             if p._deferred_init:
                 p.shape = (c,)
 
+    def cast(self, dtype):
+        # BN statistics stay fp32 under half-precision training, matching the
+        # reference's BatchNorm.cast fp16 behavior (gluon/nn/basic_layers.py);
+        # bf16 gets the same treatment on TPU.
+        import numpy as _np
+        import jax.numpy as _jnp
+        if _np.dtype(dtype) in (_np.dtype(_np.float16), _np.dtype(_jnp.bfloat16)):
+            dtype = "float32"
+        super().cast(dtype)
+
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         return F.BatchNorm(x, gamma, beta, running_mean, running_var,
                            eps=self._epsilon, momentum=self._momentum,

@@ -29,8 +29,12 @@ class _Conv(HybridBlock):
             }
             if adj is not None:
                 self._kwargs["adj"] = adj
+            self._channels_last = layout in ("NWC", "NHWC", "NDHWC")
             if op_name == "Deconvolution":
                 wshape = (in_channels, channels // groups) + tuple(kernel_size)
+            elif self._channels_last:
+                wshape = (channels,) + tuple(kernel_size) + \
+                    (in_channels // max(groups, 1) if in_channels else 0,)
             else:
                 wshape = (channels, in_channels // max(groups, 1) if in_channels
                           else 0) + tuple(kernel_size)
@@ -52,10 +56,12 @@ class _Conv(HybridBlock):
 
     def infer_param_shapes(self, x, *args):
         if self.weight._deferred_init:
-            in_c = x.shape[1]
+            in_c = x.shape[-1] if self._channels_last else x.shape[1]
             g = self._kwargs["num_group"]
             if self._op_name == "Deconvolution":
                 self.weight.shape = (in_c, self._channels // g) + tuple(self._kernel)
+            elif self._channels_last:
+                self.weight.shape = (self._channels,) + tuple(self._kernel) + (in_c // g,)
             else:
                 self.weight.shape = (self._channels, in_c // g) + tuple(self._kernel)
 
@@ -142,7 +148,7 @@ class Conv3DTranspose(_Conv):
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode=False,
                  global_pool=False, pool_type="max", count_include_pad=None,
-                 **kwargs):
+                 layout=None, **kwargs):
         super().__init__(**kwargs)
         if strides is None:
             strides = pool_size
@@ -151,6 +157,8 @@ class _Pooling(HybridBlock):
             "global_pool": global_pool, "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid",
         }
+        if layout is not None:
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -163,7 +171,7 @@ class MaxPool1D(_Pooling):
                  ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides is not None else None,
-                         _pair(padding, 1), ceil_mode, **kwargs)
+                         _pair(padding, 1), ceil_mode, layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -171,7 +179,7 @@ class MaxPool2D(_Pooling):
                  layout="NCHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides is not None else None,
-                         _pair(padding, 2), ceil_mode, **kwargs)
+                         _pair(padding, 2), ceil_mode, layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -179,7 +187,7 @@ class MaxPool3D(_Pooling):
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides is not None else None,
-                         _pair(padding, 3), ceil_mode, **kwargs)
+                         _pair(padding, 3), ceil_mode, layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -188,7 +196,8 @@ class AvgPool1D(_Pooling):
         super().__init__(_pair(pool_size, 1),
                          _pair(strides, 1) if strides is not None else None,
                          _pair(padding, 1), ceil_mode, pool_type="avg",
-                         count_include_pad=count_include_pad, **kwargs)
+                         count_include_pad=count_include_pad, layout=layout,
+                         **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -198,7 +207,8 @@ class AvgPool2D(_Pooling):
         super().__init__(_pair(pool_size, 2),
                          _pair(strides, 2) if strides is not None else None,
                          _pair(padding, 2), ceil_mode, pool_type="avg",
-                         count_include_pad=count_include_pad, **kwargs)
+                         count_include_pad=count_include_pad, layout=layout,
+                         **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -208,37 +218,44 @@ class AvgPool3D(_Pooling):
         super().__init__(_pair(pool_size, 3),
                          _pair(strides, 3) if strides is not None else None,
                          _pair(padding, 3), ceil_mode, pool_type="avg",
-                         count_include_pad=count_include_pad, **kwargs)
+                         count_include_pad=count_include_pad, layout=layout,
+                         **kwargs)
 
 
 class GlobalMaxPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "max", **kwargs)
+        super().__init__((1,), None, (0,), True, True, "max", layout=layout,
+                         **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "max", **kwargs)
+        super().__init__((1, 1), None, (0, 0), True, True, "max",
+                         layout=layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "max",
+                         layout=layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
     def __init__(self, layout="NCW", **kwargs):
-        super().__init__((1,), None, (0,), True, True, "avg", **kwargs)
+        super().__init__((1,), None, (0,), True, True, "avg", layout=layout,
+                         **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
     def __init__(self, layout="NCHW", **kwargs):
-        super().__init__((1, 1), None, (0, 0), True, True, "avg", **kwargs)
+        super().__init__((1, 1), None, (0, 0), True, True, "avg",
+                         layout=layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
     def __init__(self, layout="NCDHW", **kwargs):
-        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg", **kwargs)
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, True, "avg",
+                         layout=layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):

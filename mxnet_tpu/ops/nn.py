@@ -51,6 +51,18 @@ def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
 # ---------------------------------------------------------------------------
 _CONV_DIMNUM = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
                 3: ("NCDHW", "OIDHW", "NCDHW")}
+# channels-last layouts (reference supports NHWC/NWC via the layout param;
+# on TPU this is the native tiling — no internal transposes).  MXNet weight
+# layout for channels-last convs is (num_filter, *kernel, C/group).
+_CONV_DIMNUM_CL = {1: ("NHC", "OHI", "NHC"), 2: ("NHWC", "OHWI", "NHWC"),
+                   3: ("NDHWC", "ODHWI", "NDHWC")}
+_CHANNELS_LAST = {"NWC", "NHWC", "NDHWC"}
+
+
+def _conv_layout(layout, nsp):
+    if layout in _CHANNELS_LAST:
+        return _CONV_DIMNUM_CL[nsp], True
+    return _CONV_DIMNUM[nsp], False
 
 
 @register("Convolution", arg_names=["data", "weight", "bias"])
@@ -58,21 +70,27 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=0, num_group=1, workspace=1024,
                 no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
     """Reference: src/operator/nn/convolution.cc; weight layout
-    (num_filter, C/group, *kernel) identical to the reference."""
+    (num_filter, C/group, *kernel) identical to the reference, or
+    (num_filter, *kernel, C/group) for channels-last layouts."""
     nsp = len(kernel) if kernel else data.ndim - 2
     stride = _tup(stride, nsp)
     dilate = _tup(dilate, nsp)
     pad = _tup(pad, nsp) if pad else (0,) * nsp
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DIMNUM[nsp])
+    dimnum, channels_last = _conv_layout(layout, nsp)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, dimnum)
+    # no preferred_element_type upcast for bf16: the MXU accumulates bf16
+    # convs in fp32 natively, and jax 0.9's conv transpose rule rejects the
+    # fp32-cotangent/bf16-operand mix it would create
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     ).astype(data.dtype)
     if bias is not None and not no_bias:
-        out = out + jnp.reshape(bias, (1, -1) + (1,) * nsp)
+        bshape = (1,) * (nsp + 1) + (-1,) if channels_last \
+            else (1, -1) + (1,) * nsp
+        out = out + jnp.reshape(bias, bshape)
     return out
 
 
@@ -86,6 +104,19 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     input-dilated forward conv, which XLA lowers to the same MXU program it
     uses for conv backward-data."""
     nsp = len(kernel)
+    if layout in _CHANNELS_LAST:
+        # weight keeps the reference's channels-first (C_in, C_out/g, *k)
+        # shape; only the data layout differs, so route through the
+        # channels-first path (deconv is never the hot op)
+        perm_in = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
+        perm_out = (0,) + tuple(range(2, data.ndim)) + (1,)
+        out = deconvolution(
+            jnp.transpose(data, perm_in), weight, bias, kernel=kernel,
+            stride=stride, dilate=dilate, pad=pad, adj=adj,
+            target_shape=target_shape, num_filter=num_filter,
+            num_group=num_group, workspace=workspace, no_bias=no_bias,
+            layout=None)
+        return jnp.transpose(out, perm_out)
     stride = _tup(stride, nsp)
     dilate = _tup(dilate, nsp)
     pad = _tup(pad, nsp) if pad else (0,) * nsp
@@ -116,13 +147,17 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 # ---------------------------------------------------------------------------
 @register("Pooling", arg_names=["data"])
 def pooling(data, kernel=(), pool_type="max", global_pool=False, cudnn_off=False,
-            pooling_convention="valid", stride=(), pad=(), count_include_pad=True):
+            pooling_convention="valid", stride=(), pad=(), count_include_pad=True,
+            layout=None):
     """Reference: src/operator/nn/pooling.cc (+ pool.cuh kernels).
     max/avg/sum over reduce_window; 'full' convention (ceil) adds high-side
-    padding exactly as the reference's pooling shape rule."""
+    padding exactly as the reference's pooling shape rule.  ``layout``
+    accepts the channels-last forms (NWC/NHWC/NDHWC) natively."""
     nsp = data.ndim - 2
+    channels_last = layout in _CHANNELS_LAST
+    sp0 = 1 if channels_last else 2  # first spatial dim index
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = data.shape[sp0:sp0 + nsp]
         stride = (1,) * nsp
         pad = (0,) * nsp
     kernel = _tup(kernel, nsp)
@@ -131,13 +166,19 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, cudnn_off=False
     extra = [0] * nsp
     if pooling_convention == "full" and not global_pool:
         for i in range(nsp):
-            insz = data.shape[2 + i]
+            insz = data.shape[sp0 + i]
             out_sz = int(np.ceil((insz + 2 * pad[i] - kernel[i]) / stride[i])) + 1
             need = (out_sz - 1) * stride[i] + kernel[i] - (insz + 2 * pad[i])
             extra[i] = max(0, need)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = [(0, 0), (0, 0)] + [(p, p + e) for p, e in zip(pad, extra)]
+    sp_pad = [(p, p + e) for p, e in zip(pad, extra)]
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = [(0, 0)] + sp_pad + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = [(0, 0), (0, 0)] + sp_pad
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, np.asarray(init, data.dtype)[()], lax.max,
@@ -175,6 +216,85 @@ def _bn_moving_update(inputs, outputs, params):
     }
 
 
+def _bn_stats(x, red):
+    """Batch mean/var accumulated in fp32.  For bf16/fp16 inputs this is a
+    single fused read (E[x], E[x^2]); fp32 keeps the two-pass form to avoid
+    E[x^2]-E[x]^2 cancellation."""
+    if x.dtype in (jnp.float16, jnp.bfloat16):
+        mean = jnp.mean(x, axis=red, dtype=jnp.float32)
+        m2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=red)
+        var = jax.nn.relu(m2 - lax.square(mean))
+    else:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+    return mean, var
+
+
+def _bn_apply(x, scale, shift, axis):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return x * scale.reshape(shape).astype(x.dtype) \
+        + shift.reshape(shape).astype(x.dtype)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, gamma, beta, eps, axis):
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    mean, var = _bn_stats(x, red)
+    inv = lax.rsqrt(var + eps)
+    scale = inv * gamma.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean * scale
+    return _bn_apply(x, scale, shift, axis), mean, var
+
+
+def _bn_train_fwd(x, gamma, beta, eps, axis):
+    out, mean, var = _bn_train(x, gamma, beta, eps, axis)
+    return (out, mean, var), (x, gamma, mean, var)
+
+
+def _bn_train_bwd(eps, axis, res, cts):
+    """Hand-derived BN backward: one fused reduction pass over (g, x) and one
+    elementwise pass dx = A*g + B*x + C with per-channel A/B/C — the minimal
+    HBM traffic form (autodiff of the stats emits extra full-tensor passes).
+    Reference semantics: src/operator/nn/batch_norm.cc backward."""
+    g_out, ct_mean, ct_var = cts
+    x, gamma, mean, var = res
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    n = 1
+    for i in red:
+        n *= x.shape[i]
+    inv = lax.rsqrt(var + eps)
+    # one fused pass: both reductions read (g, x) together
+    sum_g = jnp.sum(g_out, axis=red, dtype=jnp.float32)
+    sum_gx = jnp.sum(g_out.astype(jnp.float32) * x.astype(jnp.float32),
+                     axis=red)
+    sum_gxhat = (sum_gx - mean * sum_g) * inv
+    g32 = gamma.astype(jnp.float32)
+    dgamma = sum_gxhat.astype(gamma.dtype)
+    dbeta = sum_g.astype(gamma.dtype)
+    # dx = gamma*inv*(g - sum_g/n - xhat*sum_gxhat/n)  (+ mean/var cotangent
+    # terms, which XLA folds away when those outputs are unused)
+    A = g32 * inv
+    B = -g32 * inv * inv * sum_gxhat / n \
+        + 2.0 * ct_var.astype(jnp.float32) / n
+    C = -A * sum_g / n + g32 * inv * inv * mean * sum_gxhat / n \
+        + ct_mean.astype(jnp.float32) / n \
+        - 2.0 * ct_var.astype(jnp.float32) * mean / n
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    dx = (g_out * A.reshape(shape).astype(x.dtype)
+          + x * B.reshape(shape).astype(x.dtype)
+          + C.reshape(shape).astype(x.dtype))
+    return dx, dgamma, dbeta
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 @register("BatchNorm", arg_names=["data", "gamma", "beta"],
           aux={3: "moving_mean", 4: "moving_var"}, aux_update=_bn_moving_update,
           num_outputs=lambda p: 3 if p.get("output_mean_var") else 1,
@@ -186,24 +306,19 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     stats (moving stats updated via aux_update); under inference uses the
     moving stats.  fix_gamma pins gamma to 1 as the reference does."""
     axis = axis % data.ndim
-    red = tuple(i for i in range(data.ndim) if i != axis)
-    if _train and not use_global_stats:
-        x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
-    else:
-        mean = moving_mean.astype(jnp.float32)
-        var = moving_var.astype(jnp.float32)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    shape = [1] * data.ndim
-    shape[axis] = data.shape[axis]
-    inv = lax.rsqrt(var + eps)
-    out = (data.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
-    out = out * g.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
-    out = out.astype(data.dtype)
-    if output_mean_var:
-        return out, mean.astype(data.dtype), var.astype(data.dtype)
-    return out, mean.astype(data.dtype), var.astype(data.dtype)
+    if _train and not use_global_stats:
+        # mean/var stay fp32: the moving-stat update (aux_update) and any
+        # output_mean_var consumer get full-precision statistics even under
+        # bf16 training, as the reference's fp16 path does
+        out, mean, var = _bn_train(data, g, beta, float(eps), axis)
+        return out, mean, var
+    mean = moving_mean.astype(jnp.float32)
+    var = moving_var.astype(jnp.float32)
+    inv = lax.rsqrt(var + eps) * g.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean * inv
+    out = _bn_apply(data, inv, shift, axis)
+    return out, mean, var
 
 
 @register("LayerNorm", arg_names=["data", "gamma", "beta"])
@@ -311,21 +426,34 @@ def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125
     raise ValueError(act_type)
 
 
+def _softmax_io(data, dtype):
+    """Half-precision softmax accumulates in fp32 (reference:
+    src/operator/nn/softmax-inl.h AType) and returns the input dtype unless
+    ``dtype`` overrides the output type."""
+    out_dtype = jnp.dtype(dtype) if dtype is not None else data.dtype
+    if data.dtype in (jnp.float16, jnp.bfloat16):
+        data = data.astype(jnp.float32)
+    return data, out_dtype
+
+
 @register("softmax")
 def softmax(data, axis=-1, temperature=None, dtype=None):
+    data, out_dtype = _softmax_io(data, dtype)
     x = data / temperature if temperature else data
-    return jax.nn.softmax(x, axis=axis)
+    return jax.nn.softmax(x, axis=axis).astype(out_dtype)
 
 
 @register("log_softmax")
 def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    data, out_dtype = _softmax_io(data, dtype)
     x = data / temperature if temperature else data
-    return jax.nn.log_softmax(x, axis=axis)
+    return jax.nn.log_softmax(x, axis=axis).astype(out_dtype)
 
 
 @register("softmin")
 def softmin(data, axis=-1, temperature=None, dtype=None):
-    return jax.nn.softmax(-data, axis=axis)
+    data, out_dtype = _softmax_io(data, dtype)
+    return jax.nn.softmax(-data, axis=axis).astype(out_dtype)
 
 
 @register("SoftmaxActivation")

@@ -58,7 +58,7 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             master = weight.astype("float32")
             return (master, self.create_state(index, master))
         return self.create_state(index, weight)
@@ -67,11 +67,11 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             master, inner = state
             g32 = grad.astype("float32")
             self.update(index, master, g32, inner)
-            weight._set_data(master._data.astype(jnp.float16))
+            weight._set_data(master._data.astype(weight.dtype))
         else:
             self.update(index, weight, grad, state)
 
@@ -118,6 +118,12 @@ class Optimizer:
             c = self.clip_gradient
             g = jnp.clip(g, -c, c)
         return g
+
+
+def _is_low_precision(dtype):
+    """fp16 weights get fp32 master copies under multi_precision in the
+    reference (optimizer.py SGD); bf16 is the TPU-native analogue."""
+    return dtype == np.float16 or dtype == jnp.bfloat16
 
 
 register = Optimizer.register

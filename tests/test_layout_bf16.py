@@ -1,0 +1,250 @@
+"""Channels-last (NHWC) layout + end-to-end bf16 training paths.
+
+Reference parity: the layout= param of Convolution/Pooling
+(src/operator/nn/convolution.cc supports NHWC via layout), the fp16
+multi-precision optimizer path (python/mxnet/optimizer.py SGD) — here the
+TPU-native bf16 analogue — and BatchNorm's hand-written VJP
+(src/operator/nn/batch_norm.cc backward).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_conv_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 6, 3).astype(np.float32)  # NHWC
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)  # OIHW
+    out_nchw = nd.Convolution(
+        nd.array(x.transpose(0, 3, 1, 2)), nd.array(w), None,
+        kernel=(3, 3), num_filter=4, pad=(1, 1), no_bias=True)
+    w_cl = w.transpose(0, 2, 3, 1)  # OHWI
+    out_nhwc = nd.Convolution(
+        nd.array(x), nd.array(w_cl), None, kernel=(3, 3), num_filter=4,
+        pad=(1, 1), no_bias=True, layout="NHWC")
+    np.testing.assert_allclose(
+        out_nhwc.asnumpy(), out_nchw.asnumpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_conv_nhwc_bias_and_stride():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 9, 9, 4).astype(np.float32)
+    w = rng.randn(8, 4, 3, 3).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    out_nchw = nd.Convolution(
+        nd.array(x.transpose(0, 3, 1, 2)), nd.array(w), nd.array(b),
+        kernel=(3, 3), num_filter=8, stride=(2, 2), pad=(1, 1))
+    out_nhwc = nd.Convolution(
+        nd.array(x), nd.array(w.transpose(0, 2, 3, 1)), nd.array(b),
+        kernel=(3, 3), num_filter=8, stride=(2, 2), pad=(1, 1),
+        layout="NHWC")
+    np.testing.assert_allclose(
+        out_nhwc.asnumpy(), out_nchw.asnumpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_pooling_nhwc(pool_type):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 8, 8, 3).astype(np.float32)
+    out_nchw = nd.Pooling(nd.array(x.transpose(0, 3, 1, 2)), kernel=(3, 3),
+                          stride=(2, 2), pad=(1, 1), pool_type=pool_type)
+    out_nhwc = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                          pad=(1, 1), pool_type=pool_type, layout="NHWC")
+    np.testing.assert_allclose(
+        out_nhwc.asnumpy(), out_nchw.asnumpy().transpose(0, 2, 3, 1),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_global_pool_nhwc():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 7, 7, 5).astype(np.float32)
+    out = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg",
+                     layout="NHWC")
+    np.testing.assert_allclose(out.asnumpy()[:, 0, 0, :], x.mean(axis=(1, 2)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_deconv_nhwc_matches_nchw():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 5, 5, 4).astype(np.float32)
+    w = rng.randn(4, 6, 3, 3).astype(np.float32)  # (C_in, C_out, kH, kW)
+    out_nchw = nd.Deconvolution(
+        nd.array(x.transpose(0, 3, 1, 2)), nd.array(w), None,
+        kernel=(3, 3), num_filter=6, stride=(2, 2), pad=(1, 1), adj=(1, 1))
+    out_nhwc = nd.Deconvolution(
+        nd.array(x), nd.array(w), None, kernel=(3, 3), num_filter=6,
+        stride=(2, 2), pad=(1, 1), adj=(1, 1), layout="NHWC")
+    np.testing.assert_allclose(
+        out_nhwc.asnumpy(), out_nchw.asnumpy().transpose(0, 2, 3, 1),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_gluon_conv2d_nhwc_deferred_init():
+    net = nn.Conv2D(8, 3, padding=1, layout="NHWC")
+    net.initialize()
+    x = nd.array(np.random.rand(2, 6, 6, 3).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 6, 6, 8)
+    assert net.weight.shape == (8, 3, 3, 3)  # OHWI: (O, kH, kW, I)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """Same weights, both layouts -> same logits."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net_c = vision.resnet18_v1()
+    net_c.initialize(mx.init.Xavier())
+    net_l = vision.resnet18_v1(layout="NHWC")
+    net_l.initialize(mx.init.Xavier())
+    # trigger deferred init in both layouts before copying params over
+    warm = np.zeros((1, 32, 32, 3), np.float32)
+    net_c(nd.array(warm.transpose(0, 3, 1, 2)))
+    net_l(nd.array(warm))
+    # copy params: conv weights OIHW -> OHWI, rest identical
+    src = net_c.collect_params()
+    dst = net_l.collect_params()
+    for (ns, ps), (nl, pl) in zip(sorted(src.items()), sorted(dst.items())):
+        v = ps.data().asnumpy()
+        if v.ndim == 4:  # conv weight
+            v = v.transpose(0, 2, 3, 1)
+        pl.set_data(nd.array(v))
+    x = np.random.RandomState(4).rand(2, 32, 32, 3).astype(np.float32)
+    out_l = net_l(nd.array(x))
+    out_c = net_c(nd.array(x.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(out_l.asnumpy(), out_c.asnumpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_bn_custom_vjp_matches_autodiff_reference():
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(4, 5, 6, 7).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(5).astype(np.float32) + 0.5)
+    beta = jnp.asarray(rng.randn(5).astype(np.float32))
+    mm, mv = jnp.zeros(5), jnp.ones(5)
+    from mxnet_tpu.ops import registry
+    bn = registry.get("BatchNorm").fn
+
+    def ref_bn(x, gamma, beta):
+        red = (0, 2, 3)
+        m = jnp.mean(x, axis=red)
+        v = jnp.var(x, axis=red)
+        sh = [1, 5, 1, 1]
+        xh = (x - m.reshape(sh)) * jax.lax.rsqrt(v.reshape(sh) + 1e-3)
+        return xh * gamma.reshape(sh) + beta.reshape(sh)
+
+    def f_new(x, gamma, beta):
+        out = bn(x, gamma, beta, mm, mv, fix_gamma=False, _train=True)[0]
+        return jnp.sum(jnp.sin(out))
+
+    def f_ref(x, gamma, beta):
+        return jnp.sum(jnp.sin(ref_bn(x, gamma, beta)))
+
+    np.testing.assert_allclose(float(f_new(x, gamma, beta)),
+                               float(f_ref(x, gamma, beta)), rtol=1e-5)
+    g_new = jax.grad(f_new, argnums=(0, 1, 2))(x, gamma, beta)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(g_new, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bn_mean_var_cotangents():
+    """output_mean_var=True: gradients flow through the stat outputs too."""
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(3, 4, 5).astype(np.float32))
+    gamma = jnp.asarray(rng.rand(4).astype(np.float32) + 0.5)
+    beta = jnp.zeros(4)
+    mm, mv = jnp.zeros(4), jnp.ones(4)
+    from mxnet_tpu.ops import registry
+    bn = registry.get("BatchNorm").fn
+
+    def f(x):
+        out, m, v = bn(x, gamma, beta, mm, mv, fix_gamma=False, _train=True,
+                       output_mean_var=True)
+        return 2.0 * jnp.sum(m) + 3.0 * jnp.sum(v) + jnp.sum(out)
+
+    def f_ref(x):
+        red = (0, 2)
+        m = jnp.mean(x, axis=red)
+        v = jnp.var(x, axis=red)
+        sh = [1, 4, 1]
+        out = (x - m.reshape(sh)) * jax.lax.rsqrt(v.reshape(sh) + 1e-3) \
+            * gamma.reshape(sh)
+        return 2.0 * jnp.sum(m) + 3.0 * jnp.sum(v) + jnp.sum(out)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(x)),
+                               np.asarray(jax.grad(f_ref)(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_bf16_accumulates_fp32():
+    x = (np.arange(8, dtype=np.float32) * 3.0).reshape(1, 8)
+    out_bf = nd.softmax(nd.array(x).astype("bfloat16"))
+    assert out_bf.dtype == jnp.bfloat16
+    ref = nd.softmax(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out_bf.asnumpy().astype(np.float32), ref,
+                               atol=1e-2)
+    out32 = nd.log_softmax(nd.array(x).astype("bfloat16"), dtype="float32")
+    assert out32.dtype == np.float32
+
+
+def test_multi_precision_bf16_master_weights():
+    """bf16 weights + multi_precision keep an fp32 master copy: tiny updates
+    that bf16 would lose still accumulate (reference: optimizer.py fp16)."""
+    opt = mx.optimizer.SGD(learning_rate=1.0, multi_precision=True)
+    w = nd.array(np.ones(4, np.float32)).astype("bfloat16")
+    state = opt.create_state_multi_precision(0, w)
+    master = state[0]
+    assert master.dtype == np.float32
+    g = nd.array(np.full(4, 1e-4, np.float32)).astype("bfloat16")
+    for _ in range(50):
+        opt.update_multi_precision(0, w, g, state)
+    # 50 * 1e-4 = 5e-3 accumulated in fp32; each single step is below the
+    # bf16 resolution at 1.0 (~0.0078) so a bf16-only chain would stay at 1
+    master_val = state[0].asnumpy()
+    assert np.all(master_val < 0.9975), master_val
+    # the bf16 view eventually moves too once the master drifts far enough
+    assert np.all(np.abs(w.asnumpy().astype(np.float32) - master_val) < 0.01)
+
+
+def test_batchnorm_cast_keeps_fp32():
+    net = nn.BatchNorm()
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3, 4, 4).astype(np.float32))
+    net(x)
+    net.cast("bfloat16")
+    assert net.gamma.dtype == np.float32
+    assert net.running_mean.dtype == np.float32
+
+
+def test_bf16_end_to_end_training_step():
+    """One DataParallelTrainer step on a tiny bf16 conv net."""
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, layout="NHWC"))
+    net.add(nn.BatchNorm(axis=-1))
+    net.add(nn.Activation("relu"))
+    net.add(nn.GlobalAvgPool2D(layout="NHWC"))
+    net.add(nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    devs = jax.devices()
+    mesh = make_mesh((1,), ("data",), devs[:1])
+    tr = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "multi_precision": True},
+        mesh=mesh)
+    x = nd.array(np.random.rand(4, 8, 8, 3).astype(np.float32)).astype("bfloat16")
+    y = nd.array(np.array([0, 1, 2, 0], np.int64))
+    l0 = tr.step(x, y).asscalar()
+    for _ in range(5):
+        l = tr.step(x, y).asscalar()
+    assert np.isfinite(l0) and np.isfinite(l)
+    assert l < l0  # loss decreases on a memorizable batch
