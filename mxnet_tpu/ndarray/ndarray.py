@@ -514,6 +514,11 @@ def invoke(op, args, kwargs, out=None):
         for idx, val in updates.items():
             nd_inputs[idx]._set_data(val)
 
+    # unconditional input mutation (reference: FMutateInputs on the
+    # optimizer-update ops — sgd_mom_update writes mom in place)
+    for in_idx, out_idx in op.mutates.items():
+        nd_inputs[in_idx]._set_data(outs_tuple[out_idx])
+
     n_public = op.n_outputs(params)
     out_nds = [NDArray(o) for o in outs_tuple[:n_public]]
 

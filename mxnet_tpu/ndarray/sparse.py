@@ -64,7 +64,9 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def todense(self):
         out = jnp.zeros(self._shape, dtype=self.data._data.dtype)
-        out = out.at[self.indices._data.astype(jnp.int32)].set(self.data._data)
+        # .add (not .set) so rows with duplicate indices accumulate — the
+        # invariant a compiler-friendly sparse sum relies on
+        out = out.at[self.indices._data.astype(jnp.int32)].add(self.data._data)
         return NDArray(out)
 
     tostype = NDArray.tostype
@@ -164,14 +166,11 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         return CSRNDArray(data, indices, indptr, shape)
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
     m, n = dense.shape
-    indptr = [0]
-    cols = []
-    vals = []
-    for i in range(m):
-        nz = _np.where(dense[i] != 0)[0]
-        cols.extend(nz.tolist())
-        vals.extend(dense[i][nz].tolist())
-        indptr.append(len(cols))
+    # vectorized construction (np.nonzero yields row-major = CSR order)
+    rows, cols = _np.nonzero(dense)
+    vals = dense[rows, cols]
+    indptr = _np.zeros(m + 1, _np.int64)
+    _np.cumsum(_np.bincount(rows, minlength=m), out=indptr[1:])
     return CSRNDArray(
         _dense_array(_np.asarray(vals, dtype=dense.dtype), ctx, dtype or dense.dtype),
         _dense_array(cols, ctx, "int64"), _dense_array(indptr, ctx, "int64"),
@@ -198,50 +197,121 @@ def zeros(stype, shape, ctx=None, dtype=None):
 
 
 def cast_storage(arr, stype):
-    """Reference: src/operator/tensor/cast_storage.cc."""
+    """Storage-type conversion (reference: src/operator/tensor/cast_storage.cc
+    CastStorageDnsRspImpl / CastStorageDnsCsrImpl).
+
+    Runs device-side: the nonzero scan stays on the accelerator; only the
+    data-dependent nnz forces a sync (exactly where the reference's CPU
+    sizing pass sits).
+    """
     if stype == arr.stype:
         return arr
     if stype == "default":
         return arr.todense()
+    if isinstance(arr, (RowSparseNDArray, CSRNDArray)):
+        arr = arr.todense()
+    d = arr._data
     if stype == "row_sparse":
-        dense = arr.asnumpy() if not isinstance(arr, NDArray) else arr.asnumpy()
-        return row_sparse_array(dense)
+        row_nz = jnp.any(d.reshape(d.shape[0], -1) != 0, axis=-1)
+        (nz,) = jnp.nonzero(row_nz)  # sync: dynamic nnz
+        return RowSparseNDArray(NDArray(d[nz]),
+                                NDArray(nz.astype(jnp.int64)), d.shape)
     if stype == "csr":
-        return csr_matrix(arr.asnumpy())
+        rows, cols = jnp.nonzero(d)  # sync: dynamic nnz
+        vals = d[rows, cols]
+        counts = jnp.bincount(rows, length=d.shape[0])
+        indptr = jnp.concatenate([jnp.zeros((1,), jnp.int64),
+                                  jnp.cumsum(counts).astype(jnp.int64)])
+        return CSRNDArray(NDArray(vals), NDArray(cols.astype(jnp.int64)),
+                          NDArray(indptr), d.shape)
     raise ValueError(stype)
 
 
 def retain(rsp, indices):
-    """sparse_retain: keep only given rows (reference: sparse_retain.cc)."""
+    """sparse_retain: keep only the requested rows (reference:
+    src/operator/tensor/sparse_retain.cc).
+
+    Device-side and static-shape: the output has exactly ``len(indices)``
+    rows — requested rows missing from the source come out as zero rows,
+    matching the reference's RspImpl (it allocates idx-sized output and
+    copies only the hits).  No host round-trip, so async dispatch holds.
+    """
     idx_keep = indices._data.astype(jnp.int64) if isinstance(indices, NDArray) \
         else jnp.asarray(indices, jnp.int64)
-    cur = rsp.indices._data
-    mask = jnp.isin(cur, idx_keep)
-    keep_pos = _np.where(_np.asarray(mask))[0]
-    return RowSparseNDArray(
-        NDArray(rsp.data._data[keep_pos]),
-        NDArray(cur[keep_pos]), rsp.shape)
+    src_idx = rsp.indices._data.astype(jnp.int64)
+    src_data = rsp.data._data
+    nnz = src_idx.shape[0]
+    if nnz == 0:
+        zero_rows = jnp.zeros((idx_keep.shape[0],) + tuple(rsp.data.shape[1:]),
+                              src_data.dtype)
+        return RowSparseNDArray(NDArray(zero_rows), NDArray(idx_keep),
+                                rsp.shape)
+    pos = jnp.searchsorted(src_idx, idx_keep)
+    pos_c = jnp.clip(pos, 0, nnz - 1)
+    hit = (pos < nnz) & (src_idx[pos_c] == idx_keep)
+    bshape = (-1,) + (1,) * (src_data.ndim - 1)
+    data = jnp.where(hit.reshape(bshape), src_data[pos_c], 0)
+    return RowSparseNDArray(NDArray(data), NDArray(idx_keep), rsp.shape)
 
 
 def add_rsp(a, b):
-    idx = _np.union1d(_np.asarray(a.indices._data), _np.asarray(b.indices._data))
-    n = len(idx)
-    row_shape = a.data.shape[1:]
-    out = jnp.zeros((n,) + tuple(row_shape), a.data._data.dtype)
-    pos_a = _np.searchsorted(idx, _np.asarray(a.indices._data))
-    pos_b = _np.searchsorted(idx, _np.asarray(b.indices._data))
-    out = out.at[pos_a].add(a.data._data)
-    out = out.at[pos_b].add(b.data._data)
-    return RowSparseNDArray(NDArray(out), NDArray(jnp.asarray(idx, jnp.int64)),
-                            a.shape)
+    """Row-sparse + row-sparse with exact index-union semantics.
+
+    All heavy work (sort, segment-sum) runs on device; the only host sync is
+    the scalar unique-row count (the output nnz is inherently data-dependent,
+    as in the reference's RspRspOp which sizes the output on CPU too).
+    """
+    idx = jnp.concatenate([a.indices._data.astype(jnp.int64),
+                           b.indices._data.astype(jnp.int64)])
+    if idx.shape[0] == 0:
+        return RowSparseNDArray(a.data.copy(), a.indices.copy(), a.shape)
+    data = jnp.concatenate([a.data._data, b.data._data], axis=0)
+    order = jnp.argsort(idx)
+    idx_s = idx[order]
+    data_s = data[order]
+    is_new = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                              (idx_s[1:] != idx_s[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(is_new) - 1
+    n_unique = int(seg[-1]) + 1  # scalar sync: dynamic output nnz
+    out = jax.ops.segment_sum(data_s, seg, num_segments=n_unique)
+    out_idx = jnp.zeros((n_unique,), jnp.int64).at[seg].set(idx_s)
+    return RowSparseNDArray(NDArray(out), NDArray(out_idx), a.shape)
+
+
+def _csr_rows(indptr, nnz):
+    """Row id per nnz element from indptr (device-side)."""
+    return jnp.searchsorted(indptr, jnp.arange(nnz, dtype=indptr.dtype),
+                            side="right") - 1
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse dot (reference: src/operator/tensor/dot.cc sparse paths)."""
+    """Sparse dot without densifying (reference:
+    src/operator/tensor/dot-inl.h DotCsrDnsDnsImpl / DotCsrDnsRspImpl).
+
+    csr × dense lowers to a gather + segment-sum — the TPU-native form of
+    the reference's per-row CSR kernels; the contraction stays O(nnz·k).
+    """
     if isinstance(lhs, CSRNDArray):
-        dense = lhs.todense()
-        from .ndarray import invoke
-        from ..ops import registry as _reg
-        return invoke(_reg.get("dot"), (dense, rhs),
-                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+        if transpose_b:
+            raise NotImplementedError("transpose_b unsupported for sparse dot")
+        rhs_d = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        m, n = lhs.shape
+        vals = lhs.data._data
+        cols = lhs.indices._data.astype(jnp.int32)
+        nnz = vals.shape[0]
+        if nnz == 0:
+            out_rows = n if transpose_a else m
+            return NDArray(jnp.zeros((out_rows,) + tuple(rhs_d.shape[1:]),
+                                     rhs_d.dtype))
+        indptr = lhs.indptr._data.astype(jnp.int32)
+        rows = _csr_rows(indptr, nnz)
+        if transpose_a:
+            # out[c, :] = sum_k vals[k] * rhs[rows[k], :] for cols[k] == c
+            contrib = vals[:, None] * rhs_d[rows]
+            out = jax.ops.segment_sum(contrib, cols, num_segments=n)
+        else:
+            # out[r, :] = sum_k vals[k] * rhs[cols[k], :] for rows[k] == r
+            contrib = vals[:, None] * rhs_d[cols]
+            out = jax.ops.segment_sum(contrib, rows, num_segments=m)
+        return NDArray(out)
     raise TypeError("sparse dot expects CSR lhs")

@@ -7,6 +7,7 @@ from . import indexing  # noqa: F401
 from . import init  # noqa: F401
 from . import random  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import pallas_kernels  # noqa: F401

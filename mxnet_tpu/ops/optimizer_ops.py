@@ -1,0 +1,207 @@
+"""Fused optimizer-update operators.
+
+Reference: ``src/operator/optimizer_op.cc`` registers the update rules as
+first-class ops (sgd_update, sgd_mom_update, mp_sgd_update/mp_sgd_mom_update
+with fp32 master weights, adam_update, rmsprop_update/rmspropalex_update,
+ftrl_update, ftml_update, signsgd_update/signum_update,
+_sparse_adagrad_update), each declaring FMutateInputs for its state tensors
+(``optimizer_op-inl.h`` SGDMomKernel et al.).  Here every rule is one pure
+jax function returning ``(new_weight, *new_states)``; the registry's
+``mutates`` map writes the states back in place, and under a jitted training
+step XLA fuses the whole update into the backward program — the fusion the
+reference gets from hand-written kernels falls out of the compiler.
+
+Multi-precision (mp_*) variants keep the fp32 master weight as an explicit
+input, matching the reference's (weight, grad, [states...], weight32)
+signatures, so fp16/bf16 training drives the same op the kvstore server and
+user scripts would call.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _clip(g, c):
+    """MXNet clip_gradient convention: negative (or None) disables."""
+    if c is not None and c >= 0:
+        return jnp.clip(g, -c, c)
+    return g
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# SGD family (reference: optimizer_op-inl.h SGDKernel / SGDMomKernel)
+# ---------------------------------------------------------------------------
+@register("sgd_update", arg_names=["weight", "grad"], differentiable=False)
+def sgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """w = (1 - lr*wd)*w - lr*clip(rescale_grad*g)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    return (1.0 - lr * wd) * weight - lr * g
+
+
+@register("sgd_mom_update", arg_names=["weight", "grad", "mom"],
+          differentiable=False, mutates={2: 1})
+def sgd_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    """mom = momentum*mom - lr*wd*w - lr*clip(rescale_grad*g); w += mom."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_mom = momentum * mom - lr * wd * weight - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", arg_names=["weight", "grad", "weight32"],
+          differentiable=False, mutates={2: 1})
+def mp_sgd_update(weight, grad, weight32, lr=None, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """SGD on the fp32 master copy, low-precision weight refreshed from it
+    (reference: MP_SGDKernel)."""
+    g = _clip(rescale_grad * _f32(grad), clip_gradient)
+    w32 = (1.0 - lr * wd) * weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update",
+          arg_names=["weight", "grad", "mom", "weight32"],
+          differentiable=False, mutates={2: 1, 3: 2})
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    """Momentum SGD on the fp32 master copy (reference: MP_SGDMomKernel)."""
+    g = _clip(rescale_grad * _f32(grad), clip_gradient)
+    new_mom = momentum * mom - lr * wd * weight32 - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+# ---------------------------------------------------------------------------
+# Sign-based (reference: SignSGDKernel / SignumKernel)
+# ---------------------------------------------------------------------------
+@register("signsgd_update", arg_names=["weight", "grad"],
+          differentiable=False)
+def signsgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    """w = (1 - lr*wd)*w - lr*sign(g); clip has no effect on a sign."""
+    return (1.0 - lr * wd) * weight - lr * jnp.sign(grad)
+
+
+@register("signum_update", arg_names=["weight", "grad", "mom"],
+          differentiable=False, mutates={2: 1})
+def signum_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """mom = momentum*mom - (1-momentum)*(wd*w + clip(rescale*g));
+    w = (1 - lr*wd_lh)*w + lr*sign(mom)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_mom = momentum * mom - (1.0 - momentum) * wd * weight \
+        - (1.0 - momentum) * g
+    return (1.0 - lr * wd_lh) * weight + lr * jnp.sign(new_mom), new_mom
+
+
+# ---------------------------------------------------------------------------
+# Adam (reference: adam_update — bias correction is applied by the Python
+# optimizer through lr, exactly as the reference's optimizer.py does)
+# ---------------------------------------------------------------------------
+@register("adam_update", arg_names=["weight", "grad", "mean", "var"],
+          differentiable=False, mutates={2: 1, 3: 2})
+def adam_update(weight, grad, mean, var, lr=None, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _clip(rescale_grad * grad + wd * weight, clip_gradient)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    out = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return out, new_mean, new_var
+
+
+# ---------------------------------------------------------------------------
+# RMSProp (reference: rmsprop_update = Hinton's slides; rmspropalex_update =
+# Graves 2013 with gamma2 momentum and centered variance)
+# ---------------------------------------------------------------------------
+def _clip_weights(w, cw):
+    if cw is not None and cw >= 0:
+        return jnp.clip(w, -cw, cw)
+    return w
+
+
+@register("rmsprop_update", arg_names=["weight", "grad", "n"],
+          differentiable=False, mutates={2: 1})
+def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _clip(rescale_grad * grad + wd * weight, clip_gradient)
+    new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
+    out = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    return _clip_weights(out, clip_weights), new_n
+
+
+@register("rmspropalex_update",
+          arg_names=["weight", "grad", "n", "g", "delta"],
+          differentiable=False, mutates={2: 1, 3: 2, 4: 3})
+def rmspropalex_update(weight, grad, n, g, delta, lr=None, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    gr = _clip(rescale_grad * grad + wd * weight, clip_gradient)
+    new_n = (1.0 - gamma1) * jnp.square(gr) + gamma1 * n
+    new_g = (1.0 - gamma1) * gr + gamma1 * g
+    new_delta = gamma2 * delta \
+        - lr * gr / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    out = weight + new_delta
+    return _clip_weights(out, clip_weights), new_n, new_g, new_delta
+
+
+# ---------------------------------------------------------------------------
+# Ftrl (reference: FtrlUpdate)
+# ---------------------------------------------------------------------------
+@register("ftrl_update", arg_names=["weight", "grad", "z", "n"],
+          differentiable=False, mutates={2: 1, 3: 2})
+def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _clip(rescale_grad * grad, clip_gradient)
+    new_z = z + g - (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) * weight / lr
+    new_n = n + jnp.square(g)
+    out = (jnp.sign(new_z) * lamda1 - new_z) \
+        / ((beta + jnp.sqrt(new_n)) / lr + wd) \
+        * (jnp.abs(new_z) > lamda1)
+    return out, new_z, new_n
+
+
+# ---------------------------------------------------------------------------
+# FTML (reference: FTMLKernel; note the reference spells the clip param
+# ``clip_grad`` for this one op)
+# ---------------------------------------------------------------------------
+@register("ftml_update", arg_names=["weight", "grad", "d", "v", "z"],
+          differentiable=False, mutates={2: 1, 3: 2, 4: 3})
+def ftml_update(weight, grad, d, v, z, lr=None, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = _clip(rescale_grad * grad + wd * weight, clip_grad)
+    new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+    d_t = (1.0 - beta1 ** t) / lr \
+        * (jnp.sqrt(new_v / (1.0 - beta2 ** t)) + epsilon)
+    new_z = beta1 * z + (1.0 - beta1) * g - (d_t - beta1 * d) * weight
+    return -new_z / d_t, d_t, new_v, new_z
+
+
+# ---------------------------------------------------------------------------
+# Sparse AdaGrad (reference: _sparse_adagrad_update — row-wise history
+# update for row_sparse gradients; the dense fallback applies to all rows)
+# ---------------------------------------------------------------------------
+@register("_sparse_adagrad_update",
+          arg_names=["weight", "grad", "history"], differentiable=False,
+          mutates={2: 1}, aliases=("sparse_adagrad_update",))
+def sparse_adagrad_update(weight, grad, history, lr=None, epsilon=1e-7,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Dense-tensor form; RowSparseNDArray gradients take the row-wise path
+    in ``optimizer.AdaGrad`` (only touched rows read/written)."""
+    g = _clip(rescale_grad * grad, clip_gradient)
+    if wd:
+        g = g + wd * weight
+    new_hist = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist

@@ -31,12 +31,12 @@ class Op:
     __slots__ = (
         "name", "fn", "arg_names", "aux", "aux_update", "num_outputs",
         "differentiable", "scalar_args", "doc", "needs_train",
-        "optional_args", "fn_params",
+        "optional_args", "fn_params", "mutates",
     )
 
     def __init__(self, name, fn, arg_names=None, aux=None, aux_update=None,
                  num_outputs=1, differentiable=True, scalar_args=(),
-                 needs_train=False, optional_args=()):
+                 needs_train=False, optional_args=(), mutates=None):
         self.name = name
         self.fn = fn
         self.arg_names = list(arg_names) if arg_names else ["data"]
@@ -49,6 +49,11 @@ class Op:
         # arg names that are NOT auto-created as variables by the symbolic
         # frontend when absent: a tuple of names, or callable(params)->names
         self.optional_args = optional_args
+        # unconditional in-place input mutation (reference: FMutateInputs on
+        # the optimizer-update ops): {input_idx: fn_output_idx}; the mapped
+        # fn outputs are written back into the inputs and only the first
+        # num_outputs outputs are public
+        self.mutates = dict(mutates) if mutates else {}
         try:
             # positional parameter names of fn, so scalar positional call
             # args (nd.swapaxes(x, 0, 1)) map onto the right kwargs
@@ -75,12 +80,13 @@ class Op:
 
 def register(name, *, arg_names=None, aux=None, aux_update=None, num_outputs=1,
              differentiable=True, scalar_args=(), aliases=(), needs_train=False,
-             optional_args=()):
+             optional_args=(), mutates=None):
     """Decorator registering a pure jax function as an operator."""
 
     def deco(fn):
         op = Op(name, fn, arg_names, aux, aux_update, num_outputs,
-                differentiable, scalar_args, needs_train, optional_args)
+                differentiable, scalar_args, needs_train, optional_args,
+                mutates)
         _OPS[name] = op
         for a in aliases:
             _OPS[a] = op

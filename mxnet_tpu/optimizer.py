@@ -156,6 +156,11 @@ class SGD(Optimizer):
         new_mom = self.momentum * mom - lr * g
         return w + new_mom, new_mom
 
+    def _op_kwargs(self, lr, wd):
+        return dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                    clip_gradient=-1.0 if self.clip_gradient is None
+                    else self.clip_gradient)
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -163,12 +168,35 @@ class SGD(Optimizer):
         if isinstance(grad, RowSparseNDArray):
             _sparse_sgd_update(self, weight, grad, state, lr, wd)
             return
-        new_w, new_m = self._apply(_raw(weight), _raw(grad),
-                                   _raw(state) if state is not None else None,
-                                   lr, wd)
-        weight._set_data(new_w)
+        # dense path goes through the registered fused-update ops, exactly
+        # as the reference optimizer does (optimizer.py SGD._update_impl ->
+        # sgd_update/sgd_mom_update ops)
+        kw = self._op_kwargs(lr, wd)
         if state is not None:
-            state._set_data(new_m)
+            nd.sgd_mom_update(weight, grad, state, out=weight,
+                              momentum=self.momentum,
+                              lazy_update=self.lazy_update, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight,
+                          lazy_update=self.lazy_update, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray
+        if (self.multi_precision and _is_low_precision(weight.dtype)
+                and not isinstance(grad, RowSparseNDArray)):
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            master, mom = state
+            kw = self._op_kwargs(lr, wd)
+            if mom is not None:
+                nd.mp_sgd_mom_update(weight, grad, mom, master, out=weight,
+                                     momentum=self.momentum,
+                                     lazy_update=self.lazy_update, **kw)
+            else:
+                nd.mp_sgd_update(weight, grad, master, out=weight,
+                                 lazy_update=self.lazy_update, **kw)
+        else:
+            super().update_multi_precision(index, weight, grad, state)
 
 
 def _sparse_sgd_update(opt, weight, grad, state, lr, wd):
@@ -203,14 +231,16 @@ class Signum(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        w, g = _raw(weight), self._prep_grad(_raw(grad))
+        clip = -1.0 if self.clip_gradient is None else self.clip_gradient
         if state is not None:
-            m = self.momentum * _raw(state) - (1 - self.momentum) * (g + wd * w)
-            new_w = (1 - lr * self.wd_lh) * w + lr * jnp.sign(m)
-            state._set_data(m)
+            nd.signum_update(weight, grad, state, out=weight, lr=lr, wd=wd,
+                             momentum=self.momentum, wd_lh=self.wd_lh,
+                             rescale_grad=self.rescale_grad,
+                             clip_gradient=clip)
         else:
-            new_w = (1 - lr * self.wd_lh) * w - lr * jnp.sign(g + wd * w)
-        weight._set_data(new_w)
+            nd.signsgd_update(weight, grad, out=weight, lr=lr, wd=wd,
+                              rescale_grad=self.rescale_grad,
+                              clip_gradient=clip)
 
 
 @register
@@ -229,17 +259,13 @@ class FTML(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
-        w = _raw(weight)
-        g = self._prep_grad(_raw(grad)) + wd * w
         d, v, z = state
-        b1, b2, eps = self.beta1, self.beta2, self.epsilon
-        v_t = b2 * _raw(v) + (1 - b2) * g * g
-        d_t = (1 - b1 ** t) / lr * (jnp.sqrt(v_t / (1 - b2 ** t)) + eps)
-        sigma = d_t - b1 * _raw(d)
-        z_t = b1 * _raw(z) + (1 - b1) * g - sigma * w
-        new_w = -z_t / d_t
-        d._set_data(d_t); v._set_data(v_t); z._set_data(z_t)
-        weight._set_data(new_w)
+        nd.ftml_update(weight, grad, d, v, z, out=weight, lr=lr, wd=wd, t=t,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon,
+                       rescale_grad=self.rescale_grad,
+                       clip_grad=-1.0 if self.clip_gradient is None
+                       else self.clip_gradient)
 
 
 @register
@@ -376,11 +402,16 @@ class Adam(Optimizer):
             v._set_data(_raw(v).at[idx].set(v_rows))
             weight._set_data(w.at[idx].add(-lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)))
             return
-        new_w, new_m, new_v = self._apply(_raw(weight), _raw(grad), _raw(m),
-                                          _raw(v), lr, wd, t)
-        m._set_data(new_m)
-        v._set_data(new_v)
-        weight._set_data(new_w)
+        # dense path: bias-corrected lr into the fused adam_update op, as
+        # the reference optimizer does (optimizer.py Adam.update)
+        lr_t = lr * ((1 - self.beta2 ** t) ** 0.5) / (1 - self.beta1 ** t)
+        nd.adam_update(weight, grad, m, v, out=weight, lr=lr_t, wd=wd,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon,
+                       rescale_grad=self.rescale_grad,
+                       clip_gradient=-1.0 if self.clip_gradient is None
+                       else self.clip_gradient,
+                       lazy_update=self.lazy_update)
 
 
 @register
@@ -395,11 +426,26 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        w = _raw(weight)
-        g = self._prep_grad(_raw(grad)) + wd * w
-        hist = _raw(state) + g * g
-        state._set_data(hist)
-        weight._set_data(w - lr * g / (jnp.sqrt(hist) + self.float_stable_eps))
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            # row-wise history/weight update: only touched rows read/written
+            # (reference: _sparse_adagrad_update, optimizer_op.cc:651)
+            idx = grad.indices._data.astype(jnp.int32)
+            w = _raw(weight)
+            g = self._prep_grad(grad.data._data)
+            if wd:
+                g = g + wd * w[idx]
+            h = _raw(state)
+            h_rows = h[idx] + g * g
+            state._set_data(h.at[idx].set(h_rows))
+            weight._set_data(w.at[idx].add(
+                -lr * g / (jnp.sqrt(h_rows) + self.float_stable_eps)))
+            return
+        nd.sparse_adagrad_update(weight, grad, state, out=weight, lr=lr,
+                                 wd=wd, epsilon=self.float_stable_eps,
+                                 rescale_grad=self.rescale_grad,
+                                 clip_gradient=-1.0 if self.clip_gradient
+                                 is None else self.clip_gradient)
 
 
 @register
@@ -421,25 +467,19 @@ class RMSProp(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        w = _raw(weight)
-        g = self._prep_grad(_raw(grad)) + wd * w
-        g1 = self.gamma1
+        kw = dict(lr=lr, wd=wd, gamma1=self.gamma1, epsilon=self.epsilon,
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=-1.0 if self.clip_gradient is None
+                  else self.clip_gradient,
+                  clip_weights=-1.0 if not self.clip_weights
+                  else self.clip_weights)
         if self.centered:
             n, mean_g, delta = state
-            n_t = g1 * _raw(n) + (1 - g1) * g * g
-            mg_t = g1 * _raw(mean_g) + (1 - g1) * g
-            d_t = self.gamma2 * _raw(delta) - lr * g / jnp.sqrt(
-                n_t - mg_t * mg_t + self.epsilon)
-            n._set_data(n_t); mean_g._set_data(mg_t); delta._set_data(d_t)
-            new_w = w + d_t
+            nd.rmspropalex_update(weight, grad, n, mean_g, delta, out=weight,
+                                  gamma2=self.gamma2, **kw)
         else:
             (n,) = state
-            n_t = g1 * _raw(n) + (1 - g1) * g * g
-            n._set_data(n_t)
-            new_w = w - lr * g / jnp.sqrt(n_t + self.epsilon)
-        if self.clip_weights:
-            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
-        weight._set_data(new_w)
+            nd.rmsprop_update(weight, grad, n, out=weight, **kw)
 
 
 @register
@@ -479,21 +519,12 @@ class Ftrl(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        w = _raw(weight)
-        g = self._prep_grad(_raw(grad))
         z, n = state
-        n_old = _raw(n)
-        n_t = n_old + g * g
-        sigma = (jnp.sqrt(n_t) - jnp.sqrt(n_old)) / lr
-        z_t = _raw(z) + g - sigma * w
-        new_w = jnp.where(
-            jnp.abs(z_t) <= self.lamda1,
-            jnp.zeros_like(w),
-            (jnp.sign(z_t) * self.lamda1 - z_t) /
-            ((self.beta + jnp.sqrt(n_t)) / lr + wd),
-        )
-        z._set_data(z_t); n._set_data(n_t)
-        weight._set_data(new_w)
+        nd.ftrl_update(weight, grad, z, n, out=weight, lr=lr, wd=wd,
+                       lamda1=self.lamda1, beta=self.beta,
+                       rescale_grad=self.rescale_grad,
+                       clip_gradient=-1.0 if self.clip_gradient is None
+                       else self.clip_gradient)
 
 
 @register

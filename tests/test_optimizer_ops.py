@@ -1,0 +1,207 @@
+"""Fused optimizer-update operators.
+
+Reference strategy: tests/python/unittest/test_optimizer.py — each op is
+checked against an independent numpy implementation of the reference kernel
+(src/operator/optimizer_op-inl.h), and the Python Optimizer classes are
+checked to produce identical trajectories through the ops.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RTOL, ATOL = 1e-5, 1e-6
+
+
+def _arrs(rng, shape=(4, 3)):
+    return (rng.randn(*shape).astype(np.float32),
+            rng.randn(*shape).astype(np.float32))
+
+
+class TestSGDOps:
+    def test_sgd_update(self):
+        rng = np.random.RandomState(0)
+        w, g = _arrs(rng)
+        out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01,
+                            rescale_grad=0.5)
+        expect = (1 - 0.1 * 0.01) * w - 0.1 * (0.5 * g)
+        np.testing.assert_allclose(out.asnumpy(), expect, rtol=RTOL, atol=ATOL)
+
+    def test_sgd_update_clip(self):
+        rng = np.random.RandomState(1)
+        w, g = _arrs(rng)
+        out = nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.0,
+                            rescale_grad=2.0, clip_gradient=0.5)
+        expect = w - 0.1 * np.clip(2.0 * g, -0.5, 0.5)
+        np.testing.assert_allclose(out.asnumpy(), expect, rtol=RTOL, atol=ATOL)
+
+    def test_sgd_mom_update_mutates_mom(self):
+        rng = np.random.RandomState(2)
+        w, g = _arrs(rng)
+        mom = rng.randn(4, 3).astype(np.float32)
+        w_nd, mom_nd = nd.array(w), nd.array(mom)
+        nd.sgd_mom_update(w_nd, nd.array(g), mom_nd, out=w_nd, lr=0.1,
+                          momentum=0.9, wd=0.01)
+        new_mom = 0.9 * mom - 0.1 * 0.01 * w - 0.1 * g
+        np.testing.assert_allclose(mom_nd.asnumpy(), new_mom, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(w_nd.asnumpy(), w + new_mom, rtol=RTOL, atol=ATOL)
+
+    def test_mp_sgd_mom_update(self):
+        rng = np.random.RandomState(3)
+        w32 = rng.randn(4, 3).astype(np.float32)
+        g = rng.randn(4, 3).astype(np.float32)
+        mom = np.zeros((4, 3), np.float32)
+        w16 = nd.array(w32).astype("bfloat16")
+        g16 = nd.array(g).astype("bfloat16")
+        mom_nd, w32_nd = nd.array(mom), nd.array(w32)
+        nd.mp_sgd_mom_update(w16, g16, mom_nd, w32_nd, out=w16, lr=0.1,
+                             momentum=0.9, wd=0.0)
+        g_f = np.asarray(g16.asnumpy(), np.float32)
+        new_mom = 0.9 * mom - 0.1 * g_f
+        np.testing.assert_allclose(w32_nd.asnumpy(), w32 + new_mom,
+                                   rtol=1e-3, atol=1e-3)
+        assert w16.dtype == np.dtype(np.float16).newbyteorder() or str(w16.dtype) == "bfloat16"
+
+
+class TestAdamRMSPropFtrl:
+    def test_adam_update(self):
+        rng = np.random.RandomState(4)
+        w, g = _arrs(rng)
+        m = np.zeros_like(w); v = np.zeros_like(w)
+        w_nd, m_nd, v_nd = nd.array(w), nd.array(m), nd.array(v)
+        nd.adam_update(w_nd, nd.array(g), m_nd, v_nd, out=w_nd, lr=0.01,
+                       beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.1)
+        gg = g + 0.1 * w
+        em = 0.9 * m + 0.1 * gg
+        ev = 0.999 * v + 0.001 * gg * gg
+        ew = w - 0.01 * em / (np.sqrt(ev) + 1e-8)
+        np.testing.assert_allclose(m_nd.asnumpy(), em, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(v_nd.asnumpy(), ev, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(w_nd.asnumpy(), ew, rtol=RTOL, atol=ATOL)
+
+    def test_rmsprop_update(self):
+        rng = np.random.RandomState(5)
+        w, g = _arrs(rng)
+        n = np.abs(rng.randn(4, 3).astype(np.float32))
+        w_nd, n_nd = nd.array(w), nd.array(n)
+        nd.rmsprop_update(w_nd, nd.array(g), n_nd, out=w_nd, lr=0.01,
+                          gamma1=0.95, epsilon=1e-8)
+        en = 0.05 * g * g + 0.95 * n
+        ew = w - 0.01 * g / np.sqrt(en + 1e-8)
+        np.testing.assert_allclose(n_nd.asnumpy(), en, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(w_nd.asnumpy(), ew, rtol=RTOL, atol=ATOL)
+
+    def test_rmspropalex_update(self):
+        rng = np.random.RandomState(6)
+        w, g = _arrs(rng)
+        n = np.abs(rng.randn(4, 3)).astype(np.float32)
+        gs = rng.randn(4, 3).astype(np.float32) * 0.1
+        delta = np.zeros_like(w)
+        w_nd, n_nd, g_nd, d_nd = nd.array(w), nd.array(n), nd.array(gs), nd.array(delta)
+        nd.rmspropalex_update(w_nd, nd.array(g), n_nd, g_nd, d_nd, out=w_nd,
+                              lr=0.01, gamma1=0.95, gamma2=0.9, epsilon=1e-4)
+        en = 0.05 * g * g + 0.95 * n
+        eg = 0.05 * g + 0.95 * gs
+        ed = 0.9 * delta - 0.01 * g / np.sqrt(en - eg * eg + 1e-4)
+        np.testing.assert_allclose(n_nd.asnumpy(), en, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(g_nd.asnumpy(), eg, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(d_nd.asnumpy(), ed, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w_nd.asnumpy(), w + ed, rtol=1e-4, atol=1e-5)
+
+    def test_ftrl_update(self):
+        rng = np.random.RandomState(7)
+        w, g = _arrs(rng)
+        z = np.zeros_like(w); n = np.zeros_like(w)
+        w_nd, z_nd, n_nd = nd.array(w), nd.array(z), nd.array(n)
+        nd.ftrl_update(w_nd, nd.array(g), z_nd, n_nd, out=w_nd, lr=0.1,
+                       lamda1=0.01, beta=1.0, wd=0.0)
+        ez = z + g - (np.sqrt(n + g * g) - np.sqrt(n)) * w / 0.1
+        en = n + g * g
+        ew = (np.sign(ez) * 0.01 - ez) / ((1.0 + np.sqrt(en)) / 0.1) \
+            * (np.abs(ez) > 0.01)
+        np.testing.assert_allclose(z_nd.asnumpy(), ez, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(n_nd.asnumpy(), en, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(w_nd.asnumpy(), ew, rtol=RTOL, atol=ATOL)
+
+    def test_ftml_update(self):
+        rng = np.random.RandomState(8)
+        w, g = _arrs(rng)
+        d = np.zeros_like(w); v = np.zeros_like(w); z = np.zeros_like(w)
+        w_nd, d_nd, v_nd, z_nd = nd.array(w), nd.array(d), nd.array(v), nd.array(z)
+        nd.ftml_update(w_nd, nd.array(g), d_nd, v_nd, z_nd, out=w_nd, lr=0.01,
+                       beta1=0.6, beta2=0.999, epsilon=1e-8, t=1)
+        ev = 0.999 * v + 0.001 * g * g
+        dt = (1 - 0.6) / 0.01 * (np.sqrt(ev / (1 - 0.999)) + 1e-8)
+        ez = 0.6 * z + 0.4 * g - (dt - 0.6 * d) * w
+        np.testing.assert_allclose(v_nd.asnumpy(), ev, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(d_nd.asnumpy(), dt, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(w_nd.asnumpy(), -ez / dt, rtol=1e-4, atol=1e-4)
+
+    def test_signum_update(self):
+        rng = np.random.RandomState(9)
+        w, g = _arrs(rng)
+        mom = np.zeros_like(w)
+        w_nd, mom_nd = nd.array(w), nd.array(mom)
+        nd.signum_update(w_nd, nd.array(g), mom_nd, out=w_nd, lr=0.1,
+                         momentum=0.9, wd=0.01, wd_lh=0.001)
+        em = 0.9 * mom - 0.1 * 0.01 * w - 0.1 * g
+        ew = (1 - 0.1 * 0.001) * w + 0.1 * np.sign(em)
+        np.testing.assert_allclose(mom_nd.asnumpy(), em, rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(w_nd.asnumpy(), ew, rtol=RTOL, atol=ATOL)
+
+    def test_signsgd_update(self):
+        rng = np.random.RandomState(10)
+        w, g = _arrs(rng)
+        out = nd.signsgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01)
+        expect = (1 - 0.1 * 0.01) * w - 0.1 * np.sign(g)
+        np.testing.assert_allclose(out.asnumpy(), expect, rtol=RTOL, atol=ATOL)
+
+
+class TestOptimizerClassesUseOps:
+    """Trajectory equivalence: Python Optimizer classes vs direct op calls."""
+
+    @pytest.mark.parametrize("name,kwargs", [
+        ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}),
+        ("adam", {"learning_rate": 0.01}),
+        ("rmsprop", {"learning_rate": 0.01, "gamma1": 0.9}),
+        ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+        ("ftrl", {"learning_rate": 0.1}),
+        ("ftml", {"learning_rate": 0.1}),
+        ("signum", {"learning_rate": 0.01, "momentum": 0.9}),
+        ("adagrad", {"learning_rate": 0.1}),
+        ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+        ("adadelta", {}),
+        ("adamax", {"learning_rate": 0.05}),
+        ("nadam", {"learning_rate": 0.05}),
+    ])
+    def test_optimizer_converges(self, name, kwargs):
+        """Each optimizer minimizes a quadratic through its op path."""
+        rng = np.random.RandomState(11)
+        target = rng.randn(6).astype(np.float32)
+        opt = mx.optimizer.create(name, **kwargs)
+        w = nd.array(np.zeros(6, np.float32))
+        state = opt.create_state(0, w)
+        first = None
+        for i in range(200):
+            g = nd.array(w.asnumpy() - target)  # grad of 0.5||w-target||^2
+            if first is None:
+                first = float(((w.asnumpy() - target) ** 2).sum())
+            opt.update(0, w, g, state)
+        last = float(((w.asnumpy() - target) ** 2).sum())
+        assert last < first * 0.2, (name, first, last)
+
+    def test_sgd_multi_precision_bf16_routes_mp_ops(self):
+        opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                               multi_precision=True)
+        w = nd.array(np.ones(4, np.float32)).astype("bfloat16")
+        state = opt.create_state_multi_precision(0, w)
+        master, mom = state
+        assert master.dtype == np.float32 and mom.dtype == np.float32
+        g = nd.array(np.full(4, 0.5, np.float32)).astype("bfloat16")
+        opt.update_multi_precision(0, w, g, state)
+        # mom = -lr*g; master = 1 + mom
+        np.testing.assert_allclose(mom.asnumpy(), np.full(4, -0.05),
+                                   rtol=1e-2)
+        np.testing.assert_allclose(master.asnumpy(), np.full(4, 0.95),
+                                   rtol=1e-2)
