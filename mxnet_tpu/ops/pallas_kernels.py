@@ -9,10 +9,12 @@ the standard TPU flash pattern (see /opt/skills/guides/pallas_guide.md).
 On non-TPU backends the same kernel runs in Pallas interpret mode, so
 tests exercise the real kernel logic on the CPU mesh.
 
-Training: the forward is the Pallas kernel; the backward rematerializes
-attention with the jnp formulation under XLA (sound, and XLA's own fusion
-handles the backward well; a Pallas backward kernel is a later
-optimization).
+Training: forward AND backward are Pallas kernels.  The forward emits the
+per-row logsumexp; the backward recomputes probabilities blockwise from
+(q, k, lse) with the standard two-kernel split (dq over k-blocks, dk/dv
+over q-blocks), so the (T×T) score matrix never exists in HBM in either
+direction — backward HBM is O(T·D), matching the flash-attention paper's
+recomputation scheme.
 """
 from __future__ import annotations
 
@@ -24,6 +26,18 @@ import jax.numpy as jnp
 from .registry import register
 
 _NEG_INF = -1e30
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct inheriting `like`'s varying-mesh-axes (vma) type,
+    so the kernels compose with shard_map's check_vma typing."""
+    try:
+        vma = getattr(jax.typeof(like), "vma", None)
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _on_tpu():
@@ -44,7 +58,7 @@ def _attention_reference(q, k, v, causal, scale):
     return jnp.einsum("bts,bsd->btd", p, v)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                causal, scale, block_q, block_k, num_k_blocks, t_k):
     from jax.experimental import pallas as pl
 
@@ -104,6 +118,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finish():
         denom = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        # per-row logsumexp for the backward recompute: lse = m + log(l).
+        # The 8-row broadcast satisfies the TPU (8, 128) tile constraint on
+        # the (BH, 8, T) lse buffer.
+        row = (m_scr[:, :1] + jnp.log(denom))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(row[None, :], lse_ref[0].shape)
 
 
 def _flash_attention_fwd_impl(q, k, v, causal, scale, block_q, block_k,
@@ -125,14 +144,16 @@ def _flash_attention_fwd_impl(q, k, v, causal, scale, block_q, block_k,
 
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_shape=(_sds((BH, T, D), q.dtype, q),
+                   _sds((BH, 8, T), jnp.float32, q)),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_specs=(pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
@@ -142,24 +163,243 @@ def _flash_attention_fwd_impl(q, k, v, causal, scale, block_q, block_k,
     )(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# Backward kernels: probabilities are recomputed blockwise from (q, k, lse);
+# delta = rowsum(dO ⊙ O) folds the softmax normalization gradient.
+# ---------------------------------------------------------------------------
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                  dq_scr, *, causal, scale, block_q, block_k, num_k_blocks,
+                  t_q, t_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = (qpos < t_q) & (kpos < t_k)
+        if causal:
+            valid = valid & (qpos >= kpos)
+        lse = lse_ref[0, 0][:, None]                   # (Bq, 1)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        # zero the grid-padding garbage before it enters a matmul
+        # (0 x inf/NaN = NaN would otherwise leak through p's zeros)
+        qrow_ok = (qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)) < t_q
+        krow_ok = (ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < t_k
+        do_blk = jnp.where(qrow_ok, do_ref[0].astype(jnp.float32), 0.0)
+        v_blk = jnp.where(krow_ok, v_ref[0].astype(jnp.float32), 0.0)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (Bq, Bk)
+        ds = jnp.where(valid, p * (dp - delta_ref[0, 0][:, None]), 0.0)
+        k_blk = jnp.where(krow_ok, k.astype(jnp.float32), 0.0)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale,
+                   block_q, block_k, num_q_blocks, t_q, t_k):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = (qpos < t_q) & (kpos < t_k)
+        if causal:
+            valid = valid & (qpos >= kpos)
+        lse = lse_ref[0, 0][:, None]
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)     # (Bq, Bk)
+        qrow_ok = (qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)) < t_q
+        krow_ok = (ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < t_k
+        do = jnp.where(qrow_ok, do_ref[0].astype(jnp.float32), 0.0)
+        q_blk = jnp.where(qrow_ok, q.astype(jnp.float32), 0.0)
+        v_blk = jnp.where(krow_ok, v_ref[0].astype(jnp.float32), 0.0)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (Bk, D)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # (Bq, Bk)
+        ds = jnp.where(valid, p * (dp - delta_ref[0, 0][:, None]), 0.0)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (Bk, D)
+
+    if causal:
+        # skip q blocks entirely above the diagonal for this k block
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _tile_rows(x):
+    """(BH, T) → (BH, 8, T): the sublane-broadcast tile layout the kernels
+    read per-row scalars from."""
+    BH, T = x.shape
+    return jnp.broadcast_to(x[:, None, :], (BH, 8, T))
+
+
+def flash_delta(o, do):
+    """softmax-normalization gradient delta = rowsum(dO ⊙ O), (BH, T) f32."""
+    return jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+
+def flash_dq(q, k, v, do, lse, delta, causal, scale, block_q=128,
+             block_k=128, interpret=None):
+    """dq for one (q-block × k-chunk) pairing; lse/delta are (BH, T) f32."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    BH, T, D = q.shape
+    Tk = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    nq = pl.cdiv(T, block_q)
+    nk = pl.cdiv(Tk, block_k)
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    row_q = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
+    return pl.pallas_call(
+        functools.partial(_fa_dq_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          t_q=T, t_k=Tk),
+        out_shape=_sds((BH, T, D), q.dtype, q),
+        grid=(BH, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, _tile_rows(lse), _tile_rows(delta))
+
+
+def flash_dkv(q, k, v, do, lse, delta, causal, scale, block_q=128,
+              block_k=128, interpret=None):
+    """(dk, dv) for one (q-chunk × k-block) pairing; k-major grid so q is
+    the accumulation axis."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    BH, T, D = q.shape
+    Tk = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    nq = pl.cdiv(T, block_q)
+    nk = pl.cdiv(Tk, block_k)
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    row_q = pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i))
+    return pl.pallas_call(
+        functools.partial(_fa_dkv_kernel, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          t_q=T, t_k=Tk),
+        out_shape=(_sds((BH, Tk, D), k.dtype, q),
+                   _sds((BH, Tk, D), v.dtype, q)),
+        grid=(BH, nk, nq),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_q, row_q],
+        out_specs=(k_spec, k_spec),
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, _tile_rows(lse), _tile_rows(delta))
+
+
+def flash_forward_with_lse(q, k, v, causal, scale, interpret=None):
+    """(out, lse) with lse (BH, T) f32 — building block for ring attention."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    out, lse8 = _flash_attention_fwd_impl(q, k, v, causal, scale,
+                                          block_q=128, block_k=128,
+                                          interpret=interpret)
+    return out, lse8[:, 0, :]
+
+
+def _flash_attention_bwd_impl(q, k, v, o, lse, do, causal, scale, block_q,
+                              block_k, interpret):
+    delta = flash_delta(o, do)
+    lse2 = lse[:, 0, :]
+    dq = flash_dq(q, k, v, do, lse2, delta, causal, scale, block_q, block_k,
+                  interpret)
+    dk, dv = flash_dkv(q, k, v, do, lse2, delta, causal, scale, block_q,
+                       block_k, interpret)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_core(q, k, v, causal, scale):
     interpret = not _on_tpu()
-    return _flash_attention_fwd_impl(q, k, v, causal, scale,
-                                     block_q=128, block_k=128,
-                                     interpret=interpret)
+    out, _ = _flash_attention_fwd_impl(q, k, v, causal, scale,
+                                       block_q=128, block_k=128,
+                                       interpret=interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash_core(q, k, v, causal, scale), (q, k, v)
+    interpret = not _on_tpu()
+    out, lse = _flash_attention_fwd_impl(q, k, v, causal, scale,
+                                         block_q=128, block_k=128,
+                                         interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, res, g):
-    q, k, v = res
-    # rematerialized XLA backward (jax.checkpoint-style trade)
-    _, vjp = jax.vjp(lambda a, b, c: _attention_reference(a, b, c, causal,
-                                                          scale), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    interpret = not _on_tpu()
+    return _flash_attention_bwd_impl(q, k, v, o, lse, g, causal, scale,
+                                     block_q=128, block_k=128,
+                                     interpret=interpret)
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)

@@ -48,60 +48,163 @@ def local_attention(q, k, v, causal=False, scale=None, q_offset=0,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _pvary(x, axis_name):
+    try:
+        return lax.pvary(x, (axis_name,))
+    except AttributeError:
+        return x
+
+
+def _to_bhtd(x):
+    """(B, T, H, D) → (B*H, T, D) — the flash kernels' layout."""
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _from_bhtd(x, B, H):
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _hop_cases(src, idx, causal, diag_fn, full_fn, skip_fn):
+    """Causal trichotomy per ring hop: the chunk is the diagonal (aligned
+    causal mask), strictly earlier (full attention) or strictly later
+    (contributes nothing).  Chunks are aligned so no offset math is needed
+    inside the kernels."""
+    if not causal:
+        return full_fn()
+    return lax.cond(
+        src == idx, lambda _: diag_fn(),
+        lambda _: lax.cond(src < idx, lambda __: full_fn(),
+                           lambda __: skip_fn(), _), operand=None)
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
+    from ..ops import pallas_kernels as _pk
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    qf = _to_bhtd(q)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, hop):
+        o, lse, k_cur, v_cur = carry                 # o (BH,Tl,D) f32, lse f32
+        src = (idx - hop) % n
+
+        def run(c):
+            out, l = _pk.flash_forward_with_lse(qf, _to_bhtd(k_cur),
+                                                _to_bhtd(v_cur), c, scale)
+            return out.astype(jnp.float32), l
+
+        o_h, lse_h = _hop_cases(
+            src, idx, causal,
+            diag_fn=lambda: run(True),
+            full_fn=lambda: run(False),
+            skip_fn=lambda: (jnp.zeros_like(o),
+                             jnp.full_like(lse, _NEG_INF)))
+        # combine normalized chunk outputs through their logsumexps
+        lse_new = jnp.logaddexp(lse, lse_h)
+        safe = jnp.where(lse_new <= _NEG_INF / 2, 0.0, lse_new)
+        c_old = jnp.where(lse <= _NEG_INF / 2, 0.0, jnp.exp(lse - safe))
+        c_hop = jnp.where(lse_h <= _NEG_INF / 2, 0.0, jnp.exp(lse_h - safe))
+        o_new = o * c_old[..., None] + o_h * c_hop[..., None]
+        # rotate K/V over ICI; the compiler overlaps the permute with the
+        # next hop's kernels
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, lse_new, k_next, v_next), None
+
+    o0 = _pvary(jnp.zeros((B * H, Tl, D), jnp.float32), axis_name)
+    lse0 = _pvary(jnp.full((B * H, Tl), _NEG_INF, jnp.float32), axis_name)
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    return _from_bhtd(o.astype(q.dtype), B, H), lse
+
+
+def _ring_bwd_impl(q, k, v, o_f, lse, do, axis_name, causal, scale):
+    """Second ring pass: dq accumulates locally; (dk, dv) accumulators
+    travel with their K/V chunks and are home after n hops."""
+    from ..ops import pallas_kernels as _pk
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    qf = _to_bhtd(q)
+    dof = _to_bhtd(do)
+    delta = _pk.flash_delta(_to_bhtd(o_f), dof)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, hop):
+        dq_acc, k_cur, v_cur, dk_acc, dv_acc = carry
+        src = (idx - hop) % n
+        kf, vf = _to_bhtd(k_cur), _to_bhtd(v_cur)
+
+        def run(c):
+            dq_h = _pk.flash_dq(qf, kf, vf, dof, lse, delta, c, scale)
+            dk_h, dv_h = _pk.flash_dkv(qf, kf, vf, dof, lse, delta, c, scale)
+            return (dq_h.astype(jnp.float32), dk_h.astype(jnp.float32),
+                    dv_h.astype(jnp.float32))
+
+        dq_h, dk_h, dv_h = _hop_cases(
+            src, idx, causal,
+            diag_fn=lambda: run(True),
+            full_fn=lambda: run(False),
+            skip_fn=lambda: (jnp.zeros_like(dq_acc), jnp.zeros_like(dk_acc),
+                             jnp.zeros_like(dv_acc)))
+        dq_acc = dq_acc + dq_h
+        dk_acc = dk_acc + dk_h
+        dv_acc = dv_acc + dv_h
+        # the chunk gradients rotate with their chunk: after n hops each
+        # (dk, dv) accumulator is back on the chunk's owner
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        dk_next = lax.ppermute(dk_acc, axis_name, perm)
+        dv_next = lax.ppermute(dv_acc, axis_name, perm)
+        return (dq_acc, k_next, v_next, dk_next, dv_next), None
+
+    zeros3 = lambda: _pvary(jnp.zeros((B * H, Tl, D), jnp.float32), axis_name)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (zeros3(), k, v, zeros3(), zeros3()), jnp.arange(n))
+    return (_from_bhtd(dq.astype(q.dtype), B, H),
+            _from_bhtd(dk.astype(k.dtype), B, H),
+            _from_bhtd(dv.astype(v.dtype), B, H))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_core(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, res, g):
+    q, k, v, o_f, lse = res
+    return _ring_bwd_impl(q, k, v, o_f, lse, g, axis_name, causal, scale)
+
+
+_ring_core.defvjp(_ring_fwd, _ring_bwd)
+
+
 def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     """Ring attention over a sharded sequence axis.
 
     Call inside shard_map; q/k/v are the local (B, T/n, H, D) chunks of a
     globally (B, T, H, D) tensor sharded on `axis_name`.  Returns the local
     output chunk.  Equivalent to full softmax attention over the global
-    sequence (verified against local_attention in tests)."""
+    sequence (verified against local_attention in tests).
+
+    Both directions run the Pallas flash kernels per hop: the forward
+    combines per-chunk (out, logsumexp) pairs; the backward is a second
+    ring in which (dk, dv) accumulators rotate with their chunks.  Peak
+    HBM is O(T/n · D) per chip in both directions — the T×T score matrix
+    never exists, even at training time."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
-    B, Tl, H, D = q.shape
-    qpos = idx * Tl + jnp.arange(Tl)
-
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def step(carry, hop):
-        o, m, l, k_cur, v_cur = carry
-        src = (idx - hop) % n                        # owner of current chunk
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
-        if causal:
-            kpos = src * Tl + jnp.arange(Tl)
-            mask = qpos[:, None] >= kpos[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
-        m_hop = jnp.max(s, axis=-1)                  # (B, H, Tq)
-        m_new = jnp.maximum(m, m_hop)
-        # guard fully-masked rows (exp(-inf - -inf))
-        m_safe = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        p = jnp.exp(s - m_safe[..., None])
-        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
-        corr = jnp.exp(jnp.where(m <= _NEG_INF / 2, _NEG_INF, m) - m_safe)
-        corr = jnp.where(m <= _NEG_INF / 2, 0.0, corr)
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur)
-        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
-        # rotate K/V to the next device over ICI; the compiler overlaps the
-        # permute with the next hop's einsum
-        k_next = lax.ppermute(k_cur, axis_name, perm)
-        v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_next, v_next), None
-
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full((B, H, Tl), _NEG_INF, q.dtype)
-    l0 = jnp.zeros((B, H, Tl), q.dtype)
-    # mark the fresh carries as device-varying so the scan carry type is
-    # consistent with the rotating k/v (shard_map vma typing)
-    try:
-        m0 = lax.pvary(m0, (axis_name,))
-        l0 = lax.pvary(l0, (axis_name,))
-    except AttributeError:
-        pass
-    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return o / denom
+    return _ring_core(q, k, v, axis_name, bool(causal), float(scale))
 
 
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
@@ -146,10 +249,13 @@ def _seq_sharded_spec(mesh, axis):
 def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False):
     """jit-able global entry: q/k/v are global (B, T, H, D) arrays; the
     function shards T over `axis` and runs ring attention."""
-    from jax.experimental.shard_map import shard_map
     spec = PartitionSpec(None, axis, None, None)
-    fn = shard_map(partial(ring_attention, axis_name=axis, causal=causal),
-                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    # check_vma=False: the Pallas interpret-mode lowering slices blocks with
+    # non-varying program-id indices, which the vma checker rejects; the
+    # kernels are correct under manual sharding either way
+    fn = jax.shard_map(partial(ring_attention, axis_name=axis, causal=causal),
+                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
     return fn(q, k, v)
 
 

@@ -1,0 +1,129 @@
+"""Flash-attention Pallas backward kernels.
+
+VERDICT r1 item 6: dq/dk/dv kernels with online-softmax recompute (O(T)
+HBM), wired as the custom VJP; ring attention backward uses them.  The
+memory assertion is structural: the backward jaxpr must contain no
+(T×T)-shaped intermediate — the score matrix exists only blockwise inside
+the kernels.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+SCALE = 64 ** -0.5
+
+
+def _qkv(rng, T, Tk=None, D=64, BH=2):
+    Tk = Tk or T
+    return (jnp.asarray(rng.randn(BH, T, D).astype(np.float32)),
+            jnp.asarray(rng.randn(BH, Tk, D).astype(np.float32)),
+            jnp.asarray(rng.randn(BH, Tk, D).astype(np.float32)))
+
+
+@pytest.mark.parametrize("T,Tk,causal", [
+    (128, 128, False), (128, 128, True),
+    (192, 160, False), (200, 200, True),
+])
+def test_flash_grads_match_reference(T, Tk, causal):
+    rng = np.random.RandomState(0)
+    q, k, v = _qkv(rng, T, Tk)
+
+    def f(q, k, v):
+        return jnp.sum(jnp.sin(pk._flash_core(q, k, v, causal, SCALE)))
+
+    def f_ref(q, k, v):
+        return jnp.sum(jnp.sin(pk._attention_reference(q, k, v, causal,
+                                                       SCALE)))
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g, g_ref, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-2,
+                                   rtol=1e-2, err_msg=name)
+
+
+def _all_avals(jaxpr, acc):
+    for eqn in jaxpr.eqns:
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                acc.append(tuple(aval.shape))
+        for sub in jax.core.jaxprs_in_params(eqn.params) \
+                if hasattr(jax.core, "jaxprs_in_params") else []:
+            _all_avals(sub, acc)
+    return acc
+
+
+def _shapes_in_jaxpr(closed_jaxpr):
+    """All array shapes appearing anywhere in the jaxpr (incl. sub-jaxprs)."""
+    seen = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    seen.append(tuple(aval.shape))
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (list, tuple)) else [val]
+                for v in vals:
+                    if hasattr(v, "jaxpr"):
+                        inner = v.jaxpr
+                        walk(inner if hasattr(inner, "eqns") else inner.jaxpr)
+    walk(closed_jaxpr.jaxpr)
+    return seen
+
+
+def test_flash_backward_no_quadratic_intermediate():
+    """The T×T score matrix must not appear in the backward program."""
+    T = 512
+    rng = np.random.RandomState(1)
+    q, k, v = _qkv(rng, T)
+
+    def loss(q, k, v):
+        return jnp.sum(pk._flash_core(q, k, v, False, SCALE))
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    shapes = _shapes_in_jaxpr(jaxpr)
+    quadratic = [s for s in shapes if T in s and s.count(T) >= 2]
+    assert not quadratic, quadratic
+
+    # the jnp reference *does* materialize it — sanity-check the detector
+    def loss_ref(q, k, v):
+        return jnp.sum(pk._attention_reference(q, k, v, False, SCALE))
+
+    jaxpr_ref = jax.make_jaxpr(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    shapes_ref = _shapes_in_jaxpr(jaxpr_ref)
+    assert any(T in s and s.count(T) >= 2 for s in shapes_ref)
+
+
+def test_flash_lse_matches_reference():
+    rng = np.random.RandomState(2)
+    q, k, v = _qkv(rng, 128)
+    _, lse = pk.flash_forward_with_lse(q, k, v, False, SCALE)
+    s = jnp.einsum("btd,bsd->bts", q, k) * SCALE
+    lse_ref = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bf16_backward():
+    rng = np.random.RandomState(3)
+    q, k, v = _qkv(rng, 128)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def f(q, k, v):
+        return jnp.sum(pk._flash_core(q, k, v, True, SCALE)
+                       .astype(jnp.float32))
+
+    g = jax.grad(f, argnums=(0, 1, 2))(qb, kb, vb)
+    g_ref = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), atol=0.15, rtol=0.1)
