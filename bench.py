@@ -87,12 +87,101 @@ def main():
     dt = time.perf_counter() - t0
 
     imgs_per_sec_per_chip = global_batch * iters / dt / n_dev
-    print(json.dumps({
+
+    result = {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec_per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(imgs_per_sec_per_chip / BASELINE_IMGS_PER_SEC, 3),
-    }))
+    }
+
+    # -- pipeline-fed measurement (reference: train_imagenet.py feeds the
+    # trainer through ImageRecordIter, src/io/iter_image_recordio_2.cc).
+    # A synthetic JPEG .rec is packed on the fly; both the iterator-only
+    # rate (native decode) and the trainer-fed rate are reported.  On this
+    # host the decode path is CPU-bound (os.cpu_count() cores drive
+    # libjpeg), so the pipeline rate is a host property, not a chip one.
+    if os.environ.get("MXTPU_BENCH_PIPELINE", "1") == "1":
+        try:
+            result.update(_pipeline_bench(trainer, batch, layout, dtype))
+        except Exception as e:  # never lose the primary metric
+            result["pipeline_error"] = str(e)[:200]
+
+    print(json.dumps(result))
+
+
+def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024):
+    import io as _pyio
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from PIL import Image
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    tmpdir = tempfile.mkdtemp(prefix="mxtpu_bench_rec_")
+    rec_path = os.path.join(tmpdir, "synth.rec")
+    idx_path = os.path.join(tmpdir, "synth.idx")
+    rng = np.random.RandomState(0)
+    writer = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    buf = _pyio.BytesIO()
+    for i in range(n_records):
+        img = rng.randint(0, 255, (224, 224, 3), np.uint8)
+        buf.seek(0)
+        buf.truncate()
+        Image.fromarray(img).save(buf, format="JPEG", quality=90)
+        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        writer.write_idx(i, recordio.pack(header, buf.getvalue()))
+    writer.close()
+
+    # uint8 + NHWC: the decoder's own layout, so the host does zero
+    # transpose/cast work and the host->device transfer is 4x narrower
+    # than fp32; normalization fuses into the device program
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 224, 224),
+        batch_size=batch, shuffle=True, dtype="uint8",
+        layout="NHWC" if layout == "NHWC" else "NCHW")
+
+    # iterator-only rate (native decode + batch assembly)
+    it.reset()
+    n = 0
+    t0 = time.perf_counter()
+    for b in it:
+        n += b.data[0].shape[0]
+    dt_iter = time.perf_counter() - t0
+    iter_rate = n / dt_iter
+
+    prep = jax.jit(lambda x: (x.astype(jnp.float32) / 255.0).astype(dtype))
+
+    def to_dev(b):
+        return mx.nd.NDArray(prep(b.data[0]._data)), b.label[0]
+
+    # trainer-fed rate: PrefetchingIter overlaps decode with device compute
+    it.reset()
+    n = 0
+    t0 = time.perf_counter()
+    loss = None
+    for b in it:
+        if b.data[0].shape[0] != batch:
+            break
+        x, y = to_dev(b)
+        loss = trainer.step(x, y)
+        n += batch
+    if loss is not None:
+        loss.asscalar()
+    dt_fed = time.perf_counter() - t0
+    fed_rate = n / dt_fed if n else 0.0
+
+    import shutil
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    return {
+        "pipeline_iter_imgs_per_sec": round(iter_rate, 2),
+        "pipeline_fed_imgs_per_sec": round(fed_rate, 2),
+        "pipeline_host_cores": os.cpu_count(),
+    }
 
 
 if __name__ == "__main__":

@@ -436,7 +436,7 @@ class ImageIter(_io.DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", dtype="float32",
-                 last_batch_handle="pad", **kwargs):
+                 last_batch_handle="pad", layout="NCHW", **kwargs):
         super().__init__(batch_size)
         assert len(data_shape) == 3 and data_shape[0] in (1, 3)
         self.data_shape = tuple(data_shape)
@@ -444,6 +444,12 @@ class ImageIter(_io.DataIter):
         self.label_width = label_width
         self.shuffle = shuffle
         self.dtype = dtype
+        # TPU-native extension: layout="NHWC" emits batches exactly as the
+        # decoder produces them (HWC) — no host-side transpose, and uint8
+        # dtype keeps the host->device transfer 4x narrower; normalization
+        # then fuses on-device
+        assert layout in ("NCHW", "NHWC")
+        self.layout = layout
 
         self.imgrec = None
         self.imglist = None
@@ -516,10 +522,14 @@ class ImageIter(_io.DataIter):
                     tail.append(aug)
             resize = next((a.size for a in spatial
                            if isinstance(a, ResizeAug)), 0)
-            # engage only when an explicit resize precedes the center crop —
-            # the native pipeline is resize-short + crop; a crop-only python
-            # chain crops the *original* image, which is different data
-            if resize > 0 and \
+            # the native pipeline is resize-short (optional) + center-crop
+            # to data_shape — exactly ResizeAug/CenterCropAug semantics, so
+            # engage whenever the spatial chain is those two (in any
+            # combination, including none) and every crop targets data_shape
+            target = (data_shape[2], data_shape[1])
+            crops_ok = all(a.size == target for a in spatial
+                           if isinstance(a, CenterCropAug))
+            if crops_ok and \
                     all(isinstance(a, (CastAug, ColorNormalizeAug))
                         for a in tail):
                 from .. import _native
@@ -527,9 +537,11 @@ class ImageIter(_io.DataIter):
                     self._native_resize = resize
                     self._native_tail = tail
 
-        self.provide_data = [_io.DataDesc(data_name,
-                                          (batch_size,) + self.data_shape,
-                                          np.dtype(dtype))]
+        c, h, w = self.data_shape
+        dshape = (batch_size, h, w, c) if layout == "NHWC" \
+            else (batch_size,) + self.data_shape
+        self.provide_data = [_io.DataDesc(data_name, dshape, np.dtype(dtype),
+                                          layout=layout)]
         if label_width > 1:
             self.provide_label = [_io.DataDesc(label_name,
                                                (batch_size, label_width))]
@@ -617,7 +629,9 @@ class ImageIter(_io.DataIter):
                 # iterator); DataBatch.pad tells consumers how many to drop
                 batch_data[i:] = batch_data[i - 1]
                 batch_label[i:] = batch_label[i - 1]
-        data = nd.array(batch_data.transpose(0, 3, 1, 2), dtype=self.dtype)
+        if self.layout != "NHWC":
+            batch_data = batch_data.transpose(0, 3, 1, 2)
+        data = nd.array(batch_data, dtype=self.dtype)
         label = nd.array(batch_label if lw > 1 else batch_label[:, 0])
         return _io.DataBatch([data], [label], pad=pad)
 
@@ -652,16 +666,22 @@ class ImageIter(_io.DataIter):
             bufs, h, w, c, resize_short=self._native_resize)
         if fails:
             raise MXNetError("%d corrupt image records in batch" % fails)
-        batch = decoded.astype(np.float32)
-        for aug in self._native_tail:
-            if isinstance(aug, ColorNormalizeAug):
-                if aug.mean is not None:
-                    batch = batch - aug.mean
-                if aug.std is not None:
-                    batch = batch / aug.std
-            elif isinstance(aug, CastAug):
-                batch = batch.astype(aug.typ)
-        data = nd.array(batch.transpose(0, 3, 1, 2), dtype=self.dtype)
+        if np.dtype(self.dtype) == np.uint8 and not any(
+                isinstance(a, ColorNormalizeAug) for a in self._native_tail):
+            batch = decoded           # raw uint8 pass-through, no host copy
+        else:
+            batch = decoded.astype(np.float32)
+            for aug in self._native_tail:
+                if isinstance(aug, ColorNormalizeAug):
+                    if aug.mean is not None:
+                        batch = batch - aug.mean
+                    if aug.std is not None:
+                        batch = batch / aug.std
+                elif isinstance(aug, CastAug):
+                    batch = batch.astype(aug.typ)
+        if self.layout != "NHWC":
+            batch = batch.transpose(0, 3, 1, 2)
+        data = nd.array(batch, dtype=self.dtype)
         lab = np.stack(labels)
         label = nd.array(lab if lw > 1 else lab[:, 0])
         return _io.DataBatch([data], [label],
@@ -674,7 +694,9 @@ class ImageIter(_io.DataIter):
         lw = self.label_width
         batch = np.stack([self._decode_one(s) for s in bufs]) \
             .astype(np.float32)
-        data = nd.array(batch.transpose(0, 3, 1, 2), dtype=self.dtype)
+        if self.layout != "NHWC":
+            batch = batch.transpose(0, 3, 1, 2)
+        data = nd.array(batch, dtype=self.dtype)
         lab = np.stack(labels)
         label = nd.array(lab if lw > 1 else lab[:, 0])
         return _io.DataBatch([data], [label],

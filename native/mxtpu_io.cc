@@ -198,10 +198,33 @@ long mxtpu_decode_batch(const uint8_t** bufs, const long* lens, long n,
         sw = nw;
         sh = nh;
       }
-      // center-crop (or pad-resize if smaller)
+      // center-crop; images smaller than the target follow the python
+      // center_crop semantics (image.py scale_down): shrink the crop
+      // window to fit at the target aspect, crop the center, then resize
+      // the crop up to the target — not a full-image stretch
       if (sh < out_h || sw < out_w) {
+        float cw = static_cast<float>(out_w), ch = static_cast<float>(out_h);
+        if (sh < ch) {
+          cw = cw * sh / ch;
+          ch = static_cast<float>(sh);
+        }
+        if (sw < cw) {
+          ch = ch * sw / cw;
+          cw = static_cast<float>(sw);
+        }
+        int icw = static_cast<int>(cw), ich = static_cast<int>(ch);
+        if (icw < 1) icw = 1;
+        if (ich < 1) ich = 1;
+        int y0 = (sh - ich) / 2;
+        int x0 = (sw - icw) / 2;
+        std::vector<uint8_t> crop(static_cast<size_t>(ich) * icw * c);
+        for (int y = 0; y < ich; ++y) {
+          std::memcpy(crop.data() + static_cast<size_t>(y) * icw * c,
+                      src + (static_cast<size_t>(y0 + y) * sw + x0) * c,
+                      static_cast<size_t>(icw) * c);
+        }
         std::vector<uint8_t> tmp(static_cast<size_t>(out_h) * out_w * c);
-        resize_bilinear(src, sh, sw, c, tmp.data(), out_h, out_w);
+        resize_bilinear(crop.data(), ich, icw, c, tmp.data(), out_h, out_w);
         std::memcpy(out + i * img_stride, tmp.data(), img_stride);
       } else {
         int y0 = (sh - out_h) / 2;

@@ -377,10 +377,12 @@ def test_image_iter_native_fast_path(tmp_path):
                                    path_imgrec=rec, resize=30,
                                    mean=np.zeros(3), std=np.ones(3))
     assert it_native._native_tail is not None  # fast path active
-    # crop-only chains must NOT engage (different data semantics)
+    # crop-only chains engage too: the native path center-crops with the
+    # python scale_down semantics (small images crop-then-resize, no
+    # full-image stretch)
     it_croponly = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
                                      path_imgrec=rec)
-    assert it_croponly._native_tail is None
+    assert it_croponly._native_tail is not None
     it_py = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
                                path_imgrec=rec, resize=30, mean=np.zeros(3),
                                std=np.ones(3), native_decode=False)
@@ -397,6 +399,58 @@ def test_image_iter_native_fast_path(tmp_path):
     it_rand = mx.image.ImageIter(batch_size=8, data_shape=(3, 28, 28),
                                  path_imgrec=rec, rand_mirror=True)
     assert it_rand._native_tail is None
+
+
+def test_image_iter_nhwc_uint8(tmp_path):
+    """layout=NHWC + dtype=uint8: batches come out in the decoder's own
+    layout with no host transpose (TPU-native extension)."""
+    rec = _make_rec_dataset(tmp_path)
+    it = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                            path_imgrec=rec, resize=30, layout="NHWC",
+                            dtype="uint8")
+    b = it.next()
+    assert b.data[0].shape == (4, 28, 28, 3)
+    assert b.data[0].dtype == np.uint8
+    assert it.provide_data[0].shape == (4, 28, 28, 3)
+    # pixel-identical to the NCHW path, just transposed
+    it2 = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                             path_imgrec=rec, resize=30)
+    b2 = it2.next()
+    np.testing.assert_allclose(
+        b.data[0].asnumpy().transpose(0, 3, 1, 2).astype(np.float32),
+        b2.data[0].asnumpy(), atol=1e-5)
+
+
+def test_native_small_image_matches_python_center_crop(tmp_path):
+    """Images smaller than the target: native follows python center_crop
+    (scale_down crop + resize), not a full-image stretch."""
+    from mxnet_tpu import _native
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    import io as pyio
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    rec_path = str(tmp_path / "small.rec")
+    idx_path = str(tmp_path / "small.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        img = rng.randint(0, 255, (20, 34, 3), np.uint8)  # smaller than 28
+        buf = pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=95)
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                     buf.getvalue()))
+    w.close()
+    it_n = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                              path_imgrec=rec_path, path_imgidx=idx_path)
+    it_p = mx.image.ImageIter(batch_size=4, data_shape=(3, 28, 28),
+                              path_imgrec=rec_path, path_imgidx=idx_path,
+                              native_decode=False)
+    b_n = it_n.next()
+    b_p = it_p.next()
+    assert b_n.data[0].shape == b_p.data[0].shape
+    # same crop geometry; only interpolation differs (cv2 vs bilinear)
+    diff = np.abs(b_n.data[0].asnumpy() - b_p.data[0].asnumpy()).mean()
+    assert diff < 12, diff
 
 
 def test_flash_attention_ragged_length():
