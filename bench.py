@@ -107,7 +107,56 @@ def main():
         except Exception as e:  # never lose the primary metric
             result["pipeline_error"] = str(e)[:200]
 
+    # -- int8 inference (reference: quantized resnet via
+    # quantize_graph_pass.cc + quantized_conv/pooling/fc kernels)
+    if os.environ.get("MXTPU_BENCH_INT8", "1") == "1":
+        try:
+            # drop the trainer's HBM (params, fp32 masters, momentum,
+            # donated activations) before binding the int8 executors
+            trainer = None
+            import gc
+            gc.collect()
+            result.update(_int8_bench())
+        except Exception as e:
+            result["int8_error"] = str(e)[:200]
+
     print(json.dumps(result))
+
+
+def _int8_bench(batch=64, iters=5, calib_batch=16):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.symbol.models import resnet_symbol
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(calib_batch, 3, 224, 224).astype(np.float32)
+    y = np.zeros(calib_batch, np.float32)
+    calib_it = mx.io.NDArrayIter(X, y, calib_batch)
+    net = resnet_symbol(50)
+    mod = mx.mod.Module(net)
+    mod.bind(calib_it.provide_data, calib_it.provide_label,
+             for_training=False)
+    mod.init_params(initializer=mx.init.Xavier())
+    arg, aux = mod.get_params()
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        net, arg, aux, calib_data=calib_it, num_calib_examples=calib_batch,
+        excluded_sym_names=["stem_conv"])
+    mod = None
+    Xb = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    it = mx.io.NDArrayIter(Xb, np.zeros(batch, np.float32), batch)
+    qmod = mx.mod.Module(qsym)
+    qmod.bind(it.provide_data, it.provide_label, for_training=False)
+    qmod.init_params(arg_params=qarg, aux_params=qaux)
+    b = next(iter(it))
+    qmod.forward(b, is_train=False)
+    qmod.get_outputs()[0].asnumpy()  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        qmod.forward(b, is_train=False)
+    qmod.get_outputs()[0].asnumpy()
+    dt = time.perf_counter() - t0
+    return {"int8_infer_imgs_per_sec": round(batch * iters / dt, 2)}
 
 
 def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024):

@@ -68,6 +68,11 @@ class Context:
         """Resolve to a concrete jax.Device."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             devs = _backend_devices("cpu")
+            if not devs:
+                # no host backend registered (JAX_PLATFORMS pinned to an
+                # accelerator): context is advisory in this design — every
+                # array is a jax array — so fall through to the accelerator
+                devs = _accelerator_devices()
         else:
             devs = _accelerator_devices()
         if not devs:

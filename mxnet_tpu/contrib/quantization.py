@@ -50,10 +50,19 @@ def _entry_range_key(entry):
     return node.name if node.op is None else node.name + "_output"
 
 
-def _rewrite_int8_fc(symbol, arg_params, th_dict, excluded):
-    """Replace calibrated FullyConnected nodes with
-    quantize_v2 → quantized_fully_connected → dequantize (+ fp32 bias)
-    subgraphs — the quantize_graph_pass.cc analogue.  Layers without a
+# attrs each quantized op inherits from its fp32 node
+_QCONV_ATTRS = ("kernel", "stride", "dilate", "pad", "num_filter",
+                "num_group", "layout")
+_QPOOL_ATTRS = ("kernel", "pool_type", "global_pool", "pooling_convention",
+                "stride", "pad", "count_include_pad", "layout")
+_QUANTIZABLE = {"FullyConnected", "Convolution", "Pooling"}
+
+
+def _rewrite_int8(symbol, arg_params, th_dict, excluded):
+    """Replace calibrated FullyConnected/Convolution/Pooling nodes with
+    quantize_v2 → quantized op → dequantize (+ fp32 bias) subgraphs — the
+    quantize_graph_pass.cc analogue (reference also covers conv and
+    pooling: quantized_conv.cu, quantized_pooling.cc).  Layers without a
     calibrated input range, or in `excluded`, stay fp32."""
     from ..symbol.symbol import Symbol, _Node
 
@@ -65,18 +74,31 @@ def _rewrite_int8_fc(symbol, arg_params, th_dict, excluded):
         new = _Node(node.op, node.name, dict(node.attrs), [], node._is_aux)
         memo[id(node)] = new  # register before recursing into inputs
         new.inputs = [(clone(c), i) for c, i in node.inputs]
-        if node.op != "FullyConnected" or node.name in excluded:
+        if node.op not in _QUANTIZABLE or node.name in excluded:
             return new
         rng = th_dict.get(_entry_range_key(node.inputs[0]))
-        wname = node.name + "_weight"
-        if rng is None or wname + "_quantized" not in arg_params:
+        if rng is None:
             return new
         lo, hi = rng
         data_entry = new.inputs[0]
-        has_bias = len(node.inputs) > 2
         qdata = _Node("_contrib_quantize_v2", node.name + "_qdata",
                       {"out_type": "int8", "min_calib_range": lo,
                        "max_calib_range": hi}, [data_entry])
+
+        if node.op == "Pooling":
+            qpool = _Node("_contrib_quantized_pooling", node.name + "_int8",
+                          {k: node.attrs[k] for k in _QPOOL_ATTRS
+                           if k in node.attrs},
+                          [(qdata, 0), (qdata, 1), (qdata, 2)])
+            deq = _Node("_contrib_dequantize", node.name + "_deq", {},
+                        [(qpool, 0), (qpool, 1), (qpool, 2)])
+            memo[id(node)] = deq
+            return deq
+
+        wname = node.name + "_weight"
+        if wname + "_quantized" not in arg_params:
+            return new
+
         def qvar(suffix):
             full = wname + suffix
             arr = arg_params[full]
@@ -87,23 +109,35 @@ def _rewrite_int8_fc(symbol, arg_params, th_dict, excluded):
         wq = qvar("_quantized")
         wmn = qvar("_min")
         wmx = qvar("_max")
-        attrs = {"num_hidden": node.attrs.get("num_hidden"),
-                 "no_bias": True,
-                 "flatten": node.attrs.get("flatten", True)}
-        qfc = _Node("_contrib_quantized_fully_connected",
-                    node.name + "_int8",
-                    attrs,
+        has_bias = len(node.inputs) > 2
+        if node.op == "FullyConnected":
+            attrs = {"num_hidden": node.attrs.get("num_hidden"),
+                     "no_bias": True,
+                     "flatten": node.attrs.get("flatten", True)}
+            qop_name = "_contrib_quantized_fully_connected"
+        else:
+            attrs = {k: node.attrs[k] for k in _QCONV_ATTRS
+                     if k in node.attrs}
+            attrs["no_bias"] = True
+            qop_name = "_contrib_quantized_conv"
+        qop = _Node(qop_name, node.name + "_int8", attrs,
                     [(qdata, 0), (wq, 0), (qdata, 1), (qdata, 2),
                      (wmn, 0), (wmx, 0)])
         deq = _Node("_contrib_dequantize", node.name + "_deq",
-                    {}, [(qfc, 0), (qfc, 1), (qfc, 2)])
+                    {}, [(qop, 0), (qop, 1), (qop, 2)])
         if has_bias:
             bias_entry = new.inputs[2]
             bname = node.name + "_bias"
             if bias_entry[0].op is None and bname in arg_params:
-                # no FC node derives its shape anymore — pin it on the var
+                # no fp32 node derives its shape anymore — pin it on the var
                 bias_entry[0].attrs.setdefault(
                     "__shape__", str(tuple(arg_params[bname].shape)))
+            if node.op == "Convolution":
+                # bias broadcasts over channels: (C,) -> (1, C, 1, ...)
+                nsp = len(_attr_tuple(node.attrs.get("kernel", (1, 1))))
+                bshape = (1, -1) + (1,) * nsp
+                bias_entry = (_Node("Reshape", node.name + "_bias_rs",
+                                    {"shape": str(bshape)}, [bias_entry]), 0)
             out = _Node("broadcast_add", node.name + "_addbias", {},
                         [(deq, 0), bias_entry])
         else:
@@ -112,6 +146,54 @@ def _rewrite_int8_fc(symbol, arg_params, th_dict, excluded):
         return out
 
     return Symbol([(clone(n), i) for n, i in symbol._outputs])
+
+
+def _attr_tuple(v):
+    if isinstance(v, str):
+        import ast
+        return ast.literal_eval(v)
+    return tuple(v) if not isinstance(v, int) else (v,)
+
+
+def _elide_dq_q(symbol):
+    """Fuse dequantize→quantize_v2 chains into requantize so adjacent int8
+    layers hand tensors over without a round-trip through fp32
+    (reference: quantize_graph_pass.cc requantize fusion)."""
+    from ..symbol.symbol import Symbol, _Node
+
+    memo = {}
+
+    def clone(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        new = _Node(node.op, node.name, dict(node.attrs), [], node._is_aux)
+        memo[id(node)] = new
+        new.inputs = [(clone(c), i) for c, i in node.inputs]
+        if node.op == "_contrib_quantize_v2" and node.inputs:
+            src, _ = node.inputs[0]
+            # only when the dequantize reads an int32 accumulator (conv/fc);
+            # int8 producers (pooling) use a different scale domain
+            acc_ok = src.inputs and src.inputs[0][0].op in (
+                "_contrib_quantized_conv",
+                "_contrib_quantized_fully_connected")
+            if src.op == "_contrib_dequantize" and acc_ok and \
+                    "min_calib_range" in node.attrs:
+                acc_entry = new.inputs[0][0].inputs  # dequantize's inputs
+                rq = _Node("_contrib_requantize", node.name + "_rq",
+                           {"min_calib_range":
+                            node.attrs["min_calib_range"],
+                            "max_calib_range":
+                            node.attrs["max_calib_range"],
+                            "out_type": node.attrs.get("out_type", "int8")},
+                           list(acc_entry))
+                memo[id(node)] = rq
+                return rq
+        return new
+
+    return Symbol([(clone(n), i) for n, i in symbol._outputs])
+
+
+_rewrite_int8_fc = _rewrite_int8  # back-compat name
 
 
 def calib_graph(qsym, th_dict):
@@ -157,6 +239,8 @@ def quantize_model(sym_in, arg_params, aux_params, data_names=("data",),
                                        list(data_names), list(label_names))
         logger.info("calibrated %d layer output ranges", len(th_dict))
         sym_in = calib_graph(sym_in, th_dict)
-        # rewrite calibrated FC layers to real int8 subgraphs
-        sym_in = _rewrite_int8_fc(sym_in, qarg_params, th_dict, excluded)
+        # rewrite calibrated FC/conv/pooling layers to real int8 subgraphs,
+        # then fuse dequantize->quantize handoffs into requantize
+        sym_in = _rewrite_int8(sym_in, qarg_params, th_dict, excluded)
+        sym_in = _elide_dq_q(sym_in)
     return sym_in, qarg_params, aux_params

@@ -34,9 +34,29 @@ from .symbol.symbol import make_graph_fn
 __all__ = ["Executor"]
 
 
+def _fit_spec(spec, shape, mesh):
+    """Best-effort fit of a group PartitionSpec onto a tensor: keep an axis
+    assignment only where the dimension divides evenly (GSPMD-style; one
+    group covers tensors of many ranks, as ctx_group did placement-wise)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for d, ax in enumerate(tuple(spec)[:len(shape)]):
+        if ax is None:
+            out.append(None)
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        total = 1
+        for a in axes:
+            total *= sizes.get(a, 1)
+        out.append(ax if shape[d] % total == 0 else None)
+    return PartitionSpec(*out)
+
+
 def _as_device_list(ctx):
     if ctx is None:
         ctx = current_context()
+    if isinstance(ctx, Mesh):
+        return list(ctx.devices.flat)
     if isinstance(ctx, Context):
         return [ctx.jax_device()]
     if isinstance(ctx, (list, tuple)):
@@ -46,7 +66,8 @@ def _as_device_list(ctx):
 
 class Executor:
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None, data_names=None):
+                 grad_req="write", aux_states=None, data_names=None,
+                 group2ctx=None):
         self._symbol = symbol
         self._devices = _as_device_list(ctx)
         self._arg_names = symbol.list_arguments()
@@ -96,13 +117,46 @@ class Executor:
 
         # ---- sharding across the device mesh ---------------------------
         self._mesh = None
-        if len(self._devices) > 1:
+        if isinstance(ctx, Mesh):
+            self._mesh = ctx
+        elif len(self._devices) > 1:
             self._mesh = Mesh(_np.asarray(self._devices), ("data",))
+
+        # group2ctx consumption (reference: the PlaceDevice pass turns
+        # ctx_group attrs into placement, graph_executor.cc:408; here the
+        # groups map to PartitionSpecs and GSPMD plans the collectives):
+        # {group: PartitionSpec} shards every node/arg tagged with that
+        # ctx_group.  Context values (reference API) mean replicated.
+        self._group_specs = {}
+        if group2ctx:
+            for g, spec in group2ctx.items():
+                if isinstance(spec, PartitionSpec):
+                    self._group_specs[g] = spec
+                elif isinstance(spec, (tuple, list)):
+                    self._group_specs[g] = PartitionSpec(*spec)
+                else:  # Context — placement only, replicate
+                    self._group_specs[g] = PartitionSpec()
+        self._arg_groups = {}
+        sharding_map = None
+        if self._group_specs and self._mesh is not None:
+            sharding_map = {}
+            for node in symbol._nodes():
+                g = node.attrs.get("ctx_group")
+                if g is None or g not in self._group_specs:
+                    continue
+                if node.op is None:
+                    self._arg_groups[node.name] = g
+                else:
+                    # fitted per-output at trace time (shapes unknown here)
+                    sharding_map[node.name] = (self._mesh,
+                                               self._group_specs[g])
         self._place_arrays()
 
         # ---- compiled programs -----------------------------------------
-        self._graph_infer = make_graph_fn(symbol, train=False)
-        self._graph_train = make_graph_fn(symbol, train=True)
+        self._graph_infer = make_graph_fn(symbol, train=False,
+                                          sharding_map=sharding_map)
+        self._graph_train = make_graph_fn(symbol, train=True,
+                                          sharding_map=sharding_map)
         self._jit_infer = jax.jit(self._graph_infer)
         self._jit_train = jax.jit(self._graph_train)
 
@@ -130,8 +184,17 @@ class Executor:
     def _sharding(self, name):
         if self._mesh is None:
             return None
+        if name in self._arg_groups:
+            spec = self._group_specs[self._arg_groups[name]]
+            arr = self.arg_dict.get(name)
+            if arr is None:
+                arr = self.aux_dict.get(name)
+            if arr is not None:
+                spec = _fit_spec(spec, arr.shape, self._mesh)
+            return NamedSharding(self._mesh, spec)
         if name in self._data_names or name.endswith("_label"):
-            return NamedSharding(self._mesh, PartitionSpec("data"))
+            if "data" in self._mesh.axis_names:
+                return NamedSharding(self._mesh, PartitionSpec("data"))
         return NamedSharding(self._mesh, PartitionSpec())
 
     def _place_arrays(self):
@@ -149,7 +212,7 @@ class Executor:
     # ------------------------------------------------------------------
     @classmethod
     def simple_bind(cls, symbol, ctx=None, grad_req="write", type_dict=None,
-                    shapes=None, data_names=None):
+                    shapes=None, data_names=None, group2ctx=None):
         shapes = shapes or {}
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
         if arg_shapes is None:
@@ -170,7 +233,8 @@ class Executor:
         if data_names is None:
             data_names = [n for n in shapes if n in arg_names]
         return cls(symbol, ctx, args=args, grad_req=grad_req,
-                   aux_states=aux, data_names=data_names)
+                   aux_states=aux, data_names=data_names,
+                   group2ctx=group2ctx)
 
     # ------------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):

@@ -40,6 +40,9 @@ class Module(BaseModule):
         self._context = context if context is not None else cpu()
         self._fixed_param_names = list(fixed_param_names or [])
         self._state_names = list(state_names or [])
+        # group2ctxs: {ctx_group: PartitionSpec | Context} — consumed at
+        # bind time into GSPMD shardings (reference: PlaceDevice pass)
+        self._group2ctxs = group2ctxs
 
         arg_names = symbol.list_arguments()
         input_names = self._data_names + self._label_names + self._state_names
@@ -134,7 +137,8 @@ class Module(BaseModule):
         self._exec = Executor.simple_bind(
             self._symbol, self._context, grad_req=req, type_dict=type_dict,
             shapes=shapes,
-            data_names=self._data_names + self._label_names + self._state_names)
+            data_names=self._data_names + self._label_names + self._state_names,
+            group2ctx=self._group2ctxs)
         if shared_module is not None and shared_module._exec is not None:
             # share parameter arrays (BucketingModule memory sharing)
             for n in self._param_names:

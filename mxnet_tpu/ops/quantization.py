@@ -127,6 +127,93 @@ def quantized_fully_connected(data, weight, min_data, max_data,
     return acc, -out_max.reshape(1), out_max.reshape(1)
 
 
+def _qconv_optional(params):
+    if params.get("no_bias", True):
+        return ("bias", "min_bias", "max_bias")
+    return ()
+
+
+@register("_contrib_quantized_conv",
+          arg_names=["data", "weight", "min_data", "max_data",
+                     "min_weight", "max_weight", "bias", "min_bias",
+                     "max_bias"],
+          num_outputs=3, differentiable=False,
+          aliases=("quantized_conv",), optional_args=_qconv_optional)
+def quantized_conv(data, weight, min_data, max_data, min_weight, max_weight,
+                   bias=None, min_bias=None, max_bias=None, kernel=(),
+                   stride=(), dilate=(), pad=(), num_filter=0, num_group=1,
+                   no_bias=True, layout=None, workspace=1024,
+                   cudnn_tune=None, cudnn_off=False):
+    """int8×int8→int32 convolution (reference: quantized_conv.cu).  The
+    integer conv hits the MXU with an int32 accumulator; output carries the
+    (min, max) range of the int32 domain like the reference."""
+    from jax import lax
+    from .nn import _tup, _conv_layout
+
+    nsp = len(kernel) if kernel else data.ndim - 2
+    stride = _tup(stride, nsp) if stride else (1,) * nsp
+    dilate = _tup(dilate, nsp) if dilate else (1,) * nsp
+    pad = _tup(pad, nsp) if pad else (0,) * nsp
+    dimnum, channels_last = _conv_layout(layout, nsp)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, dimnum)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int32), weight.astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(num_group))
+    d_amax = jnp.maximum(jnp.abs(min_data.reshape(())),
+                         jnp.abs(max_data.reshape(())))
+    w_amax = jnp.maximum(jnp.abs(min_weight.reshape(())),
+                         jnp.abs(max_weight.reshape(())))
+    out_scale = (d_amax / _INT8_MAX) * (w_amax / _INT8_MAX)
+    if bias is not None and not no_bias:
+        b_amax = jnp.maximum(jnp.abs(min_bias.reshape(())),
+                             jnp.abs(max_bias.reshape(())))
+        b_real = bias.astype(jnp.float32) * (b_amax / _INT8_MAX)
+        bshape = (1,) * (nsp + 1) + (-1,) if channels_last \
+            else (1, -1) + (1,) * nsp
+        acc = acc + jnp.round(b_real / out_scale).astype(jnp.int32) \
+            .reshape(bshape)
+    out_max = out_scale * (2.0 ** 31 - 1)
+    return acc, -out_max.reshape(1), out_max.reshape(1)
+
+
+@register("_contrib_quantized_pooling",
+          arg_names=["data", "min_data", "max_data"], num_outputs=3,
+          differentiable=False, aliases=("quantized_pooling",))
+def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                      global_pool=False, pooling_convention="valid",
+                      stride=(), pad=(), count_include_pad=True,
+                      layout=None, cudnn_off=False):
+    """Pooling on int8 tensors (reference: quantized_pooling.cc): max pool
+    compares int8 directly; avg pool accumulates in int32 and rounds back.
+    The (min, max) range passes through unchanged."""
+    from .nn import pooling
+
+    if pool_type == "max":
+        out = pooling(data, kernel=kernel, pool_type="max",
+                      global_pool=global_pool,
+                      pooling_convention=pooling_convention, stride=stride,
+                      pad=pad, layout=layout)
+    else:
+        acc = pooling(data.astype(jnp.int32), kernel=kernel, pool_type="sum",
+                      global_pool=global_pool,
+                      pooling_convention=pooling_convention, stride=stride,
+                      pad=pad, layout=layout)
+        if global_pool:
+            sp = data.shape[1:-1] if layout in ("NWC", "NHWC", "NDHWC") \
+                else data.shape[2:]
+            denom = 1
+            for s in sp:
+                denom *= s
+        else:
+            denom = 1
+            for k in (kernel if kernel else ()):
+                denom *= k
+        out = jnp.clip(jnp.round(acc / denom), -127, 127).astype(data.dtype)
+    return out, min_data.reshape(1), max_data.reshape(1)
+
+
 def calib_minmax(arrays):
     """Min/max calibration over representative activations
     (reference: contrib/quantization.py _collect_layer_output_min_max)."""

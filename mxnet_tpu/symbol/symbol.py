@@ -508,7 +508,8 @@ class Symbol:
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
         return Executor(self, ctx, args=args, args_grad=args_grad,
-                        grad_req=grad_req, aux_states=aux_states)
+                        grad_req=grad_req, aux_states=aux_states,
+                        group2ctx=group2ctx)
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     shared_arg_names=None, shared_exec=None,
@@ -530,7 +531,7 @@ class Symbol:
 # ---------------------------------------------------------------------------
 def _attr_params(op, attrs):
     params = {k: _reg.canonicalize(v) for k, v in attrs.items()
-              if not k.startswith("__")}
+              if not k.startswith("__") and k not in _EXECUTOR_ATTRS}
     if op is not None and op.needs_train:
         params["_train"] = False
     return params
@@ -611,11 +612,24 @@ def _infer_entry_shapes(heads, known_shapes, known_dtypes, need_shapes=True):
 # ---------------------------------------------------------------------------
 # graph evaluation — shared by Executor and Module
 # ---------------------------------------------------------------------------
-def make_graph_fn(symbol, train):
+# attrs consumed by the executor (placement/learning-rate metadata), never
+# forwarded to op kernels — the reference strips these in the same way
+# (nnvm attrs vs op params)
+_EXECUTOR_ATTRS = frozenset({
+    "ctx_group", "lr_mult", "wd_mult", "force_mirroring", "mirror_stage",
+})
+
+
+def make_graph_fn(symbol, train, sharding_map=None):
     """Build fn(arg_dict, aux_dict) -> (list outputs, new_aux_dict) — a pure
     jax function over the DAG, suitable for jit/vjp.  The reference analogue
     is GraphExecutor::RunOps over cached engine ops; XLA compiles the whole
-    thing into one program instead."""
+    thing into one program instead.
+
+    ``sharding_map``: {node_name: jax sharding} — outputs of those nodes
+    get ``lax.with_sharding_constraint``, the GSPMD consumption of the
+    reference's ``ctx_group``/PlaceDevice pass
+    (src/executor/graph_executor.cc:408)."""
     order = symbol._nodes()
     heads = symbol._outputs
 
@@ -627,6 +641,7 @@ def make_graph_fn(symbol, train):
             return _graph_eval(arg_dict, aux_dict)
 
     def _graph_eval(arg_dict, aux_dict):
+        import jax as _jax
         env = {}
         new_aux = dict(aux_dict)
         for n in order:
@@ -638,12 +653,18 @@ def make_graph_fn(symbol, train):
                 continue
             op = _reg.get(n.op)
             params = {k: _reg.canonicalize(v) for k, v in n.attrs.items()
-                      if not k.startswith("__")}
+                      if not k.startswith("__") and k not in _EXECUTOR_ATTRS}
             if op.needs_train:
                 params["_train"] = train
             ins = [env[(id(c), oi)] for c, oi in n.inputs]
             out = op.fn(*ins, **params)
             outs = out if isinstance(out, (tuple, list)) else (out,)
+            if sharding_map and n.name in sharding_map:
+                mesh, spec = sharding_map[n.name]
+                from jax.sharding import NamedSharding as _NS
+                from ..executor import _fit_spec as _fit
+                outs = tuple(_jax.lax.with_sharding_constraint(
+                    o, _NS(mesh, _fit(spec, o.shape, mesh))) for o in outs)
             for i, o in enumerate(outs):
                 env[(id(n), i)] = o
             if train and op.aux_update is not None and not params.get("use_global_stats"):
@@ -705,6 +726,9 @@ def _sym_invoke(op, op_name, args, kwargs):
         entries = []
         no_bias = params.get("no_bias", _reg.canonicalize(params.get("no_bias", False)))
         optional = op.optional(_reg.canonicalize_kwargs(params))
+        # auto-created variables inherit the scope attrs (ctx_group,
+        # lr_mult, ...) exactly as the reference's AttrScope does
+        scope_attrs = AttrScope._current.get({})
         for an in names:
             if an in slots:
                 entries.append(slots[an]._outputs[0])
@@ -715,15 +739,17 @@ def _sym_invoke(op, op_name, args, kwargs):
                     continue
                 if an in ("label",) and an not in slots:
                     # SoftmaxOutput etc: auto label variable named <name>_label
-                    vnode = _Node(None, "%s_%s" % (name, an))
+                    vnode = _Node(None, "%s_%s" % (name, an),
+                                  dict(scope_attrs))
                     entries.append((vnode, 0))
                     continue
                 # auto-create parameter/aux variable <name>_<argname>
                 if an == names[0]:
-                    vnode = _Node(None, "%s_%s" % (name, an))
+                    vnode = _Node(None, "%s_%s" % (name, an),
+                                  dict(scope_attrs))
                 else:
                     vnode = _Node(None, "%s_%s" % (name, an),
-                                  is_aux=an in aux_names)
+                                  dict(scope_attrs), is_aux=an in aux_names)
                 entries.append((vnode, 0))
 
     attrs = AttrScope._current.get(attr or {})

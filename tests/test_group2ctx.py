@@ -1,0 +1,112 @@
+"""ctx_group → GSPMD shardings (VERDICT r1 item 7).
+
+Reference: AttrScope(ctx_group=...) + bind(group2ctx=...) drive the
+PlaceDevice pass (src/executor/graph_executor.cc:408); here groups map to
+PartitionSpecs over a jax Mesh and GSPMD plans the collectives.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mesh(axis="model", n=None):
+    devs = jax.devices()
+    n = n or min(len(devs), 8)
+    if n < 2:
+        pytest.skip("needs multi-device mesh")
+    return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def _two_group_net():
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="g0"):
+        fc1 = sym.FullyConnected(data, num_hidden=32, name="fc1")
+        act = sym.Activation(fc1, act_type="relu")
+    with mx.AttrScope(ctx_group="g1"):
+        fc2 = sym.FullyConnected(act, num_hidden=16, name="fc2")
+    return fc2
+
+
+def test_groups_land_different_shardings():
+    mesh = _mesh()
+    net = _two_group_net()
+    rng = np.random.RandomState(0)
+    args = {"data": rng.randn(4, 8).astype(np.float32),
+            "fc1_weight": rng.randn(32, 8).astype(np.float32),
+            "fc1_bias": np.zeros(32, np.float32),
+            "fc2_weight": rng.randn(16, 32).astype(np.float32),
+            "fc2_bias": np.zeros(16, np.float32)}
+    exe = net.bind(mesh, args=args,
+                   group2ctx={"g0": PartitionSpec("model"),
+                              "g1": PartitionSpec(None, "model")})
+    s0 = exe.arg_dict["fc1_weight"]._data.sharding
+    s1 = exe.arg_dict["fc2_weight"]._data.sharding
+    assert s0.spec == PartitionSpec("model")
+    assert s1.spec == PartitionSpec(None, "model")
+    assert s0.spec != s1.spec
+    # the compiled step runs and matches the unsharded execution
+    out = exe.forward(is_train=True)[0].asnumpy()
+    exe_ref = net.bind(None, args=args)
+    ref = exe_ref.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+    # backward flows across the group boundary
+    g = exe.backward()
+    assert all(np.isfinite(x.asnumpy()).all() for x in g)
+
+
+def test_ctx_group_attr_not_leaked_to_kernels():
+    """ctx_group is executor metadata, not an op kwarg."""
+    with mx.AttrScope(ctx_group="anything"):
+        out = sym.Activation(sym.Variable("x"), act_type="relu")
+    exe = out.bind(None, args={"x": np.ones((2, 2), np.float32)})
+    res = exe.forward()[0].asnumpy()
+    np.testing.assert_array_equal(res, np.ones((2, 2), np.float32))
+
+
+def test_group_spec_fits_small_dims():
+    """A group spec that doesn't divide a tensor's dim falls back to
+    replication for that dim (one group covers many ranks)."""
+    from mxnet_tpu.executor import _fit_spec
+    mesh = _mesh()
+    spec = PartitionSpec("model")
+    assert _fit_spec(spec, (1, 4), mesh) == PartitionSpec(None)
+    assert _fit_spec(spec, (16, 4), mesh) == PartitionSpec("model")
+    assert _fit_spec(PartitionSpec(None, "model"), (3, 16), mesh) == \
+        PartitionSpec(None, "model")
+
+
+def test_module_group2ctxs():
+    """Module(group2ctxs=...) reaches the executor (reference: Module's
+    group2ctxs argument)."""
+    mesh = _mesh()
+    net = sym.SoftmaxOutput(_two_group_net(), name="softmax")
+    mod = mx.mod.Module(net, context=mesh,
+                        group2ctxs={"g0": PartitionSpec("model"),
+                                    "g1": PartitionSpec(None, "model")})
+    it = mx.io.NDArrayIter(np.random.rand(16, 8).astype(np.float32),
+                           (np.arange(16) % 4).astype(np.float32), 8)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    assert mod._exec.arg_dict["fc1_weight"]._data.sharding.spec == \
+        PartitionSpec("model")
+    mod.forward(next(iter(it)), is_train=True)
+    mod.backward()
+    assert np.isfinite(mod.get_outputs()[0].asnumpy()).all()
+
+
+def test_model_parallel_lstm_example_trains():
+    """The model-parallel LSTM example (reference:
+    example/model-parallel/lstm/lstm.py) trains under group shardings."""
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "model_parallel_lstm", "lstm.py")
+    spec = importlib.util.spec_from_file_location("mp_lstm", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()

@@ -719,6 +719,8 @@ ALSO_COVERED = {
     "_contrib_getnnz": "test_contrib.py",
     "_contrib_flash_attention": "test_flash_backward.py",
     "_contrib_quantize": "test_linalg_cf_quant.py",
+    "_contrib_quantized_conv": "test_quantization_int8.py",
+    "_contrib_quantized_pooling": "test_quantization_int8.py",
     "_contrib_requantize": "test_linalg_cf_quant.py",
     "_contrib_quantized_fully_connected": "test_linalg_cf_quant.py",
     "_linalg_gemm": "test_linalg_cf_quant.py",
