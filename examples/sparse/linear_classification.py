@@ -19,33 +19,39 @@ import mxnet_tpu as mx
 from mxnet_tpu.ndarray import sparse
 
 
-def synthetic_libsvm(num_samples, feat_dim, nnz, rng):
-    """Sparse features with a planted linear rule."""
+def write_libsvm(path, num_samples, feat_dim, nnz, rng):
+    """Write a LibSVM text file with a planted linear rule (the input
+    format of the reference's example/sparse/linear_classification)."""
     w_true = rng.randn(feat_dim).astype(np.float32)
-    rows = []
-    labels = []
-    for _ in range(num_samples):
-        idx = rng.choice(feat_dim, nnz, replace=False)
-        val = rng.randn(nnz).astype(np.float32)
-        rows.append((idx, val))
-        labels.append(1.0 if (w_true[idx] * val).sum() > 0 else 0.0)
-    return rows, np.asarray(labels, np.float32)
+    with open(path, "w") as f:
+        for _ in range(num_samples):
+            idx = np.sort(rng.choice(feat_dim, nnz, replace=False))
+            val = rng.randn(nnz).astype(np.float32)
+            label = 1.0 if (w_true[idx] * val).sum() > 0 else 0.0
+            toks = " ".join("%d:%.5f" % (i, v) for i, v in zip(idx, val))
+            f.write("%g %s\n" % (label, toks))
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--feat-dim", type=int, default=10000)
+    parser.add_argument("--feat-dim", type=int, default=1000)
     parser.add_argument("--nnz", type=int, default=20)
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--num-batches", type=int, default=100)
-    parser.add_argument("--lr", type=float, default=0.5)
+    parser.add_argument("--lr", type=float, default=1.0)
     parser.add_argument("--kv-store", default="local")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     rng = np.random.RandomState(0)
 
-    rows, labels = synthetic_libsvm(args.batch_size * args.num_batches,
-                                    args.feat_dim, args.nnz, rng)
+    import tempfile
+    path = os.path.join(tempfile.mkdtemp(prefix="mxtpu_libsvm_"),
+                        "train.libsvm")
+    write_libsvm(path, args.batch_size * args.num_batches, args.feat_dim,
+                 args.nnz, rng)
+    # LibSVMIter yields CSR batches (reference: src/io/iter_libsvm.cc)
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(args.feat_dim,),
+                          batch_size=args.batch_size)
 
     # row_sparse weight lives on the kvstore with a server-side optimizer:
     # push(grad) applies SGD to the stored weight, row_sparse_pull fetches
@@ -55,36 +61,32 @@ def main():
     kv.init("weight", mx.nd.zeros((args.feat_dim, 1)))
     kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=args.lr))
 
+    w_local = mx.nd.zeros((args.feat_dim, 1))
     correct = total = 0
-    for step in range(args.num_batches):
-        batch = rows[step * args.batch_size:(step + 1) * args.batch_size]
-        y = labels[step * args.batch_size:(step + 1) * args.batch_size]
-        batch_rows = np.unique(np.concatenate([i for i, _ in batch]))
-        pulled = sparse.row_sparse_array(
-            (np.zeros((len(batch_rows), 1), np.float32), batch_rows),
-            shape=(args.feat_dim, 1))
-        kv.row_sparse_pull("weight", out=pulled,
+    for step, batch in enumerate(it):
+        x_csr = batch.data[0]                   # CSRNDArray (B, feat_dim)
+        y = batch.label[0].asnumpy()
+        batch_rows = np.unique(x_csr.indices.asnumpy())
+        kv.row_sparse_pull("weight", out=w_local,
                            row_ids=mx.nd.array(batch_rows.astype(np.float32)))
-        w_rows = pulled.data.asnumpy()[:, 0]
-        lookup = {r: i for i, r in enumerate(batch_rows)}
-
-        # forward + logistic grad in one pass over the sparse rows
-        grad_vals = np.zeros_like(w_rows)
-        for (idx, val), lab in zip(batch, y):
-            score = sum(w_rows[lookup[i]] * v for i, v in zip(idx, val))
-            p = 1.0 / (1.0 + np.exp(-score))
-            correct += int((p > 0.5) == bool(lab))
-            total += 1
-            for i, v in zip(idx, val):
-                grad_vals[lookup[i]] += (p - lab) * v
-        grad = sparse.row_sparse_array(
-            (grad_vals[:, None] / args.batch_size, batch_rows),
-            shape=(args.feat_dim, 1))
+        # forward: device-side CSR x dense (segment-sum kernel, no densify)
+        score = sparse.dot(x_csr, w_local).asnumpy()[:, 0]
+        p = 1.0 / (1.0 + np.exp(-score))
+        correct += int(((p > 0.5) == (y > 0.5)).sum())
+        total += len(y)
+        # grad wrt w = X^T (p - y) / B, via the transpose sparse dot,
+        # shipped as row_sparse over only the touched rows
+        err = ((p - y) / len(y)).astype(np.float32)[:, None]
+        gw = sparse.dot(x_csr, mx.nd.array(err), transpose_a=True)
+        grad = sparse.retain(
+            sparse.cast_storage(gw, "row_sparse"),
+            mx.nd.array(batch_rows.astype(np.int64), dtype=np.int64))
         kv.push("weight", grad)   # server-side SGD update
         if step % 20 == 0:
             logging.info("step %d  running acc %.3f", step,
                          correct / max(total, 1))
     logging.info("final running accuracy: %.3f", correct / total)
+    assert correct / total > 0.7, "sparse linear model failed to learn"
 
 
 if __name__ == "__main__":

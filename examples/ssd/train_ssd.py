@@ -49,19 +49,30 @@ def build_net(num_classes, num_anchors):
     return mx.sym.Group([anchors, cls_pred, loc_pred])
 
 
-def synthetic_batch(rng, batch_size, num_classes):
-    """Images with one colored square; label = [cls, x1, y1, x2, y2]."""
-    imgs = np.zeros((batch_size, 3, 64, 64), np.float32)
-    labels = np.full((batch_size, 1, 5), -1.0, np.float32)
-    for b in range(batch_size):
+def pack_det_records(path_prefix, num_images, num_classes, rng):
+    """Pack a synthetic detection .rec: images with one colored square,
+    labels in the det header format [header_w, obj_w, cls, x1, y1, x2, y2]
+    (reference: tools/im2rec + iter_image_det_recordio.cc contract)."""
+    import io as pyio
+    from PIL import Image
+    from mxnet_tpu import recordio
+    rec, idx = path_prefix + ".rec", path_prefix + ".idx"
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(num_images):
         cls = rng.randint(num_classes)
         cx, cy = rng.uniform(0.3, 0.7, 2)
         s = rng.uniform(0.15, 0.3)
         x1, y1, x2, y2 = cx - s, cy - s, cx + s, cy + s
+        img = np.zeros((64, 64, 3), np.uint8)
         xi = [int(v * 64) for v in (x1, y1, x2, y2)]
-        imgs[b, cls, xi[1]:xi[3], xi[0]:xi[2]] = 1.0
-        labels[b, 0] = [cls, x1, y1, x2, y2]
-    return mx.nd.array(imgs), mx.nd.array(labels)
+        img[xi[1]:xi[3], xi[0]:xi[2], cls] = 255
+        buf = pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=95)
+        label = np.asarray([2.0, 5.0, cls, x1, y1, x2, y2], np.float32)
+        hdr = recordio.IRHeader(len(label), label, i, 0)
+        writer.write_idx(i, recordio.pack(hdr, buf.getvalue()))
+    writer.close()
+    return rec, idx
 
 
 def main():
@@ -75,6 +86,16 @@ def main():
     rng = np.random.RandomState(0)
     num_anchors = 4  # len(sizes) + len(ratios) - 1
 
+    # real det-record pipeline (reference: train.py feeds
+    # ImageDetRecordIter over a packed .rec)
+    import tempfile
+    prefix = os.path.join(tempfile.mkdtemp(prefix="mxtpu_ssd_"), "det")
+    rec, idx = pack_det_records(prefix, args.batch_size * 8,
+                                args.num_classes, rng)
+    it = mx.io.ImageDetRecordIter(
+        path_imgrec=rec, path_imgidx=idx, batch_size=args.batch_size,
+        data_shape=(3, 64, 64), shuffle=True, rand_mirror=True)
+
     net = build_net(args.num_classes, num_anchors)
     ex = net.simple_bind(data=(args.batch_size, 3, 64, 64),
                          grad_req="write")
@@ -87,8 +108,16 @@ def main():
         "sgd", learning_rate=args.lr, momentum=0.9,
         rescale_grad=1.0 / args.batch_size))
 
+    def batches():
+        while True:
+            it.reset()
+            for b in it:
+                if b.data[0].shape[0] == args.batch_size:
+                    yield b.data[0] / 255.0, b.label[0]
+
+    batch_gen = batches()
     for step in range(args.num_batches):
-        x, y = synthetic_batch(rng, args.batch_size, args.num_classes)
+        x, y = next(batch_gen)
         anchors, cls_pred, loc_pred = ex.forward(is_train=True, data=x)
         loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
             anchors, y, cls_pred, negative_mining_ratio=3.0)
@@ -121,8 +150,8 @@ def main():
         if step % 10 == 0:
             logging.info("step %d  loss %.4f", step, float(loss))
 
-    # inference: decode + NMS
-    x, y = synthetic_batch(rng, args.batch_size, args.num_classes)
+    # inference: decode + NMS on a fresh batch from the record pipeline
+    x, y = next(batch_gen)
     anchors, cls_pred, loc_pred = ex.forward(is_train=False, data=x)
     cls_prob = mx.nd.softmax(cls_pred, axis=1)
     det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,

@@ -22,7 +22,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array as _nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter", "ImageDetRecordIter"]
 
 
 def ImageRecordIter(**kwargs):
@@ -398,6 +399,123 @@ class CSVIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text-format iterator yielding CSR batches
+    (reference: src/io/iter_libsvm.cc — "label idx:val idx:val ..." lines,
+    zero-based indices; labels from a separate file when ``label_libsvm``
+    is given, else the leading value per line).
+
+    data comes out as CSRNDArray (batch_size, *data_shape) — the sparse
+    storage the row-sparse linear models train on."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, num_parts=1, part_index=0,
+                 round_batch=True, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape)
+        self._feat_dim = 1
+        for d in self._data_shape:
+            self._feat_dim *= d
+        rows, inline_labels = self._parse(data_libsvm, with_label=True)
+        if label_libsvm is not None:
+            lab_rows, _ = self._parse(label_libsvm, with_label=False)
+            labels = _np.asarray([r[1][0] if len(r[1]) else 0.0
+                                  for r in lab_rows], _np.float32)
+        else:
+            labels = _np.asarray(inline_labels, _np.float32)
+        # worker sharding, as the reference's num_parts/part_index
+        if num_parts > 1:
+            n_per = len(rows) // num_parts
+            rows = rows[part_index * n_per:(part_index + 1) * n_per]
+            labels = labels[part_index * n_per:(part_index + 1) * n_per]
+        self._rows = rows
+        self._labels = labels
+        self._round_batch = round_batch
+        self._cursor = 0
+        self.data_name = data_name
+        self.label_name = label_name
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self._data_shape)]
+        self.provide_label = [DataDesc(label_name, (batch_size,))]
+
+    @staticmethod
+    def _parse(path, with_label):
+        rows, labels = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                start = 0
+                if with_label:
+                    labels.append(float(parts[0]))
+                    start = 1
+                idx, val = [], []
+                for tok in parts[start:]:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                rows.append((_np.asarray(idx, _np.int64),
+                             _np.asarray(val, _np.float32)))
+        return rows, labels
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from .ndarray import sparse
+        if self._cursor >= len(self._rows):
+            raise StopIteration
+        take = self._rows[self._cursor:self._cursor + self.batch_size]
+        labs = self._labels[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        pad = self.batch_size - len(take)
+        if pad and self._round_batch:
+            take = list(take) + [self._rows[-1]] * pad
+            labs = _np.concatenate([labs,
+                                    _np.repeat(labs[-1:], pad)])
+        else:
+            pad = 0
+        indptr = _np.zeros(len(take) + 1, _np.int64)
+        cols, vals = [], []
+        for i, (idx, val) in enumerate(take):
+            cols.append(idx)
+            vals.append(val)
+            indptr[i + 1] = indptr[i] + len(idx)
+        cols = _np.concatenate(cols) if cols else _np.zeros(0, _np.int64)
+        vals = _np.concatenate(vals) if vals else _np.zeros(0, _np.float32)
+        data = sparse.CSRNDArray(
+            _nd_array(vals), _nd_array(cols, dtype="int64"),
+            _nd_array(indptr, dtype="int64"),
+            (len(take), self._feat_dim))
+        return DataBatch([data], [_nd_array(labs)], pad=pad)
+
+
+def ImageDetRecordIter(**kwargs):
+    """Detection record iterator (reference: src/io/
+    iter_image_det_recordio.cc).  Name-parity wrapper over
+    image.ImageDetIter with the C kwargs mapped (mean_r/g/b etc.)."""
+    from .image.detection import ImageDetIter
+    mean = None
+    if any(k in kwargs for k in ("mean_r", "mean_g", "mean_b")):
+        mean = _np.array([kwargs.pop("mean_r", 0.0),
+                          kwargs.pop("mean_g", 0.0),
+                          kwargs.pop("mean_b", 0.0)], dtype=_np.float32)
+    std = None
+    if any(k in kwargs for k in ("std_r", "std_g", "std_b")):
+        std = _np.array([kwargs.pop("std_r", 1.0),
+                         kwargs.pop("std_g", 1.0),
+                         kwargs.pop("std_b", 1.0)], dtype=_np.float32)
+    kwargs.pop("preprocess_threads", None)
+    kwargs.pop("prefetch_buffer", None)
+    if kwargs.pop("round_batch", True):
+        kwargs.setdefault("last_batch_handle", "pad")
+    else:
+        kwargs.setdefault("last_batch_handle", "keep")
+    return ImageDetIter(mean=mean, std=std, **kwargs)
 
 
 class MNISTIter(DataIter):

@@ -66,6 +66,8 @@ class KVStore:
         self._compression = None
         self._compression_residuals = {}
         self._is_dist = kv_type in _DIST_TYPES
+        self._ps_client = None
+        self._ps_server = None
         if self._is_dist:
             _check_dist_env()
             self._rank = jax.process_index()
@@ -73,6 +75,25 @@ class KVStore:
         else:
             self._rank = 0
             self._num_workers = 1
+        if kv_type == "dist_async" and self._num_workers > 1:
+            self._start_ps()
+
+    def _start_ps(self):
+        """dist_async rides a host-side parameter server on rank 0 — async
+        per-push application is what a collective cannot express
+        (reference: kvstore_dist_server.h:285)."""
+        import os
+        from . import kvstore_ps
+        host = os.environ.get("JAX_COORDINATOR_ADDRESS",
+                              "127.0.0.1:0").split(":")[0]
+        port = int(os.environ.get("MXTPU_PS_PORT", "0"))
+        if not port:
+            raise MXNetError(
+                "dist_async needs MXTPU_PS_PORT (tools/launch.py sets it)")
+        if self._rank == 0:
+            self._ps_server = kvstore_ps.PSServer(
+                port=port, num_workers=self._num_workers)
+        self._ps_client = kvstore_ps.PSClient(host, port)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -89,7 +110,13 @@ class KVStore:
         for k, v in zip(keys, values):
             if k in self._store:
                 raise MXNetError("key %r already initialized" % (k,))
-            self._store[k] = v if isinstance(v, NDArray) else nd.array(v)
+            arr = v if isinstance(v, NDArray) else nd.array(v)
+            self._store[k] = arr
+            if self._ps_client is not None:
+                import numpy as _np
+                self._ps_client.request("init", k,
+                                        _np.asarray(arr.asnumpy(),
+                                                    _np.float32))
 
     def _merge(self, vlist):
         """Sum a list of same-key arrays (Comm::Reduce analogue, comm.h:451)."""
@@ -113,9 +140,18 @@ class KVStore:
         for k, v in zip(keys, values):
             vlist = v if isinstance(v, (list, tuple)) else [v]
             merged = self._merge(list(vlist))
+            if self._ps_client is not None:
+                self._ps_push(k, merged)
+                continue
             if self._compression is not None:
                 merged = self._compress(k, merged)
-            if self._is_dist and self._num_workers > 1:
+                if self._is_dist and self._num_workers > 1:
+                    # compressed wire path: all-gather the packed 2-bit
+                    # payloads (16x narrower than an fp32 psum), decode and
+                    # sum locally (reference: gradient_compression.h)
+                    merged = _cross_process_sum_packed(
+                        merged, self._compression["threshold"])
+            elif self._is_dist and self._num_workers > 1:
                 merged = _cross_process_sum(merged)
             stored = self._store.get(k)
             if stored is None:
@@ -128,10 +164,38 @@ class KVStore:
                     merged = merged.todense()
                 stored._set_data(merged._data)
 
+    def _ps_push(self, k, merged):
+        """Async push: ships the gradient to the PS, which applies it
+        immediately — no cross-worker rendezvous of any kind."""
+        import numpy as _np
+        from .ndarray.sparse import RowSparseNDArray
+        from . import kvstore_ps
+        if isinstance(merged, RowSparseNDArray):
+            payload = (_np.asarray(merged.indices.asnumpy(), _np.int64),
+                       _np.asarray(merged.data.asnumpy(), _np.float32),
+                       tuple(merged.shape))
+            self._ps_client.request("push", k, "rsp", payload)
+            return
+        if self._compression is not None:
+            q = self._compress(k, merged)
+            thr = self._compression["threshold"]
+            packed, shape = kvstore_ps.pack_2bit(q.asnumpy(), thr)
+            self._ps_client.request("push", k, "2bit",
+                                    (packed, shape, thr))
+            return
+        self._ps_client.request(
+            "push", k, "dense", _np.asarray(merged.asnumpy(), _np.float32))
+
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out, allow_list_values=True)
         for k, o in zip(keys, outs):
-            stored = self._store[k]
+            if self._ps_client is not None:
+                import jax.numpy as _jnp
+                arr = self._ps_client.request("pull", k)[1]
+                stored = self._store[k]
+                stored._set_data(_jnp.asarray(arr))
+            else:
+                stored = self._store[k]
             olist = o if isinstance(o, (list, tuple)) else [o]
             for dst in olist:
                 dst._set_data(stored._data)
@@ -169,6 +233,11 @@ class KVStore:
         it to PS servers, python/mxnet/kvstore.py:443)."""
         if isinstance(optimizer, str):
             optimizer = opt.create(optimizer)
+        if self._ps_client is not None:
+            # shipped to the server exactly as the reference does
+            self._ps_client.request("set_optimizer",
+                                    pickle.dumps(optimizer))
+            return
         # round-trip through pickle like the reference to guarantee the
         # optimizer is serializable for multi-host shipping
         optimizer = pickle.loads(pickle.dumps(optimizer))
@@ -197,6 +266,9 @@ class KVStore:
 
     # -- cluster control ---------------------------------------------------
     def barrier(self):
+        if self._ps_client is not None:
+            self._ps_client.request("barrier")
+            return
         if self._is_dist and self._num_workers > 1:
             _cross_process_sum(nd.ones((1,)))
 
@@ -267,6 +339,38 @@ def _allsum_program():
 
 def _sum_axis0(a):
     return jnp.sum(a, axis=0)
+
+
+def _cross_process_sum_packed(q_arr, threshold):
+    """Compressed cross-host reduction: the wire carries packed 2-bit codes
+    (uint8, 4 values/byte) via all-gather; every host decodes the other
+    workers' payloads locally and sums in fp32.  Moves ~W x n/4 bytes vs
+    the psum's ~4n (reference: gradient_compression.h wire format)."""
+    import numpy as _np
+    from . import kvstore_ps
+    if jax.process_count() == 1:
+        return q_arr
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    packed, shape = kvstore_ps.pack_2bit(_np.asarray(q_arr.asnumpy()),
+                                         threshold)
+    mesh, my_dev, _ = _allsum_program()
+    gather = _allgather_program()
+    shard = jax.device_put(packed[None], my_dev)
+    global_arr = jax.make_array_from_single_device_arrays(
+        (jax.process_count(),) + packed.shape,
+        NamedSharding(mesh, P("hosts")), [shard])
+    gathered = _np.asarray(gather(global_arr).addressable_data(0))
+    total = _np.zeros(shape, _np.float32)
+    for w in range(gathered.shape[0]):
+        total += kvstore_ps.unpack_2bit(gathered[w], shape, threshold)
+    return NDArray(jnp.asarray(total))
+
+
+@_functools.lru_cache(maxsize=1)
+def _allgather_program():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh, _, _ = _allsum_program()
+    return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
 
 
 def _key_value(key, value, allow_list_values=False):

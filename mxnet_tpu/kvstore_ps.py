@@ -1,0 +1,271 @@
+"""Parameter-server transport for ``dist_async``.
+
+Reference: ``src/kvstore/kvstore_dist_server.h`` — the async mode applies
+each worker's push to the stored weight the moment it arrives (line 285:
+no cross-worker barrier; workers train on mixed-staleness weights), and
+``gradient_compression.h`` ships 2-bit-quantized payloads over the wire.
+
+TPU-native mapping: the synchronous types ride XLA collectives
+(kvstore.py), but *async* semantics are precisely what a collective
+cannot express — so the PS role survives here as a small host-side TCP
+server on rank 0 (the dmlc ps-lite analogue), applying updates per-push
+under a key lock.  Payloads cross DCN as numpy bytes; with gradient
+compression enabled the wire carries 4-values-per-byte packed 2-bit
+codes + one threshold scalar — a real 16x narrowing vs fp32.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["PSServer", "PSClient", "pack_2bit", "unpack_2bit"]
+
+
+# ---------------------------------------------------------------------------
+# 2-bit payload packing (reference: gradient_compression.h Quantize2Bit)
+# ---------------------------------------------------------------------------
+def pack_2bit(values, threshold):
+    """{-t, 0, +t} float array -> (packed uint8 [ceil(n/4)], shape).
+    Codes: 0 -> 0, +t -> 1, -t -> 2."""
+    flat = np.asarray(values, np.float32).reshape(-1)
+    codes = np.zeros(flat.size, np.uint8)
+    codes[flat > 0] = 1
+    codes[flat < 0] = 2
+    pad = (-flat.size) % 4
+    if pad:
+        codes = np.concatenate([codes, np.zeros(pad, np.uint8)])
+    codes = codes.reshape(-1, 4)
+    packed = (codes[:, 0] | (codes[:, 1] << 2) | (codes[:, 2] << 4)
+              | (codes[:, 3] << 6)).astype(np.uint8)
+    return packed, values.shape
+
+
+def unpack_2bit(packed, shape, threshold):
+    """Inverse of pack_2bit."""
+    p = np.asarray(packed, np.uint8)
+    codes = np.stack([p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3],
+                     axis=1).reshape(-1)
+    n = int(np.prod(shape))
+    codes = codes[:n]
+    out = np.zeros(n, np.float32)
+    out[codes == 1] = threshold
+    out[codes == 2] = -threshold
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# framing: 4-byte length prefix + pickled message
+# ---------------------------------------------------------------------------
+def _send(sock, obj):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv(sock):
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack("<I", hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class PSServer:
+    """Host-side async parameter server (runs as a thread on rank 0)."""
+
+    def __init__(self, port=0, num_workers=1):
+        self._store = {}
+        self._locks = {}
+        self._updater = None
+        self._store_lock = threading.Lock()
+        self._num_workers = num_workers
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    # -- server loop -------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv(conn)
+                if msg is None:
+                    return
+                reply = self._handle(msg)
+                _send(conn, reply)
+        except (OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    def _key_lock(self, key):
+        with self._store_lock:
+            return self._locks.setdefault(key, threading.Lock())
+
+    def _handle(self, msg):
+        cmd = msg[0]
+        if cmd == "init":
+            _, key, arr = msg
+            with self._key_lock(key):
+                # first init wins (reference: server keeps the first copy)
+                if key not in self._store:
+                    self._store[key] = np.array(arr, np.float32)
+            return ("ok",)
+        if cmd == "set_optimizer":
+            _, blob = msg
+            from . import optimizer as opt_mod
+            optimizer = pickle.loads(blob)
+            self._updater = opt_mod.get_updater(optimizer)
+            return ("ok",)
+        if cmd == "push":
+            _, key, kind, payload = msg
+            grad = self._decode(kind, payload)
+            with self._key_lock(key):
+                stored = self._store.get(key)
+                if stored is None:
+                    return ("err", "key %r not initialized" % (key,))
+                if self._updater is not None:
+                    # applied immediately — the async server never waits
+                    # for other workers (kvstore_dist_server.h:285)
+                    from .ndarray import NDArray
+                    import jax.numpy as jnp
+                    w = NDArray(jnp.asarray(stored))
+                    g = self._as_nd(grad)
+                    self._updater(key, g, w)
+                    self._store[key] = np.asarray(w._data)
+                else:
+                    g = grad if not isinstance(grad, tuple) else None
+                    if g is None:
+                        idx, vals, shape = grad[1]
+                        dense = np.zeros(shape, np.float32)
+                        np.add.at(dense, idx.astype(np.int64), vals)
+                        g = dense
+                    self._store[key] = np.asarray(g, np.float32)
+            return ("ok",)
+        if cmd == "pull":
+            _, key = msg
+            with self._key_lock(key):
+                arr = self._store.get(key)
+            if arr is None:
+                return ("err", "key %r not initialized" % (key,))
+            return ("ok", arr)
+        if cmd == "row_sparse_pull":
+            _, key, row_ids = msg
+            with self._key_lock(key):
+                arr = self._store.get(key)
+            if arr is None:
+                return ("err", "key %r not initialized" % (key,))
+            idx = np.asarray(row_ids, np.int64)
+            return ("ok", arr[idx], idx)
+        if cmd == "barrier":
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= self._num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    while self._barrier_gen == gen:
+                        self._barrier_cv.wait(timeout=60)
+            return ("ok",)
+        return ("err", "unknown command %r" % (cmd,))
+
+    def _decode(self, kind, payload):
+        if kind == "dense":
+            return np.asarray(payload, np.float32)
+        if kind == "rsp":
+            return ("rsp", payload)
+        if kind == "2bit":
+            packed, shape, thr = payload
+            return unpack_2bit(packed, shape, thr)
+        raise ValueError(kind)
+
+    def _as_nd(self, grad):
+        from .ndarray import NDArray
+        from .ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        if isinstance(grad, tuple) and grad[0] == "rsp":
+            idx, vals, shape = grad[1]
+            return RowSparseNDArray(
+                NDArray(jnp.asarray(vals)),
+                NDArray(jnp.asarray(idx.astype(np.int64))), tuple(shape))
+        return NDArray(jnp.asarray(grad))
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """Blocking request/response client; one socket per process.
+
+    Connection retries cover the startup race: workers may dial before
+    rank 0's server thread is listening (ps-lite handles this with its
+    own rendezvous; plain TCP needs the retry loop)."""
+
+    def __init__(self, host, port, timeout=120, connect_retry_s=60):
+        import time
+        deadline = time.time() + connect_retry_s
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._lock = threading.Lock()
+
+    def request(self, *msg):
+        with self._lock:
+            _send(self._sock, msg)
+            reply = _recv(self._sock)
+        if reply is None:
+            raise ConnectionError("parameter server closed the connection")
+        if reply[0] == "err":
+            from .base import MXNetError
+            raise MXNetError(reply[1])
+        return reply
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -74,3 +74,30 @@ class Monitor:
         res = self.toc()
         for n, k, v in res:
             logging.info("Batch: %7d %30s %s", n, k, v)
+
+    # -- eager per-op tap ---------------------------------------------------
+    def install_eager(self):
+        """Tap every imperative op execution (the eager-mode analogue of
+        MXExecutorSetMonitorCallback, c_api.h:1720): each nd.* invoke
+        reports its named outputs while activated."""
+        from .ndarray import ndarray as _ndmod
+
+        def tap(op_name, outs):
+            if not self.activated:
+                return
+            for i, o in enumerate(outs):
+                name = "%s_output%s" % (op_name, i if len(outs) > 1 else "")
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(_np.asarray(o._data))))
+
+        self._eager_tap = tap
+        _ndmod._MONITOR_TAPS.append(tap)
+        return self
+
+    def uninstall_eager(self):
+        from .ndarray import ndarray as _ndmod
+        tap = getattr(self, "_eager_tap", None)
+        if tap is not None and tap in _ndmod._MONITOR_TAPS:
+            _ndmod._MONITOR_TAPS.remove(tap)
+        self._eager_tap = None

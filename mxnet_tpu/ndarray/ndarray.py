@@ -28,6 +28,8 @@ __all__ = ["NDArray", "array", "empty", "concatenate", "invoke", "imperative_inv
 
 # stack of mutation trackers used by CachedOp tracing (gluon/block.py)
 _MUTATION_TRACKERS = []
+# eager monitor taps: fn(op_name, [NDArray outputs]) called per invoke
+_MONITOR_TAPS = []
 
 
 class NDArray:
@@ -518,6 +520,12 @@ def invoke(op, args, kwargs, out=None):
     # optimizer-update ops — sgd_mom_update writes mom in place)
     for in_idx, out_idx in op.mutates.items():
         nd_inputs[in_idx]._set_data(outs_tuple[out_idx])
+
+    # eager per-op monitor taps (MXExecutorSetMonitorCallback analogue)
+    if _MONITOR_TAPS:
+        _tap_outs = [NDArray(o) for o in outs_tuple[:op.n_outputs(params)]]
+        for tap in _MONITOR_TAPS:
+            tap(op.name, _tap_outs)
 
     n_public = op.n_outputs(params)
     out_nds = [NDArray(o) for o in outs_tuple[:n_public]]

@@ -568,3 +568,246 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
     if bias is not None and not no_bias:
         out = out + bias[None, :, None, None]
     return out
+
+
+# ---------------------------------------------------------------------------
+# Proposal / MultiProposal (reference: src/operator/contrib/proposal.cc,
+# multi_proposal.cc — RPN proposal generation: anchors + deltas, clip,
+# min-size filter, top-K, NMS)
+# ---------------------------------------------------------------------------
+def _gen_anchors(scales, ratios, stride):
+    """Base anchors centered on a stride x stride cell (reference:
+    proposal.cc GenerateAnchors semantics)."""
+    base = jnp.asarray([0, 0, stride - 1, stride - 1], jnp.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = jnp.round(jnp.sqrt(size / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append(jnp.stack([cx - 0.5 * (wss - 1),
+                                      cy - 0.5 * (hss - 1),
+                                      cx + 0.5 * (wss - 1),
+                                      cy + 0.5 * (hss - 1)]))
+    return jnp.stack(anchors)                      # (A, 4)
+
+
+def _proposal_single(score_fg, bbox_delta, im_info, anchors, stride,
+                     pre_n, post_n, thresh, min_size):
+    """One image: (A,H,W) fg scores + (4A,H,W) deltas -> (post_n, 5) rois."""
+    A = anchors.shape[0]
+    H, W = score_fg.shape[1:]
+    shift_x = jnp.arange(W, dtype=jnp.float32) * stride
+    shift_y = jnp.arange(H, dtype=jnp.float32) * stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)        # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1)  # (H, W, 4)
+    all_anchors = (anchors[None, None] + shifts[:, :, None]) \
+        .reshape(-1, 4)                             # (H*W*A, 4)
+    deltas = bbox_delta.reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+        .reshape(-1, 4)
+    scores = score_fg.transpose(1, 2, 0).reshape(-1)
+
+    # bbox transform (dx, dy, dw, dh)
+    widths = all_anchors[:, 2] - all_anchors[:, 0] + 1.0
+    heights = all_anchors[:, 3] - all_anchors[:, 1] + 1.0
+    ctr_x = all_anchors[:, 0] + 0.5 * (widths - 1)
+    ctr_y = all_anchors[:, 1] + 0.5 * (heights - 1)
+    px = deltas[:, 0] * widths + ctr_x
+    py = deltas[:, 1] * heights + ctr_y
+    pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * widths
+    ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * heights
+    boxes = jnp.stack([px - 0.5 * (pw - 1), py - 0.5 * (ph - 1),
+                       px + 0.5 * (pw - 1), py + 0.5 * (ph - 1)], axis=1)
+    # clip to image
+    boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 1], 0, im_info[0] - 1),
+                       jnp.clip(boxes[:, 2], 0, im_info[1] - 1),
+                       jnp.clip(boxes[:, 3], 0, im_info[0] - 1)], axis=1)
+    # min-size filter in original-image scale
+    ms = min_size * im_info[2]
+    keep = ((boxes[:, 2] - boxes[:, 0] + 1) >= ms) & \
+        ((boxes[:, 3] - boxes[:, 1] + 1) >= ms)
+    scores = jnp.where(keep, scores, -jnp.inf)
+
+    n = scores.shape[0]
+    pre = min(pre_n, n) if pre_n > 0 else n
+    top_scores, top_idx = jax.lax.top_k(scores, pre)
+    top_boxes = boxes[top_idx]
+    keep_mask = _nms_single(top_boxes, top_scores,
+                            jnp.isfinite(top_scores), thresh, -1)
+    # order surviving boxes by score, take post_n (pad with zeros)
+    ranked = jnp.argsort(-jnp.where(keep_mask, top_scores, -jnp.inf))
+    sel = ranked[:post_n]
+    sel_valid = keep_mask[sel] & jnp.isfinite(top_scores[sel])
+    out_boxes = jnp.where(sel_valid[:, None], top_boxes[sel], 0.0)
+    out_scores = jnp.where(sel_valid, top_scores[sel], 0.0)
+    return out_boxes, out_scores
+
+
+@register("_contrib_Proposal",
+          arg_names=["cls_prob", "bbox_pred", "im_info"],
+          differentiable=False,
+          aliases=("Proposal", "_contrib_MultiProposal", "MultiProposal"),
+          num_outputs=lambda p: 2 if p.get("output_score") else 1)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2), feature_stride=16,
+             output_score=False, iou_loss=False):
+    """RPN proposals (reference: contrib/proposal.cc; MultiProposal is the
+    batched variant, multi_proposal.cc — here one vmapped kernel serves
+    both).  Returns rois (N*post_n, 5) with the batch index in column 0."""
+    N = cls_prob.shape[0]
+    A = cls_prob.shape[1] // 2
+    anchors = _gen_anchors(list(scales), list(ratios), float(feature_stride))
+
+    def one(cp, bp, info):
+        return _proposal_single(cp[A:], bp, info, anchors,
+                                float(feature_stride),
+                                int(rpn_pre_nms_top_n),
+                                int(rpn_post_nms_top_n), float(threshold),
+                                float(rpn_min_size))
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_ids = jnp.repeat(jnp.arange(N, dtype=jnp.float32),
+                           boxes.shape[1])
+    rois = jnp.concatenate([batch_ids[:, None],
+                            boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+# ---------------------------------------------------------------------------
+# bipartite matching (reference: contrib/bounding_box.cc
+# _contrib_bipartite_matching — greedy best-pair assignment)
+# ---------------------------------------------------------------------------
+@register("_contrib_bipartite_matching", arg_names=["data"],
+          differentiable=False, num_outputs=2,
+          aliases=("bipartite_matching",))
+def bipartite_matching(data, is_ascend=False, threshold=1e-12, topk=-1):
+    """Greedy bipartite matching over a score matrix (..., N, M).
+    Outputs: row match (col index or -1) and col match (row index or -1)."""
+    scores = data.astype(jnp.float32)
+    lead = scores.shape[:-2]
+    N, M = scores.shape[-2:]
+    flat = scores.reshape((-1, N, M))
+    sign = 1.0 if is_ascend else -1.0
+    bad = jnp.inf if is_ascend else -jnp.inf
+
+    def one(s):
+        def body(i, carry):
+            s_cur, row_m, col_m = carry
+            key = s_cur if is_ascend else -s_cur
+            idx = jnp.argmin(key)          # best remaining pair
+            r, c = idx // M, idx % M
+            ok = (s_cur[r, c] > threshold) if not is_ascend \
+                else (s_cur[r, c] < threshold)
+            if topk > 0:
+                ok = ok & (i < topk)
+            row_m = jnp.where(ok, row_m.at[r].set(c), row_m)
+            col_m = jnp.where(ok, col_m.at[c].set(r), col_m)
+            s_cur = jnp.where(ok, s_cur.at[r, :].set(bad), s_cur)
+            s_cur = jnp.where(ok, s_cur.at[:, c].set(bad), s_cur)
+            return s_cur, row_m, col_m
+
+        init = (s, jnp.full((N,), -1.0, jnp.float32),
+                jnp.full((M,), -1.0, jnp.float32))
+        _, row_m, col_m = lax.fori_loop(0, min(N, M), body, init)
+        return row_m, col_m
+
+    row, col = jax.vmap(one)(flat)
+    return row.reshape(lead + (N,)), col.reshape(lead + (M,))
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling (reference: contrib/deformable_psroi_pooling.cc —
+# position-sensitive ROI pooling with learned per-part offsets, R-FCN/
+# Deformable ConvNets)
+# ---------------------------------------------------------------------------
+@register("_contrib_DeformablePSROIPooling",
+          arg_names=["data", "rois", "trans"],
+          aliases=("DeformablePSROIPooling",),
+          optional_args=lambda p: ("trans",) if p.get("no_trans") else ())
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=0, group_size=1, pooled_size=1,
+                             part_size=0, sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """data (N, C, H, W) with C = output_dim * group_size^2; rois (R, 5);
+    trans (R, 2*cls, part, part) offsets.  Each pooled bin averages
+    sample_per_part^2 bilinear samples from its position-sensitive channel
+    group, displaced by the (scaled) learned offset."""
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    P = int(pooled_size)
+    G = int(group_size)
+    D = int(output_dim)
+    part = int(part_size) or P
+    sp = int(sample_per_part)
+
+    def one_roi(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * spatial_scale - 0.5
+        y1 = roi[2] * spatial_scale - 0.5
+        x2 = (roi[3] + 1.0) * spatial_scale - 0.5
+        y2 = (roi[4] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / P
+        bin_h = rh / P
+        feat = data[bidx]                               # (C, H, W)
+
+        iy, ix = jnp.meshgrid(jnp.arange(P), jnp.arange(P), indexing="ij")
+        # learned offsets per part cell
+        if no_trans or tr is None:
+            off_x = jnp.zeros((P, P), jnp.float32)
+            off_y = jnp.zeros((P, P), jnp.float32)
+        else:
+            px = (ix * part) // P
+            py = (iy * part) // P
+            off_x = tr[0, py, px] * trans_std * rw
+            off_y = tr[1, py, px] * trans_std * rh
+        sub_y = jnp.arange(sp, dtype=jnp.float32)
+        sub_x = jnp.arange(sp, dtype=jnp.float32)
+        # sample grid: (P, P, sp, sp)
+        ys = y1 + iy[..., None, None] * bin_h + off_y[..., None, None] \
+            + (sub_y[None, None, :, None] + 0.5) * (bin_h / sp)
+        xs = x1 + ix[..., None, None] * bin_w + off_x[..., None, None] \
+            + (sub_x[None, None, None, :] + 0.5) * (bin_w / sp)
+        ys, xs = jnp.broadcast_arrays(ys, xs)       # (P, P, sp, sp)
+        ys = jnp.clip(ys, 0, H - 1)
+        xs = jnp.clip(xs, 0, W - 1)
+        # position-sensitive channel per (output_dim, bin): channel index
+        gy = (iy * G) // P
+        gx = (ix * G) // P
+        cidx = (jnp.arange(D)[:, None, None] * G + gy[None]) * G + gx[None]
+        vals = _bilinear_gather(
+            feat, ys.reshape(-1), xs.reshape(-1))       # (C, P*P*sp*sp)
+        vals = vals.reshape(C, P, P, sp, sp).mean(axis=(3, 4))  # (C, P, P)
+        out = jnp.take_along_axis(
+            vals, cidx.reshape(D, P, P) % C, axis=0)    # (D, P, P)
+        return out
+
+    if trans is None or no_trans:
+        out = jax.vmap(lambda r: one_roi(r, None))(rois)
+    else:
+        out = jax.vmap(one_roi)(rois, trans)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SparseEmbedding (reference: src/operator/tensor/indexing_op.cc
+# SparseEmbedding — Embedding whose weight gradient is row_sparse; the
+# forward math is identical, and the gluon sparse_grad path produces the
+# row-sparse gradient)
+# ---------------------------------------------------------------------------
+@register("_contrib_SparseEmbedding", arg_names=["data", "weight"],
+          aliases=("SparseEmbedding",))
+def sparse_embedding(data, weight, input_dim=0, output_dim=0,
+                     dtype="float32", deterministic=False):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)

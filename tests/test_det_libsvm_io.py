@@ -1,0 +1,181 @@
+"""LibSVMIter + ImageDetRecordIter (VERDICT r1 item 8).
+
+Reference: src/io/iter_libsvm.cc, src/io/iter_image_det_recordio.cc,
+python/mxnet/image/detection.py.
+"""
+import io as pyio
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image.detection import (DetHorizontalFlipAug,
+                                       DetRandomCropAug, CreateDetAugmenter)
+
+
+class TestLibSVMIter:
+    def _write(self, path, lines):
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def test_basic_csr_batches(self, tmp_path):
+        p = str(tmp_path / "d.libsvm")
+        self._write(p, ["1 0:1.5 3:2.0", "0 1:0.5", "1 2:3.0 4:1.0",
+                        "0 0:0.25 4:4.0"])
+        it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(5,), batch_size=2)
+        b1 = it.next()
+        assert b1.data[0].stype == "csr"
+        np.testing.assert_allclose(
+            b1.data[0].todense().asnumpy(),
+            [[1.5, 0, 0, 2.0, 0], [0, 0.5, 0, 0, 0]])
+        np.testing.assert_allclose(b1.label[0].asnumpy(), [1, 0])
+        b2 = it.next()
+        np.testing.assert_allclose(b2.label[0].asnumpy(), [1, 0])
+        with pytest.raises(StopIteration):
+            it.next()
+        it.reset()
+        assert it.next().label[0].asnumpy()[0] == 1
+
+    def test_round_batch_pads_tail(self, tmp_path):
+        p = str(tmp_path / "d.libsvm")
+        self._write(p, ["1 0:1.0", "0 1:1.0", "1 2:1.0"])
+        it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(4,), batch_size=2)
+        it.next()
+        tail = it.next()
+        assert tail.pad == 1
+        assert tail.data[0].shape == (2, 4)
+
+    def test_separate_label_file(self, tmp_path):
+        p = str(tmp_path / "d.libsvm")
+        lp = str(tmp_path / "l.libsvm")
+        self._write(p, ["0 0:1.0", "0 1:2.0"])
+        self._write(lp, ["0:5.0", "0:7.0"])
+        it = mx.io.LibSVMIter(data_libsvm=p, label_libsvm=lp,
+                              data_shape=(2,), batch_size=2)
+        b = it.next()
+        np.testing.assert_allclose(b.label[0].asnumpy(), [5.0, 7.0])
+
+    def test_num_parts_sharding(self, tmp_path):
+        p = str(tmp_path / "d.libsvm")
+        self._write(p, ["%d 0:1.0" % (i % 2) for i in range(8)])
+        it0 = mx.io.LibSVMIter(data_libsvm=p, data_shape=(1,), batch_size=4,
+                               num_parts=2, part_index=0)
+        it1 = mx.io.LibSVMIter(data_libsvm=p, data_shape=(1,), batch_size=4,
+                               num_parts=2, part_index=1)
+        assert len(it0._rows) == 4 and len(it1._rows) == 4
+
+    def test_trains_sparse_linear(self, tmp_path):
+        """The sparse linear example path: LibSVM input end-to-end."""
+        import importlib.util
+        import sys
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "sparse", "linear_classification.py")
+        spec = importlib.util.spec_from_file_location("sparse_lc", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        argv = sys.argv
+        sys.argv = ["x", "--num-batches", "120", "--feat-dim", "500"]
+        try:
+            mod.main()  # asserts accuracy > 0.7 internally
+        finally:
+            sys.argv = argv
+
+
+def _pack_det(tmp_path, n=8, size=40, max_obj=2):
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "det.rec")
+    idx = str(tmp_path / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    truth = []
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        nobj = 1 + i % max_obj
+        label = [2.0, 5.0]
+        objs = []
+        for k in range(nobj):
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            bw, bh = rng.uniform(0.2, 0.4, 2)
+            objs.append([float(k), x1, y1, min(1.0, x1 + bw),
+                         min(1.0, y1 + bh)])
+            label += objs[-1]
+        truth.append(objs)
+        buf = pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        hdr = recordio.IRHeader(len(label), np.asarray(label, np.float32),
+                                i, 0)
+        w.write_idx(i, recordio.pack(hdr, buf.getvalue()))
+    w.close()
+    return rec, idx, truth
+
+
+class TestImageDetRecordIter:
+    def test_batches_and_label_padding(self, tmp_path):
+        rec, idx, truth = _pack_det(tmp_path)
+        it = mx.io.ImageDetRecordIter(path_imgrec=rec, path_imgidx=idx,
+                                      batch_size=4, data_shape=(3, 32, 32))
+        b = it.next()
+        assert b.data[0].shape == (4, 3, 32, 32)
+        lab = b.label[0].asnumpy()
+        assert lab.shape == (4, 2, 5)         # max 2 objects, padded
+        # first record has 1 object: second row is -1 padding
+        assert lab[0, 1, 0] == -1.0
+        np.testing.assert_allclose(lab[0, 0], truth[0][0], atol=1e-5)
+
+    def test_shuffle_and_reset(self, tmp_path):
+        rec, idx, _ = _pack_det(tmp_path)
+        it = mx.io.ImageDetRecordIter(path_imgrec=rec, path_imgidx=idx,
+                                      batch_size=8, data_shape=(3, 32, 32),
+                                      shuffle=True)
+        b1 = it.next()
+        it.reset()
+        b2 = it.next()
+        assert b1.data[0].shape == b2.data[0].shape
+
+    def test_flip_aug_mirrors_boxes(self):
+        aug = DetHorizontalFlipAug(p=1.0)
+        img = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
+        label = np.array([[0, 0.1, 0.2, 0.4, 0.8]], np.float32)
+        out, lab = aug(img, label)
+        np.testing.assert_array_equal(out, img[:, ::-1])
+        np.testing.assert_allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.8],
+                                   atol=1e-6)
+
+    def test_random_crop_keeps_box_geometry(self):
+        rng = np.random.RandomState(0)
+        aug = DetRandomCropAug(min_object_covered=0.5,
+                               area_range=(0.5, 1.0))
+        img = rng.randint(0, 255, (40, 40, 3), np.uint8)
+        label = np.array([[1, 0.3, 0.3, 0.7, 0.7]], np.float32)
+        out, lab = aug(img, label)
+        kept = lab[lab[:, 0] >= 0]
+        for row in kept:
+            assert 0.0 <= row[1] <= row[3] <= 1.0
+            assert 0.0 <= row[2] <= row[4] <= 1.0
+
+    def test_create_det_augmenter_chain(self):
+        augs = CreateDetAugmenter((3, 32, 32), rand_mirror=True,
+                                  rand_crop=0.5)
+        img = np.random.randint(0, 255, (48, 64, 3), np.uint8)
+        label = np.array([[0, 0.2, 0.2, 0.8, 0.8]], np.float32)
+        for aug in augs:
+            img, label = aug(img, label)
+        assert np.asarray(img).shape == (32, 32, 3)
+
+    def test_ssd_example_on_det_records(self):
+        """The SSD example consumes a packed det recordfile end-to-end."""
+        import importlib.util
+        import sys
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "ssd", "train_ssd.py")
+        spec = importlib.util.spec_from_file_location("ssd_ex", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        argv = sys.argv
+        sys.argv = ["x", "--num-batches", "6", "--batch-size", "8"]
+        try:
+            mod.main()
+        finally:
+            sys.argv = argv

@@ -36,11 +36,75 @@ _WORKER = textwrap.dedent("""
 """ % _ROOT)
 
 
-@pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
-                    reason="dist test disabled")
-def test_dist_sync_kvstore_two_processes(tmp_path):
-    worker = tmp_path / "worker.py"
-    worker.write_text(_WORKER)
+_ASYNC_WORKER = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, %r)
+    import mxnet_tpu as mx
+    import numpy as np
+
+    kv = mx.kv.create("dist_async")
+    assert kv.num_workers == 2, kv.num_workers
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+
+    # async semantics: each push applies IMMEDIATELY server-side with no
+    # cross-worker rendezvous.  Worker 1 pushes nothing until it OBSERVES
+    # worker 0's three updates in the store — if pushes had a sync
+    # barrier, worker 0 would block forever waiting for worker 1 and the
+    # launch would time out.
+    def poll(pred):
+        out = mx.nd.zeros((4,))
+        for _ in range(600):
+            kv.pull("w", out=out)
+            if pred(out.asnumpy()[0]):
+                return out.asnumpy()[0]
+            time.sleep(0.05)
+        raise AssertionError("store never reached expected state")
+
+    if kv.rank == 0:
+        for _ in range(3):
+            kv.push("w", mx.nd.ones((4,)))  # sgd lr=1: each subtracts 1
+        v = poll(lambda x: x <= -3.0 + 1e-5)
+    else:
+        poll(lambda x: x <= -3.0 + 1e-5)    # wait for worker 0's updates
+        for _ in range(2):
+            kv.push("w", mx.nd.ones((4,)))
+    # both workers converge on all 5 pushes applied exactly once
+    final = poll(lambda x: x <= -5.0 + 1e-5)
+    np.testing.assert_allclose(final, -5.0, atol=1e-5)
+    kv.barrier()
+    print("ASYNC WORKER %%d OK" %% kv.rank)
+""" % _ROOT)
+
+
+_COMPRESSED_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, %r)
+    import mxnet_tpu as mx
+    import numpy as np
+
+    kv = mx.kv.create("dist_sync")
+    kv.init("g", mx.nd.zeros((8,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    # worker r pushes +/- values beyond the threshold
+    sign = 1.0 if kv.rank == 0 else -1.0
+    grad = mx.nd.array(np.array([2.0, -2.0, 0.1, 2.0, 0.0, -2.0, 2.0, 0.1],
+                                np.float32) * sign)
+    kv.push("g", grad)
+    out = mx.nd.zeros((8,))
+    kv.pull("g", out=out)
+    # each worker quantized to +/-0.5; sum across the two opposite-signed
+    # workers cancels exactly where both exceeded the threshold
+    np.testing.assert_allclose(out.asnumpy(), 0.0, atol=1e-6)
+    print("COMP WORKER %%d OK" %% kv.rank)
+""" % _ROOT)
+
+
+def _launch(tmp_path, script, tag, timeout=240):
+    worker = tmp_path / ("worker_%s.py" % tag)
+    worker.write_text(script)
     env = dict(os.environ)
     env.pop("JAX_COORDINATOR_ADDRESS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -48,7 +112,46 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
          "-n", "2", "--launcher", "local", sys.executable, str(worker)],
-        env=env, capture_output=True, text=True, timeout=240)
-    out = proc.stdout + proc.stderr
+        env=env, capture_output=True, text=True, timeout=timeout)
+    return proc, proc.stdout + proc.stderr
+
+
+@pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
+                    reason="dist test disabled")
+def test_dist_sync_kvstore_two_processes(tmp_path):
+    proc, out = _launch(tmp_path, _WORKER, "sync")
     assert proc.returncode == 0, out[-3000:]
     assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-3000:]
+
+
+@pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
+                    reason="dist test disabled")
+def test_dist_async_kvstore_two_processes(tmp_path):
+    """True async semantics (reference: kvstore_dist_server.h:285): pushes
+    apply per-arrival on the rank-0 parameter server, no barrier."""
+    proc, out = _launch(tmp_path, _ASYNC_WORKER, "async")
+    assert proc.returncode == 0, out[-3000:]
+    assert "ASYNC WORKER 0 OK" in out and "ASYNC WORKER 1 OK" in out, \
+        out[-3000:]
+
+
+@pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
+                    reason="dist test disabled")
+def test_dist_sync_compressed_wire(tmp_path):
+    """2-bit compression rides the wire as packed payloads and still sums
+    exactly (reference: gradient_compression.h)."""
+    proc, out = _launch(tmp_path, _COMPRESSED_WORKER, "comp")
+    assert proc.returncode == 0, out[-3000:]
+    assert "COMP WORKER 0 OK" in out and "COMP WORKER 1 OK" in out, \
+        out[-3000:]
+
+
+def test_pack_2bit_roundtrip_and_width():
+    """Packed payload is actually 4 values/byte (the wire narrowing)."""
+    import numpy as np
+    from mxnet_tpu.kvstore_ps import pack_2bit, unpack_2bit
+    vals = np.array([0.5, -0.5, 0.0, 0.5, -0.5, 0.0, 0.5], np.float32)
+    packed, shape = pack_2bit(vals, 0.5)
+    assert packed.dtype == np.uint8 and packed.size == 2  # ceil(7/4)
+    back = unpack_2bit(packed, shape, 0.5)
+    np.testing.assert_allclose(back, vals)

@@ -721,6 +721,13 @@ ALSO_COVERED = {
     "_contrib_quantize": "test_linalg_cf_quant.py",
     "_contrib_quantized_conv": "test_quantization_int8.py",
     "_contrib_quantized_pooling": "test_quantization_int8.py",
+    "_contrib_Proposal": "test_contrib_proposal.py",
+    "MultiProposal": "test_contrib_proposal.py",
+    "_contrib_bipartite_matching": "test_contrib_proposal.py",
+    "_contrib_DeformablePSROIPooling": "test_contrib_proposal.py",
+    "DeformablePSROIPooling": "test_contrib_proposal.py",
+    "_contrib_SparseEmbedding": "test_contrib_proposal.py",
+    "SparseEmbedding": "test_contrib_proposal.py",
     "_contrib_requantize": "test_linalg_cf_quant.py",
     "_contrib_quantized_fully_connected": "test_linalg_cf_quant.py",
     "_linalg_gemm": "test_linalg_cf_quant.py",

@@ -44,6 +44,7 @@ def main():
 
     port = free_port()
     coordinator = "127.0.0.1:%d" % port
+    ps_port = free_port()
 
     if args.launcher == "echo":
         for rank in range(args.num_workers):
@@ -64,6 +65,8 @@ def main():
             "DMLC_ROLE": "worker",
             "DMLC_NUM_WORKER": str(args.num_workers),
             "DMLC_WORKER_ID": str(rank),
+            # rank-0-hosted async parameter server (kvstore dist_async)
+            "MXTPU_PS_PORT": str(ps_port),
         })
         procs.append(subprocess.Popen(args.command, env=env))
     rc = 0
