@@ -134,6 +134,18 @@ def _raw(x):
     return x._data if isinstance(x, NDArray) else x
 
 
+def _colocate(arr, like):
+    """Replicate a small array onto the mesh a weight lives on, so sparse
+    row updates compose with GSPMD placement (single-device arrays can't
+    mix with multi-device ones in one op)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = getattr(like, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh.devices.size > 1:
+        return jax.device_put(arr, NamedSharding(sh.mesh, PartitionSpec()))
+    return arr
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum + optional fp16 master weights
@@ -202,13 +214,13 @@ class SGD(Optimizer):
 def _sparse_sgd_update(opt, weight, grad, state, lr, wd):
     """Row-sparse SGD: only touched rows updated (reference:
     optimizer_op-inl.h SGDUpdateRspRspImpl, 'lazy update')."""
-    idx = grad.indices._data.astype(jnp.int32)
-    gval = opt._prep_grad(grad.data._data)
     w = _raw(weight)
+    idx = _colocate(grad.indices._data.astype(jnp.int32), w)
+    gval = _colocate(opt._prep_grad(grad.data._data), w)
     rows = w[idx]
     upd = gval + wd * rows
     if state is not None:
-        m = _raw(state)
+        m = _colocate(_raw(state), w)
         new_m_rows = opt.momentum * m[idx] - lr * upd
         state._set_data(m.at[idx].set(new_m_rows))
         weight._set_data(w.at[idx].add(new_m_rows))
@@ -391,15 +403,17 @@ class Adam(Optimizer):
         m, v = state
         from .ndarray.sparse import RowSparseNDArray
         if isinstance(grad, RowSparseNDArray):
-            idx = grad.indices._data.astype(jnp.int32)
             w = _raw(weight)
-            gval = self._prep_grad(grad.data._data) + wd * w[idx]
+            idx = _colocate(grad.indices._data.astype(jnp.int32), w)
+            gval = _colocate(self._prep_grad(grad.data._data), w) + wd * w[idx]
             b1, b2 = self.beta1, self.beta2
             lr_t = lr * ((1 - b2 ** t) ** 0.5) / (1 - b1 ** t)
-            m_rows = b1 * _raw(m)[idx] + (1 - b1) * gval
-            v_rows = b2 * _raw(v)[idx] + (1 - b2) * gval * gval
-            m._set_data(_raw(m).at[idx].set(m_rows))
-            v._set_data(_raw(v).at[idx].set(v_rows))
+            m_raw = _colocate(_raw(m), w)
+            v_raw = _colocate(_raw(v), w)
+            m_rows = b1 * m_raw[idx] + (1 - b1) * gval
+            v_rows = b2 * v_raw[idx] + (1 - b2) * gval * gval
+            m._set_data(m_raw.at[idx].set(m_rows))
+            v._set_data(v_raw.at[idx].set(v_rows))
             weight._set_data(w.at[idx].add(-lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)))
             return
         # dense path: bias-corrected lr into the fused adam_update op, as
@@ -430,12 +444,12 @@ class AdaGrad(Optimizer):
         if isinstance(grad, RowSparseNDArray):
             # row-wise history/weight update: only touched rows read/written
             # (reference: _sparse_adagrad_update, optimizer_op.cc:651)
-            idx = grad.indices._data.astype(jnp.int32)
             w = _raw(weight)
-            g = self._prep_grad(grad.data._data)
+            idx = _colocate(grad.indices._data.astype(jnp.int32), w)
+            g = _colocate(self._prep_grad(grad.data._data), w)
             if wd:
                 g = g + wd * w[idx]
-            h = _raw(state)
+            h = _colocate(_raw(state), w)
             h_rows = h[idx] + g * g
             state._set_data(h.at[idx].set(h_rows))
             weight._set_data(w.at[idx].add(
