@@ -182,7 +182,13 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
         if h._entry is None:
             if getattr(h, "_mark", False):
                 _leaf_add(leaf_acc, h, g)
-            continue
+                continue
+            # fail loudly like the reference (imperative.cc Backward:
+            # "cannot differentiate a variable that was not recorded")
+            from .base import MXNetError
+            raise MXNetError(
+                "cannot run backward on an array computed outside "
+                "autograd.record() (no gradient graph attached)")
         node, idx = h._entry
         head_nodes.append(node)
         slot = buckets.setdefault(node.id, [None] * len(node.out_avals))

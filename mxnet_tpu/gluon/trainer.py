@@ -15,6 +15,10 @@ class Trainer:
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
             raise ValueError("params must be a ParameterDict or list of Parameters")
+        if not params:
+            raise ValueError(
+                "no parameters to optimize (reference Trainer raises on an "
+                "empty ParameterDict too)")
         self._params = []
         self._param2idx = {}
         for i, param in enumerate(params):

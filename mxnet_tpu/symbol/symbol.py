@@ -715,6 +715,13 @@ def _sym_invoke(op, op_name, args, kwargs):
         for i, a in enumerate(args):
             if isinstance(a, Symbol):
                 slots[names[i]] = a
+            elif isinstance(a, str):
+                # the classic misuse (reference raises TypeError when a
+                # non-Symbol lands in a tensor slot); scalar positionals
+                # are still accepted as params for nd/sym API symmetry
+                raise TypeError(
+                    "%s expects Symbol for argument %r, got str %r"
+                    % (op_name, names[i], a))
             else:
                 params[names[i]] = a
         for k, v in kwargs.items():

@@ -1,0 +1,113 @@
+"""Exception propagation and fail-loud behavior.
+
+Reference strategy: tests/python/unittest/test_exc_handling.py — errors
+raised inside engine-scheduled work must surface to the caller, not hang
+or corrupt state.  In this design jax raises shape/dtype errors eagerly at
+dispatch and data-dependent errors at the sync point (`asnumpy`), so the
+tests pin both surfaces.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.base import MXNetError
+
+
+class TestOpErrors:
+    def test_shape_mismatch_raises(self):
+        a = nd.array(np.ones((2, 3), np.float32))
+        b = nd.array(np.ones((4, 5), np.float32))
+        with pytest.raises(Exception):
+            nd.dot(a, b).asnumpy()
+
+    def test_elemwise_shape_mismatch_raises(self):
+        a = nd.array(np.ones((2, 3), np.float32))
+        b = nd.array(np.ones((2, 4), np.float32))
+        with pytest.raises(Exception):
+            (a + b).asnumpy()
+
+    def test_unknown_op_param_is_error(self):
+        a = nd.array(np.ones((2, 2), np.float32))
+        with pytest.raises(Exception):
+            nd.relu(a, bogus_param=3).asnumpy()
+
+    def test_bad_reshape_raises(self):
+        a = nd.array(np.ones((2, 3), np.float32))
+        with pytest.raises(Exception):
+            a.reshape((7, 7)).asnumpy()
+
+    def test_concat_rank_mismatch(self):
+        a = nd.array(np.ones((2, 3), np.float32))
+        b = nd.array(np.ones((2, 3, 1), np.float32))
+        with pytest.raises(Exception):
+            nd.Concat(a, b, dim=0, num_args=2).asnumpy()
+
+    def test_invalid_pool_type(self):
+        a = nd.array(np.ones((1, 1, 4, 4), np.float32))
+        with pytest.raises(Exception):
+            nd.Pooling(a, kernel=(2, 2), pool_type="nope").asnumpy()
+
+    def test_state_intact_after_failure(self):
+        """A failed op leaves existing arrays usable (no engine poison)."""
+        a = nd.array(np.ones((2, 3), np.float32))
+        with pytest.raises(Exception):
+            nd.dot(a, nd.array(np.ones((5, 5), np.float32))).asnumpy()
+        np.testing.assert_allclose((a * 2).asnumpy(), 2.0)
+
+
+class TestGraphErrors:
+    def test_executor_missing_args(self):
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                    name="fc")
+        with pytest.raises(MXNetError):
+            net.bind(None, args={"data": np.ones((2, 3), np.float32)})
+
+    def test_symbol_compose_type_error(self):
+        with pytest.raises(TypeError):
+            mx.sym.FullyConnected("not a symbol", num_hidden=4)
+
+    def test_kvstore_push_uninitialized_key(self):
+        kv = mx.kv.create("local")
+        with pytest.raises(MXNetError):
+            kv.push("nope", nd.array(np.ones(3, np.float32)))
+
+    def test_kvstore_double_init(self):
+        kv = mx.kv.create("local")
+        kv.init("k", nd.array(np.zeros(2, np.float32)))
+        with pytest.raises(MXNetError):
+            kv.init("k", nd.array(np.zeros(2, np.float32)))
+
+    def test_unknown_kvstore_type(self):
+        with pytest.raises(MXNetError):
+            mx.kv.create("quantum")
+
+
+class TestGluonErrors:
+    def test_forward_before_initialize(self):
+        net = gluon.nn.Dense(4)
+        x = nd.array(np.ones((2, 3), np.float32))
+        with pytest.raises(Exception):
+            net(x)
+
+    def test_deferred_shape_mismatch_on_load(self):
+        import tempfile, os
+        net = gluon.nn.Dense(4, in_units=3)
+        net.initialize()
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "p.params")
+            net.save_parameters(p)
+            other = gluon.nn.Dense(4, in_units=7)
+            with pytest.raises(Exception):
+                other.load_parameters(p)
+
+    def test_trainer_requires_params(self):
+        with pytest.raises(Exception):
+            gluon.Trainer({}, "sgd").step(1)
+
+    def test_grad_without_record_raises(self):
+        x = nd.array(np.ones((2, 2), np.float32))
+        x.attach_grad()
+        y = x * 2  # outside autograd.record
+        with pytest.raises(Exception):
+            y.backward()
