@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Matrix factorization for recommendation.
+
+Reference: example/recommenders/demo1-MF.ipynb + example/sparse/
+matrix_factorization/train.py — user/item embeddings whose dot product
+predicts ratings, trained with embedding gradients.
+
+Synthetic low-rank ratings keep it runnable offline; the model and
+training loop match the reference's structure.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+class MFBlock(gluon.HybridBlock):
+    def __init__(self, num_users, num_items, rank, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.user_embed = gluon.nn.Embedding(num_users, rank)
+            self.item_embed = gluon.nn.Embedding(num_items, rank)
+            self.user_bias = gluon.nn.Embedding(num_users, 1)
+            self.item_bias = gluon.nn.Embedding(num_items, 1)
+
+    def hybrid_forward(self, F, users, items):
+        p = self.user_embed(users)
+        q = self.item_embed(items)
+        pred = F.sum(p * q, axis=-1)
+        return pred + self.user_bias(users).reshape((-1,)) \
+            + self.item_bias(items).reshape((-1,))
+
+
+def synthetic_ratings(num_users, num_items, rank, n, rng):
+    u_true = rng.randn(num_users, rank).astype(np.float32) / np.sqrt(rank)
+    i_true = rng.randn(num_items, rank).astype(np.float32) / np.sqrt(rank)
+    users = rng.randint(0, num_users, n)
+    items = rng.randint(0, num_items, n)
+    ratings = (u_true[users] * i_true[items]).sum(-1) \
+        + 0.05 * rng.randn(n).astype(np.float32)
+    return users, items, ratings.astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-users", type=int, default=200)
+    parser.add_argument("--num-items", type=int, default=150)
+    parser.add_argument("--rank", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--epochs", type=int, default=12)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    users, items, ratings = synthetic_ratings(
+        args.num_users, args.num_items, args.rank, 8192, rng)
+
+    net = MFBlock(args.num_users, args.num_items, args.rank)
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    loss_fn = gluon.loss.L2Loss()
+
+    n = len(ratings)
+    first_mse = None
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n)
+        total = 0.0
+        for s in range(0, n - args.batch_size + 1, args.batch_size):
+            sel = perm[s:s + args.batch_size]
+            u = nd.array(users[sel].astype(np.float32))
+            i = nd.array(items[sel].astype(np.float32))
+            r = nd.array(ratings[sel])
+            with autograd.record():
+                pred = net(u, i)
+                loss = loss_fn(pred, r)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total += float(loss.mean().asscalar())
+        mse = 2 * total / (n // args.batch_size)   # L2Loss is 1/2 MSE
+        if first_mse is None:
+            first_mse = mse
+        logging.info("epoch %d  mse %.4f", epoch, mse)
+    assert mse < first_mse * 0.3, (first_mse, mse)
+    logging.info("done: mse %.4f -> %.4f", first_mse, mse)
+
+
+if __name__ == "__main__":
+    main()

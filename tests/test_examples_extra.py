@@ -1,0 +1,38 @@
+"""Round-2 example scripts run end-to-end and learn (reference: the
+example/ tree is executable documentation — recommenders, rnn/bucketing)."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(path, argv):
+    spec = importlib.util.spec_from_file_location("ex_mod_%s" %
+                                                  os.path.basename(path),
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    saved = sys.argv
+    sys.argv = ["x"] + argv
+    try:
+        mod.main()   # each example asserts its own learning criterion
+    finally:
+        sys.argv = saved
+
+
+def test_matrix_factorization_example():
+    _run(os.path.join(_EXAMPLES, "recommenders", "matrix_fact.py"),
+         ["--epochs", "8"])
+
+
+def test_char_lm_bucketing_example():
+    _run(os.path.join(_EXAMPLES, "rnn_lm", "char_lm.py"),
+         ["--epochs", "4"])
+
+
+def test_wide_deep_example():
+    _run(os.path.join(_EXAMPLES, "wide_deep", "train.py"),
+         ["--num-batches", "100"])

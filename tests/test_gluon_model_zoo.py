@@ -43,8 +43,16 @@ def test_forward(name):
     assert np.isfinite(out.asnumpy()).all()
 
 
-def test_hybridize_consistency():
-    net = get_model("resnet18_v1", classes=10)
+# one per family: the SURVEY §5 race-detection analogue at model level —
+# the compiled (hybridize→jit) and op-by-op executions must agree
+HYBRID_MODELS = ["resnet18_v1", "resnet18_v2", "vgg11_bn", "alexnet",
+                 "densenet121", "squeezenet1.1", "mobilenet0.25",
+                 "mobilenetv2_0.25"]
+
+
+@pytest.mark.parametrize("name", HYBRID_MODELS)
+def test_hybridize_consistency(name):
+    net = get_model(name, classes=10)
     net.initialize()
     x = mx.nd.array(np.random.rand(2, 3, 224, 224).astype("float32"))
     eager = net(x).asnumpy()
