@@ -36,3 +36,9 @@ def test_char_lm_bucketing_example():
 def test_wide_deep_example():
     _run(os.path.join(_EXAMPLES, "wide_deep", "train.py"),
          ["--num-batches", "100"])
+
+
+def test_dcgan_example():
+    """Adversarial training end-to-end: Conv2DTranspose generator vs conv
+    discriminator, alternating updates (reference: example/gan/dcgan.py)."""
+    _run(os.path.join(_EXAMPLES, "gan", "dcgan.py"), ["--steps", "150"])
