@@ -107,15 +107,22 @@ def main():
         except Exception as e:  # never lose the primary metric
             result["pipeline_error"] = str(e)[:200]
 
-    # -- int8 inference (reference: quantized resnet via
-    # quantize_graph_pass.cc + quantized_conv/pooling/fc kernels)
+    # -- inference: bf16 denominator + int8 (reference: benchmark_score.py
+    # fp32/fp16 table in docs/faq/perf.md:156,170, and quantized resnet via
+    # quantize_graph_pass.cc + quantized_conv/pooling/fc kernels).
+    # Each bench guards itself: one failing must not drop the other.
     if os.environ.get("MXTPU_BENCH_INT8", "1") == "1":
+        # drop the trainer's HBM (params, fp32 masters, momentum,
+        # donated activations) before binding the inference executors
+        trainer = None
+        import gc
+        gc.collect()
         try:
-            # drop the trainer's HBM (params, fp32 masters, momentum,
-            # donated activations) before binding the int8 executors
-            trainer = None
-            import gc
-            gc.collect()
+            result.update(_bf16_infer_bench())
+        except Exception as e:
+            result["bf16_infer_error"] = str(e)[:200]
+        gc.collect()
+        try:
             result.update(_int8_bench())
         except Exception as e:
             result["int8_error"] = str(e)[:200]
@@ -123,27 +130,75 @@ def main():
     print(json.dumps(result))
 
 
-def _int8_bench(batch=64, iters=5, calib_batch=16):
+def _bf16_infer_bench(batch=None, iters=20):
+    """bf16 inference denominator (reference: benchmark_score.py, the fp16
+    row of docs/faq/perf.md:170) — NHWC bf16 jitted forward, bs>=64."""
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = batch or int(os.environ.get("MXTPU_BENCH_INFER_BATCH", "256"))
+    rng = np.random.RandomState(0)
+    net = vision.resnet50_v1(layout="NHWC")
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    net.hybridize()
+    x = mx.nd.array(rng.rand(batch, 224, 224, 3).astype(np.float32)) \
+        .astype("bfloat16")
+    out = net(x)
+    out.asnumpy()  # compile + hard sync (device->host round-trip; the
+    # axon tunnel's block_until_ready is not a reliable fence)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = net(x)
+    out.asnumpy()
+    dt = time.perf_counter() - t0
+    return {"bf16_infer_imgs_per_sec": round(batch * iters / dt, 2)}
+
+
+def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024):
     import numpy as np
 
     import mxnet_tpu as mx
     from mxnet_tpu.symbol.models import resnet_symbol
 
+    batch = batch or int(os.environ.get("MXTPU_BENCH_INFER_BATCH", "256"))
     rng = np.random.RandomState(0)
-    X = rng.rand(calib_batch, 3, 224, 224).astype(np.float32)
+    # NHWC end to end: the quantized graph keeps the TPU-native layout so
+    # the int8 convs/dots land on the MXU int8 path without transposes
+    X = rng.rand(calib_batch, 224, 224, 3).astype(np.float32)
     y = np.zeros(calib_batch, np.float32)
     calib_it = mx.io.NDArrayIter(X, y, calib_batch)
-    net = resnet_symbol(50)
+    net = resnet_symbol(50, layout="NHWC")
     mod = mx.mod.Module(net)
     mod.bind(calib_it.provide_data, calib_it.provide_label,
              for_training=False)
     mod.init_params(initializer=mx.init.Xavier())
     arg, aux = mod.get_params()
+    # entropy (KL) calibration + BN folding — the round-3 int8 pipeline
     qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
         net, arg, aux, calib_data=calib_it, num_calib_examples=calib_batch,
-        excluded_sym_names=["stem_conv"])
+        calib_mode="entropy", excluded_sym_names=["stem_conv"])
+
+    # fp32 reference predictions for the accuracy gate, captured BEFORE
+    # the fp32 executor is dropped so it never coexists with the int8 one
+    # in HBM (VERDICT r2 item 2: 1024-image eval set; fp32 predictions
+    # stand in for labels since weights are random — the trained-model
+    # variant runs in tests/test_quantization_int8.py)
+    eval_sets = [rng.rand(batch, 224, 224, 3).astype(np.float32)
+                 for _ in range(max(1, eval_images // batch))]
+    fp32_preds = []
+    for Xe in eval_sets:
+        eb = mx.io.DataBatch(data=[mx.nd.array(Xe)], label=[])
+        mod.forward(eb, is_train=False)
+        fp32_preds.append(mod.get_outputs()[0].asnumpy().argmax(1))
     mod = None
-    Xb = rng.rand(batch, 3, 224, 224).astype(np.float32)
+    import gc
+    gc.collect()
+
+    Xb = rng.rand(batch, 224, 224, 3).astype(np.float32)
     it = mx.io.NDArrayIter(Xb, np.zeros(batch, np.float32), batch)
     qmod = mx.mod.Module(qsym)
     qmod.bind(it.provide_data, it.provide_label, for_training=False)
@@ -156,7 +211,17 @@ def _int8_bench(batch=64, iters=5, calib_batch=16):
         qmod.forward(b, is_train=False)
     qmod.get_outputs()[0].asnumpy()
     dt = time.perf_counter() - t0
-    return {"int8_infer_imgs_per_sec": round(batch * iters / dt, 2)}
+    out = {"int8_infer_imgs_per_sec": round(batch * iters / dt, 2)}
+
+    agree = tot = 0
+    for Xe, ref in zip(eval_sets, fp32_preds):
+        eb = mx.io.DataBatch(data=[mx.nd.array(Xe)], label=[])
+        qmod.forward(eb, is_train=False)
+        got = qmod.get_outputs()[0].asnumpy().argmax(1)
+        agree += int((ref == got).sum())
+        tot += batch
+    out["int8_top1_agreement"] = round(agree / tot, 4)
+    return out
 
 
 def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024):

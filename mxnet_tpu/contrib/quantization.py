@@ -14,7 +14,118 @@ import numpy as np
 from .. import ndarray as nd
 from .. import symbol as sym
 
-__all__ = ["quantize_model", "calib_graph"]
+__all__ = ["quantize_model", "calib_graph", "optimal_threshold"]
+
+
+# -- entropy (KL) calibration --------------------------------------------
+# Reference: python/mxnet/contrib/quantization.py:253 _get_optimal_threshold
+# — the TensorRT-style histogram/KL-divergence threshold search.  Naive
+# min/max calibration lets one outlier blow up the scale; the entropy mode
+# picks the clip threshold whose 255-level quantized distribution is
+# closest (in KL divergence) to the clipped fp32 distribution.
+
+_NUM_HIST_BINS = 8001
+_NUM_QUANT_BINS = 255
+
+
+def _smoothed_kl(p, q):
+    """KL(p || q) with the zero-bin smoothing the calibration literature
+    uses: mass from q's empty bins that are non-empty in p is redistributed
+    so the divergence stays finite."""
+    p = p.astype(np.float64)
+    q = q.astype(np.float64)
+    eps = 1e-4
+    p_nz = p > 0
+    q_z = (q == 0) & p_nz
+    # move eps into q's problem bins, taking it from its non-empty ones
+    if q_z.any():
+        take = eps * q_z.sum() / max(1, (q > 0).sum())
+        q = np.where(q_z, eps, np.where(q > 0, q - take, 0.0))
+    ps = p[p_nz] / p.sum()
+    qs = q[p_nz] / q.sum()
+    return float(np.sum(ps * np.log(ps / np.maximum(qs, 1e-12))))
+
+
+def optimal_threshold(hist, hist_edges,
+                      num_quantized_bins=_NUM_QUANT_BINS):
+    """Pick the |threshold| minimizing KL(clipped fp32 dist || int8 dist).
+
+    ``hist`` is a symmetric histogram over ``[-amax, amax]``.  For every
+    candidate half-width ``i`` the central ``2i+1`` bins are kept (outlier
+    mass folded into the edge bins), down-quantized to
+    ``num_quantized_bins`` levels, expanded back, and scored by KL
+    divergence (reference: _get_optimal_threshold:253)."""
+    hist = np.asarray(hist, np.float64).copy()
+    num_bins = hist.size
+    zero = num_bins // 2
+    # exclude the zero bin: zero is exactly representable at any threshold,
+    # and after relu its spike would dominate the distributions, washing
+    # out the clipping cost of every candidate (TensorRT's calibration
+    # skips bin 0 for the same reason)
+    hist[zero] = 0.0
+    half_start = num_quantized_bins // 2 + 1
+    best = (np.inf, float(hist_edges[-1]))
+    for i in range(half_start, zero + 1):
+        lo, hi = zero - i, zero + i + 1
+        sliced = hist[lo:hi]
+        # p: the clipped reference distribution — outlier mass folded into
+        # the boundary bins
+        p = sliced.copy()
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        if p.sum() == 0:
+            continue
+        # q: the int8 rendition, built from the *unfolded* slice — the
+        # folded outlier mass present in p but absent from q is exactly
+        # the clipping cost KL charges this candidate with
+        n = p.size
+        idx = (np.arange(n) * num_quantized_bins // n)
+        q_groups = np.bincount(idx, weights=sliced,
+                               minlength=num_quantized_bins)
+        # each group's mass spread uniformly over its non-empty source bins
+        nonzero = np.bincount(idx, weights=(p > 0).astype(np.float64),
+                              minlength=num_quantized_bins)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per_bin = np.where(nonzero > 0, q_groups / nonzero, 0.0)
+        q = np.where(p > 0, per_bin[idx], 0.0)
+        kl = _smoothed_kl(p, q)
+        if kl < best[0]:
+            th = float(max(abs(hist_edges[lo]), abs(hist_edges[hi])))
+            best = (kl, th)
+    return best[1]
+
+
+def _collect_layer_histograms(symbol, arg_params, aux_params, calib_data,
+                              num_calib_examples, data_names, stats):
+    """Second calibration pass: per-layer histograms over the naive
+    [-amax, amax] range (reference: _LayerHistogramCollector)."""
+    from ..module.module import Module
+    internals = symbol.get_internals()
+    outputs = list(stats.keys())
+    group = sym.Group([internals[o] for o in outputs])
+    mod = Module(group, data_names=data_names, label_names=None)
+    mod.bind(calib_data.provide_data, for_training=False)
+    mod.set_params(arg_params, aux_params, allow_missing=True,
+                   allow_extra=True)
+    hists = {}
+    edges = {}
+    for name in outputs:
+        lo, hi = stats[name]
+        amax = max(abs(lo), abs(hi)) or 1.0
+        hists[name] = np.zeros(_NUM_HIST_BINS, np.float64)
+        edges[name] = np.linspace(-amax, amax, _NUM_HIST_BINS + 1)
+    seen = 0
+    calib_data.reset()
+    for batch in calib_data:
+        mod.forward(batch, is_train=False)
+        for name, out in zip(outputs, mod.get_outputs()):
+            a = out.asnumpy().ravel()
+            h, _ = np.histogram(a, bins=edges[name])
+            hists[name] += h
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return hists, edges
 
 
 def _collect_layer_stats(symbol, arg_params, aux_params, calib_data,
@@ -48,6 +159,104 @@ def _collect_layer_stats(symbol, arg_params, aux_params, calib_data,
 def _entry_range_key(entry):
     node, _ = entry
     return node.name if node.op is None else node.name + "_output"
+
+
+def fold_batch_norms(symbol, arg_params, aux_params):
+    """Fold Convolution→BatchNorm chains into the conv weights/bias — the
+    standard inference-graph transform (the reference's MKLDNN subgraph
+    fuse pass does the same ahead of int8 rewriting).  Inference only:
+    uses the moving statistics.
+
+    Returns (new_symbol, new_arg_params, new_aux_params)."""
+    from ..symbol.symbol import Symbol, _Node
+
+    arg_params = dict(arg_params)
+    aux_params = dict(aux_params)
+
+    # consumer counts: a conv feeding anything besides its BN stays intact
+    consumers = {}
+    seen = set()
+
+    def count(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for child, _ in node.inputs:
+            consumers[id(child)] = consumers.get(id(child), 0) + 1
+            count(child)
+
+    for n, _ in symbol._outputs:
+        consumers[id(n)] = consumers.get(id(n), 0) + 1  # head is a consumer
+        count(n)
+
+    memo = {}
+
+    def clone(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        new = _Node(node.op, node.name, dict(node.attrs), [], node._is_aux)
+        memo[id(node)] = new
+        new.inputs = [(clone(c), i) for c, i in node.inputs]
+        if node.op != "BatchNorm" or not node.inputs:
+            return new
+        src, src_out = node.inputs[0]
+        if src.op != "Convolution" or consumers.get(id(src), 0) != 1:
+            return new
+        # the BN must normalize the conv's channel axis: channels-last
+        # convs carry channels on the minor axis, channels-first on axis 1
+        bn_axis = int(_reg_canon(node.attrs.get("axis", 1)))
+        kernel = node.inputs and src.attrs.get("kernel")
+        nsp = len(_attr_tuple(kernel)) if kernel else 2
+        ch_axis = nsp + 1 if src.attrs.get("layout") in (
+            "NWC", "NHWC", "NDHWC") else 1
+        if bn_axis != ch_axis:
+            return new
+        wname = src.name + "_weight"
+        gname, bname = node.name + "_gamma", node.name + "_beta"
+        mname, vname = node.name + "_moving_mean", node.name + "_moving_var"
+        if wname not in arg_params or mname not in aux_params:
+            return new
+        eps = float(_reg_canon(node.attrs.get("eps", 1e-3)))
+        fix_gamma = _reg_canon(node.attrs.get("fix_gamma", True))
+        mean = aux_params[mname].asnumpy()
+        var = aux_params[vname].asnumpy()
+        gamma = np.ones_like(mean) if fix_gamma else \
+            arg_params[gname].asnumpy()
+        beta = arg_params[bname].asnumpy() if bname in arg_params \
+            else np.zeros_like(mean)
+        scale = gamma / np.sqrt(var + eps)
+        shift = beta - mean * scale
+        w = arg_params[wname].asnumpy()
+        # output channels are axis 0 in both OIHW and O*kernel*I layouts
+        arg_params[wname] = nd.array(
+            w * scale.reshape((-1,) + (1,) * (w.ndim - 1)))
+        cbias = src.name + "_bias"
+        had_bias = not _reg_canon(src.attrs.get("no_bias", False))
+        if had_bias and cbias in arg_params:
+            shift = arg_params[cbias].asnumpy() * scale + shift
+        arg_params[cbias] = nd.array(shift)
+        folded = memo[id(src)]
+        conv = _Node(src.op, src.name, dict(src.attrs), list(folded.inputs))
+        conv.attrs["no_bias"] = False
+        if not had_bias:
+            bvar = _Node(None, cbias, {"__shape__": str(shift.shape),
+                                       "__dtype__": "float32"})
+            conv.inputs = conv.inputs[:2] + [(bvar, 0)]
+        memo[id(node)] = conv
+        return conv
+
+    out = Symbol([(clone(n), i) for n, i in symbol._outputs])
+    # drop the folded BN params so set_params doesn't complain
+    live = {n.name for n in out._nodes() if n.op is None}
+    arg_params = {k: v for k, v in arg_params.items()
+                  if k in live or not k.endswith(("_gamma", "_beta"))}
+    aux_params = {k: v for k, v in aux_params.items() if k in live}
+    return out, arg_params, aux_params
+
+
+def _reg_canon(v):
+    from ..ops.registry import canonicalize
+    return canonicalize(v)
 
 
 # attrs each quantized op inherits from its fp32 node
@@ -132,8 +341,10 @@ def _rewrite_int8(symbol, arg_params, th_dict, excluded):
                 # no fp32 node derives its shape anymore — pin it on the var
                 bias_entry[0].attrs.setdefault(
                     "__shape__", str(tuple(arg_params[bname].shape)))
-            if node.op == "Convolution":
-                # bias broadcasts over channels: (C,) -> (1, C, 1, ...)
+            if node.op == "Convolution" and \
+                    node.attrs.get("layout") not in ("NWC", "NHWC", "NDHWC"):
+                # bias broadcasts over channels: (C,) -> (1, C, 1, ...);
+                # channels-last layouts broadcast on the minor axis natively
                 nsp = len(_attr_tuple(node.attrs.get("kernel", (1, 1))))
                 bshape = (1, -1) + (1,) * nsp
                 bias_entry = (_Node("Reshape", node.name + "_bias_rs",
@@ -212,13 +423,20 @@ def quantize_model(sym_in, arg_params, aux_params, data_names=("data",),
                    label_names=("softmax_label",), ctx=None,
                    excluded_sym_names=None, calib_mode="naive",
                    calib_data=None, num_calib_examples=None,
-                   quantized_dtype="int8", logger=logging):
+                   quantized_dtype="int8", fold_bn=True, logger=logging):
     """Quantize weights to int8 and (optionally) calibrate activations
     (reference: contrib/quantization.py quantize_model).
+    ``calib_mode``: "naive" (min/max) or "entropy" (KL-optimal thresholds,
+    reference :253); ``fold_bn`` folds Convolution→BatchNorm chains into
+    the conv weights first so the int8 convs carry their scale/shift as a
+    fused epilogue instead of a separate fp32 BN pass.
 
     Returns (symbol, qarg_params, aux_params): weights stored quantized as
     (int8 data, min, max) triples under their original names + suffixes."""
     excluded = set(excluded_sym_names or [])
+    if fold_bn:
+        sym_in, arg_params, aux_params = fold_batch_norms(
+            sym_in, arg_params, aux_params)
     qarg_params = {}
     for name, arr in arg_params.items():
         layer = name[:-len("_weight")] if name.endswith("_weight") else name
@@ -237,7 +455,28 @@ def quantize_model(sym_in, arg_params, aux_params, data_names=("data",),
         th_dict = _collect_layer_stats(sym_in, arg_params, aux_params,
                                        calib_data, num_calib_examples,
                                        list(data_names), list(label_names))
-        logger.info("calibrated %d layer output ranges", len(th_dict))
+        if calib_mode == "entropy":
+            # KL-optimal clip thresholds (reference: calib_mode='entropy',
+            # contrib/quantization.py:340) from a second histogram pass —
+            # only over ranges a quantizable node will actually consume
+            # (the KL search is host-side Python; running it for every
+            # internal output would cost minutes on a deep net)
+            needed = set()
+            for node in sym_in._nodes():
+                if node.op in _QUANTIZABLE and node.name not in excluded \
+                        and node.inputs:
+                    needed.add(_entry_range_key(node.inputs[0]))
+            needed &= set(th_dict)
+            sub_stats = {k: th_dict[k] for k in needed}
+            if sub_stats:
+                hists, edges = _collect_layer_histograms(
+                    sym_in, arg_params, aux_params, calib_data,
+                    num_calib_examples, list(data_names), sub_stats)
+                for name in needed:
+                    th = optimal_threshold(hists[name], edges[name])
+                    th_dict[name] = (-th, th)
+        logger.info("calibrated %d layer output ranges (%s)",
+                    len(th_dict), calib_mode)
         sym_in = calib_graph(sym_in, th_dict)
         # rewrite calibrated FC/conv/pooling layers to real int8 subgraphs,
         # then fuse dequantize->quantize handoffs into requantize

@@ -26,6 +26,15 @@ from .functional import (functionalize_forward, functional_optimizer_update,
 
 __all__ = ["DataParallelTrainer"]
 
+# optimizers whose update rule is purely per-scalar (no cross-element or
+# per-layer reductions), so concatenated flat buckets are numerically
+# identical to per-param updates.  LBSGD (layer-wise lr from norms) and
+# DCASGD (uses previous-weight deltas per layer) stay per-param.
+_ELEMENTWISE_OPTIMIZERS = {
+    "SGD", "NAG", "Signum", "FTML", "SGLD", "Adam", "AdaGrad", "RMSProp",
+    "AdaDelta", "Ftrl", "Adamax", "Nadam",
+}
+
 
 class DataParallelTrainer:
     """Train a Gluon block data-parallel (optionally tensor-parallel) on a mesh.
@@ -80,19 +89,45 @@ class DataParallelTrainer:
             self._param_shardings[name] = sh
             p._data._set_data(jax.device_put(p.data()._data, sh))
 
-        # optimizer states live next to their (possibly sharded) param
-        self._states_raw = []
-        for i, name in enumerate(self._train_names):
+        # group parameters into fused update buckets (reference precedent:
+        # multi-tensor optimizer launches, docs/faq/perf.md:214-216 的
+        # "grouped updates" lever): every elementwise optimizer applies the
+        # identical per-scalar rule, so same-hyper same-dtype replicated
+        # params can be updated as ONE flat concatenated vector — dozens of
+        # small per-param fusions collapse into a handful of launches.
+        groupable = type(self._opt).__name__ in _ELEMENTWISE_OPTIMIZERS
+        buckets = {}
+        self._groups = []  # list of [name, ...]
+        for name in self._train_names:
             p = self._params_by_name[name]
-            state = self._opt.create_state_multi_precision(i, p.data())
+            spec = self._param_spec_fn(name, p.shape)
+            if not groupable or spec != PartitionSpec():
+                self._groups.append([name])
+                continue
+            key = (float(p.lr_mult), float(p.wd_mult),
+                   str(np.dtype(p.dtype) if p.dtype else "float32"))
+            buckets.setdefault(key, []).append(name)
+        self._groups = [v for v in buckets.values()] + self._groups
+
+        # optimizer states live next to their (possibly sharded) params;
+        # grouped buckets get one state over the flat concatenation
+        self._states_raw = []
+        for gi, names in enumerate(self._groups):
+            ps = [self._params_by_name[n] for n in names]
+            if len(names) == 1:
+                wflat = ps[0].data()._data
+                sh = self._param_shardings[names[0]]
+            else:
+                wflat = jnp.concatenate([p.data()._data.ravel() for p in ps])
+                sh = NamedSharding(mesh, PartitionSpec())
+            state = self._opt.create_state_multi_precision(gi, NDArray(wflat))
             raw = tree_raw(state)
-            sh = self._param_shardings[name]
             self._states_raw.append(jax.tree_util.tree_map(
                 lambda v: jax.device_put(v, sh), raw))
-            if p.lr_mult != 1.0:
-                self._opt.lr_mult.setdefault(i, p.lr_mult)
-            if p.wd_mult != 1.0:
-                self._opt.wd_mult.setdefault(i, p.wd_mult)
+            if ps[0].lr_mult != 1.0:
+                self._opt.lr_mult.setdefault(gi, ps[0].lr_mult)
+            if ps[0].wd_mult != 1.0:
+                self._opt.wd_mult.setdefault(gi, ps[0].wd_mult)
 
         def run(x, y):
             out = block(x)
@@ -107,7 +142,8 @@ class DataParallelTrainer:
     # -- the compiled step -------------------------------------------------
     def _build_step(self):
         fwd, opt = self._fwd, self._opt
-        n_train = len(self._train_names)
+        groups = self._groups
+        name_to_idx = {n: i for i, n in enumerate(self._train_names)}
 
         def pure_step(train_vals, states, aux_vals, x, y, key, lr, t):
             def loss_of(tv):
@@ -116,11 +152,29 @@ class DataParallelTrainer:
 
             (loss_val, muts), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_vals)
-            new_vals, new_states = [], []
-            for i in range(n_train):
-                nw, ns = functional_optimizer_update(
-                    opt, i, train_vals[i], grads[i], states[i], lr, t)
-                new_vals.append(nw)
+            new_vals = [None] * len(train_vals)
+            new_states = []
+            for gi, names in enumerate(groups):
+                idxs = [name_to_idx[n] for n in names]
+                if len(idxs) == 1:
+                    i = idxs[0]
+                    nw, ns = functional_optimizer_update(
+                        opt, gi, train_vals[i], grads[i], states[gi], lr, t)
+                    new_vals[i] = nw
+                else:
+                    # fused bucket: one flat elementwise update for the
+                    # whole group instead of len(group) small fusions
+                    wf = jnp.concatenate(
+                        [train_vals[i].ravel() for i in idxs])
+                    gf = jnp.concatenate([grads[i].ravel() for i in idxs])
+                    nwf, ns = functional_optimizer_update(
+                        opt, gi, wf, gf, states[gi], lr, t)
+                    off = 0
+                    for i in idxs:
+                        sz = train_vals[i].size
+                        new_vals[i] = nwf[off:off + sz].reshape(
+                            train_vals[i].shape)
+                        off += sz
                 new_states.append(ns)
             return loss_val, tuple(new_vals), tuple(new_states), muts
 

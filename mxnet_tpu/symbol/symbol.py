@@ -130,7 +130,11 @@ def _conv_param_shapes(attrs, dshape):
     kernel = attrs.get("kernel", ())
     num_filter = int(attrs.get("num_filter"))
     num_group = int(attrs.get("num_group", 1))
-    w = (num_filter, dshape[1] // num_group) + tuple(kernel)
+    if attrs.get("layout") in ("NWC", "NHWC", "NDHWC"):
+        # channels-last weight layout is (O, *kernel, I/group)
+        w = (num_filter,) + tuple(kernel) + (dshape[-1] // num_group,)
+    else:
+        w = (num_filter, dshape[1] // num_group) + tuple(kernel)
     shapes = {"weight": w}
     if not attrs.get("no_bias", False):
         shapes["bias"] = (num_filter,)

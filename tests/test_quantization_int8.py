@@ -159,3 +159,202 @@ def test_resnet18_int8_prediction_agreement():
         agree += (ref == out).sum()
         n_tot += len(ref)
     assert agree / n_tot >= 0.95, agree / n_tot
+
+
+# ---------------------------------------------------------------------------
+# Round 3: entropy/KL calibration, BN folding, NHWC int8 graphs
+# (reference: contrib/quantization.py:253 _get_optimal_threshold)
+# ---------------------------------------------------------------------------
+class TestKLCalibration:
+    def test_clips_outliers(self):
+        """A gaussian bulk with far outliers: the KL threshold must clip
+        the outliers instead of stretching the int8 range over them."""
+        from mxnet_tpu.contrib.quantization import optimal_threshold
+        rng = np.random.RandomState(0)
+        a = np.concatenate([rng.randn(100000), [50.0, -60.0]])
+        amax = np.abs(a).max()
+        edges = np.linspace(-amax, amax, 8002)
+        hist, _ = np.histogram(a, bins=edges)
+        th = optimal_threshold(hist, edges)
+        assert 2.0 < th < 15.0, th
+
+    def test_keeps_full_range_without_outliers(self):
+        from mxnet_tpu.contrib.quantization import optimal_threshold
+        rng = np.random.RandomState(1)
+        b = rng.uniform(-1, 1, 100000)
+        edges = np.linspace(-1, 1, 8002)
+        hist, _ = np.histogram(b, bins=edges)
+        th = optimal_threshold(hist, edges)
+        assert th > 0.9, th
+
+    def test_entropy_beats_naive_on_bulk(self):
+        """On outlier-heavy data the KL threshold trades one clipped
+        outlier for far higher fidelity on the bulk of the distribution —
+        naive min/max squeezes the gaussian bulk into a handful of int8
+        levels."""
+        from mxnet_tpu.contrib.quantization import optimal_threshold
+        rng = np.random.RandomState(2)
+        bulk = rng.randn(50000).astype(np.float32)
+        a = np.concatenate([bulk, [80.0]])
+        amax = np.abs(a).max()
+        edges = np.linspace(-amax, amax, 8002)
+        hist, _ = np.histogram(a, bins=edges)
+        th = optimal_threshold(hist, edges)
+
+        def bulk_sqnr(t):
+            q = np.clip(np.round(bulk / t * 127), -127, 127) * t / 127
+            return 10 * np.log10(
+                (bulk ** 2).sum() / ((bulk - q) ** 2).sum())
+
+        assert bulk_sqnr(th) > bulk_sqnr(amax) + 15.0  # >15 dB better
+
+
+class TestBNFolding:
+    def _toy(self, layout):
+        rng = np.random.RandomState(3)
+        shape = (4, 3, 16, 16) if layout == "NCHW" else (4, 16, 16, 3)
+        X = rng.rand(*shape).astype(np.float32)
+        it = mx.io.NDArrayIter(X, np.zeros(4, np.float32), 4)
+        data = mx.sym.Variable("data")
+        c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                               pad=(1, 1), no_bias=True, layout=layout,
+                               name="c1")
+        bn = mx.sym.BatchNorm(c, fix_gamma=False, name="bn1",
+                              axis=3 if layout == "NHWC" else 1)
+        r = mx.sym.Activation(bn, act_type="relu")
+        fc = mx.sym.FullyConnected(r, num_hidden=4, name="fc")
+        net = mx.sym.SoftmaxOutput(fc, name="softmax")
+        mod = mx.mod.Module(net)
+        mod.bind(it.provide_data, it.provide_label, for_training=False)
+        mod.init_params(initializer=mx.init.Xavier())
+        arg, aux = mod.get_params()
+        # non-trivial moving stats so folding actually does arithmetic
+        for k in list(aux):
+            a = aux[k].asnumpy()
+            aux[k] = mx.nd.array(
+                rng.rand(*a.shape).astype(np.float32) * 0.5 +
+                (1.0 if k.endswith("_var") else -0.2))
+        mod.set_params(arg, aux)
+        return net, mod, arg, aux, it
+
+    @pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+    def test_fold_exact(self, layout):
+        from mxnet_tpu.contrib.quantization import fold_batch_norms
+        net, mod, arg, aux, it = self._toy(layout)
+        it.reset()
+        b = next(iter(it))
+        mod.forward(b, is_train=False)
+        ref = mod.get_outputs()[0].asnumpy()
+        fsym, farg, faux = fold_batch_norms(net, arg, aux)
+        ops = [n.op for n in fsym._nodes()]
+        assert "BatchNorm" not in ops
+        fmod = mx.mod.Module(fsym)
+        fmod.bind(it.provide_data, it.provide_label, for_training=False)
+        fmod.init_params(arg_params=farg, aux_params=faux)
+        fmod.forward(b, is_train=False)
+        out = fmod.get_outputs()[0].asnumpy()
+        assert np.abs(ref - out).max() < 1e-4
+
+    def test_fold_skips_shared_conv(self):
+        """A conv consumed by two heads must not be folded."""
+        from mxnet_tpu.contrib.quantization import fold_batch_norms
+        data = mx.sym.Variable("data")
+        c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                               pad=(1, 1), no_bias=True, name="c1")
+        bn = mx.sym.BatchNorm(c, name="bn1")
+        out = mx.sym.Group([bn, c])
+        arg = {"c1_weight": mx.nd.array(np.ones((4, 3, 3, 3), np.float32))}
+        aux = {"bn1_moving_mean": mx.nd.array(np.zeros(4, np.float32)),
+               "bn1_moving_var": mx.nd.array(np.ones(4, np.float32))}
+        fsym, _, _ = fold_batch_norms(out, arg, aux)
+        assert "BatchNorm" in [n.op for n in fsym._nodes()]
+
+
+def test_quantize_model_entropy_nhwc_resnet():
+    """End to end: NHWC resnet-18, entropy calibration, BN folding — the
+    round-3 int8 path (quantize_v2 ranges come from KL thresholds)."""
+    from mxnet_tpu.symbol.models import resnet_symbol
+    rng = np.random.RandomState(6)
+    X = rng.rand(32, 32, 32, 3).astype(np.float32)
+    y = (np.arange(32) % 10).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, 16)
+    net = resnet_symbol(18, num_classes=10, thumbnail=True, layout="NHWC")
+    mod = mx.mod.Module(net)
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params(initializer=mx.init.Xavier())
+    arg, aux = mod.get_params()
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        net, arg, aux, calib_data=it, num_calib_examples=32,
+        calib_mode="entropy", excluded_sym_names=["stem_conv"])
+    ops = [n.op for n in qsym._nodes()]
+    assert "_contrib_quantized_conv" in ops
+    assert "BatchNorm" not in ops  # folded
+    qmod = mx.mod.Module(qsym)
+    qmod.bind(it.provide_data, it.provide_label, for_training=False)
+    qmod.init_params(arg_params=qarg, aux_params=qaux)
+    it.reset()
+    b = next(iter(it))
+    mod.forward(b, is_train=False)
+    qmod.forward(b, is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+    out = qmod.get_outputs()[0].asnumpy()
+    # relative fidelity of the logit field, not argmax roulette
+    denom = np.abs(ref - ref.mean(axis=1, keepdims=True)).max() + 1e-6
+    assert np.abs(ref - out).max() / denom < 1.0
+
+
+def test_trained_net_int8_accuracy_gate():
+    """The real accuracy gate: train a small conv net to high accuracy on
+    separable synthetic data, quantize with entropy calibration, and assert
+    int8 top-1 within 1% of fp32 (VERDICT r2 item 2's criterion, at CPU
+    test scale; bench.py applies it to resnet-50 on 1024 images)."""
+    rng = np.random.RandomState(7)
+    n, nclass = 512, 4
+    y = np.arange(n) % nclass
+    # class-dependent blobs in 2 channels of an 8x8 image
+    X = rng.randn(n, 8, 8, 2).astype(np.float32) * 0.3
+    for i in range(n):
+        c = y[i]
+        X[i, c // 2 * 4:(c // 2) * 4 + 4, (c % 2) * 4:(c % 2) * 4 + 4, :] += 1.5
+    it = mx.io.NDArrayIter(X, y.astype(np.float32), 64, shuffle=True)
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            layout="NHWC", name="c1")
+    r1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(r1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        layout="NHWC", name="p1")
+    c2 = mx.sym.Convolution(p1, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                            layout="NHWC", name="c2")
+    r2 = mx.sym.Activation(c2, act_type="relu")
+    fc = mx.sym.FullyConnected(r2, num_hidden=nclass, name="fc")
+    net = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=8,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    arg, aux = mod.get_params()
+
+    eval_it = mx.io.NDArrayIter(X, y.astype(np.float32), 64)
+
+    def top1(m):
+        eval_it.reset()
+        correct = tot = 0
+        for b in eval_it:
+            m.forward(b, is_train=False)
+            pred = m.get_outputs()[0].asnumpy().argmax(1)
+            correct += (pred == b.label[0].asnumpy()).sum()
+            tot += len(pred)
+        return correct / tot
+
+    fp32_acc = top1(mod)
+    assert fp32_acc > 0.9, fp32_acc  # the net actually learned
+
+    calib_it = mx.io.NDArrayIter(X[:128], y[:128].astype(np.float32), 64)
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        net, arg, aux, calib_data=calib_it, num_calib_examples=128,
+        calib_mode="entropy")
+    qmod = mx.mod.Module(qsym)
+    qmod.bind(eval_it.provide_data, eval_it.provide_label,
+              for_training=False)
+    qmod.init_params(arg_params=qarg, aux_params=qaux)
+    int8_acc = top1(qmod)
+    assert int8_acc >= fp32_acc - 0.01, (fp32_acc, int8_acc)
