@@ -103,7 +103,9 @@ def main():
     # libjpeg), so the pipeline rate is a host property, not a chip one.
     if os.environ.get("MXTPU_BENCH_PIPELINE", "1") == "1":
         try:
-            result.update(_pipeline_bench(trainer, batch, layout, dtype))
+            result.update(_pipeline_bench(
+                trainer, batch, layout, dtype,
+                synth_rate=imgs_per_sec_per_chip * n_dev))
         except Exception as e:  # never lose the primary metric
             result["pipeline_error"] = str(e)[:200]
 
@@ -111,18 +113,23 @@ def main():
     # fp32/fp16 table in docs/faq/perf.md:156,170, and quantized resnet via
     # quantize_graph_pass.cc + quantized_conv/pooling/fc kernels).
     # Each bench guards itself: one failing must not drop the other.
-    if os.environ.get("MXTPU_BENCH_INT8", "1") == "1":
+    run_bf16 = os.environ.get("MXTPU_BENCH_BF16", "1") == "1"
+    run_int8 = os.environ.get("MXTPU_BENCH_INT8", "1") == "1"
+    if run_bf16 or run_int8:
         # drop the trainer's HBM (params, fp32 masters, momentum,
         # donated activations) before binding the inference executors
         trainer = None
         import gc
         gc.collect()
+    if run_bf16:
         try:
             result.update(_bf16_infer_bench())
         except Exception as e:
             result["bf16_infer_error"] = str(e)[:200]
-        gc.collect()
+    if run_int8:
         try:
+            import gc
+            gc.collect()
             result.update(_int8_bench())
         except Exception as e:
             result["int8_error"] = str(e)[:200]
@@ -224,7 +231,8 @@ def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024):
     return out
 
 
-def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024):
+def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024,
+                    synth_rate=None):
     import io as _pyio
     import tempfile
 
@@ -253,47 +261,90 @@ def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024):
 
     # uint8 + NHWC: the decoder's own layout, so the host does zero
     # transpose/cast work and the host->device transfer is 4x narrower
-    # than fp32; normalization fuses into the device program
-    it = mx.io.ImageRecordIter(
-        path_imgrec=rec_path, path_imgidx=idx_path, data_shape=(3, 224, 224),
-        batch_size=batch, shuffle=True, dtype="uint8",
-        layout="NHWC" if layout == "NHWC" else "NCHW")
+    # than fp32; normalization fuses into the device program.
+    # NOTE the iterator produces batches whose nd.array already *dispatches*
+    # the h2d transfer; rates below differ by what they wait for:
+    #   decode rate  — host decode+assembly only (no transfer fence)
+    #   feed rate    — decode + transfer fenced on device (DeviceFeedIter):
+    #                  the true rate at which the device can be fed
+    #   fed rate     — full training consuming the device feed
+    def make_it():
+        return mx.io.ImageRecordIter(
+            path_imgrec=rec_path, path_imgidx=idx_path,
+            data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
+            dtype="uint8", layout="NHWC" if layout == "NHWC" else "NCHW")
 
-    # iterator-only rate (native decode + batch assembly)
-    it.reset()
+    it = make_it()
     n = 0
     t0 = time.perf_counter()
     for b in it:
         n += b.data[0].shape[0]
     dt_iter = time.perf_counter() - t0
-    iter_rate = n / dt_iter
+    decode_rate = n / dt_iter
+
+    # decode-thread scaling harness (reference: preprocess_threads /
+    # the OMP decode team in iter_image_recordio_2.cc:139): pure native
+    # decode of one batch worth of JPEGs at 1/2/4 threads.  On a 1-core
+    # host the curve is flat — the harness proves the architecture.
+    from mxnet_tpu import _native
+    scaling = {}
+    if _native.available():
+        reader = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+        bufs = [recordio.unpack(reader.read_idx(i))[1]
+                for i in range(min(batch, n_records))]
+        reader.close()
+        for nt in (1, 2, 4):
+            t0 = time.perf_counter()
+            _native.decode_batch(bufs, 224, 224, 3, num_threads=nt)
+            scaling[str(nt)] = round(len(bufs) /
+                                     (time.perf_counter() - t0), 2)
 
     prep = jax.jit(lambda x: (x.astype(jnp.float32) / 255.0).astype(dtype))
 
-    def to_dev(b):
-        return mx.nd.NDArray(prep(b.data[0]._data)), b.label[0]
+    # feed rate: decode + fenced device transfer, no training.  The timer
+    # starts BEFORE the iterator is built: its worker begins prefetching
+    # at construction, and with only ~4 batches the warm prefetch would
+    # otherwise hide most of the feed work.
+    t0 = time.perf_counter()
+    feed = mx.io.DeviceFeedIter(make_it(), transform=prep)
+    n_feed = 0
+    for b in feed:
+        n_feed += b.data[0].shape[0]
+    dt_feed = time.perf_counter() - t0
+    feed_rate = n_feed / dt_feed
 
-    # trainer-fed rate: PrefetchingIter overlaps decode with device compute
-    it.reset()
+    # fed rate: trainer consumes the double-buffered device feed — the
+    # worker fences one transfer at a time while the previous step's
+    # compute runs on device (iter_prefetcher.h:47 analogue)
+    loss = None
     n = 0
     t0 = time.perf_counter()
-    loss = None
-    for b in it:
+    fed = mx.io.DeviceFeedIter(make_it(), transform=prep)
+    for b in fed:
         if b.data[0].shape[0] != batch:
             break
-        x, y = to_dev(b)
-        loss = trainer.step(x, y)
+        loss = trainer.step(b.data[0], b.label[0])
         n += batch
     if loss is not None:
         loss.asscalar()
     dt_fed = time.perf_counter() - t0
     fed_rate = n / dt_fed if n else 0.0
 
+    # stall accounting: time per fed batch not explained by the binding
+    # constraint (host feed or device compute) = repo-caused serialization
+    t_fed_b = dt_fed / max(1, n // batch)
+    t_feed_b = dt_feed / max(1, n_feed // batch)
+    t_synth_b = batch / synth_rate if synth_rate else t_fed_b
+    stall = max(0.0, t_fed_b - max(t_feed_b, t_synth_b)) / t_fed_b
+
     import shutil
     shutil.rmtree(tmpdir, ignore_errors=True)
     return {
-        "pipeline_iter_imgs_per_sec": round(iter_rate, 2),
+        "pipeline_decode_imgs_per_sec": round(decode_rate, 2),
+        "pipeline_iter_imgs_per_sec": round(feed_rate, 2),
         "pipeline_fed_imgs_per_sec": round(fed_rate, 2),
+        "pipeline_stall_pct": round(stall * 100, 2),
+        "pipeline_decode_thread_scaling": scaling,
         "pipeline_host_cores": os.cpu_count(),
     }
 

@@ -436,8 +436,13 @@ class ImageIter(_io.DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", dtype="float32",
-                 last_batch_handle="pad", layout="NCHW", **kwargs):
+                 last_batch_handle="pad", layout="NCHW",
+                 preprocess_threads=0, **kwargs):
         super().__init__(batch_size)
+        # decode-thread count for the native libjpeg pipeline (reference:
+        # preprocess_threads on ImageRecordIter, iter_image_recordio_2.cc
+        # OMP team); 0 = all host cores
+        self.preprocess_threads = int(preprocess_threads)
         assert len(data_shape) == 3 and data_shape[0] in (1, 3)
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
@@ -663,7 +668,8 @@ class ImageIter(_io.DataIter):
             self._native_tail = None
             return self._decode_python_bufs(bufs, labels, pad)
         decoded, fails = _native.decode_batch(
-            bufs, h, w, c, resize_short=self._native_resize)
+            bufs, h, w, c, resize_short=self._native_resize,
+            num_threads=self.preprocess_threads)
         if fails:
             raise MXNetError("%d corrupt image records in batch" % fails)
         if np.dtype(self.dtype) == np.uint8 and not any(

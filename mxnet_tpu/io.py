@@ -22,7 +22,8 @@ from .base import MXNetError
 from .ndarray import NDArray, array as _nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "PrefetchingIter", "DeviceFeedIter", "CSVIter", "MNISTIter",
+           "ImageRecordIter",
            "LibSVMIter", "ImageDetRecordIter"]
 
 
@@ -43,7 +44,6 @@ def ImageRecordIter(**kwargs):
         std = _np2.array([kwargs.pop("std_r", 1.0),
                           kwargs.pop("std_g", 1.0),
                           kwargs.pop("std_b", 1.0)], dtype=_np2.float32)
-    kwargs.pop("preprocess_threads", None)
     kwargs.pop("prefetch_buffer", None)
     # C++ round_batch: True wraps/pads the tail batch, False emits it partial
     if kwargs.pop("round_batch", True):
@@ -359,6 +359,131 @@ class PrefetchingIter(DataIter):
             data = sum([list(x.data) for x in batches], [])
             label = sum([list(x.label or []) for x in batches], [])
             return DataBatch(data, label or None, pad=b.pad, index=b.index)
+        return b
+
+    def iter_next(self):
+        raise NotImplementedError("use next()")
+
+
+class DeviceFeedIter(DataIter):
+    """Double-buffered device feed (reference: ``iter_prefetcher.h:47`` +
+    the per-executor copy in ``executor_group.py _load_data``).
+
+    A worker thread pulls host batches from ``base``, moves them to device
+    (optionally through a jitted ``transform``) and **synchronizes the
+    transfer before handing the batch over**.  Two effects: the device
+    always holds the next batch when the trainer asks for it, and — on
+    remote-tunnel transports where a long h2d RPC and compute dispatch
+    RPCs contend pathologically when interleaved — the tunnel runs one
+    big transfer at a time while the previous step's compute proceeds on
+    device.  ``depth`` bounds device-resident prefetched batches (HBM).
+    """
+
+    def __init__(self, base, transform=None, depth=2):
+        super().__init__(base.batch_size)
+        import jax as _jax
+        self._jax = _jax
+        self.base = base
+        self.transform = transform
+        self._depth = depth
+        self._queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._exhausted = False
+        # serializes base-iterator access across worker generations: a
+        # worker stuck in a long transfer past reset()'s join timeout must
+        # not interleave base.next() with its replacement
+        self._base_lock = threading.Lock()
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.base.provide_data
+
+    @property
+    def provide_label(self):
+        return self.base.provide_label
+
+    def _to_device(self, batch):
+        from .ndarray import NDArray
+        outs = []
+        for arr in batch.data:
+            raw = arr._data if isinstance(arr, NDArray) else \
+                self._jax.numpy.asarray(arr)
+            if self.transform is not None:
+                raw = self.transform(raw)
+            outs.append(raw)
+        labels = [(a._data if isinstance(a, NDArray)
+                   else self._jax.numpy.asarray(a)) for a in (batch.label or [])]
+        # fence the transfer inside the worker: the consumer must never
+        # block on (or contend with) a half-shipped batch
+        self._jax.block_until_ready(outs + labels)
+        return DataBatch([NDArray(o) for o in outs],
+                         [NDArray(l) for l in labels] or None,
+                         pad=batch.pad, index=batch.index)
+
+    def _start(self):
+        self._error = None
+        # the worker captures ITS OWN stop event and queue: after a timed-
+        # out reset() swaps in fresh ones, a zombie worker can neither
+        # pollute the new queue nor miss its (already set) stop signal
+        stop, q = self._stop, self._queue
+
+        def run():
+            while not stop.is_set():
+                try:
+                    with self._base_lock:
+                        if stop.is_set():
+                            return
+                        host_batch = self.base.next()
+                    b = self._to_device(host_batch)
+                except StopIteration:
+                    q.put(None)
+                    return
+                except BaseException as e:
+                    self._error = e
+                    q.put(None)
+                    return
+                q.put(b)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        import time as _time
+        self._stop.set()
+        # drain while joining: the worker may be blocked on a full queue,
+        # and its final put must not deadlock the join
+        deadline = _time.monotonic() + 10
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=0.25)
+            if _time.monotonic() > deadline:
+                # stuck mid-transfer: abandon it — its captured queue/event
+                # are about to be swapped out and the base lock keeps it
+                # from touching the iterator again
+                break
+        with self._base_lock:
+            self.base.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._exhausted = False
+        self._start()
+
+    def next(self):
+        if self._exhausted:
+            raise StopIteration
+        b = self._queue.get()
+        if b is None:
+            self._exhausted = True
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            raise StopIteration
         return b
 
     def iter_next(self):

@@ -1,0 +1,551 @@
+"""Deep configuration sweeps merged into test_op_sweep.CASES (round 3).
+
+Reference: tests/python/unittest/test_operator.py runs conv across
+stride/pad/dilate/group combinations, reductions across axis sets, and
+indexing across mode/edge-index cases — one configuration per op is not a
+sweep.  Each entry here appends cases to the base sweep; the harness runs
+forward (+oracle when given), finite-difference gradients, and jit-vs-eager
+consistency for every case.
+
+Oracles: numpy where direct; conv/deconv/pooling configs rely on the
+FD-gradient + jit/eager checks here and get torch forward oracles in
+tests/test_op_deep_nn.py.
+"""
+import numpy as np
+
+from test_op_sweep import C, r, rpos
+
+
+def _r(*shape):
+    return r(*shape)
+
+
+def _idx(shape, high, dtype=np.float32):
+    def gen(rng):
+        return [rng.randint(0, high, shape).astype(dtype)]
+    return gen
+
+
+# conv/deconv cases whose FD check is disabled produce |scalar| large
+# enough that fp32 central-difference cancellation noise exceeds the
+# harness tolerance; their backward is covered analytically vs torch in
+# test_op_deep_nn.py.
+DEEP_CASES = {
+    # ---- Convolution: stride x pad x dilate x groups x layout x rank ----
+    # (reference: test_operator.py test_convolution_options)
+    "Convolution": [
+        C(lambda rng: [rng.randn(2, 4, 9, 9).astype(np.float32),
+                       rng.randn(6, 4, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "stride": (2, 2),
+                  "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 4, 9, 9).astype(np.float32),
+                       rng.randn(6, 4, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "pad": (2, 2),
+                  "no_bias": True}, tol=1e-4, grad=False),
+        C(lambda rng: [rng.randn(2, 4, 11, 11).astype(np.float32),
+                       rng.randn(6, 4, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "dilate": (2, 2),
+                  "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 4, 8, 8).astype(np.float32),
+                       rng.randn(6, 2, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "num_group": 2,
+                  "pad": (1, 1), "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 8, 8, 4).astype(np.float32),
+                       rng.randn(6, 3, 3, 4).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "layout": "NHWC",
+                  "pad": (1, 1), "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 3, 10).astype(np.float32),
+                       rng.randn(4, 3, 5).astype(np.float32)],
+          params={"kernel": (5,), "num_filter": 4, "stride": (2,),
+                  "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(1, 2, 5, 6, 7).astype(np.float32),
+                       rng.randn(4, 2, 3, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3, 3), "num_filter": 4, "pad": (1, 1, 1),
+                  "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 4, 9, 9).astype(np.float32),
+                       rng.randn(5, 4, 3, 2).astype(np.float32)],
+          params={"kernel": (3, 2), "num_filter": 5, "stride": (2, 1),
+                  "pad": (1, 0), "no_bias": True}, tol=1e-4),
+    ],
+    # ---- Deconvolution -------------------------------------------------
+    "Deconvolution": [
+        C(lambda rng: [rng.randn(2, 4, 5, 5).astype(np.float32),
+                       rng.randn(4, 6, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "stride": (2, 2),
+                  "no_bias": True}, tol=1e-4, grad=False),
+        C(lambda rng: [rng.randn(2, 4, 5, 5).astype(np.float32),
+                       rng.randn(4, 6, 4, 4).astype(np.float32)],
+          params={"kernel": (4, 4), "num_filter": 6, "stride": (2, 2),
+                  "pad": (1, 1), "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 4, 5, 5).astype(np.float32),
+                       rng.randn(4, 6, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "stride": (2, 2),
+                  "adj": (1, 1), "no_bias": True}, tol=1e-4, grad=False),
+        C(lambda rng: [rng.randn(2, 4, 6, 6).astype(np.float32),
+                       rng.randn(4, 2, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 4, "num_group": 2,
+                  "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 3, 7).astype(np.float32),
+                       rng.randn(3, 5, 4).astype(np.float32)],
+          params={"kernel": (4,), "num_filter": 5, "stride": (2,),
+                  "pad": (1,), "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(1, 2, 4, 4, 4).astype(np.float32),
+                       rng.randn(2, 3, 3, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3, 3), "num_filter": 3, "stride": (2, 2, 2),
+                  "no_bias": True}, tol=1e-4, grad=False),
+        C(lambda rng: [rng.randn(2, 5, 4, 6).astype(np.float32),
+                       rng.randn(5, 6, 3, 3).astype(np.float32)],
+          params={"kernel": (3, 3), "num_filter": 6, "dilate": (2, 2),
+                  "no_bias": True}, tol=1e-4),
+        C(lambda rng: [rng.randn(2, 3, 6, 4).astype(np.float32),
+                       rng.randn(3, 4, 2, 3).astype(np.float32)],
+          params={"kernel": (2, 3), "num_filter": 4, "stride": (2, 1),
+                  "no_bias": True}, tol=1e-4),
+    ],
+    # ---- Pooling: type x stride x pad x convention x layout x rank ------
+    "Pooling": [
+        C(r(2, 3, 9, 9), params={"kernel": (3, 3), "stride": (2, 2),
+                                 "pool_type": "max"}),
+        C(r(2, 3, 9, 9), params={"kernel": (3, 3), "stride": (2, 2),
+                                 "pad": (1, 1), "pool_type": "avg"}),
+        C(r(2, 3, 9, 9), params={"kernel": (3, 3), "stride": (2, 2),
+                                 "pad": (1, 1), "pool_type": "avg",
+                                 "count_include_pad": False}),
+        C(r(2, 3, 8, 8), params={"kernel": (2, 2), "stride": (2, 2),
+                                 "pool_type": "sum"}),
+        C(r(2, 3, 9, 9), params={"kernel": (3, 3), "stride": (2, 2),
+                                 "pooling_convention": "full",
+                                 "pool_type": "max"}),
+        C(r(2, 9, 9, 3), params={"kernel": (3, 3), "stride": (2, 2),
+                                 "layout": "NHWC", "pool_type": "max"}),
+        C(r(2, 3, 12), params={"kernel": (4,), "stride": (3,),
+                               "pool_type": "avg"}),
+        C(r(1, 2, 5, 6, 7), params={"kernel": (2, 2, 2), "stride": (2, 2, 2),
+                                    "pool_type": "max"}),
+        C(r(2, 3, 7, 7), params={"global_pool": True, "pool_type": "avg"}),
+        C(r(2, 3, 7, 7), params={"kernel": (3, 3), "stride": (1, 1),
+                                 "pool_type": "lp"}),
+    ],
+    # ---- FullyConnected -------------------------------------------------
+    "FullyConnected": [
+        C(lambda rng: [rng.randn(4, 7).astype(np.float32),
+                       rng.randn(5, 7).astype(np.float32)],
+          params={"num_hidden": 5, "no_bias": True},
+          oracle=lambda x, w, num_hidden, no_bias: x @ w.T),
+        C(lambda rng: [rng.randn(2, 3, 4).astype(np.float32),
+                       rng.randn(6, 4).astype(np.float32)],
+          params={"num_hidden": 6, "flatten": False, "no_bias": True},
+          oracle=lambda x, w, num_hidden, flatten, no_bias: x @ w.T),
+        C(lambda rng: [rng.randn(2, 3, 4).astype(np.float32),
+                       rng.randn(6, 12).astype(np.float32)],
+          params={"num_hidden": 6, "no_bias": True},
+          oracle=lambda x, w, num_hidden, no_bias:
+          x.reshape(2, 12) @ w.T),
+    ],
+    # ---- BatchNorm / LayerNorm ------------------------------------------
+    "BatchNorm": [
+        C(lambda rng: [rng.randn(2, 6, 6, 3).astype(np.float32),
+                       np.ones(3, np.float32), np.zeros(3, np.float32),
+                       np.zeros(3, np.float32), np.ones(3, np.float32)],
+          params={"axis": 3, "fix_gamma": False}, grad=False),
+        C(lambda rng: [rng.randn(2, 3, 5).astype(np.float32),
+                       np.ones(3, np.float32), np.zeros(3, np.float32),
+                       np.zeros(3, np.float32), np.ones(3, np.float32)],
+          params={"fix_gamma": False}, grad=False),
+        C(lambda rng: [rng.randn(2, 3, 6, 6).astype(np.float32),
+                       rng.rand(3).astype(np.float32) + 0.5,
+                       rng.randn(3).astype(np.float32),
+                       rng.randn(3).astype(np.float32),
+                       rng.rand(3).astype(np.float32) + 0.5],
+          params={"use_global_stats": True, "fix_gamma": False},
+          grad=False),
+    ],
+    "LayerNorm": [
+        C(lambda rng: [rng.randn(2, 3, 4).astype(np.float32),
+                       np.ones(3, np.float32), np.zeros(3, np.float32)],
+          params={"axis": 1}, grad=False),
+        C(lambda rng: [rng.randn(5, 8).astype(np.float32),
+                       np.ones(8, np.float32), np.zeros(8, np.float32)],
+          params={"axis": -1, "eps": 1e-3}, grad=False),
+    ],
+    # ---- activations ----------------------------------------------------
+    "Activation": [
+        C(r(3, 4), params={"act_type": "sigmoid"},
+          oracle=lambda x, act_type: 1 / (1 + np.exp(-x))),
+        C(r(3, 4), params={"act_type": "tanh"},
+          oracle=lambda x, act_type: np.tanh(x)),
+        C(r(3, 4), params={"act_type": "softrelu"},
+          oracle=lambda x, act_type: np.log1p(np.exp(x))),
+        C(r(3, 4), params={"act_type": "softsign"},
+          oracle=lambda x, act_type: x / (1 + np.abs(x))),
+    ],
+    "LeakyReLU": [
+        C(r(3, 4), params={"act_type": "leaky", "slope": 0.1},
+          oracle=lambda x, act_type, slope: np.where(x > 0, x, slope * x)),
+        C(r(3, 4), params={"act_type": "elu", "slope": 1.0},
+          oracle=lambda x, act_type, slope:
+          np.where(x > 0, x, slope * (np.exp(x) - 1))),
+    ],
+    "softmax": [
+        C(r(3, 4, 5), params={"axis": 0}),
+        C(r(3, 4), params={"temperature": 2.0}),
+        C(r(2, 3, 4, 5), params={"axis": 2}),
+    ],
+    "log_softmax": [
+        C(r(3, 4, 5), params={"axis": 0}),
+        C(r(3, 4), params={"axis": -1}),
+    ],
+    # ---- reductions: axis combos, negative axis, degenerate shapes ------
+    # (reference: test_operator.py test_reduce)
+    "sum": [
+        C(r(3, 4, 5), params={"axis": (0, 2)},
+          oracle=lambda x, axis: x.sum(axis=axis)),
+        C(r(3, 4, 5), params={"axis": -1},
+          oracle=lambda x, axis: x.sum(axis=-1)),
+        C(r(3, 4), params={},
+          oracle=lambda x: np.asarray(x.sum())),
+        C(r(3, 1, 5), params={"axis": 1, "keepdims": True},
+          oracle=lambda x, axis, keepdims: x.sum(axis=1, keepdims=True)),
+        C(r(1,), params={"axis": 0},
+          oracle=lambda x, axis: np.asarray(x.sum())),
+    ],
+    "mean": [
+        C(r(3, 4, 5), params={"axis": (0, 1)},
+          oracle=lambda x, axis: x.mean(axis=axis)),
+        C(r(3, 4, 5), params={"axis": -2, "keepdims": True},
+          oracle=lambda x, axis, keepdims: x.mean(axis=-2, keepdims=True)),
+        C(r(2, 3), params={"exclude": True, "axis": 0},
+          oracle=lambda x, axis, exclude: x.mean(axis=1)),
+    ],
+    "prod": [
+        C(r(2, 3, 4), params={"axis": (1, 2)},
+          oracle=lambda x, axis: x.prod(axis=axis)),
+        C(r(5,), params={"axis": 0},
+          oracle=lambda x, axis: np.asarray(x.prod())),
+    ],
+    "max": [
+        C(r(3, 4, 5), params={"axis": (0, 2)},
+          oracle=lambda x, axis: x.max(axis=axis)),
+        C(r(3, 4), params={"axis": -1, "keepdims": True},
+          oracle=lambda x, axis, keepdims: x.max(axis=-1, keepdims=True)),
+    ],
+    "min": [
+        C(r(3, 4, 5), params={"axis": (1, 2)},
+          oracle=lambda x, axis: x.min(axis=axis)),
+        C(r(7,), params={"axis": 0},
+          oracle=lambda x, axis: np.asarray(x.min())),
+    ],
+    "norm": [
+        C(r(3, 4, 5), params={"axis": (1, 2)},
+          oracle=lambda x, axis: np.sqrt((x * x).sum(axis=axis))),
+        C(r(3, 4), params={"ord": 2},
+          oracle=lambda x, ord: np.asarray(np.sqrt((x * x).sum()))),
+    ],
+    "argmax": [
+        C(r(3, 4, 5), params={"axis": 2, "keepdims": True},
+          oracle=lambda x, axis, keepdims:
+          x.argmax(axis=2)[:, :, None].astype(np.float32), grad=False),
+        C(r(6,), params={"axis": 0},
+          oracle=lambda x, axis: np.asarray(float(x.argmax())), grad=False),
+    ],
+    "argmin": [
+        C(r(3, 4, 5), params={"axis": 0},
+          oracle=lambda x, axis: x.argmin(axis=0).astype(np.float32),
+          grad=False),
+    ],
+    # ---- broadcast: both-sides, degenerate, 3-D -------------------------
+    "broadcast_add": [
+        C(lambda rng: [rng.randn(3, 1).astype(np.float32),
+                       rng.randn(1, 4).astype(np.float32)],
+          oracle=np.add),
+        C(lambda rng: [rng.randn(2, 1, 4).astype(np.float32),
+                       rng.randn(2, 3, 1).astype(np.float32)],
+          oracle=np.add),
+        C(lambda rng: [rng.randn(1, 1).astype(np.float32),
+                       rng.randn(3, 4).astype(np.float32)],
+          oracle=np.add),
+    ],
+    "broadcast_mul": [
+        C(lambda rng: [rng.randn(3, 1).astype(np.float32),
+                       rng.randn(1, 4).astype(np.float32)],
+          oracle=np.multiply),
+        C(lambda rng: [rng.randn(2, 3, 4).astype(np.float32),
+                       rng.randn(1, 3, 1).astype(np.float32)],
+          oracle=np.multiply),
+    ],
+    "broadcast_sub": [
+        C(lambda rng: [rng.randn(2, 1, 1).astype(np.float32),
+                       rng.randn(1, 3, 4).astype(np.float32)],
+          oracle=np.subtract),
+    ],
+    "broadcast_div": [
+        C(lambda rng: [rng.randn(3, 1).astype(np.float32),
+                       rng.rand(1, 4).astype(np.float32) + 0.5],
+          oracle=np.divide),
+    ],
+    "broadcast_to": [
+        C(r(1, 4), params={"shape": (3, 4)},
+          oracle=lambda x, shape: np.broadcast_to(x, shape)),
+        C(r(3, 1, 1), params={"shape": (3, 2, 5)},
+          oracle=lambda x, shape: np.broadcast_to(x, shape)),
+    ],
+    "broadcast_axis": [
+        C(r(1, 4), params={"axis": 0, "size": 3},
+          oracle=lambda x, axis, size: np.broadcast_to(x, (3, 4))),
+    ],
+    # ---- indexing: modes, negative, duplicate, out-of-range -------------
+    # (reference: test_operator.py test_take / indexing_op.h)
+    "take": [
+        C(lambda rng: [rng.randn(5, 4).astype(np.float32),
+                       np.array([0, 4, 2], np.int32)],
+          params={"axis": 0},
+          oracle=lambda a, i, axis: a[i.astype(int)]),
+        C(lambda rng: [rng.randn(5, 4).astype(np.float32),
+                       np.array([1, 1, 1], np.int32)],  # duplicates
+          params={"axis": 0},
+          oracle=lambda a, i, axis: a[i.astype(int)]),
+        C(lambda rng: [rng.randn(5, 4).astype(np.float32),
+                       np.array([7., -9.], np.float32)],  # out of range
+          params={"axis": 0, "mode": "clip"},
+          oracle=lambda a, i, axis, mode:
+          a[np.clip(i.astype(int), 0, 4)], grad=False),
+        C(lambda rng: [rng.randn(5, 4).astype(np.float32),
+                       np.array([6., -1.], np.float32)],
+          params={"axis": 0, "mode": "wrap"},
+          oracle=lambda a, i, axis, mode: a[i.astype(int) % 5], grad=False),
+        C(lambda rng: [rng.randn(3, 5).astype(np.float32),
+                       np.array([[0, 4], [2, 2]], np.int32)],
+          params={"axis": 1},
+          oracle=lambda a, i, axis: np.take(a, i.astype(int), axis=1)),
+    ],
+    "Embedding": [
+        C(lambda rng: [np.array([1, 3, 1, 0], np.int32),
+                       rng.randn(5, 6).astype(np.float32)],
+          params={"input_dim": 5, "output_dim": 6},
+          oracle=lambda i, w, input_dim, output_dim: w[i.astype(int)]),
+    ],
+    "batch_take": [
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       np.array([0., 3., 2.], np.float32)],
+          oracle=lambda a, i: a[np.arange(3), i.astype(int)], grad=False),
+    ],
+    "pick": [
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       np.array([0, 3, 1], np.int32)],
+          params={"axis": 1},
+          oracle=lambda a, i, axis: a[np.arange(3), i.astype(int)]),
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       np.array([9., -1., 1.], np.float32)],
+          params={"axis": 1, "mode": "clip"},
+          oracle=lambda a, i, axis, mode:
+          a[np.arange(3), np.clip(i.astype(int), 0, 3)], grad=False),
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       np.array([0, 3, 1], np.int32)],
+          params={"axis": 1, "keepdims": True},
+          oracle=lambda a, i, axis, keepdims:
+          a[np.arange(3), i.astype(int)][:, None]),
+    ],
+    "gather_nd": [
+        C(lambda rng: [rng.randn(4, 5).astype(np.float32),
+                       np.array([[0, 3, 3], [1, 1, 4]], np.int32)],
+          oracle=lambda d, i: d[i[0].astype(int), i[1].astype(int)]),
+        C(lambda rng: [rng.randn(4, 5, 2).astype(np.float32),
+                       np.array([[2, 2]], np.int32)],
+          oracle=lambda d, i: d[i[0].astype(int)]),
+    ],
+    "scatter_nd": [
+        C(lambda rng: [rng.randn(3).astype(np.float32),
+                       np.array([[0., 2., 0.]], np.float32)],  # dup index 0
+          params={"shape": (4,)}, grad=False),
+    ],
+    "one_hot": [
+        C(lambda rng: [np.array([0., 2., 1.], np.float32)],
+          params={"depth": 4},
+          oracle=lambda i, depth: np.eye(4, dtype=np.float32)[i.astype(int)],
+          grad=False),
+        C(lambda rng: [np.array([1., 3.], np.float32)],
+          params={"depth": 4, "on_value": 2.0, "off_value": -1.0},
+          oracle=lambda i, depth, on_value, off_value:
+          np.where(np.eye(4)[i.astype(int)] > 0, 2.0, -1.0)
+          .astype(np.float32), grad=False),
+    ],
+    "slice": [
+        C(r(5, 6), params={"begin": (1, 2), "end": (4, 5)},
+          oracle=lambda x, begin, end: x[1:4, 2:5]),
+        C(r(5, 6), params={"begin": (0, None), "end": (None, None),
+                           "step": (2, 1)},
+          oracle=lambda x, begin, end, step: x[::2, :]),
+        C(r(5, 6), params={"begin": (-3, 0), "end": (None, 6)},
+          oracle=lambda x, begin, end: x[-3:, :]),
+        C(r(5, 6), params={"begin": (4, None), "end": (0, None),
+                           "step": (-2, 1)},
+          oracle=lambda x, begin, end, step: x[4:0:-2, :]),
+    ],
+    "slice_axis": [
+        C(r(4, 5, 6), params={"axis": 1, "begin": 1, "end": 4},
+          oracle=lambda x, axis, begin, end: x[:, 1:4]),
+        C(r(4, 5, 6), params={"axis": -1, "begin": 0, "end": 3},
+          oracle=lambda x, axis, begin, end: x[..., :3]),
+        C(r(4, 5), params={"axis": 0, "begin": -2, "end": None},
+          oracle=lambda x, axis, begin, end: x[-2:]),
+    ],
+    "reverse": [
+        C(r(3, 4), params={"axis": 0}, oracle=lambda x, axis: x[::-1]),
+        C(r(3, 4, 5), params={"axis": (0, 2)},
+          oracle=lambda x, axis: x[::-1, :, ::-1]),
+    ],
+    "tile": [
+        C(r(2, 3), params={"reps": (2, 2)},
+          oracle=lambda x, reps: np.tile(x, reps)),
+        C(r(3,), params={"reps": (2, 3)},
+          oracle=lambda x, reps: np.tile(x, (2, 3))),
+    ],
+    "repeat": [
+        C(r(2, 3), params={"repeats": 2, "axis": 1},
+          oracle=lambda x, repeats, axis: np.repeat(x, 2, axis=1)),
+        C(r(2, 3), params={"repeats": 3},
+          oracle=lambda x, repeats: np.repeat(x, 3)),
+    ],
+    # ---- shape manipulation edge cases ----------------------------------
+    "Reshape": [
+        C(r(2, 3, 4), params={"shape": (0, -1)},
+          oracle=lambda x, shape: x.reshape(2, 12)),
+        C(r(2, 3, 4), params={"shape": (-1, 0)},
+          oracle=lambda x, shape: x.reshape(8, 3)),
+        C(r(2, 3, 4), params={"shape": (0, 0, 2, 2)},
+          oracle=lambda x, shape: x.reshape(2, 3, 2, 2)),
+        C(r(2, 12), params={"shape": (0, -4, 3, 4)},
+          oracle=lambda x, shape: x.reshape(2, 3, 4)),
+        C(r(2, 3, 4), params={"shape": (-3, 0)},
+          oracle=lambda x, shape: x.reshape(6, 4)),
+    ],
+    "transpose": [
+        C(r(2, 3, 4), params={"axes": (2, 0, 1)},
+          oracle=lambda x, axes: x.transpose(axes)),
+        C(r(2, 3), params={},
+          oracle=lambda x: x.T),
+        C(r(2, 3, 4, 5), params={"axes": (0, 3, 1, 2)},
+          oracle=lambda x, axes: x.transpose(axes)),
+    ],
+    "expand_dims": [
+        C(r(2, 3), params={"axis": 0},
+          oracle=lambda x, axis: x[None]),
+        C(r(2, 3), params={"axis": -1},
+          oracle=lambda x, axis: x[..., None]),
+        C(r(2, 3), params={"axis": 2},
+          oracle=lambda x, axis: x[:, :, None]),
+    ],
+    "squeeze": [
+        C(r(1, 3, 1, 4), params={},
+          oracle=lambda x: x.reshape(3, 4)),
+        C(r(1, 3, 1, 4), params={"axis": 2},
+          oracle=lambda x, axis: x.reshape(1, 3, 4)),
+    ],
+    "Flatten": [
+        C(r(2, 3, 4, 5), params={},
+          oracle=lambda x: x.reshape(2, 60)),
+        C(r(4, 1), params={}, oracle=lambda x: x),
+    ],
+    "stack": [
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       rng.randn(3, 4).astype(np.float32)],
+          params={"axis": 1},
+          oracle=lambda a, b, axis: np.stack([a, b], axis=1)),
+    ],
+    "Concat": [
+        C(lambda rng: [rng.randn(2, 3).astype(np.float32),
+                       rng.randn(2, 5).astype(np.float32)],
+          params={"dim": 1},
+          oracle=lambda a, b, dim: np.concatenate([a, b], axis=1)),
+        C(lambda rng: [rng.randn(1, 3).astype(np.float32),
+                       rng.randn(4, 3).astype(np.float32),
+                       rng.randn(2, 3).astype(np.float32)],
+          params={"dim": 0},
+          oracle=lambda *xs, dim: np.concatenate(xs, axis=0)),
+    ],
+    "split": [
+        C(r(4, 6), params={"num_outputs": 3, "axis": 1}, grad=False),
+        C(r(6, 4), params={"num_outputs": 2, "axis": 0,
+                           "squeeze_axis": False}, grad=False),
+    ],
+    "flip": [
+        C(r(3, 4), params={"axis": 1}, oracle=lambda x, axis: x[:, ::-1]),
+    ],
+    "Pad": [
+        C(r(2, 3, 4, 5), params={"mode": "constant",
+                                 "pad_width": (0, 0, 0, 0, 1, 1, 2, 2),
+                                 "constant_value": 0.5},
+          oracle=lambda x, mode, pad_width, constant_value:
+          np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)),
+                 constant_values=0.5)),
+        C(r(2, 3, 4, 5), params={"mode": "edge",
+                                 "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)},
+          oracle=lambda x, mode, pad_width:
+          np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="edge")),
+        C(r(2, 3, 4, 5), params={"mode": "reflect",
+                                 "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)},
+          oracle=lambda x, mode, pad_width:
+          np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="reflect")),
+    ],
+    # ---- dot family: transpose flags ------------------------------------
+    "dot": [
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       rng.randn(3, 5).astype(np.float32)],
+          params={"transpose_a": True},
+          oracle=lambda a, b, transpose_a: a.T @ b),
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       rng.randn(5, 4).astype(np.float32)],
+          params={"transpose_b": True},
+          oracle=lambda a, b, transpose_b: a @ b.T),
+        C(lambda rng: [rng.randn(4, 3).astype(np.float32),
+                       rng.randn(5, 4).astype(np.float32)],
+          params={"transpose_a": True, "transpose_b": True},
+          oracle=lambda a, b, transpose_a, transpose_b: a.T @ b.T),
+    ],
+    "batch_dot": [
+        C(lambda rng: [rng.randn(2, 3, 4).astype(np.float32),
+                       rng.randn(2, 3, 5).astype(np.float32)],
+          params={"transpose_a": True},
+          oracle=lambda a, b, transpose_a:
+          np.einsum("bij,bik->bjk", a, b)),
+        C(lambda rng: [rng.randn(2, 3, 4).astype(np.float32),
+                       rng.randn(2, 5, 4).astype(np.float32)],
+          params={"transpose_b": True},
+          oracle=lambda a, b, transpose_b:
+          np.einsum("bij,bkj->bik", a, b)),
+    ],
+    # ---- misc degenerate shapes -----------------------------------------
+    "where": [
+        C(lambda rng: [(rng.rand(3, 4) > 0.5).astype(np.float32),
+                       rng.randn(3, 4).astype(np.float32),
+                       rng.randn(3, 4).astype(np.float32)],
+          oracle=lambda c, a, b: np.where(c > 0, a, b)),
+    ],
+    "clip": [
+        C(r(3, 4), params={"a_min": 0.0, "a_max": 0.0},
+          oracle=lambda x, a_min, a_max: np.zeros_like(x), grad=False),
+    ],
+    "abs": [
+        C(r(1, 1), oracle=np.abs),
+        C(r(7,), oracle=np.abs),
+    ],
+    "_add": [
+        C(lambda rng: [rng.randn(1).astype(np.float32),
+                       rng.randn(1).astype(np.float32)], oracle=np.add),
+    ],
+    "SequenceMask": [
+        C(lambda rng: [rng.randn(4, 2, 3).astype(np.float32),
+                       np.array([2., 4.], np.float32)],
+          params={"use_sequence_length": True, "value": -1.0}, grad=False),
+    ],
+    "SequenceLast": [
+        C(lambda rng: [rng.randn(4, 2, 3).astype(np.float32),
+                       np.array([2., 4.], np.float32)],
+          params={"use_sequence_length": True}, grad=False),
+    ],
+    "SequenceReverse": [
+        C(lambda rng: [rng.randn(4, 2, 3).astype(np.float32),
+                       np.array([2., 4.], np.float32)],
+          params={"use_sequence_length": True}, grad=False),
+    ],
+}

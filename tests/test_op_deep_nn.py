@@ -1,0 +1,270 @@
+"""Deep NN-op verification vs the torch CPU oracle (round 3).
+
+Reference: tests/python/unittest/test_operator.py verifies Convolution/
+Deconvolution/Pooling forward AND backward across stride/pad/dilate/group
+configurations against hand-rolled numpy; torch's CPU kernels serve as the
+same role here (analytic-vs-analytic, no finite-difference noise).  The
+bf16 section checks that bf16 gradients track fp32 gradients — the dtype
+axis the reference runs via test_operator_gpu.py check_consistency.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import registry
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+SEED = 0
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x), requires_grad=False)
+
+
+def _tg(x):
+    t = torch.tensor(np.asarray(x))
+    t.requires_grad_(True)
+    return t
+
+
+# (data_shape, w_shape, params) — mirrors the op_sweep_deep_cases configs
+CONV_CONFIGS = [
+    ((2, 4, 9, 9), (6, 4, 3, 3), dict(stride=(2, 2))),
+    ((2, 4, 9, 9), (6, 4, 3, 3), dict(pad=(2, 2))),
+    ((2, 4, 11, 11), (6, 4, 3, 3), dict(dilate=(2, 2))),
+    ((2, 4, 8, 8), (6, 2, 3, 3), dict(num_group=2, pad=(1, 1))),
+    ((2, 4, 9, 9), (5, 4, 3, 3), dict(stride=(2, 1), pad=(1, 0))),
+    ((2, 4, 10, 10), (6, 4, 5, 5), dict(stride=(2, 2), pad=(2, 2))),
+    ((1, 3, 7, 7), (8, 3, 1, 1), dict()),
+    ((2, 4, 9, 9), (6, 4, 3, 3), dict(stride=(2, 2), dilate=(2, 2),
+                                      pad=(2, 2))),
+]
+
+
+@pytest.mark.parametrize("dshape,wshape,cfg", CONV_CONFIGS,
+                         ids=[str(i) for i in range(len(CONV_CONFIGS))])
+def test_convolution_vs_torch(dshape, wshape, cfg):
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(*dshape).astype(np.float32)
+    w = rng.randn(*wshape).astype(np.float32)
+    kernel = wshape[2:]
+    stride = cfg.get("stride", (1, 1))
+    pad = cfg.get("pad", (0, 0))
+    dilate = cfg.get("dilate", (1, 1))
+    groups = cfg.get("num_group", 1)
+    op = registry.get("Convolution")
+
+    def f(x_, w_):
+        return op.fn(x_, w_, None, kernel=kernel, num_filter=wshape[0],
+                     stride=stride, pad=pad, dilate=dilate,
+                     num_group=groups, no_bias=True)
+
+    out = f(jnp.asarray(x), jnp.asarray(w))
+    xt, wt = _tg(x), _tg(w)
+    ref = F.conv2d(xt, wt, stride=stride, padding=pad, dilation=dilate,
+                   groups=groups)
+    np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    # backward: cotangent of ones
+    dy = np.ones(ref.shape, np.float32)
+    ref.backward(_t(dy))
+    _, vjp = jax.vjp(f, jnp.asarray(x), jnp.asarray(w))
+    dx, dw = vjp(jnp.asarray(dy))
+    np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dw), wt.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+DECONV_CONFIGS = [
+    ((2, 4, 5, 5), (4, 6, 3, 3), dict(stride=(2, 2))),
+    ((2, 4, 5, 5), (4, 6, 4, 4), dict(stride=(2, 2), pad=(1, 1))),
+    ((2, 4, 5, 5), (4, 6, 3, 3), dict(stride=(2, 2), adj=(1, 1))),
+    ((2, 4, 6, 6), (4, 2, 3, 3), dict(num_group=2)),
+    ((2, 5, 4, 6), (5, 6, 3, 3), dict(dilate=(2, 2))),
+    ((2, 3, 6, 4), (3, 4, 2, 3), dict(stride=(2, 1))),
+    ((1, 2, 4, 4), (2, 3, 3, 3), dict(stride=(3, 3), pad=(1, 1),
+                                      adj=(2, 2))),
+    ((2, 4, 5, 5), (4, 4, 3, 3), dict(num_group=4, stride=(2, 2))),
+]
+
+
+@pytest.mark.parametrize("dshape,wshape,cfg", DECONV_CONFIGS,
+                         ids=[str(i) for i in range(len(DECONV_CONFIGS))])
+def test_deconvolution_vs_torch(dshape, wshape, cfg):
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(*dshape).astype(np.float32)
+    w = rng.randn(*wshape).astype(np.float32)
+    kernel = wshape[2:]
+    stride = cfg.get("stride", (1, 1))
+    pad = cfg.get("pad", (0, 0))
+    dilate = cfg.get("dilate", (1, 1))
+    adj = cfg.get("adj", (0, 0))
+    groups = cfg.get("num_group", 1)
+    num_filter = wshape[1] * groups
+    op = registry.get("Deconvolution")
+
+    def f(x_, w_):
+        return op.fn(x_, w_, None, kernel=kernel, num_filter=num_filter,
+                     stride=stride, pad=pad, dilate=dilate, adj=adj,
+                     num_group=groups, no_bias=True)
+
+    out = f(jnp.asarray(x), jnp.asarray(w))
+    xt, wt = _tg(x), _tg(w)
+    ref = F.conv_transpose2d(xt, wt, stride=stride, padding=pad,
+                             output_padding=adj, dilation=dilate,
+                             groups=groups)
+    np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    dy = np.ones(ref.shape, np.float32)
+    ref.backward(_t(dy))
+    _, vjp = jax.vjp(f, jnp.asarray(x), jnp.asarray(w))
+    dx, dw = vjp(jnp.asarray(dy))
+    np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(dw), wt.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+POOL_CONFIGS = [
+    (dict(kernel=(3, 3), stride=(2, 2), pool_type="max"), None),
+    (dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="max"), None),
+    (dict(kernel=(2, 2), stride=(2, 2), pool_type="max"), None),
+    (dict(kernel=(3, 3), stride=(1, 1), pool_type="max"), None),
+    (dict(kernel=(3, 3), stride=(2, 2), pool_type="avg"), None),
+    (dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="avg",
+          count_include_pad=True), None),
+    (dict(kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type="avg",
+          count_include_pad=False), None),
+    (dict(kernel=(2, 2), stride=(1, 1), pool_type="avg"), None),
+]
+
+
+@pytest.mark.parametrize("cfg,_", POOL_CONFIGS,
+                         ids=[str(i) for i in range(len(POOL_CONFIGS))])
+def test_pooling_vs_torch(cfg, _):
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    op = registry.get("Pooling")
+
+    def f(x_):
+        return op.fn(x_, **cfg)
+
+    out = f(jnp.asarray(x))
+    xt = _tg(x)
+    k, s = cfg["kernel"], cfg["stride"]
+    p = cfg.get("pad", (0, 0))
+    if cfg["pool_type"] == "max":
+        ref = F.max_pool2d(xt, k, s, p)
+    else:
+        ref = F.avg_pool2d(xt, k, s, p,
+                           count_include_pad=cfg.get("count_include_pad",
+                                                     True))
+    np.testing.assert_allclose(np.asarray(out), ref.detach().numpy(),
+                               rtol=1e-5, atol=1e-5)
+    dy = rng.rand(*ref.shape).astype(np.float32)
+    ref.backward(_t(dy))
+    _, vjp = jax.vjp(f, jnp.asarray(x))
+    (dx,) = vjp(jnp.asarray(dy))
+    np.testing.assert_allclose(np.asarray(dx), xt.grad.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# bf16 gradients track fp32 gradients on the NN set (reference dtype axis:
+# tests/python/gpu/test_operator_gpu.py check_consistency fp16-vs-fp32)
+# ---------------------------------------------------------------------------
+def _bf16_vs_fp32_grads(f, args, rtol=0.06, atol=0.06):
+    """Relative comparison of jax.grad at bf16 vs fp32 inputs.
+
+    The scalar is a fixed random-cotangent contraction sum(out * r): a
+    sum-of-squares would be scale-invariant for the normalizers (LN/BN
+    outputs have fixed norm), making dx identically ~0 and the comparison
+    pure rounding noise."""
+    f32 = [jnp.asarray(a, jnp.float32) for a in args]
+    b16 = [jnp.asarray(a, jnp.bfloat16) for a in args]
+    cot = {}
+
+    def scalar(dtype_args):
+        out = f(*dtype_args)
+        out = out.astype(jnp.float32)
+        if "r" not in cot:
+            cot["r"] = jnp.asarray(
+                np.random.RandomState(99).randn(*out.shape), jnp.float32)
+        return jnp.sum(out * cot["r"])
+
+    g32 = jax.grad(lambda *a: scalar(a), argnums=tuple(range(len(args))))(*f32)
+    g16 = jax.grad(lambda *a: scalar(a), argnums=tuple(range(len(args))))(*b16)
+    for a32, a16 in zip(g32, g16):
+        a32 = np.asarray(a32, np.float64)
+        a16 = np.asarray(a16.astype(jnp.float32), np.float64)
+        scale = np.abs(a32).max() + 1e-6
+        np.testing.assert_allclose(a16 / scale, a32 / scale,
+                                   rtol=rtol, atol=atol)
+
+
+def test_bf16_grad_convolution():
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32) * 0.5
+    w = rng.randn(6, 4, 3, 3).astype(np.float32) * 0.5
+    op = registry.get("Convolution")
+    _bf16_vs_fp32_grads(
+        lambda x_, w_: op.fn(x_, w_, None, kernel=(3, 3), num_filter=6,
+                             pad=(1, 1), no_bias=True), [x, w])
+
+
+def test_bf16_grad_fully_connected():
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(4, 7).astype(np.float32) * 0.5
+    w = rng.randn(5, 7).astype(np.float32) * 0.5
+    op = registry.get("FullyConnected")
+    _bf16_vs_fp32_grads(
+        lambda x_, w_: op.fn(x_, w_, None, num_hidden=5, no_bias=True),
+        [x, w])
+
+
+def test_bf16_grad_pooling():
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    op = registry.get("Pooling")
+    _bf16_vs_fp32_grads(
+        lambda x_: op.fn(x_, kernel=(3, 3), stride=(2, 2),
+                         pool_type="max"), [x])
+
+
+def test_bf16_grad_batchnorm():
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(4, 3, 6, 6).astype(np.float32)
+    g = np.ones(3, np.float32)
+    b = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    op = registry.get("BatchNorm")
+
+    def f(x_, g_, b_):
+        out = op.fn(x_, g_, b_, jnp.asarray(mm), jnp.asarray(mv),
+                    fix_gamma=False, _train=True)
+        return out[0] if isinstance(out, tuple) else out
+
+    _bf16_vs_fp32_grads(f, [x, g, b], rtol=0.1, atol=0.1)
+
+
+def test_bf16_grad_softmax():
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(4, 10).astype(np.float32)
+    op = registry.get("softmax")
+    _bf16_vs_fp32_grads(lambda x_: op.fn(x_), [x])
+
+
+def test_bf16_grad_layernorm():
+    rng = np.random.RandomState(SEED)
+    x = rng.randn(4, 8).astype(np.float32)
+    g = np.ones(8, np.float32)
+    b = np.zeros(8, np.float32)
+    op = registry.get("LayerNorm")
+    _bf16_vs_fp32_grads(lambda x_, g_, b_: op.fn(x_, g_, b_), [x, g, b],
+                        rtol=0.1, atol=0.1)
