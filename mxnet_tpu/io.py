@@ -423,10 +423,11 @@ class DeviceFeedIter(DataIter):
                          pad=batch.pad, index=batch.index)
 
     def _start(self):
-        self._error = None
-        # the worker captures ITS OWN stop event and queue: after a timed-
-        # out reset() swaps in fresh ones, a zombie worker can neither
-        # pollute the new queue nor miss its (already set) stop signal
+        # the worker captures ITS OWN stop event, queue and error box:
+        # after a timed-out reset() swaps in fresh ones, a zombie worker
+        # can neither pollute the new queue, nor miss its (already set)
+        # stop signal, nor write a stale exception into the new epoch
+        self._error_box = err = [None]
         stop, q = self._stop, self._queue
 
         def run():
@@ -441,7 +442,7 @@ class DeviceFeedIter(DataIter):
                     q.put(None)
                     return
                 except BaseException as e:
-                    self._error = e
+                    err[0] = e
                     q.put(None)
                     return
                 q.put(b)
@@ -480,8 +481,8 @@ class DeviceFeedIter(DataIter):
         b = self._queue.get()
         if b is None:
             self._exhausted = True
-            if self._error is not None:
-                err, self._error = self._error, None
+            if self._error_box[0] is not None:
+                err, self._error_box[0] = self._error_box[0], None
                 raise err
             raise StopIteration
         return b

@@ -14,5 +14,7 @@ from . import pallas_kernels  # noqa: F401
 from . import linalg  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import sparse_ops  # noqa: F401
 
 from .registry import register, get, list_ops  # noqa: F401

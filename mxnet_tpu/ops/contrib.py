@@ -811,3 +811,64 @@ def sparse_embedding(data, weight, input_dim=0, output_dim=0,
                      dtype="float32", deterministic=False):
     idx = data.astype(jnp.int32)
     return jnp.take(weight, idx, axis=0)
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    """data / sqrt(last_dim) — the transformer attention-scale helper
+    (reference: src/operator/contrib/transformer.cc)."""
+    return data / data.dtype.type(float(data.shape[-1]) ** 0.5)
+
+
+@register("_contrib_PSROIPooling", arg_names=["data", "rois"],
+          aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                  pooled_size=1, group_size=0):
+    """Plain position-sensitive ROI pooling (reference:
+    src/operator/contrib/psroi_pooling.cc, R-FCN) — the no-offset case of
+    the deformable kernel."""
+    g = int(group_size) or int(pooled_size)
+    return deformable_psroi_pooling(
+        data, rois, None, spatial_scale=spatial_scale,
+        output_dim=output_dim, group_size=g, pooled_size=pooled_size,
+        no_trans=True)
+
+
+@register("_contrib_quadratic", aliases=("quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """Elementwise a*x^2 + b*x + c (reference:
+    src/operator/contrib/quadratic_op.cc — the tutorial op)."""
+    return a * data * data + b * data + c
+
+
+from functools import partial as _q_partial
+
+
+@_q_partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _kl_sparse_reg(data, sparseness_target, penalty, momentum):
+    return data
+
+
+def _kl_sparse_fwd(data, sparseness_target, penalty, momentum):
+    return data, data
+
+
+def _kl_sparse_bwd(sparseness_target, penalty, momentum, res, g):
+    data = res
+    rho_hat = jnp.clip(jnp.mean(data, axis=0), 1e-6, 1 - 1e-6)
+    rho = sparseness_target
+    reg = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+    return (g + reg.astype(g.dtype),)
+
+
+_kl_sparse_reg.defvjp(_kl_sparse_fwd, _kl_sparse_bwd)
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    """Identity forward; backward adds the KL sparseness penalty gradient
+    on the mean activation (reference:
+    src/operator/identity_attach_KL_sparse_reg-inl.h, sparse autoencoder)."""
+    return _kl_sparse_reg(data, float(sparseness_target), float(penalty),
+                          float(momentum))

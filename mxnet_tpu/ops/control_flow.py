@@ -162,7 +162,7 @@ def histogram(data, bins=None, bin_cnt=10, range=None):
     return counts, edges
 
 
-@register("square_sum", arg_names=["data"])
+@register("square_sum", arg_names=["data"], aliases=("_square_sum",))
 def square_sum(data, axis=None, keepdims=False):
     """Reference: src/operator/tensor/square_sum.cc (row_sparse-aware in
     the reference; dense math is identical)."""

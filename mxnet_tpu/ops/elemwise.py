@@ -186,3 +186,49 @@ def add_n(*args):
     for a in args[1:]:
         out = out + a
     return out
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """Piecewise-linear sigmoid y = clip(alpha*x + beta, 0, 1)
+    (reference: src/operator/tensor/elemwise_unary_op_basic.cc:109)."""
+    return jnp.clip(data * data.dtype.type(alpha) + data.dtype.type(beta),
+                    0, 1)
+
+
+@register("_copyto", differentiable=True)
+def copyto_op(data):
+    """Cross-context copy node (reference: src/ndarray/ndarray.cc _copyto).
+    Device placement is XLA's job here, so this is identity."""
+    return data
+
+
+@register("_grad_add", arg_names=["lhs", "rhs"])
+def grad_add(lhs, rhs):
+    """Gradient-accumulation add (reference: elemwise_binary_op_basic.cc
+    _grad_add — the grad_req='add' aggregation node)."""
+    return lhs + rhs
+
+
+@register("_identity_with_attr_like_rhs", arg_names=["lhs", "rhs"])
+def identity_with_attr_like_rhs(lhs, rhs):
+    """Identity on lhs carrying rhs's storage attrs (reference:
+    elemwise_unary_op_basic.cc — sparse-gradient plumbing node)."""
+    return lhs
+
+
+@register("_scatter_plus_scalar")
+def scatter_plus_scalar(data, scalar=0.0):
+    """Storage-preserving scalar add (reference: elemwise_scatter_op.cc);
+    dense semantics are identical to _plus_scalar."""
+    return data + data.dtype.type(scalar)
+
+
+@register("_scatter_minus_scalar")
+def scatter_minus_scalar(data, scalar=0.0):
+    return data - data.dtype.type(scalar)
+
+
+@register("_scatter_elemwise_div", arg_names=["lhs", "rhs"])
+def scatter_elemwise_div(lhs, rhs):
+    return lhs / rhs

@@ -1,0 +1,30 @@
+"""Image-namespace operators (reference: src/operator/image/image_random.cc
+— the _image_* registered ops behind mx.nd.image.*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_image_to_tensor", arg_names=["data"])
+def image_to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]; batched NHWC -> NCHW
+    (reference: image_random.cc ToTensor)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("_image_normalize", arg_names=["data"])
+def image_normalize(data, mean=0.0, std=1.0):
+    """Per-channel normalize of CHW / NCHW tensors
+    (reference: image_random.cc Normalize)."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    cshape = (-1,) + (1,) * 2
+    if data.ndim == 4:
+        cshape = (1,) + cshape
+    return (data - mean.reshape(cshape) if mean.ndim else data - mean) / \
+        (std.reshape(cshape) if std.ndim else std)

@@ -8,6 +8,7 @@ hand-tuned transpose kernels.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register
@@ -261,3 +262,50 @@ def zeros_like(data):
 @register("ones_like")
 def ones_like(data):
     return jnp.ones_like(data)
+
+
+@register("_ravel_multi_index", arg_names=["data"], differentiable=False,
+          aliases=("ravel_multi_index",))
+def ravel_multi_index(data, shape=()):
+    """(ndim, N) coordinate rows -> flat indices for ``shape``
+    (reference: src/operator/tensor/ravel.cc:32)."""
+    strides = np.cumprod((list(shape[1:]) + [1])[::-1])[::-1].copy()
+    s = jnp.asarray(strides, data.dtype).reshape((-1,) + (1,) * (data.ndim - 1))
+    return (data * s).sum(axis=0)
+
+
+@register("_unravel_index", arg_names=["data"], differentiable=False,
+          aliases=("unravel_index",))
+def unravel_index(data, shape=()):
+    """Flat indices -> (ndim, N) coordinate rows for ``shape``
+    (reference: src/operator/tensor/ravel.cc:56)."""
+    strides = np.cumprod((list(shape[1:]) + [1])[::-1])[::-1].copy()
+    rows = []
+    for dim, st in zip(shape, strides):
+        rows.append((data // data.dtype.type(int(st))) %
+                    data.dtype.type(int(dim)))
+    return jnp.stack(rows, axis=0)
+
+
+def _assign_index(data, begin, end, step):
+    ndim = data.ndim
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step) + [None] * (ndim - len(step)) if step else [None] * ndim
+    return tuple(slice(b, e, s if s != 0 else None)
+                 for b, e, s in zip(begin, end, step))
+
+
+@register("_slice_assign", arg_names=["lhs", "rhs"])
+def slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """Copy of ``lhs`` with ``lhs[begin:end:step] = rhs``
+    (reference: src/operator/tensor/matrix_op.cc _slice_assign)."""
+    return lhs.at[_assign_index(lhs, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar")
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    """Copy of ``data`` with the slice filled by ``scalar``
+    (reference: matrix_op.cc _slice_assign_scalar)."""
+    return data.at[_assign_index(data, begin, end, step)].set(
+        data.dtype.type(scalar))

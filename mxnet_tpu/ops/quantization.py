@@ -242,3 +242,13 @@ def calib_minmax(arrays):
     mx = max(float(np.max(a.asnumpy() if hasattr(a, "asnumpy") else a))
              for a in arrays)
     return mn, mx
+
+
+@register("_contrib_quantized_flatten",
+          arg_names=["data", "min_data", "max_data"], num_outputs=3,
+          differentiable=False, aliases=("quantized_flatten",))
+def quantized_flatten(data, min_data, max_data):
+    """Flatten on the int8 tensor; the range rides through
+    (reference: src/operator/quantization/quantized_flatten.cc:31)."""
+    return (data.reshape(data.shape[0], -1), min_data.reshape(1),
+            max_data.reshape(1))

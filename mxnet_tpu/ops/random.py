@@ -101,10 +101,19 @@ def sample_multinomial(data, shape=(), get_prob=False, dtype="int32"):
     return samp
 
 
+def _shape_tuple(shape):
+    """MXNet accepts scalar shapes (shape=500) as well as tuples."""
+    if not shape:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
 def _elem_sample(name, draw):
     @register(name, arg_names=["low", "high"], differentiable=False)
     def fn(a, b, shape=(), dtype=None, __draw=draw):
-        s = tuple(shape) if shape else ()
+        s = _shape_tuple(shape)
         return __draw(a, b, a.shape + s)
     return fn
 
@@ -126,3 +135,51 @@ def _bshape(x, shape):
 @register("_shuffle", differentiable=False, aliases=("shuffle",))
 def shuffle(data):
     return jax.random.permutation(_rng.next_key(), data, axis=0)
+
+
+def _one_param_sample(name, draw):
+    @register(name, arg_names=["data"], differentiable=False)
+    def fn(lam, shape=(), dtype=None, __draw=draw):
+        s = _shape_tuple(shape)
+        return __draw(lam, lam.shape + s).astype(_dt(dtype or "float32"))
+    return fn
+
+
+# per-element distribution-parameter samplers (reference:
+# src/operator/random/sample_op.cc — the _sample_* forms take parameter
+# *tensors*, one draw block per element, unlike the scalar _random_* forms)
+_one_param_sample(
+    "_sample_poisson",
+    lambda lam, s: jax.random.poisson(_rng.next_key(), _bshape(lam, s)))
+_one_param_sample(
+    "_sample_exponential",
+    lambda lam, s: jax.random.exponential(_rng.next_key(), s) /
+    _bshape(lam, s))
+
+
+@register("_sample_negative_binomial", arg_names=["k", "p"],
+          differentiable=False)
+def sample_negative_binomial(k, p, shape=(), dtype=None):
+    """NB(k, p) via the gamma–Poisson mixture (reference: sample_op.cc
+    NegativeBinomialSampler): lambda ~ Gamma(k, (1-p)/p), X ~ Poisson."""
+    s = _shape_tuple(shape)
+    full = k.shape + s
+    kb = _bshape(k.astype(jnp.float32), full)
+    pb = _bshape(p.astype(jnp.float32), full)
+    lam = jax.random.gamma(_rng.next_key(), kb) * (1.0 - pb) / pb
+    return jax.random.poisson(_rng.next_key(), lam).astype(
+        _dt(dtype or "float32"))
+
+
+@register("_sample_generalized_negative_binomial", arg_names=["mu", "alpha"],
+          differentiable=False)
+def sample_generalized_negative_binomial(mu, alpha, shape=(), dtype=None):
+    """GNB(mu, alpha): lambda ~ Gamma(1/alpha, mu*alpha), X ~ Poisson
+    (reference: sample_op.cc GeneralizedNegativeBinomialSampler)."""
+    s = _shape_tuple(shape)
+    full = mu.shape + s
+    mub = _bshape(mu.astype(jnp.float32), full)
+    ab = jnp.clip(_bshape(alpha.astype(jnp.float32), full), 1e-9, None)
+    lam = jax.random.gamma(_rng.next_key(), 1.0 / ab) * mub * ab
+    return jax.random.poisson(_rng.next_key(), lam).astype(
+        _dt(dtype or "float32"))

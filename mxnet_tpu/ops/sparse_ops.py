@@ -1,0 +1,77 @@
+"""Sparse storage operators in the registry.
+
+Reference: ``src/operator/tensor/cast_storage.cc`` (CastStorageDnsRspImpl /
+CastStorageDnsCsrImpl) and ``sparse_retain.cc`` — registered ops there,
+previously only Python helpers here.
+
+TPU-native design: sparse values cross the op boundary as **static-capacity
+padded** ``(data, indices[, indptr], nnz)`` tuples.  XLA requires static
+shapes, so instead of a host sync to size the output by the true nnz (the
+dynamic-shape trap), the caller picks a capacity (default: the worst case)
+and the op pads — rows past ``nnz`` carry an out-of-range sentinel index
+and zero data.  ``jnp.nonzero(..., size=..., fill_value=...)`` keeps the
+whole scan on device.  Indices are int32 — XLA's native index type (the
+wrapper classes in ndarray/sparse.py widen to int64 at their boundary for
+reference dtype parity).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("cast_storage", arg_names=["data"], differentiable=False,
+          num_outputs=lambda p: 4 if p.get("stype") == "csr" else 3)
+def cast_storage(data, stype="row_sparse", capacity=0):
+    """Dense -> padded sparse encoding, fully on device.
+
+    ``row_sparse`` returns ``(values, row_indices, nnz)`` where
+    ``values.shape = (capacity,) + data.shape[1:]`` and padding rows have
+    index ``data.shape[0]`` (out of range) and zero values.
+    ``csr`` (2-D data) returns ``(values, col_indices, indptr, nnz)`` with
+    element capacity padding.  ``capacity=0`` means worst case
+    (``shape[0]`` rows / ``size`` elements) — always exact, never syncs.
+    """
+    if stype == "row_sparse":
+        n = data.shape[0]
+        cap = int(capacity) or n
+        flat = data.reshape(n, -1)
+        row_nz = jnp.any(flat != 0, axis=-1)
+        (idx,) = jnp.nonzero(row_nz, size=cap, fill_value=n)
+        hit = idx < n
+        vals = jnp.where(hit.reshape((-1,) + (1,) * (data.ndim - 1)),
+                         data[jnp.clip(idx, 0, n - 1)], 0)
+        return vals, idx.astype(jnp.int32), row_nz.sum().astype(jnp.int32)
+    if stype == "csr":
+        assert data.ndim == 2, "csr needs 2-D data"
+        n, m = data.shape
+        cap = int(capacity) or data.size
+        rows, cols = jnp.nonzero(data != 0, size=cap, fill_value=n)
+        hit = rows < n
+        vals = jnp.where(hit, data[jnp.clip(rows, 0, n - 1),
+                                   jnp.clip(cols, 0, m - 1)], 0)
+        counts = jnp.bincount(jnp.where(hit, rows, n), length=n + 1)[:n]
+        indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(counts).astype(jnp.int32)])
+        return (vals, jnp.where(hit, cols, 0).astype(jnp.int32), indptr,
+                hit.sum().astype(jnp.int32))
+    raise ValueError("cast_storage target %r" % (stype,))
+
+
+@register("_sparse_retain", arg_names=["data", "indices", "new_idx"],
+          differentiable=False, num_outputs=2)
+def sparse_retain(data, indices, new_idx):
+    """Keep the requested rows of a (padded) row-sparse pair
+    (reference: sparse_retain.cc).  Static output shape
+    ``(len(new_idx),) + data.shape[1:]``; requested rows missing from the
+    source come out zero — matching the reference RspImpl."""
+    src_idx = indices.astype(jnp.int32)
+    keep = new_idx.astype(jnp.int32)
+    nnz = src_idx.shape[0]
+    pos = jnp.searchsorted(src_idx, keep)
+    pos_c = jnp.clip(pos, 0, max(nnz - 1, 0))
+    hit = (pos < nnz) & (src_idx[pos_c] == keep)
+    bshape = (-1,) + (1,) * (data.ndim - 1)
+    out = jnp.where(hit.reshape(bshape), data[pos_c], 0)
+    return out, keep

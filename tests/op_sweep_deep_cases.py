@@ -549,3 +549,124 @@ DEEP_CASES = {
           params={"use_sequence_length": True}, grad=False),
     ],
 }
+
+
+# ---- round-3 operator tail (VERDICT r2 Missing #2) ----------------------
+DEEP_CASES.update({
+    "hard_sigmoid": [
+        C(r(3, 4), oracle=lambda x: np.clip(0.2 * x + 0.5, 0, 1)),
+        C(r(5,), params={"alpha": 0.5, "beta": 0.0},
+          oracle=lambda x, alpha, beta: np.clip(0.5 * x, 0, 1)),
+    ],
+    "_ravel_multi_index": [
+        C(lambda rng: [np.array([[1., 2.], [0., 1.]], np.float32)],
+          params={"shape": (3, 4)},
+          oracle=lambda d, shape: np.asarray(
+              np.ravel_multi_index(d.astype(int), shape), np.float32),
+          grad=False),
+    ],
+    "_unravel_index": [
+        C(lambda rng: [np.array([4., 9.], np.float32)],
+          params={"shape": (3, 4)},
+          oracle=lambda d, shape: np.asarray(
+              np.unravel_index(d.astype(int), shape), np.float32),
+          grad=False),
+    ],
+    "_slice_assign": [
+        C(lambda rng: [rng.randn(4, 5).astype(np.float32),
+                       rng.randn(2, 2).astype(np.float32)],
+          params={"begin": (1, 2), "end": (3, 4)},
+          oracle=lambda a, b, begin, end:
+          np.concatenate([a[:1], np.concatenate(
+              [a[1:3, :2], b, a[1:3, 4:]], axis=1), a[3:]], axis=0)),
+    ],
+    "_slice_assign_scalar": [
+        C(r(4, 5), params={"scalar": 7.0, "begin": (1,), "end": (3,)},
+          oracle=lambda x, scalar, begin, end: np.concatenate(
+              [x[:1], np.full((2, 5), 7.0, np.float32), x[3:]], axis=0)),
+    ],
+    "_sample_poisson": [
+        C(lambda rng: [np.array([1.0, 20.0], np.float32)],
+          params={"shape": (500,)}, grad=False),
+    ],
+    "_sample_exponential": [
+        C(lambda rng: [np.array([1.0, 10.0], np.float32)],
+          params={"shape": (500,)}, grad=False),
+    ],
+    "_sample_negative_binomial": [
+        C(lambda rng: [np.array([5.0], np.float32),
+                       np.array([0.5], np.float32)],
+          params={"shape": (500,)}, grad=False),
+    ],
+    "_sample_generalized_negative_binomial": [
+        C(lambda rng: [np.array([4.0], np.float32),
+                       np.array([0.25], np.float32)],
+          params={"shape": (500,)}, grad=False),
+    ],
+    "_image_to_tensor": [
+        C(lambda rng: [rng.randint(0, 255, (4, 5, 3)).astype(np.uint8)],
+          oracle=lambda x: (x.astype(np.float32) / 255.0)
+          .transpose(2, 0, 1), grad=False),
+        C(lambda rng: [rng.randint(0, 255, (2, 4, 5, 3)).astype(np.uint8)],
+          oracle=lambda x: (x.astype(np.float32) / 255.0)
+          .transpose(0, 3, 1, 2), grad=False),
+    ],
+    "_image_normalize": [
+        C(r(3, 4, 5), params={"mean": (0.1, 0.2, 0.3), "std": (1., 2., 4.)},
+          oracle=lambda x, mean, std:
+          (x - np.asarray(mean, np.float32).reshape(3, 1, 1)) /
+          np.asarray(std, np.float32).reshape(3, 1, 1)),
+    ],
+    "_contrib_div_sqrt_dim": [
+        C(r(2, 16), oracle=lambda x: x / 4.0),
+    ],
+    "_contrib_quantized_flatten": [
+        C(lambda rng: [rng.randint(-127, 127, (2, 3, 4)).astype(np.int8),
+                       np.array([-1.0], np.float32),
+                       np.array([1.0], np.float32)], grad=False),
+    ],
+    "_contrib_PSROIPooling": [
+        C(lambda rng: [rng.randn(1, 8, 8, 8).astype(np.float32),
+                       np.array([[0, 0, 0, 7, 7]], np.float32)],
+          params={"spatial_scale": 1.0, "output_dim": 2, "pooled_size": 2},
+          grad=False),
+    ],
+    "cast_storage": [
+        C(lambda rng: [np.array([[0, 0], [1, 2], [0, 0], [3, 0]],
+                                np.float32)],
+          params={"stype": "row_sparse"}, grad=False),
+        C(lambda rng: [np.array([[0, 1], [2, 0]], np.float32)],
+          params={"stype": "csr"}, grad=False),
+    ],
+    "_sparse_retain": [
+        C(lambda rng: [rng.randn(3, 2).astype(np.float32),
+                       np.array([0, 2, 5], np.int64),
+                       np.array([2, 3, 5], np.int64)], grad=False),
+    ],
+})
+
+
+DEEP_CASES.update({
+    "_copyto": [C(r(3, 4), oracle=lambda x: x)],
+    "_grad_add": [C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                                 rng.randn(3, 4).astype(np.float32)],
+                    oracle=np.add)],
+    "_identity_with_attr_like_rhs": [
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       rng.randn(3, 4).astype(np.float32)],
+          oracle=lambda a, b: a)],
+    "_scatter_plus_scalar": [C(r(3, 4), params={"scalar": 2.0},
+                               oracle=lambda x, scalar: x + 2.0)],
+    "_scatter_minus_scalar": [C(r(3, 4), params={"scalar": 2.0},
+                                oracle=lambda x, scalar: x - 2.0)],
+    "_scatter_elemwise_div": [
+        C(lambda rng: [rng.randn(3, 4).astype(np.float32),
+                       rng.rand(3, 4).astype(np.float32) + 0.5],
+          oracle=np.divide)],
+    "_contrib_quadratic": [
+        C(r(3, 4), params={"a": 1.0, "b": 2.0, "c": 3.0},
+          oracle=lambda x, a, b, c: x * x + 2 * x + 3)],
+    "IdentityAttachKLSparseReg": [
+        C(lambda rng: [rng.rand(4, 3).astype(np.float32)],
+          oracle=lambda x: x, grad=False)],
+})

@@ -771,6 +771,8 @@ ALSO_COVERED = {
     "_sparse_adagrad_update": "test_optimizer_ops.py",
     "_scatter_set_nd": "test_ndarray.py (indexed assignment)",
     "_getitem": "test_ndarray.py (slicing)",
+    "PSROIPooling": "sweep (as _contrib_PSROIPooling)",
+    "_square_sum": "sweep (alias of square_sum)",
 }
 
 
