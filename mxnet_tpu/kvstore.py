@@ -93,7 +93,7 @@ class KVStore:
         if self._rank == 0:
             self._ps_server = kvstore_ps.PSServer(
                 port=port, num_workers=self._num_workers)
-        self._ps_client = kvstore_ps.PSClient(host, port)
+        self._ps_client = kvstore_ps.PSClient(host, port, rank=self._rank)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -183,15 +183,15 @@ class KVStore:
             self._ps_client.request("push", k, "2bit",
                                     (packed, shape, thr))
             return
-        self._ps_client.request(
-            "push", k, "dense", _np.asarray(merged.asnumpy(), _np.float32))
+        self._ps_client.push_array(
+            k, _np.asarray(merged.asnumpy(), _np.float32))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out, allow_list_values=True)
         for k, o in zip(keys, outs):
             if self._ps_client is not None:
                 import jax.numpy as _jnp
-                arr = self._ps_client.request("pull", k)[1]
+                arr = self._ps_client.pull_array(k)
                 stored = self._store[k]
                 stored._set_data(_jnp.asarray(arr))
             else:
@@ -285,8 +285,16 @@ class KVStore:
             self._updater.set_states(f.read())
 
     def get_num_dead_node(self, node_id=0):
-        """PS liveness probe (reference: kvstore.h:339).  jax.distributed
-        surfaces failures as errors rather than counts; report 0."""
+        """PS liveness probe (reference: kvstore.h:339 — ps-lite heartbeat
+        dead-node count).  The PS tracks worker connections: a rank whose
+        socket closed without reconnecting counts as dead.  Non-PS types
+        have no server to ask; jax.distributed surfaces failures as
+        errors, so report 0 there."""
+        if self._ps_client is not None:
+            try:
+                return int(self._ps_client.request("num_dead")[1])
+            except (OSError, ConnectionError):
+                return 1  # the server itself is unreachable
         return 0
 
     def _barrier_before_exit(self):

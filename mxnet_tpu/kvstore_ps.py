@@ -86,6 +86,14 @@ def _recv_exact(sock, n):
     return buf
 
 
+BIGARRAY_BOUND = int(__import__("os").environ.get(
+    "MXNET_KVSTORE_BIGARRAY_BOUND", str(1_000_000)))  # elements per chunk
+# (reference: kvstore_dist.h:522 EncodeDefaultKey shards keys above
+# MXNET_KVSTORE_BIGARRAY_BOUND across servers; with one host server the
+# analogue is chunked wire transfers so a 100M-param key never serializes
+# through one pickle blob)
+
+
 class PSServer:
     """Host-side async parameter server (runs as a thread on rank 0)."""
 
@@ -95,6 +103,13 @@ class PSServer:
         self._updater = None
         self._store_lock = threading.Lock()
         self._num_workers = num_workers
+        # liveness: ranks that said hello on a live socket; a closed socket
+        # moves its rank to dead until it reconnects (reference:
+        # kvstore.h:339 get_num_dead_node over ps-lite heartbeats)
+        self._live_ranks = {}
+        self._dead_ranks = set()
+        self._live_lock = threading.Lock()
+        self._push_staging = {}
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -118,16 +133,29 @@ class PSServer:
                              daemon=True).start()
 
     def _serve(self, conn):
+        rank_box = [None]
         try:
             while True:
                 msg = _recv(conn)
                 if msg is None:
                     return
+                if msg[0] == "hello":
+                    rank_box[0] = msg[1]
+                    with self._live_lock:
+                        self._live_ranks[msg[1]] = conn
+                        self._dead_ranks.discard(msg[1])
+                    _send(conn, ("ok",))
+                    continue
                 reply = self._handle(msg)
                 _send(conn, reply)
         except (OSError, EOFError):
             pass
         finally:
+            if rank_box[0] is not None:
+                with self._live_lock:
+                    if self._live_ranks.get(rank_box[0]) is conn:
+                        del self._live_ranks[rank_box[0]]
+                        self._dead_ranks.add(rank_box[0])
             conn.close()
 
     def _key_lock(self, key):
@@ -189,6 +217,38 @@ class PSServer:
                 return ("err", "key %r not initialized" % (key,))
             idx = np.asarray(row_ids, np.int64)
             return ("ok", arr[idx], idx)
+        if cmd == "num_dead":
+            with self._live_lock:
+                return ("ok", len(self._dead_ranks))
+        if cmd == "pull_meta":
+            _, key = msg
+            with self._key_lock(key):
+                arr = self._store.get(key)
+            if arr is None:
+                return ("err", "key %r not initialized" % (key,))
+            return ("ok", tuple(arr.shape), int(arr.size))
+        if cmd == "pull_chunk":
+            _, key, start, stop = msg
+            with self._key_lock(key):
+                arr = self._store.get(key)
+            if arr is None:
+                return ("err", "key %r not initialized" % (key,))
+            return ("ok", arr.reshape(-1)[start:stop])
+        if cmd == "push_chunk":
+            _, key, shape, start, stop, payload, last = msg
+            with self._key_lock(key):
+                if key not in self._store:
+                    return ("err", "key %r not initialized" % (key,))
+                buf = self._push_staging.get(key)
+                if buf is None:
+                    buf = self._push_staging[key] = np.zeros(
+                        int(np.prod(shape)), np.float32)
+                buf[start:stop] = payload
+                if not last:
+                    return ("ok",)
+                grad = self._push_staging.pop(key).reshape(shape)
+            # apply like a dense push (re-enter the push path)
+            return self._handle(("push", key, "dense", grad))
         if cmd == "barrier":
             with self._barrier_cv:
                 gen = self._barrier_gen
@@ -239,7 +299,8 @@ class PSClient:
     rank 0's server thread is listening (ps-lite handles this with its
     own rendezvous; plain TCP needs the retry loop)."""
 
-    def __init__(self, host, port, timeout=120, connect_retry_s=60):
+    def __init__(self, host, port, timeout=120, connect_retry_s=60,
+                 rank=None):
         import time
         deadline = time.time() + connect_retry_s
         while True:
@@ -252,6 +313,32 @@ class PSClient:
                     raise
                 time.sleep(0.2)
         self._lock = threading.Lock()
+        if rank is not None:
+            self.request("hello", rank)
+
+    def push_array(self, key, arr):
+        """Dense push, chunked above BIGARRAY_BOUND elements
+        (EncodeDefaultKey analogue — bounds per-message pickle size)."""
+        if arr.size <= BIGARRAY_BOUND:
+            return self.request("push", key, "dense", arr)
+        flat = arr.reshape(-1)
+        for start in range(0, arr.size, BIGARRAY_BOUND):
+            stop = min(start + BIGARRAY_BOUND, arr.size)
+            self.request("push_chunk", key, tuple(arr.shape), start, stop,
+                         flat[start:stop], stop == arr.size)
+        return ("ok",)
+
+    def pull_array(self, key):
+        """Dense pull, chunked above BIGARRAY_BOUND elements."""
+        _, shape, size = self.request("pull_meta", key)
+        if size <= BIGARRAY_BOUND:
+            return self.request("pull", key)[1]
+        import numpy as _np
+        out = _np.empty(size, _np.float32)
+        for start in range(0, size, BIGARRAY_BOUND):
+            stop = min(start + BIGARRAY_BOUND, size)
+            out[start:stop] = self.request("pull_chunk", key, start, stop)[1]
+        return out.reshape(shape)
 
     def request(self, *msg):
         with self._lock:

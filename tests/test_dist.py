@@ -155,3 +155,149 @@ def test_pack_2bit_roundtrip_and_width():
     assert packed.dtype == np.uint8 and packed.size == 2  # ceil(7/4)
     back = unpack_2bit(packed, shape, 0.5)
     np.testing.assert_allclose(back, vals)
+
+
+# ---------------------------------------------------------------------------
+# Round 3: liveness, chunked big arrays, kill-resume (VERDICT r2 #9, #8)
+# ---------------------------------------------------------------------------
+_KILL_WORKER = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, %r)
+    import mxnet_tpu as mx
+    import numpy as np
+
+    kv = mx.kv.create("dist_async")
+    kv.init("w", mx.nd.zeros((4,)))
+    assert kv.get_num_dead_node() == 0
+    if kv.rank == 1:
+        # die without goodbye: socket closes, server must notice
+        os._exit(0)
+    # survivor observes the death (reference: kvstore.h:339)
+    for _ in range(600):
+        if kv.get_num_dead_node() >= 1:
+            print("SURVIVOR SAW DEATH")
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("dead node never observed")
+""" % _ROOT)
+
+
+@pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
+                    reason="dist test disabled")
+def test_kill_a_worker_liveness(tmp_path):
+    """A worker killed mid-run is observed by the survivor through
+    get_num_dead_node (reference: ps-lite heartbeats, kvstore.h:339)."""
+    proc, out = _launch(tmp_path, _KILL_WORKER, "kill")
+    assert "SURVIVOR SAW DEATH" in out, out[-3000:]
+
+
+def test_bigarray_chunked_push_pull(monkeypatch):
+    """Keys above MXNET_KVSTORE_BIGARRAY_BOUND ride the wire in chunks
+    (reference: kvstore_dist.h:522 EncodeDefaultKey sharding)."""
+    import numpy as np
+    from mxnet_tpu import kvstore_ps
+
+    monkeypatch.setattr(kvstore_ps, "BIGARRAY_BOUND", 1000)
+    server = kvstore_ps.PSServer(port=0, num_workers=1)
+    try:
+        client = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+        big = np.arange(5003, dtype=np.float32).reshape(-1)
+        client.request("init", "big", np.zeros_like(big))
+        client.push_array("big", big)
+        got = client.pull_array("big")
+        np.testing.assert_allclose(got, big)
+        # num_dead: this client is alive
+        assert client.request("num_dead")[1] == 0
+        client.close()
+        # closing the socket marks the rank dead
+        import time
+        probe = kvstore_ps.PSClient("127.0.0.1", server.port)
+        for _ in range(100):
+            if probe.request("num_dead")[1] == 1:
+                break
+            time.sleep(0.02)
+        assert probe.request("num_dead")[1] == 1
+        probe.close()
+    finally:
+        server.stop()
+
+
+def test_checkpoint_kill_resume_matches_uninterrupted(tmp_path):
+    """Mid-training kill + resume from checkpoint matches the
+    uninterrupted trajectory exactly (reference posture: SURVEY §5
+    checkpoint/resume; Module.save_checkpoint + load_epoch)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(256, 10).astype(np.float32)
+    y = (np.arange(256) % 4).astype(np.float32)
+
+    def build():
+        data = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = mx.sym.Activation(h, act_type="relu")
+        out = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(h, num_hidden=4, name="fc2"),
+            name="softmax")
+        return out
+
+    def train(mod, epochs, it):
+        for _ in range(epochs):
+            it.reset()
+            for b in it:
+                mod.forward_backward(b)
+                mod.update()
+
+    def new_it():
+        return mx.io.NDArrayIter(X, y, 32)
+
+    # identical initial draws for both runs: init_params consumes the
+    # global RNG, so each run reseeds first
+    mx.random.seed(1234)
+    # uninterrupted: 6 epochs
+    mod_a = mx.mod.Module(build())
+    it = new_it()
+    mod_a.bind(it.provide_data, it.provide_label)
+    mod_a.init_params(initializer=mx.init.Xavier(rnd_type="uniform",
+                                                 magnitude=2.0))
+    mod_a.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+    train(mod_a, 6, it)
+    ref_arg, _ = mod_a.get_params()
+
+    # interrupted: 3 epochs -> checkpoint (params + optimizer states) ->
+    # fresh process-equivalent Module -> resume -> 3 more epochs
+    mx.random.seed(1234)
+    mod_b = mx.mod.Module(build())
+    it = new_it()
+    mod_b.bind(it.provide_data, it.provide_label)
+    mod_b.init_params(initializer=mx.init.Xavier(rnd_type="uniform",
+                                                 magnitude=2.0))
+    mod_b.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+    train(mod_b, 3, it)
+    prefix = str(tmp_path / "ckpt")
+    mod_b.save_checkpoint(prefix, 3)
+    mod_b.save_optimizer_states(prefix + ".states")
+
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 3)
+    mod_c = mx.mod.Module(sym)
+    it = new_it()
+    mod_c.bind(it.provide_data, it.provide_label)
+    mod_c.set_params(arg, aux)
+    mod_c.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+    mod_c.load_optimizer_states(prefix + ".states")
+    train(mod_c, 3, it)
+    res_arg, _ = mod_c.get_params()
+
+    for k in ref_arg:
+        np.testing.assert_allclose(res_arg[k].asnumpy(),
+                                   ref_arg[k].asnumpy(), rtol=1e-5,
+                                   atol=1e-5)

@@ -42,3 +42,31 @@ def test_dcgan_example():
     """Adversarial training end-to-end: Conv2DTranspose generator vs conv
     discriminator, alternating updates (reference: example/gan/dcgan.py)."""
     _run(os.path.join(_EXAMPLES, "gan", "dcgan.py"), ["--steps", "150"])
+
+
+# -- round 3 (VERDICT r2 #7): detector + autoencoder + multi-task + nce ----
+def test_rcnn_lite_example():
+    """Faster-RCNN-lite: Proposal + ROIAlign + bipartite_matching get an
+    end-to-end consumer that learns (reference: example/rcnn/)."""
+    _run(os.path.join(_EXAMPLES, "rcnn", "train_rcnn_lite.py"),
+         ["--steps", "100"])
+
+
+def test_autoencoder_example():
+    """Stacked AE + KL-sparseness penalty (reference:
+    example/autoencoder/)."""
+    _run(os.path.join(_EXAMPLES, "autoencoder", "train_ae.py"),
+         ["--epochs", "15"])
+
+
+def test_multi_task_example():
+    """Two SoftmaxOutput heads on one trunk (reference:
+    example/multi-task/)."""
+    _run(os.path.join(_EXAMPLES, "multi_task", "train_multi_task.py"),
+         ["--epochs", "10"])
+
+
+def test_nce_loss_example():
+    """NCE word embeddings (reference: example/nce-loss/)."""
+    _run(os.path.join(_EXAMPLES, "nce_loss", "train_nce.py"),
+         ["--steps", "600"])

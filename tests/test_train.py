@@ -1,0 +1,215 @@
+"""Convergence / dtype training suite (round 3, VERDICT r2 item 10).
+
+Reference: ``tests/python/train/`` — small *real* trainings with accuracy
+asserts: ``test_mlp.py`` (MLP to >95%), ``test_conv.py`` (conv net),
+``test_bucketing.py`` (bucketing LM to a perplexity bound),
+``test_dtype.py`` (fp16 CIFAR within tolerance of fp32 — here bf16, the
+TPU reduced precision).
+
+Synthetic separable datasets stand in for MNIST/CIFAR (zero-egress image)
+— what is being asserted is the same: the full Module/Gluon training
+loops actually optimize to high accuracy, in fp32 and bf16.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+
+SEED = 11
+
+
+def _blob_images(n, nclass, size=12, channels=3, flat=False, seed=SEED):
+    """Class-separable images: each class lights a distinct quadrant
+    pattern under noise."""
+    rng = np.random.RandomState(seed)
+    y = np.arange(n) % nclass
+    X = rng.randn(n, size, size, channels).astype(np.float32) * 0.4
+    q = size // 2
+    for i in range(n):
+        c = int(y[i])
+        r0, c0 = (c // 2) % 2 * q, c % 2 * q
+        X[i, r0:r0 + q, c0:c0 + q] += 1.2 + 0.2 * (c // 4)
+    if flat:
+        X = X.reshape(n, -1)
+    return X, y.astype(np.float32)
+
+
+def _top1(mod, it):
+    it.reset()
+    correct = tot = 0
+    for b in it:
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(1)
+        lab = b.label[0].asnumpy()
+        correct += int((pred == lab).sum())
+        tot += len(pred)
+    return correct / tot
+
+
+def test_mlp_convergence():
+    """Module.fit trains an MLP to >=95% (reference: train/test_mlp.py)."""
+    X, y = _blob_images(512, 4, flat=True)
+    it = mx.io.NDArrayIter(X, y, 64, shuffle=True)
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=32, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=4, name="fc3"), name="softmax")
+    mod = mx.mod.Module(out)
+    mod.fit(it, num_epoch=10,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    acc = _top1(mod, mx.io.NDArrayIter(X, y, 64))
+    assert acc >= 0.95, acc
+
+
+def _conv_sym(nclass, layout="NHWC", dtype=None):
+    data = mx.sym.Variable("data")
+    if dtype is not None:
+        data = mx.sym.Cast(data, dtype=dtype, name="cast_in")
+    axis = 3 if layout == "NHWC" else 1
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           layout=layout, name="c1")
+    c = mx.sym.BatchNorm(c, fix_gamma=False, axis=axis, name="bn1")
+    c = mx.sym.Activation(c, act_type="relu")
+    c = mx.sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       layout=layout, name="p1")
+    c = mx.sym.Convolution(c, kernel=(3, 3), num_filter=16, pad=(1, 1),
+                           layout=layout, name="c2")
+    c = mx.sym.Activation(c, act_type="relu")
+    fc = mx.sym.FullyConnected(c, num_hidden=nclass, name="fc")
+    if dtype is not None:
+        fc = mx.sym.Cast(fc, dtype="float32", name="cast_out")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def test_conv_convergence():
+    """Small conv net trains to >=95% (reference: train/test_conv.py)."""
+    X, y = _blob_images(512, 4)
+    it = mx.io.NDArrayIter(X, y, 64, shuffle=True)
+    mod = mx.mod.Module(_conv_sym(4))
+    mod.fit(it, num_epoch=8,
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    acc = _top1(mod, mx.io.NDArrayIter(X, y, 64))
+    assert acc >= 0.95, acc
+
+
+def test_bf16_training_matches_fp32():
+    """End-to-end bf16 training (Gluon trainer, multi_precision masters)
+    reaches fp32 accuracy within 2% (reference: train/test_dtype.py fp16
+    CIFAR within tolerance)."""
+    X, y = _blob_images(512, 4)
+
+    def run(dtype):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, layout="NHWC"),
+                gluon.nn.Activation("relu"),
+                gluon.nn.MaxPool2D((2, 2), layout="NHWC"),
+                gluon.nn.Conv2D(16, 3, padding=1, layout="NHWC"),
+                gluon.nn.Activation("relu"),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        if dtype != "float32":
+            net.cast(dtype)
+        net.hybridize()
+        trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9,
+             "multi_precision": dtype != "float32"})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        it = mx.io.NDArrayIter(X, y, 64, shuffle=True, shuffle_seed=SEED)
+        for _epoch in range(8):
+            it.reset()
+            for b in it:
+                x = b.data[0].astype(dtype) if dtype != "float32" \
+                    else b.data[0]
+                with autograd.record():
+                    loss = loss_fn(net(x), b.label[0]).mean()
+                loss.backward()
+                trainer.step(b.data[0].shape[0])
+        # eval
+        correct = tot = 0
+        ev = mx.io.NDArrayIter(X, y, 64)
+        for b in ev:
+            x = b.data[0].astype(dtype) if dtype != "float32" else b.data[0]
+            pred = net(x).asnumpy().astype(np.float32).argmax(1)
+            correct += int((pred == b.label[0].asnumpy()).sum())
+            tot += len(pred)
+        return correct / tot
+
+    acc32 = run("float32")
+    acc16 = run("bfloat16")
+    assert acc32 >= 0.95, acc32
+    assert acc16 >= acc32 - 0.02, (acc32, acc16)
+
+
+def test_bucketing_lm_convergence():
+    """Bucketing char-LM trains until perplexity clearly drops
+    (reference: train/test_bucketing.py's perplexity bound)."""
+    rng = np.random.RandomState(SEED)
+    vocab = 16
+    # deterministic cyclic "language": next = (cur + 1) % vocab, so a
+    # learned model approaches perplexity 1
+    buckets = [8, 12]
+    batches = []
+    for _ in range(40):
+        L = buckets[rng.randint(2)]
+        start = rng.randint(vocab, size=(16,))
+        seq = (start[:, None] + np.arange(L + 1)[None, :]) % vocab
+        batches.append((L, seq[:, :-1].astype(np.float32),
+                        seq[:, 1:].astype(np.float32)))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=16,
+                               name="emb")
+        cell = mx.rnn.GRUCell(24, prefix="gru_")
+        outputs, _ = cell.unroll(seq_len, inputs=emb, merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 24))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="fc")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(pred, label, name="softmax"), \
+            ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=max(buckets))
+    dummy_key = max(buckets)
+    example = [b for b in batches if b[0] == dummy_key][0]
+    mod.bind([("data", example[1].shape)], [("softmax_label",
+                                             example[2].shape)])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+
+    def perplexity():
+        tot_nll = tot_n = 0
+        for L, xb, yb in batches[:10]:
+            batch = mx.io.DataBatch([mx.nd.array(xb)], [mx.nd.array(yb)],
+                                    bucket_key=L,
+                                    provide_data=[("data", xb.shape)],
+                                    provide_label=[("softmax_label",
+                                                    yb.shape)])
+            mod.forward(batch, is_train=False)
+            probs = mod.get_outputs()[0].asnumpy()
+            labels = yb.reshape(-1).astype(int)
+            p = probs[np.arange(len(labels)), labels]
+            tot_nll += -np.log(np.clip(p, 1e-9, None)).sum()
+            tot_n += len(labels)
+        return float(np.exp(tot_nll / tot_n))
+
+    start_ppl = perplexity()
+    for _epoch in range(6):
+        for L, xb, yb in batches:
+            batch = mx.io.DataBatch([mx.nd.array(xb)], [mx.nd.array(yb)],
+                                    bucket_key=L,
+                                    provide_data=[("data", xb.shape)],
+                                    provide_label=[("softmax_label",
+                                                    yb.shape)])
+            mod.forward_backward(batch)
+            mod.update()
+    end_ppl = perplexity()
+    assert end_ppl < 2.0, (start_ppl, end_ppl)
+    assert end_ppl < start_ppl / 3
