@@ -166,18 +166,12 @@ def _bf16_infer_bench(batch=None, iters=20):
 
 
 def _blob_images(rng, n, nclass=8, size=224):
-    """Class-separable synthetic images (lit quadrant per class) — gives
-    the accuracy gate a functioning classifier to quantize instead of
-    argmax roulette on near-uniform untrained logits."""
-    import numpy as np
-    y = (np.arange(n) % nclass).astype(np.float32)
-    X = rng.randn(n, size, size, 3).astype(np.float32) * 0.3
-    q = size // 2
-    for i in range(n):
-        c = int(y[i])
-        r0, c0 = (c // 2) % 2 * q, c % 2 * q
-        X[i, r0:r0 + q, c0:c0 + q] += 0.8 + 0.2 * (c // 4)
-    return X, y
+    """Class-separable synthetic images — gives the accuracy gate a
+    functioning classifier to quantize instead of argmax roulette on
+    near-uniform untrained logits (shared impl: test_utils)."""
+    from mxnet_tpu.test_utils import separable_images
+    return separable_images(rng, n, nclass=nclass, size=size, channels=3,
+                            noise=0.3, base=0.8)
 
 
 def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
@@ -251,7 +245,7 @@ def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
         got = qmod.get_outputs()[0].asnumpy().argmax(1)
         agree += int((ref == got).sum())
         int8_correct += int((got == ye).sum())
-        tot += batch
+        tot += len(got)
     out["int8_top1_agreement"] = round(agree / tot, 4)
     out["fp32_top1_acc"] = round(fp32_correct / tot, 4)
     out["int8_top1_acc"] = round(int8_correct / tot, 4)

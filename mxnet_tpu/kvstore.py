@@ -114,9 +114,8 @@ class KVStore:
             self._store[k] = arr
             if self._ps_client is not None:
                 import numpy as _np
-                self._ps_client.request("init", k,
-                                        _np.asarray(arr.asnumpy(),
-                                                    _np.float32))
+                self._ps_client.init_array(
+                    k, _np.asarray(arr.asnumpy(), _np.float32))
 
     def _merge(self, vlist):
         """Sum a list of same-key arrays (Comm::Reduce analogue, comm.h:451)."""

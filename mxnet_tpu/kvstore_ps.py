@@ -109,7 +109,6 @@ class PSServer:
         self._live_ranks = {}
         self._dead_ranks = set()
         self._live_lock = threading.Lock()
-        self._push_staging = {}
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -134,6 +133,11 @@ class PSServer:
 
     def _serve(self, conn):
         rank_box = [None]
+        # per-connection state: chunked-push staging buffers and pull
+        # snapshots.  Keeping them here (not on the server) means two
+        # workers chunk-pushing the same key never interleave, and a
+        # client that dies mid-transfer leaks nothing.
+        ctx = {"staging": {}, "snapshots": {}}
         try:
             while True:
                 msg = _recv(conn)
@@ -146,7 +150,7 @@ class PSServer:
                         self._dead_ranks.discard(msg[1])
                     _send(conn, ("ok",))
                     continue
-                reply = self._handle(msg)
+                reply = self._handle(msg, ctx)
                 _send(conn, reply)
         except (OSError, EOFError):
             pass
@@ -162,7 +166,8 @@ class PSServer:
         with self._store_lock:
             return self._locks.setdefault(key, threading.Lock())
 
-    def _handle(self, msg):
+    def _handle(self, msg, ctx=None):
+        ctx = ctx if ctx is not None else {"staging": {}, "snapshots": {}}
         cmd = msg[0]
         if cmd == "init":
             _, key, arr = msg
@@ -170,6 +175,23 @@ class PSServer:
                 # first init wins (reference: server keeps the first copy)
                 if key not in self._store:
                     self._store[key] = np.array(arr, np.float32)
+            return ("ok",)
+        if cmd == "init_meta":
+            # chunked init: create the zero array; reply says whether this
+            # caller owns the fill (first init wins)
+            _, key, shape = msg
+            with self._key_lock(key):
+                fresh = key not in self._store
+                if fresh:
+                    self._store[key] = np.zeros(shape, np.float32)
+            return ("ok", fresh)
+        if cmd == "init_chunk":
+            _, key, start, stop, payload = msg
+            with self._key_lock(key):
+                arr = self._store.get(key)
+                if arr is None:
+                    return ("err", "key %r not initialized" % (key,))
+                arr.reshape(-1)[start:stop] = payload
             return ("ok",)
         if cmd == "set_optimizer":
             _, blob = msg
@@ -221,34 +243,41 @@ class PSServer:
             with self._live_lock:
                 return ("ok", len(self._dead_ranks))
         if cmd == "pull_meta":
+            # snapshot under the key lock: chunked pulls must never see a
+            # torn mix of pre- and post-update halves
             _, key = msg
             with self._key_lock(key):
                 arr = self._store.get(key)
-            if arr is None:
-                return ("err", "key %r not initialized" % (key,))
+                if arr is None:
+                    return ("err", "key %r not initialized" % (key,))
+                if arr.size > BIGARRAY_BOUND:
+                    ctx["snapshots"][key] = arr.reshape(-1).copy()
             return ("ok", tuple(arr.shape), int(arr.size))
         if cmd == "pull_chunk":
             _, key, start, stop = msg
-            with self._key_lock(key):
-                arr = self._store.get(key)
-            if arr is None:
-                return ("err", "key %r not initialized" % (key,))
-            return ("ok", arr.reshape(-1)[start:stop])
+            snap = ctx["snapshots"].get(key)
+            if snap is None:
+                return ("err", "pull_chunk without pull_meta for %r"
+                        % (key,))
+            out = snap[start:stop]
+            if stop >= snap.size:
+                del ctx["snapshots"][key]
+            return ("ok", out)
         if cmd == "push_chunk":
             _, key, shape, start, stop, payload, last = msg
             with self._key_lock(key):
                 if key not in self._store:
                     return ("err", "key %r not initialized" % (key,))
-                buf = self._push_staging.get(key)
-                if buf is None:
-                    buf = self._push_staging[key] = np.zeros(
-                        int(np.prod(shape)), np.float32)
-                buf[start:stop] = payload
-                if not last:
-                    return ("ok",)
-                grad = self._push_staging.pop(key).reshape(shape)
+            buf = ctx["staging"].get(key)
+            if buf is None:
+                buf = ctx["staging"][key] = np.zeros(
+                    int(np.prod(shape)), np.float32)
+            buf[start:stop] = payload
+            if not last:
+                return ("ok",)
+            grad = ctx["staging"].pop(key).reshape(shape)
             # apply like a dense push (re-enter the push path)
-            return self._handle(("push", key, "dense", grad))
+            return self._handle(("push", key, "dense", grad), ctx)
         if cmd == "barrier":
             with self._barrier_cv:
                 gen = self._barrier_gen
@@ -326,6 +355,19 @@ class PSClient:
             stop = min(start + BIGARRAY_BOUND, arr.size)
             self.request("push_chunk", key, tuple(arr.shape), start, stop,
                          flat[start:stop], stop == arr.size)
+        return ("ok",)
+
+    def init_array(self, key, arr):
+        """Init, chunked above BIGARRAY_BOUND (first init wins either way)."""
+        if arr.size <= BIGARRAY_BOUND:
+            return self.request("init", key, arr)
+        _, fresh = self.request("init_meta", key, tuple(arr.shape))
+        if not fresh:
+            return ("ok",)
+        flat = arr.reshape(-1)
+        for start in range(0, arr.size, BIGARRAY_BOUND):
+            stop = min(start + BIGARRAY_BOUND, arr.size)
+            self.request("init_chunk", key, start, stop, flat[start:stop])
         return ("ok",)
 
     def pull_array(self, key):

@@ -128,3 +128,21 @@ def download(url, fname=None, dirname=None, overwrite=False):
     raise RuntimeError(
         "no network egress in this environment — place %r locally and pass "
         "the path instead" % url)
+
+
+def separable_images(rng, n, nclass=4, size=12, channels=3, noise=0.4,
+                     base=1.2):
+    """Class-separable synthetic images: class c lights quadrant
+    ((c//2)%%2, c%%2) with brightness base + 0.2*(c//4) over gaussian
+    noise.  NHWC float32; labels float32.  Used by the convergence suite
+    (tests/test_train.py) and the bench accuracy gate in place of real
+    image datasets (zero-egress environment)."""
+    import numpy as _np
+    y = (_np.arange(n) % nclass).astype(_np.float32)
+    X = rng.randn(n, size, size, channels).astype(_np.float32) * noise
+    q = size // 2
+    for i in range(n):
+        c = int(y[i])
+        r0, c0 = (c // 2) % 2 * q, c % 2 * q
+        X[i, r0:r0 + q, c0:c0 + q] += base + 0.2 * (c // 4)
+    return X, y

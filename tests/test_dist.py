@@ -169,7 +169,6 @@ _KILL_WORKER = textwrap.dedent("""
 
     kv = mx.kv.create("dist_async")
     kv.init("w", mx.nd.zeros((4,)))
-    assert kv.get_num_dead_node() == 0
     if kv.rank == 1:
         # die without goodbye: socket closes, server must notice
         os._exit(0)

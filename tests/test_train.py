@@ -20,19 +20,14 @@ SEED = 11
 
 
 def _blob_images(n, nclass, size=12, channels=3, flat=False, seed=SEED):
-    """Class-separable images: each class lights a distinct quadrant
-    pattern under noise."""
-    rng = np.random.RandomState(seed)
-    y = np.arange(n) % nclass
-    X = rng.randn(n, size, size, channels).astype(np.float32) * 0.4
-    q = size // 2
-    for i in range(n):
-        c = int(y[i])
-        r0, c0 = (c // 2) % 2 * q, c % 2 * q
-        X[i, r0:r0 + q, c0:c0 + q] += 1.2 + 0.2 * (c // 4)
+    """Class-separable images (shared impl: mxnet_tpu.test_utils)."""
+    from mxnet_tpu.test_utils import separable_images
+    X, y = separable_images(np.random.RandomState(seed), n, nclass=nclass,
+                            size=size, channels=channels, noise=0.4,
+                            base=1.2)
     if flat:
         X = X.reshape(n, -1)
-    return X, y.astype(np.float32)
+    return X, y
 
 
 def _top1(mod, it):
