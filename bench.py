@@ -192,8 +192,10 @@ def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
     train_it = mx.io.NDArrayIter(Xtr, ytr, 128, shuffle=True)
     net = resnet_symbol(50, num_classes=8, layout="NHWC")
     mod = mx.mod.Module(net)
-    mod.fit(train_it, num_epoch=2,
-            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    # enough steps for the BN statistics to settle and the stem to latch
+    # onto the quadrant pattern; lr tuned for bs=128 from-scratch
+    mod.fit(train_it, num_epoch=5,
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9})
     arg, aux = mod.get_params()
     calib_it = mx.io.NDArrayIter(Xtr[:calib_batch], ytr[:calib_batch],
                                  calib_batch)

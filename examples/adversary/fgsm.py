@@ -1,0 +1,66 @@
+"""Fast Gradient Sign Method adversarial examples.
+
+Reference: ``example/adversary/`` — train a classifier, then perturb
+inputs along the sign of the input gradient and watch accuracy collapse.
+Exercises gradients *with respect to inputs* (mark_variables/attach_grad),
+a distinct autograd surface from parameter training.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.test_utils import separable_images
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--epsilon", type=float, default=0.6)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    X, y = separable_images(rng, 512, nclass=4, size=12, channels=2)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, layout="NHWC",
+                            activation="relu"),
+            gluon.nn.Flatten(), gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    it = mx.io.NDArrayIter(X, y, 64, shuffle=True)
+    for _ in range(args.epochs):
+        it.reset()
+        for b in it:
+            with autograd.record():
+                loss = loss_fn(net(b.data[0]), b.label[0]).mean()
+            loss.backward()
+            trainer.step(64)
+
+    def accuracy(Xe):
+        pred = net(nd.array(Xe)).asnumpy().argmax(1)
+        return float((pred == y).mean())
+
+    clean_acc = accuracy(X)
+
+    # FGSM: x_adv = x + eps * sign(dL/dx)
+    x_in = nd.array(X)
+    x_in.attach_grad()
+    with autograd.record():
+        loss = loss_fn(net(x_in), nd.array(y)).mean()
+    loss.backward()
+    x_adv = X + args.epsilon * np.sign(x_in.grad.asnumpy())
+    adv_acc = accuracy(x_adv)
+    print("clean acc %.3f -> adversarial acc %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, args.epsilon))
+    assert clean_acc >= 0.95, clean_acc
+    assert adv_acc <= clean_acc - 0.3, (clean_acc, adv_acc)
+    print("FGSM OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -161,33 +161,16 @@ def _entry_range_key(entry):
     return node.name if node.op is None else node.name + "_output"
 
 
-def fold_batch_norms(symbol, arg_params, aux_params):
-    """Fold Convolution→BatchNorm chains into the conv weights/bias — the
-    standard inference-graph transform (the reference's MKLDNN subgraph
-    fuse pass does the same ahead of int8 rewriting).  Inference only:
-    uses the moving statistics.
+def _graph_rewrite(symbol, hook):
+    """Memoized clone of a symbol graph with a per-node rewrite hook — the
+    single walker behind every quantization pass (each used to hand-roll
+    its own memo/clone recursion).
 
-    Returns (new_symbol, new_arg_params, new_aux_params)."""
+    ``hook(node, new, clone)`` runs after ``new`` (a fresh ``_Node`` with
+    cloned inputs) is built; ``clone`` maps original nodes to their copies
+    (memoized).  A non-None return replaces ``new`` in the memo so every
+    downstream consumer rewires to it."""
     from ..symbol.symbol import Symbol, _Node
-
-    arg_params = dict(arg_params)
-    aux_params = dict(aux_params)
-
-    # consumer counts: a conv feeding anything besides its BN stays intact
-    consumers = {}
-    seen = set()
-
-    def count(node):
-        if id(node) in seen:
-            return
-        seen.add(id(node))
-        for child, _ in node.inputs:
-            consumers[id(child)] = consumers.get(id(child), 0) + 1
-            count(child)
-
-    for n, _ in symbol._outputs:
-        consumers[id(n)] = consumers.get(id(n), 0) + 1  # head is a consumer
-        count(n)
 
     memo = {}
 
@@ -195,27 +178,72 @@ def fold_batch_norms(symbol, arg_params, aux_params):
         if id(node) in memo:
             return memo[id(node)]
         new = _Node(node.op, node.name, dict(node.attrs), [], node._is_aux)
-        memo[id(node)] = new
+        memo[id(node)] = new  # register before recursing into inputs
         new.inputs = [(clone(c), i) for c, i in node.inputs]
+        repl = hook(node, new, clone)
+        if repl is not None and repl is not new:
+            memo[id(node)] = repl
+            return repl
+        return new
+
+    return Symbol([(clone(n), i) for n, i in symbol._outputs])
+
+
+def _consumer_sets(symbol):
+    """{id(node): set of distinct consumers} with ``"head"`` marking graph
+    outputs.  A multi-output producer feeding one consumer through several
+    edges still counts as a single consumer."""
+    consumers = {}
+    seen = set()
+
+    def walk(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for child, _ in node.inputs:
+            consumers.setdefault(id(child), set()).add(id(node))
+            walk(child)
+
+    for n, _ in symbol._outputs:
+        consumers.setdefault(id(n), set()).add("head")
+        walk(n)
+    return consumers
+
+
+def fold_batch_norms(symbol, arg_params, aux_params):
+    """Fold Convolution→BatchNorm chains into the conv weights/bias — the
+    standard inference-graph transform (the reference's MKLDNN subgraph
+    fuse pass does the same ahead of int8 rewriting).  Inference only:
+    uses the moving statistics.
+
+    Returns (new_symbol, new_arg_params, new_aux_params)."""
+    from ..symbol.symbol import _Node
+
+    arg_params = dict(arg_params)
+    aux_params = dict(aux_params)
+    consumers = _consumer_sets(symbol)
+
+    def hook(node, new, clone):
         if node.op != "BatchNorm" or not node.inputs:
-            return new
-        src, src_out = node.inputs[0]
-        if src.op != "Convolution" or consumers.get(id(src), 0) != 1:
-            return new
+            return None
+        src, _src_out = node.inputs[0]
+        if src.op != "Convolution" or \
+                len(consumers.get(id(src), ())) != 1:
+            return None
         # the BN must normalize the conv's channel axis: channels-last
         # convs carry channels on the minor axis, channels-first on axis 1
         bn_axis = int(_reg_canon(node.attrs.get("axis", 1)))
-        kernel = node.inputs and src.attrs.get("kernel")
+        kernel = src.attrs.get("kernel")
         nsp = len(_attr_tuple(kernel)) if kernel else 2
         ch_axis = nsp + 1 if src.attrs.get("layout") in (
             "NWC", "NHWC", "NDHWC") else 1
         if bn_axis != ch_axis:
-            return new
+            return None
         wname = src.name + "_weight"
         gname, bname = node.name + "_gamma", node.name + "_beta"
         mname, vname = node.name + "_moving_mean", node.name + "_moving_var"
         if wname not in arg_params or mname not in aux_params:
-            return new
+            return None
         eps = float(_reg_canon(node.attrs.get("eps", 1e-3)))
         fix_gamma = _reg_canon(node.attrs.get("fix_gamma", True))
         mean = aux_params[mname].asnumpy()
@@ -235,17 +263,16 @@ def fold_batch_norms(symbol, arg_params, aux_params):
         if had_bias and cbias in arg_params:
             shift = arg_params[cbias].asnumpy() * scale + shift
         arg_params[cbias] = nd.array(shift)
-        folded = memo[id(src)]
+        folded = clone(src)
         conv = _Node(src.op, src.name, dict(src.attrs), list(folded.inputs))
         conv.attrs["no_bias"] = False
         if not had_bias:
             bvar = _Node(None, cbias, {"__shape__": str(shift.shape),
                                        "__dtype__": "float32"})
             conv.inputs = conv.inputs[:2] + [(bvar, 0)]
-        memo[id(node)] = conv
         return conv
 
-    out = Symbol([(clone(n), i) for n, i in symbol._outputs])
+    out = _graph_rewrite(symbol, hook)
     # drop the folded BN params so set_params doesn't complain
     live = {n.name for n in out._nodes() if n.op is None}
     arg_params = {k: v for k, v in arg_params.items()
@@ -273,21 +300,14 @@ def _rewrite_int8(symbol, arg_params, th_dict, excluded):
     quantize_graph_pass.cc analogue (reference also covers conv and
     pooling: quantized_conv.cu, quantized_pooling.cc).  Layers without a
     calibrated input range, or in `excluded`, stay fp32."""
-    from ..symbol.symbol import Symbol, _Node
+    from ..symbol.symbol import _Node
 
-    memo = {}
-
-    def clone(node):
-        if id(node) in memo:
-            return memo[id(node)]
-        new = _Node(node.op, node.name, dict(node.attrs), [], node._is_aux)
-        memo[id(node)] = new  # register before recursing into inputs
-        new.inputs = [(clone(c), i) for c, i in node.inputs]
+    def hook(node, new, clone):
         if node.op not in _QUANTIZABLE or node.name in excluded:
-            return new
+            return None
         rng = th_dict.get(_entry_range_key(node.inputs[0]))
         if rng is None:
-            return new
+            return None
         lo, hi = rng
         data_entry = new.inputs[0]
         qdata = _Node("_contrib_quantize_v2", node.name + "_qdata",
@@ -299,14 +319,12 @@ def _rewrite_int8(symbol, arg_params, th_dict, excluded):
                           {k: node.attrs[k] for k in _QPOOL_ATTRS
                            if k in node.attrs},
                           [(qdata, 0), (qdata, 1), (qdata, 2)])
-            deq = _Node("_contrib_dequantize", node.name + "_deq", {},
-                        [(qpool, 0), (qpool, 1), (qpool, 2)])
-            memo[id(node)] = deq
-            return deq
+            return _Node("_contrib_dequantize", node.name + "_deq", {},
+                         [(qpool, 0), (qpool, 1), (qpool, 2)])
 
         wname = node.name + "_weight"
         if wname + "_quantized" not in arg_params:
-            return new
+            return None
 
         def qvar(suffix):
             full = wname + suffix
@@ -334,29 +352,26 @@ def _rewrite_int8(symbol, arg_params, th_dict, excluded):
                      (wmn, 0), (wmx, 0)])
         deq = _Node("_contrib_dequantize", node.name + "_deq",
                     {}, [(qop, 0), (qop, 1), (qop, 2)])
-        if has_bias:
-            bias_entry = new.inputs[2]
-            bname = node.name + "_bias"
-            if bias_entry[0].op is None and bname in arg_params:
-                # no fp32 node derives its shape anymore — pin it on the var
-                bias_entry[0].attrs.setdefault(
-                    "__shape__", str(tuple(arg_params[bname].shape)))
-            if node.op == "Convolution" and \
-                    node.attrs.get("layout") not in ("NWC", "NHWC", "NDHWC"):
-                # bias broadcasts over channels: (C,) -> (1, C, 1, ...);
-                # channels-last layouts broadcast on the minor axis natively
-                nsp = len(_attr_tuple(node.attrs.get("kernel", (1, 1))))
-                bshape = (1, -1) + (1,) * nsp
-                bias_entry = (_Node("Reshape", node.name + "_bias_rs",
-                                    {"shape": str(bshape)}, [bias_entry]), 0)
-            out = _Node("broadcast_add", node.name + "_addbias", {},
-                        [(deq, 0), bias_entry])
-        else:
-            out = deq
-        memo[id(node)] = out
-        return out
+        if not has_bias:
+            return deq
+        bias_entry = new.inputs[2]
+        bname = node.name + "_bias"
+        if bias_entry[0].op is None and bname in arg_params:
+            # no fp32 node derives its shape anymore — pin it on the var
+            bias_entry[0].attrs.setdefault(
+                "__shape__", str(tuple(arg_params[bname].shape)))
+        if node.op == "Convolution" and \
+                node.attrs.get("layout") not in ("NWC", "NHWC", "NDHWC"):
+            # bias broadcasts over channels: (C,) -> (1, C, 1, ...);
+            # channels-last layouts broadcast on the minor axis natively
+            nsp = len(_attr_tuple(node.attrs.get("kernel", (1, 1))))
+            bshape = (1, -1) + (1,) * nsp
+            bias_entry = (_Node("Reshape", node.name + "_bias_rs",
+                                {"shape": str(bshape)}, [bias_entry]), 0)
+        return _Node("broadcast_add", node.name + "_addbias", {},
+                     [(deq, 0), bias_entry])
 
-    return Symbol([(clone(n), i) for n, i in symbol._outputs])
+    return _graph_rewrite(symbol, hook)
 
 
 def _attr_tuple(v):
@@ -370,38 +385,107 @@ def _elide_dq_q(symbol):
     """Fuse dequantize→quantize_v2 chains into requantize so adjacent int8
     layers hand tensors over without a round-trip through fp32
     (reference: quantize_graph_pass.cc requantize fusion)."""
-    from ..symbol.symbol import Symbol, _Node
+    from ..symbol.symbol import _Node
 
-    memo = {}
+    def hook(node, new, clone):
+        if node.op != "_contrib_quantize_v2" or not node.inputs:
+            return None
+        src, _ = node.inputs[0]
+        # only when the dequantize reads an int32 accumulator (conv/fc);
+        # int8 producers (pooling) use a different scale domain
+        acc_ok = src.inputs and src.inputs[0][0].op in (
+            "_contrib_quantized_conv",
+            "_contrib_quantized_fully_connected")
+        if src.op != "_contrib_dequantize" or not acc_ok or \
+                "min_calib_range" not in node.attrs:
+            return None
+        acc_entry = new.inputs[0][0].inputs  # dequantize's inputs
+        return _Node("_contrib_requantize", node.name + "_rq",
+                     {"min_calib_range": node.attrs["min_calib_range"],
+                      "max_calib_range": node.attrs["max_calib_range"],
+                      "out_type": node.attrs.get("out_type", "int8")},
+                     list(acc_entry))
 
-    def clone(node):
-        if id(node) in memo:
-            return memo[id(node)]
-        new = _Node(node.op, node.name, dict(node.attrs), [], node._is_aux)
-        memo[id(node)] = new
-        new.inputs = [(clone(c), i) for c, i in node.inputs]
-        if node.op == "_contrib_quantize_v2" and node.inputs:
-            src, _ = node.inputs[0]
-            # only when the dequantize reads an int32 accumulator (conv/fc);
-            # int8 producers (pooling) use a different scale domain
-            acc_ok = src.inputs and src.inputs[0][0].op in (
-                "_contrib_quantized_conv",
-                "_contrib_quantized_fully_connected")
-            if src.op == "_contrib_dequantize" and acc_ok and \
-                    "min_calib_range" in node.attrs:
-                acc_entry = new.inputs[0][0].inputs  # dequantize's inputs
-                rq = _Node("_contrib_requantize", node.name + "_rq",
-                           {"min_calib_range":
-                            node.attrs["min_calib_range"],
-                            "max_calib_range":
-                            node.attrs["max_calib_range"],
-                            "out_type": node.attrs.get("out_type", "int8")},
-                           list(acc_entry))
-                memo[id(node)] = rq
-                return rq
-        return new
+    return _graph_rewrite(symbol, hook)
 
-    return Symbol([(clone(n), i) for n, i in symbol._outputs])
+
+def _amax_of(attrs):
+    lo = float(_reg_canon(attrs["min_calib_range"]))
+    hi = float(_reg_canon(attrs["max_calib_range"]))
+    return max(abs(lo), abs(hi))
+
+
+_CALIB_PRODUCERS = ("_contrib_quantize_v2", "_contrib_requantize",
+                    "_contrib_quantized_conv_requant")
+
+
+def _fuse_conv_requant(symbol, arg_params):
+    """Fuse qconv → dequantize → [bias add] → [relu] → quantize chains into
+    one ``_contrib_quantized_conv_requant`` node (reference:
+    quantize_graph_pass.cc fusion; kernel: ops/pallas_kernels.py
+    qmm_requant).  Only NHWC chains whose intermediates have exactly one
+    consumer fuse; residual branches (dequantize feeding an fp32 add)
+    stay unfused.  Opt-in via MXTPU_FUSE_QCONV=1 — measured slower than
+    the split graph on v5e (docs/perf_resnet50_tpu.md r3)."""
+    from ..symbol.symbol import _Node
+
+    consumers = _consumer_sets(symbol)
+
+    def single(node):
+        return len(consumers.get(id(node), ())) == 1
+
+    def hook(node, new, clone):
+        if node.op != "_contrib_quantize_v2" or \
+                "min_calib_range" not in node.attrs or not node.inputs:
+            return None
+        # walk up: [relu] <- [bias add] <- dequantize <- qconv
+        cur = node.inputs[0][0]
+        relu = False
+        bias_node = None
+        if cur.op == "Activation" and single(cur) and \
+                _reg_canon(cur.attrs.get("act_type")) == "relu":
+            relu = True
+            cur = cur.inputs[0][0]
+        if cur.op == "broadcast_add" and single(cur) and \
+                cur.inputs[1][0].op is None:
+            bias_node = cur.inputs[1][0]
+            cur = cur.inputs[0][0]
+        if cur.op != "_contrib_dequantize" or not single(cur):
+            return None
+        qconv = cur.inputs[0][0]
+        if qconv.op != "_contrib_quantized_conv" or not single(qconv):
+            return None
+        if qconv.attrs.get("layout") not in ("NWC", "NHWC", "NDHWC"):
+            return None
+        qdata = qconv.inputs[0][0]
+        if qdata.op not in _CALIB_PRODUCERS or \
+                "min_calib_range" not in qdata.attrs:
+            return None
+        wq = qconv.inputs[1][0]
+        if wq.op is not None or not wq.name.endswith("_quantized"):
+            return None
+        base = wq.name[:-len("_quantized")]
+        if base + "_min" not in arg_params:
+            return None
+        w_amax = max(abs(float(arg_params[base + "_min"].asnumpy()[0])),
+                     abs(float(arg_params[base + "_max"].asnumpy()[0])))
+        attrs = {k: qconv.attrs[k] for k in _QCONV_ATTRS
+                 if k in qconv.attrs}
+        attrs.update({
+            "in_scale": _amax_of(qdata.attrs) / 127.0,
+            "w_scale": w_amax / 127.0,
+            "out_scale": _amax_of(node.attrs) / 127.0,
+            "relu": relu,
+            "min_calib_range": node.attrs["min_calib_range"],
+            "max_calib_range": node.attrs["max_calib_range"],
+        })
+        inputs = [(clone(qdata), 0), (clone(wq), 0)]
+        if bias_node is not None:
+            inputs.append((clone(bias_node), 0))
+        return _Node("_contrib_quantized_conv_requant",
+                     node.name + "_fused", attrs, inputs)
+
+    return _graph_rewrite(symbol, hook)
 
 
 _rewrite_int8_fc = _rewrite_int8  # back-compat name
@@ -479,7 +563,18 @@ def quantize_model(sym_in, arg_params, aux_params, data_names=("data",),
                     len(th_dict), calib_mode)
         sym_in = calib_graph(sym_in, th_dict)
         # rewrite calibrated FC/conv/pooling layers to real int8 subgraphs,
-        # then fuse dequantize->quantize handoffs into requantize
+        # fuse dequantize->quantize handoffs into requantize, then fuse
+        # whole qconv->bias->relu->quantize chains into single int8-out
+        # nodes (Pallas MXU kernel for NHWC 1x1)
         sym_in = _rewrite_int8(sym_in, qarg_params, th_dict, excluded)
         sym_in = _elide_dq_q(sym_in)
+        # opt-in: collapsing the whole qconv->bias->relu->quantize chain
+        # into one node measured 25-40% SLOWER on v5e — XLA fuses the
+        # epilogue INTO the conv and loses the conv's optimal tiling;
+        # as separate HLOs the conv runs clean and the elementwise chain
+        # is one fast standalone fusion (docs/perf_resnet50_tpu.md r3,
+        # "levers measured and rejected")
+        import os as _os
+        if _os.environ.get("MXTPU_FUSE_QCONV", "0") == "1":
+            sym_in = _fuse_conv_requant(sym_in, qarg_params)
     return sym_in, qarg_params, aux_params

@@ -109,6 +109,9 @@ class PSServer:
         self._live_ranks = {}
         self._dead_ranks = set()
         self._live_lock = threading.Lock()
+        # keys claimed by an in-flight chunked init (readers wait on cv)
+        self._pending_init = set()
+        self._pending_cv = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -162,6 +165,12 @@ class PSServer:
                         self._dead_ranks.add(rank_box[0])
             conn.close()
 
+    def _await_init(self, key, timeout=60):
+        """Block while `key` has a chunked init in flight."""
+        with self._pending_cv:
+            self._pending_cv.wait_for(
+                lambda: key not in self._pending_init, timeout=timeout)
+
     def _key_lock(self, key):
         with self._store_lock:
             return self._locks.setdefault(key, threading.Lock())
@@ -177,21 +186,33 @@ class PSServer:
                     self._store[key] = np.array(arr, np.float32)
             return ("ok",)
         if cmd == "init_meta":
-            # chunked init: create the zero array; reply says whether this
-            # caller owns the fill (first init wins)
+            # chunked init: claim the key (first caller wins); the array
+            # is NOT visible until the owner's last chunk installs it
+            # atomically, and readers of a pending key wait (the single-
+            # message init was atomic; the chunked path must stay so)
             _, key, shape = msg
             with self._key_lock(key):
-                fresh = key not in self._store
-                if fresh:
-                    self._store[key] = np.zeros(shape, np.float32)
+                with self._pending_cv:
+                    fresh = key not in self._store and                         key not in self._pending_init
+                    if fresh:
+                        self._pending_init.add(key)
             return ("ok", fresh)
         if cmd == "init_chunk":
-            _, key, start, stop, payload = msg
+            _, key, shape, start, stop, payload, last = msg
+            buf = ctx["staging"].get(("init", key))
+            if buf is None:
+                buf = ctx["staging"][("init", key)] = np.zeros(
+                    int(np.prod(shape)), np.float32)
+            buf[start:stop] = payload
+            if not last:
+                return ("ok",)
+            arr = ctx["staging"].pop(("init", key)).reshape(shape)
             with self._key_lock(key):
-                arr = self._store.get(key)
-                if arr is None:
-                    return ("err", "key %r not initialized" % (key,))
-                arr.reshape(-1)[start:stop] = payload
+                with self._pending_cv:
+                    if key not in self._store:
+                        self._store[key] = arr
+                    self._pending_init.discard(key)
+                    self._pending_cv.notify_all()
             return ("ok",)
         if cmd == "set_optimizer":
             _, blob = msg
@@ -201,6 +222,7 @@ class PSServer:
             return ("ok",)
         if cmd == "push":
             _, key, kind, payload = msg
+            self._await_init(key)
             grad = self._decode(kind, payload)
             with self._key_lock(key):
                 stored = self._store.get(key)
@@ -226,6 +248,7 @@ class PSServer:
             return ("ok",)
         if cmd == "pull":
             _, key = msg
+            self._await_init(key)
             with self._key_lock(key):
                 arr = self._store.get(key)
             if arr is None:
@@ -233,6 +256,7 @@ class PSServer:
             return ("ok", arr)
         if cmd == "row_sparse_pull":
             _, key, row_ids = msg
+            self._await_init(key)
             with self._key_lock(key):
                 arr = self._store.get(key)
             if arr is None:
@@ -244,14 +268,17 @@ class PSServer:
                 return ("ok", len(self._dead_ranks))
         if cmd == "pull_meta":
             # snapshot under the key lock: chunked pulls must never see a
-            # torn mix of pre- and post-update halves
+            # torn mix of pre- and post-update halves.  Unconditional —
+            # the client's chunking threshold may differ from the
+            # server's (per-process env), so any pull_meta may be
+            # followed by pull_chunks.
             _, key = msg
+            self._await_init(key)
             with self._key_lock(key):
                 arr = self._store.get(key)
                 if arr is None:
                     return ("err", "key %r not initialized" % (key,))
-                if arr.size > BIGARRAY_BOUND:
-                    ctx["snapshots"][key] = arr.reshape(-1).copy()
+                ctx["snapshots"][key] = arr.reshape(-1).copy()
             return ("ok", tuple(arr.shape), int(arr.size))
         if cmd == "pull_chunk":
             _, key, start, stop = msg
@@ -367,7 +394,8 @@ class PSClient:
         flat = arr.reshape(-1)
         for start in range(0, arr.size, BIGARRAY_BOUND):
             stop = min(start + BIGARRAY_BOUND, arr.size)
-            self.request("init_chunk", key, start, stop, flat[start:stop])
+            self.request("init_chunk", key, tuple(arr.shape), start, stop,
+                         flat[start:stop], stop == arr.size)
         return ("ok",)
 
     def pull_array(self, key):

@@ -220,11 +220,17 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, cudnn_off=False
         strides = (1, 1) + stride
         padding = [(0, 0), (0, 0)] + sp_pad
     if pool_type == "max":
-        if jnp.issubdtype(data.dtype, jnp.floating) and not global_pool:
-            # custom-VJP path: the offset-sum backward is ~2x faster than
-            # XLA's select_and_scatter on TPU (measured 0.051 vs 0.103 ms
-            # at 256x112x112x64) and matches the reference CPU kernel's
-            # grad-to-every-tied-max semantics (src/operator/nn/pool.h)
+        import os as _os
+        if jnp.issubdtype(data.dtype, jnp.floating) and not global_pool \
+                and _os.environ.get("MXTPU_MAXPOOL_VJP", "0") == "1":
+            # opt-in custom-VJP path: the offset-sum backward beats XLA's
+            # select_and_scatter 2x in isolation (0.051 vs 0.103 ms at
+            # 256x112x112x64) and matches the reference CPU kernel's
+            # grad-to-every-tied-max semantics (src/operator/nn/pool.h),
+            # but inside the full resnet-50 training graph it measures 7%
+            # SLOWER end to end (its 9 strided scatter-adds break XLA's
+            # backward fusion) — docs/perf_resnet50_tpu.md "levers
+            # measured and rejected".  Default: select_and_scatter.
             return _max_pool(data, window, strides, tuple(padding))
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, np.asarray(init, data.dtype)[()], lax.max,

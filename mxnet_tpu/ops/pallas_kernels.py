@@ -423,3 +423,161 @@ def flash_attention(query, key, value, causal=False, scale=None):
     out = _flash_core(to_bh(query, T), to_bh(key, Tk), to_bh(value, Tk),
                       bool(causal), float(scale))
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# int8 matmul with fused requantize epilogue (reference equivalence:
+# src/operator/quantization/quantized_conv.cu + requantize.cu — cuDNN int8
+# conv followed by a separate requantize kernel; here one Pallas kernel
+# does s8xs8->s32 on the MXU and scales/bias/relu/rounds back to int8 in
+# VMEM, so the int32 accumulator never touches HBM)
+# ---------------------------------------------------------------------------
+def _qmm_requant_kernel(x_ref, w_ref, bias_ref, o_ref, *, out_scale,
+                        relu, nsteps):
+    """One (Mb, Nb) output tile: accumulate s32 over K-blocks (unrolled —
+    K/512 is <=4 for resnet), then the epilogue: acc*scale + bias ->
+    [relu] -> round -> clip -> int8."""
+    acc = None
+    for step in range(nsteps):
+        xk = x_ref[:, step * _QMM_KB:(step + 1) * _QMM_KB]
+        wk = w_ref[step * _QMM_KB:(step + 1) * _QMM_KB, :]
+        part = jax.lax.dot_general(xk, wk, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+        acc = part if acc is None else acc + part
+    real = acc.astype(jnp.float32) * out_scale + bias_ref[:]
+    if relu:
+        real = jnp.maximum(real, 0.0)
+    o_ref[:, :] = jnp.clip(jnp.round(real), -127, 127).astype(jnp.int8)
+
+
+_QMM_MB = 512
+_QMM_NB = 256
+_QMM_KB = 512
+
+
+def qmm_requant(x, w, bias, out_scale, relu=True, interpret=None):
+    """int8 (M, K) x (K, N) -> int8 (M, N) with the requantize epilogue
+    fused: out = clip(round(relu(acc * out_scale + bias))).
+
+    ``out_scale`` folds s_x * s_w / s_out; ``bias`` is fp32 in the
+    *output-quantized* domain (already divided by s_out).  Shapes are
+    padded to tile multiples; K must fit VMEM blocks of _QMM_KB.
+    """
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    M, K = x.shape
+    N = w.shape[1]
+
+    def rup(v, m):
+        return (v + m - 1) // m * m
+
+    Mp, Kp, Np = rup(M, _QMM_MB), rup(K, _QMM_KB), rup(N, _QMM_NB)
+    if (Mp, Kp) != (M, K):
+        x = jnp.pad(x, ((0, Mp - M), (0, Kp - K)))
+    if (Kp, Np) != (K, N):
+        w = jnp.pad(w, ((0, Kp - K), (0, Np - N)))
+    bias = jnp.pad(bias.astype(jnp.float32), (0, Np - N)) \
+        .reshape(1, Np)
+
+    kernel = functools.partial(
+        _qmm_requant_kernel, out_scale=float(out_scale), relu=bool(relu),
+        nsteps=Kp // _QMM_KB)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Mp // _QMM_MB, Np // _QMM_NB),
+        in_specs=[
+            pl.BlockSpec((_QMM_MB, Kp), lambda i, j: (i, 0)),
+            pl.BlockSpec((Kp, _QMM_NB), lambda i, j: (0, j)),
+            pl.BlockSpec((1, _QMM_NB), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((_QMM_MB, _QMM_NB), lambda i, j: (i, j)),
+        out_shape=_sds((Mp, Np), jnp.int8, x),
+        interpret=interpret,
+    )(x, w, bias)
+    return out[:M, :N]
+
+
+@register("_contrib_quantized_conv_requant",
+          arg_names=["data", "weight", "bias"], differentiable=False,
+          num_outputs=3, optional_args=("bias",))
+def quantized_conv_requant(data, weight, bias=None, kernel=(), stride=(),
+                           dilate=(), pad=(), num_filter=0, num_group=1,
+                           layout=None, in_scale=1.0, w_scale=1.0,
+                           out_scale=1.0, relu=True,
+                           min_calib_range=None, max_calib_range=None):
+    """Fused int8 conv + bias + [relu] + requantize -> int8 (the
+    quantize_graph_pass fusion target).  Scales are real-domain:
+    ``x_real = x_int * in_scale`` etc.; output ints are
+    ``round(real / out_scale)``.
+
+    NHWC 1x1 stride-1 convs lower to the Pallas MXU kernel (the int32
+    accumulator stays in VMEM); everything else uses the XLA int8 conv
+    with the epilogue fused by XLA."""
+    from jax import lax
+    from .nn import _tup, _conv_layout
+
+    nsp = len(kernel) if kernel else data.ndim - 2
+    stride = _tup(stride, nsp) if stride else (1,) * nsp
+    dilate = _tup(dilate, nsp) if dilate else (1,) * nsp
+    pad = _tup(pad, nsp) if pad else (0,) * nsp
+    dimnum, channels_last = _conv_layout(layout, nsp)
+    x = data.astype(jnp.int8)
+    w = weight.astype(jnp.int8)
+    scale = float(in_scale) * float(w_scale) / float(out_scale)
+    if bias is None:
+        bias_q = jnp.zeros((int(num_filter),), jnp.float32)
+    else:
+        bias_q = bias.astype(jnp.float32) / float(out_scale)
+
+    if (channels_last and all(k == 1 for k in kernel) and num_group == 1
+            and all(p == 0 for p in pad)):
+        if any(s != 1 for s in stride):
+            sl = (slice(None),) + tuple(slice(None, None, s)
+                                       for s in stride)
+            x = x[sl]
+        sp_shape = x.shape[:-1]
+        xf = x.reshape(-1, x.shape[-1])
+        wf = w.reshape(w.shape[0], w.shape[-1]).T  # (K, N)
+        import os as _os
+        if _os.environ.get("MXTPU_PALLAS_QMM", "0") == "1":
+            # opt-in: the Pallas kernel wins on CPU-interpret correctness
+            # tests but XLA's int8 dot out-tiles it at resnet's large-M
+            # small-K shapes (measured 22 vs 55 ms at M=800k K=64) — the
+            # epilogue below fuses into the dot either way
+            out = qmm_requant(xf, wf, bias_q, scale, relu=relu)
+        else:
+            acc = jax.lax.dot_general(
+                xf, wf, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            real = acc.astype(jnp.float32) * scale + bias_q
+            if relu:
+                real = jnp.maximum(real, 0.0)
+            out = jnp.clip(jnp.round(real), -127, 127).astype(jnp.int8)
+        return (out.reshape(sp_shape + (w.shape[0],)),) + _qcr_range(
+            out_scale, min_calib_range, max_calib_range)
+
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, dimnum)
+    acc = lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+    bshape = (1,) * (acc.ndim - 1) + (-1,) if channels_last \
+        else (1, -1) + (1,) * nsp
+    real = acc.astype(jnp.float32) * scale + bias_q.reshape(bshape)
+    if relu:
+        real = jnp.maximum(real, 0.0)
+    q = jnp.clip(jnp.round(real), -127, 127).astype(jnp.int8)
+    return (q,) + _qcr_range(out_scale, min_calib_range, max_calib_range)
+
+
+def _qcr_range(out_scale, lo, hi):
+    """(min, max) companion outputs so downstream quantized consumers can
+    keep reading the (data, min, max) triple ABI."""
+    if lo is None:
+        hi = float(out_scale) * 127.0
+        lo = -hi
+    return (jnp.asarray([float(lo)], jnp.float32),
+            jnp.asarray([float(hi)], jnp.float32))

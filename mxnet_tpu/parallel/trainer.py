@@ -95,13 +95,27 @@ class DataParallelTrainer:
         # identical per-scalar rule, so same-hyper same-dtype replicated
         # params can be updated as ONE flat concatenated vector — dozens of
         # small per-param fusions collapse into a handful of launches.
-        groupable = type(self._opt).__name__ in _ELEMENTWISE_OPTIMIZERS
+        import os as _os
+        # opt-in: fused buckets measured ~2-4%% SLOWER end to end on
+        # resnet-50/v5e even when restricted to tiny BN/bias params — the
+        # concat barriers the backward->optimizer overlap that XLA
+        # otherwise schedules per-gradient (docs/perf_resnet50_tpu.md
+        # "levers measured and rejected").  Kept env-gated for workloads
+        # with thousands of small params.
+        groupable = type(self._opt).__name__ in _ELEMENTWISE_OPTIMIZERS \
+            and _os.environ.get("MXTPU_GROUP_UPDATES", "0") == "1"
+        max_group_elems = int(_os.environ.get(
+            "MXTPU_GROUP_MAX_ELEMS", str(65536)))
         buckets = {}
         self._groups = []  # list of [name, ...]
         for name in self._train_names:
             p = self._params_by_name[name]
             spec = self._param_spec_fn(name, p.shape)
-            if not groupable or spec != PartitionSpec():
+            psize = 1
+            for d in p.shape:
+                psize *= int(d)
+            if not groupable or spec != PartitionSpec() or \
+                    psize > max_group_elems:
                 self._groups.append([name])
                 continue
             key = (float(p.lr_mult), float(p.wd_mult),

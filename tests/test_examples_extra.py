@@ -70,3 +70,16 @@ def test_nce_loss_example():
     """NCE word embeddings (reference: example/nce-loss/)."""
     _run(os.path.join(_EXAMPLES, "nce_loss", "train_nce.py"),
          ["--steps", "600"])
+
+
+def test_fgsm_adversary_example():
+    """Input-gradient FGSM attack (reference: example/adversary/)."""
+    _run(os.path.join(_EXAMPLES, "adversary", "fgsm.py"),
+         ["--epochs", "6"])
+
+
+def test_custom_softmax_example():
+    """Training through a numpy CustomOp (reference:
+    example/numpy-ops/custom_softmax.py)."""
+    _run(os.path.join(_EXAMPLES, "numpy_ops", "custom_softmax.py"),
+         ["--epochs", "10"])

@@ -773,6 +773,7 @@ ALSO_COVERED = {
     "_getitem": "test_ndarray.py (slicing)",
     "PSROIPooling": "sweep (as _contrib_PSROIPooling)",
     "_square_sum": "sweep (alias of square_sum)",
+    "_contrib_quantized_conv_requant": "test_quantization_int8.py",
 }
 
 
