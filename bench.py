@@ -189,13 +189,17 @@ def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
     # accuracy gate ("int8 top-1 within 1% of fp32 on 1000+ images") needs
     # a model whose predictions mean something.
     Xtr, ytr = _blob_images(rng, train_images)
-    train_it = mx.io.NDArrayIter(Xtr, ytr, 128, shuffle=True)
+    train_it = mx.io.NDArrayIter(Xtr, ytr, 128, shuffle=True,
+                                 shuffle_seed=3)
     net = resnet_symbol(50, num_classes=8, layout="NHWC")
     mod = mx.mod.Module(net)
-    # enough steps for the BN statistics to settle and the stem to latch
-    # onto the quadrant pattern; lr tuned for bs=128 from-scratch
-    mod.fit(train_it, num_epoch=5,
-            optimizer_params={"learning_rate": 0.02, "momentum": 0.9})
+    # adam + seeded shuffle + seeded init: short from-scratch sgd on
+    # resnet-50 sat on a knife edge where run-to-run noise decided
+    # whether the gate's classifier converged at all
+    mx.random.seed(11)
+    np.random.seed(11)
+    mod.fit(train_it, num_epoch=5, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3})
     arg, aux = mod.get_params()
     calib_it = mx.io.NDArrayIter(Xtr[:calib_batch], ytr[:calib_batch],
                                  calib_batch)

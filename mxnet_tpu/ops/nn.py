@@ -96,7 +96,16 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     return out
 
 
-@register("Deconvolution", arg_names=["data", "weight", "bias"])
+def _deconv_optional(params):
+    # reference default is no_bias=True: the bias var only exists when
+    # bias is requested (matches _deconv_param_shapes in symbol.py)
+    if params.get("no_bias", True):
+        return ("bias",)
+    return ()
+
+
+@register("Deconvolution", arg_names=["data", "weight", "bias"],
+          optional_args=_deconv_optional)
 def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                   pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
                   workspace=512, no_bias=True, cudnn_tune=None, cudnn_off=False,

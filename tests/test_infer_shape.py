@@ -1,0 +1,153 @@
+"""Symbol shape/type inference (reference:
+tests/python/unittest/test_infer_shape.py + infer_graph_attr_pass.cc).
+
+The executor's bind path must derive every argument/output shape from the
+data shape alone for each frontend layer family, reject inconsistent
+bindings, and honor the channels-last layouts added round 3.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _infer(sym, **shapes):
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**shapes)
+    args = dict(zip(sym.list_arguments(), arg_shapes or []))
+    auxs = dict(zip(sym.list_auxiliary_states(), aux_shapes or []))
+    return args, out_shapes, auxs
+
+
+def test_mlp_chain():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    args, outs, _ = _infer(out, data=(16, 100))
+    assert args["fc1_weight"] == (32, 100)
+    assert args["fc1_bias"] == (32,)
+    assert args["fc2_weight"] == (10, 32)
+    assert outs == [(16, 10)]
+
+
+def test_conv_chain_nchw():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           stride=(2, 2), name="c")
+    p = mx.sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    args, outs, _ = _infer(p, data=(4, 3, 32, 32))
+    assert args["c_weight"] == (8, 3, 3, 3)
+    assert outs == [(4, 8, 8, 8)]
+
+
+def test_conv_chain_nhwc():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           layout="NHWC", name="c")
+    b = mx.sym.BatchNorm(c, axis=3, name="bn")
+    args, outs, auxs = _infer(b, data=(4, 32, 32, 3))
+    # channels-last weight layout (O, kh, kw, I)
+    assert args["c_weight"] == (8, 3, 3, 3)
+    assert args["bn_gamma"] == (8,)
+    assert auxs["bn_moving_mean"] == (8,)
+    assert outs[0] == (4, 32, 32, 8)
+
+
+def test_grouped_and_dilated_conv():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, num_group=2,
+                           dilate=(2, 2), name="c")
+    args, outs, _ = _infer(c, data=(1, 4, 16, 16))
+    assert args["c_weight"] == (8, 2, 3, 3)   # I/group = 2
+    assert outs == [(1, 8, 12, 12)]           # eff kernel 5
+
+
+def test_deconv_shape():
+    data = mx.sym.Variable("data")
+    d = mx.sym.Deconvolution(data, kernel=(4, 4), num_filter=2,
+                             stride=(2, 2), pad=(1, 1), name="d")
+    args, outs, _ = _infer(d, data=(1, 3, 8, 8))
+    assert args["d_weight"] == (3, 2, 4, 4)
+    assert outs == [(1, 2, 16, 16)]
+
+
+def test_rnn_param_vector():
+    data = mx.sym.Variable("data")
+    r = mx.sym.RNN(data, state_size=16, num_layers=1, mode="lstm",
+                   name="rnn")
+    args, outs, _ = _infer(r, data=(10, 4, 8))  # (T, B, input)
+    # lstm: 4 gates x (16x8 + 16x16 + 16 + 16)
+    assert args["rnn_parameters"] == (4 * (16 * 8 + 16 * 16 + 2 * 16),)
+
+
+def test_embedding_and_flatten():
+    data = mx.sym.Variable("data")
+    e = mx.sym.Embedding(data, input_dim=50, output_dim=8, name="emb")
+    f = mx.sym.Flatten(e)
+    args, outs, _ = _infer(f, data=(4, 7))
+    assert args["emb_weight"] == (50, 8)
+    assert outs == [(4, 56)]
+
+
+def test_concat_and_broadcast():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.Concat(a, b, dim=1)
+    _, outs, _ = _infer(c, a=(2, 3), b=(2, 5))
+    assert outs == [(2, 8)]
+    s = mx.sym.broadcast_add(a, b)
+    _, outs2, _ = _infer(s, a=(2, 1), b=(1, 5))
+    assert outs2 == [(2, 5)]
+
+
+def test_reshape_special_codes():
+    data = mx.sym.Variable("data")
+    r = mx.sym.Reshape(data, shape=(0, -1))
+    _, outs, _ = _infer(r, data=(4, 3, 5))
+    assert outs == [(4, 15)]
+    r2 = mx.sym.Reshape(data, shape=(-3, 0))
+    _, outs2, _ = _infer(r2, data=(4, 3, 5))
+    assert outs2 == [(12, 5)]
+
+
+def test_label_shape_inferred_for_output_heads():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=10, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    args, _, _ = _infer(out, data=(8, 20))
+    assert args["softmax_label"] == (8,)
+
+
+def test_multi_output_heads_group():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=6, name="fc")
+    g = mx.sym.Group([mx.sym.softmax(fc), mx.sym.sum(fc)])
+    _, outs, _ = _infer(g, data=(4, 3))
+    assert outs[0] == (4, 6) and outs[1] == ()
+
+
+def test_pooling_full_convention():
+    data = mx.sym.Variable("data")
+    p = mx.sym.Pooling(data, kernel=(3, 3), stride=(2, 2),
+                       pooling_convention="full", pool_type="max")
+    _, outs, _ = _infer(p, data=(1, 1, 7, 7))
+    # ceil((7-3)/2)+1 = 3
+    assert outs == [(1, 1, 3, 3)]
+
+
+def test_simple_bind_rejects_unresolvable():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("mystery")
+    out = data + w  # no shape rule relates mystery to data beyond broadcast
+    fc = mx.sym.FullyConnected(out, num_hidden=4, name="fc")
+    with pytest.raises(mx.MXNetError):
+        from mxnet_tpu.executor import Executor
+        Executor.simple_bind(mx.sym.SoftmaxOutput(fc, name="softmax"),
+                             shapes={})  # no data shape given at all
+
+
+def test_infer_type_propagates():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Cast(data, dtype="float64")
+    arg_types, out_types, _ = c.infer_type(data="float32")
+    assert out_types == [np.dtype("float64")]

@@ -1,0 +1,380 @@
+"""All gluon losses vs the torch oracle; metric registry vs hand-computed
+references; LR scheduler trajectories; initializer statistics.
+
+Reference: ``python/mxnet/gluon/loss.py`` (11 losses), ``metric.py``
+(registry of 13), ``lr_scheduler.py`` (Factor/MultiFactor/Poly),
+``initializer.py`` — each previously covered by one or two smoke cases;
+this file gives every implementation an independent numeric oracle, the
+per-component depth the reference's ``test_loss.py``/``test_metric.py``
+carry.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+RNG = np.random.RandomState(42)
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# losses vs torch
+# ---------------------------------------------------------------------------
+def test_l2_loss_vs_torch():
+    p = RNG.randn(6, 4).astype(np.float32)
+    t = RNG.randn(6, 4).astype(np.float32)
+    out = gluon.loss.L2Loss()(nd.array(p), nd.array(t)).asnumpy()
+    # mxnet convention: 0.5 * mse per sample
+    ref = 0.5 * F.mse_loss(_t(p), _t(t), reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_l1_loss_vs_torch():
+    p = RNG.randn(6, 4).astype(np.float32)
+    t = RNG.randn(6, 4).astype(np.float32)
+    out = gluon.loss.L1Loss()(nd.array(p), nd.array(t)).asnumpy()
+    ref = F.l1_loss(_t(p), _t(t), reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_sigmoid_bce_vs_torch():
+    x = RNG.randn(8, 3).astype(np.float32)
+    y = (RNG.rand(8, 3) > 0.5).astype(np.float32)
+    out = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(x), nd.array(y)).asnumpy()
+    ref = F.binary_cross_entropy_with_logits(
+        _t(x), _t(y), reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sigmoid_bce_from_sigmoid():
+    p = RNG.rand(8).astype(np.float32) * 0.9 + 0.05
+    y = (RNG.rand(8) > 0.5).astype(np.float32)
+    out = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)(
+        nd.array(p), nd.array(y)).asnumpy()
+    ref = F.binary_cross_entropy(_t(p), _t(y), reduction="none").numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_softmax_ce_vs_torch():
+    x = RNG.randn(8, 5).astype(np.float32)
+    y = RNG.randint(0, 5, 8).astype(np.float32)
+    out = gluon.loss.SoftmaxCrossEntropyLoss()(
+        nd.array(x), nd.array(y)).asnumpy()
+    ref = F.cross_entropy(_t(x), _t(y).long(), reduction="none").numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_softmax_ce_sparse_false_vs_torch():
+    x = RNG.randn(8, 5).astype(np.float32)
+    y = RNG.rand(8, 5).astype(np.float32)
+    y = y / y.sum(1, keepdims=True)  # soft labels
+    out = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd.array(x), nd.array(y)).asnumpy()
+    ref = (-(F.log_softmax(_t(x), dim=-1) * _t(y)).sum(-1)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_kldiv_loss_vs_torch():
+    x = RNG.randn(6, 5).astype(np.float32)
+    t = RNG.rand(6, 5).astype(np.float32)
+    t = t / t.sum(1, keepdims=True)
+    out = gluon.loss.KLDivLoss(from_logits=False)(
+        nd.array(x), nd.array(t)).asnumpy()
+    ref = F.kl_div(F.log_softmax(_t(x), dim=-1), _t(t),
+                   reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_huber_loss_vs_torch():
+    p = RNG.randn(10).astype(np.float32) * 3
+    t = RNG.randn(10).astype(np.float32)
+    rho = 1.0
+    out = gluon.loss.HuberLoss(rho=rho)(nd.array(p), nd.array(t)).asnumpy()
+    # torch smooth_l1 with beta=rho equals mxnet huber / rho... check raw:
+    d = np.abs(p - t)
+    ref = np.where(d <= rho, 0.5 * d * d / rho, d - 0.5 * rho)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_hinge_losses():
+    p = RNG.randn(8).astype(np.float32)
+    y = np.where(RNG.rand(8) > 0.5, 1.0, -1.0).astype(np.float32)
+    out = gluon.loss.HingeLoss()(nd.array(p), nd.array(y)).asnumpy()
+    ref = np.maximum(0, 1 - p * y)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    out2 = gluon.loss.SquaredHingeLoss()(nd.array(p), nd.array(y)).asnumpy()
+    np.testing.assert_allclose(out2, ref ** 2, rtol=1e-5)
+
+
+def test_logistic_loss():
+    p = RNG.randn(8).astype(np.float32)
+    y = np.where(RNG.rand(8) > 0.5, 1.0, -1.0).astype(np.float32)
+    out = gluon.loss.LogisticLoss()(nd.array(p), nd.array(y)).asnumpy()
+    ref = np.log1p(np.exp(-p * y))
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_triplet_loss_vs_torch():
+    a = RNG.randn(6, 4).astype(np.float32)
+    p = RNG.randn(6, 4).astype(np.float32)
+    n = RNG.randn(6, 4).astype(np.float32)
+    out = gluon.loss.TripletLoss(margin=1.0)(
+        nd.array(a), nd.array(p), nd.array(n)).asnumpy()
+    ref = np.maximum(
+        0, ((a - p) ** 2).sum(1) - ((a - n) ** 2).sum(1) + 1.0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    """gluon CTCLoss uses blank_label='last' (blank = C-1)."""
+    T, B, C = 10, 2, 5
+    x = RNG.randn(B, T, C).astype(np.float32)
+    labels = np.array([[1, 2, 3], [2, 2, 3]], np.float32)
+    lens = np.array([3, 3], np.float32)
+    out = gluon.loss.CTCLoss()(
+        nd.array(x), nd.array(labels), None,
+        nd.array(lens)).asnumpy()
+    lp_t = F.log_softmax(_t(x), dim=-1).transpose(0, 1)  # (T, B, C)
+    tgt = torch.tensor([[1, 2, 3], [2, 2, 3]], dtype=torch.long)
+    ref = torch.nn.functional.ctc_loss(
+        lp_t, tgt, torch.full((B,), T, dtype=torch.long),
+        torch.tensor([3, 3]), blank=C - 1, reduction="none")
+    np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_loss_sample_weight_and_batch_axis():
+    p = RNG.randn(4, 3).astype(np.float32)
+    t = RNG.randn(4, 3).astype(np.float32)
+    w = np.array([[1.0], [0.0], [2.0], [0.5]], np.float32)
+    out = gluon.loss.L2Loss()(nd.array(p), nd.array(t),
+                              nd.array(w)).asnumpy()
+    base = 0.5 * ((p - t) ** 2).mean(1)
+    np.testing.assert_allclose(out, base * w[:, 0], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# metrics vs hand-computed references
+# ---------------------------------------------------------------------------
+def test_accuracy_metric_stream():
+    m = mx.metric.Accuracy()
+    preds = [np.array([[0.9, 0.1], [0.2, 0.8]]),
+             np.array([[0.4, 0.6], [0.7, 0.3]])]
+    labels = [np.array([0, 0]), np.array([1, 0])]
+    for p, l in zip(preds, labels):
+        m.update([nd.array(l)], [nd.array(p)])
+    # correct: [yes, no], [yes, yes] -> 3/4
+    assert m.get()[1] == pytest.approx(0.75)
+    m.reset()
+    assert np.isnan(m.get()[1]) or m.get()[1] == 0.0
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    # tie-free rows so the reference top-2 set is unambiguous
+    p = np.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1], [0.25, 0.35, 0.4]])
+    l = np.array([1, 1, 0])
+    m.update([nd.array(l)], [nd.array(p)])
+    # top2 sets: {2,1} hit, {0,1} hit, {2,1} miss -> 2/3
+    assert m.get()[1] == pytest.approx(2 / 3)
+
+
+def test_f1_and_mcc():
+    l = np.array([1, 0, 1, 1, 0, 0], np.float32)
+    p = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6],
+                  [0.6, 0.4], [0.1, 0.9], [0.8, 0.2]], np.float32)
+    pred = p.argmax(1)
+    tp = int(((pred == 1) & (l == 1)).sum())
+    fp = int(((pred == 1) & (l == 0)).sum())
+    fn = int(((pred == 0) & (l == 1)).sum())
+    tn = int(((pred == 0) & (l == 0)).sum())
+    f1 = mx.metric.F1()
+    f1.update([nd.array(l)], [nd.array(p)])
+    prec = tp / max(tp + fp, 1)
+    rec = tp / max(tp + fn, 1)
+    ref_f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+    assert f1.get()[1] == pytest.approx(ref_f1, abs=1e-6)
+    mcc = mx.metric.MCC()
+    mcc.update([nd.array(l)], [nd.array(p)])
+    denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    ref_mcc = (tp * tn - fp * fn) / denom
+    assert mcc.get()[1] == pytest.approx(ref_mcc, abs=1e-6)
+
+
+def test_regression_metrics():
+    l = RNG.randn(10).astype(np.float32)
+    p = RNG.randn(10).astype(np.float32)
+    for name, ref in [("mae", np.abs(p - l).mean()),
+                      ("mse", ((p - l) ** 2).mean()),
+                      ("rmse", np.sqrt(((p - l) ** 2).mean()))]:
+        m = mx.metric.create(name)
+        m.update([nd.array(l)], [nd.array(p)])
+        assert m.get()[1] == pytest.approx(float(ref), rel=1e-5), name
+
+
+def test_perplexity_metric():
+    p = np.array([[0.5, 0.5], [0.9, 0.1], [0.2, 0.8]], np.float32)
+    l = np.array([0, 0, 1], np.float32)
+    m = mx.metric.Perplexity(ignore_label=None)
+    m.update([nd.array(l)], [nd.array(p)])
+    ref = np.exp(-(np.log(0.5) + np.log(0.9) + np.log(0.8)) / 3)
+    assert m.get()[1] == pytest.approx(float(ref), rel=1e-5)
+
+
+def test_cross_entropy_metric():
+    p = np.array([[0.7, 0.3], [0.4, 0.6]], np.float32)
+    l = np.array([0, 1], np.float32)
+    m = mx.metric.create("ce")
+    m.update([nd.array(l)], [nd.array(p)])
+    ref = -(np.log(0.7) + np.log(0.6)) / 2
+    assert m.get()[1] == pytest.approx(float(ref), rel=1e-5)
+
+
+def test_pearson_metric():
+    l = RNG.randn(20).astype(np.float32)
+    p = 0.7 * l + 0.3 * RNG.randn(20).astype(np.float32)
+    m = mx.metric.create("pearsonr")
+    m.update([nd.array(l)], [nd.array(p)])
+    ref = np.corrcoef(p, l)[0, 1]
+    assert m.get()[1] == pytest.approx(float(ref), rel=1e-4)
+
+
+def test_composite_and_custom_metric():
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy())
+    comp.add(mx.metric.TopKAccuracy(top_k=2))
+    l = np.array([0, 1], np.float32)
+    p = np.array([[0.8, 0.2], [0.3, 0.7]], np.float32)
+    comp.update([nd.array(l)], [nd.array(p)])
+    names, vals = comp.get()
+    assert len(names) == 2 and vals[0] == pytest.approx(1.0)
+    assert vals[1] == pytest.approx(1.0)
+
+    cust = mx.metric.CustomMetric(
+        lambda label, pred: float(np.mean(label)))
+    cust.update([nd.array(l)], [nd.array(p)])
+    assert cust.get()[1] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# LR schedulers — full trajectories
+# ---------------------------------------------------------------------------
+def test_factor_scheduler():
+    # reference semantics: lr drops after each full `step` window, i.e.
+    # at num_update = step+1 (lr_scheduler.py `while num_update > count+step`)
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == pytest.approx(1.0)
+    assert s(10) == pytest.approx(1.0)
+    assert s(11) == pytest.approx(0.5)
+    assert s(21) == pytest.approx(0.25)
+    # floor
+    s2 = mx.lr_scheduler.FactorScheduler(step=1, factor=0.1,
+                                         stop_factor_lr=1e-3, base_lr=1.0)
+    for i in range(1, 20):
+        lr = s2(i)
+    assert lr >= 1e-3
+
+
+def test_multifactor_scheduler():
+    s = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                             base_lr=1.0)
+    assert s(4) == pytest.approx(1.0)
+    assert s(6) == pytest.approx(0.1)
+    assert s(14) == pytest.approx(0.1)
+    assert s(16) == pytest.approx(0.01)
+
+
+def test_poly_scheduler():
+    s = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert s(0) == pytest.approx(1.0)
+    assert s(50) == pytest.approx((1 - 0.5) ** 2)
+    assert s(100) == pytest.approx(0.0, abs=1e-9)
+    assert s(150) == pytest.approx(0.0, abs=1e-9)  # clamps past the end
+
+
+def test_scheduler_drives_trainer():
+    """The scheduler actually reaches the optimizer inside Module.fit."""
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=0.8)
+    opt = mx.optimizer.SGD(learning_rate=0.8, lr_scheduler=sched)
+    X = RNG.randn(32, 4).astype(np.float32)
+    y = (np.arange(32) % 2).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, 16)
+    data = mx.sym.Variable("data")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(out)
+    mod.fit(it, num_epoch=2, optimizer=opt)
+    # after 4 updates, lr halved at least twice
+    assert opt.lr_scheduler(4) <= 0.8 * 0.5 ** 2 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# initializers — statistical contracts
+# ---------------------------------------------------------------------------
+def _init_array(init, shape=(256, 128), name="fc_weight"):
+    arr = nd.zeros(shape)
+    desc = mx.init.InitDesc(name)
+    init(desc, arr)
+    return arr.asnumpy()
+
+
+def test_uniform_initializer_range():
+    a = _init_array(mx.init.Uniform(0.3))
+    assert a.min() >= -0.3 - 1e-6 and a.max() <= 0.3 + 1e-6
+    assert a.std() == pytest.approx(0.3 / np.sqrt(3), rel=0.1)
+
+
+def test_normal_initializer_sigma():
+    a = _init_array(mx.init.Normal(0.05))
+    assert a.std() == pytest.approx(0.05, rel=0.1)
+    assert abs(a.mean()) < 0.005
+
+
+def test_xavier_initializer_scale():
+    a = _init_array(mx.init.Xavier(rnd_type="uniform", factor_type="avg",
+                                   magnitude=3))
+    bound = np.sqrt(3.0 * 2 / (256 + 128))
+    assert a.max() <= bound + 1e-6 and a.min() >= -bound - 1e-6
+    assert a.std() == pytest.approx(bound / np.sqrt(3), rel=0.15)
+
+
+def test_msra_prelu_initializer():
+    a = _init_array(mx.init.MSRAPrelu(factor_type="in", slope=0.0))
+    # He init: std = sqrt(2 / fan_in); fan_in = 128
+    assert a.std() == pytest.approx(np.sqrt(2.0 / 128), rel=0.15)
+
+
+def test_orthogonal_initializer():
+    a = _init_array(mx.init.Orthogonal())
+    g = a @ a.T if a.shape[0] <= a.shape[1] else a.T @ a
+    n = g.shape[0]
+    np.testing.assert_allclose(g, np.eye(n) * g[0, 0], atol=1e-3 * abs(g[0, 0]) * n)
+
+
+def test_constant_and_zero_one():
+    assert (_init_array(mx.init.Zero()) == 0).all()
+    assert (_init_array(mx.init.One()) == 1).all()
+    assert (_init_array(mx.init.Constant(2.5)) == 2.5).all()
+
+
+def test_bilinear_initializer_upsampling():
+    """Bilinear weights make Deconvolution an exact 2x bilinear upsampler
+    on a linear ramp (reference: initializer.py Bilinear docstring)."""
+    w = nd.zeros((1, 1, 4, 4))
+    mx.init.Bilinear()(mx.init.InitDesc("up_weight"), w)
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = nd.Deconvolution(nd.array(x), w, None, kernel=(4, 4),
+                           stride=(2, 2), pad=(1, 1), num_filter=1,
+                           no_bias=True).asnumpy()
+    # interior of a bilinearly upsampled ramp stays a ramp with half step
+    row = out[0, 0, 4, 2:6]
+    diffs = np.diff(row)
+    np.testing.assert_allclose(diffs, diffs[0], rtol=0.2)
