@@ -1,17 +1,69 @@
 """North-star benchmark: ResNet-50 training throughput, img/s per chip.
 
 Baseline (BASELINE.md / docs/faq/perf.md:214 in the reference): 298.51 img/s
-on V100 fp32, bs=32 — MXNet 1.2 `train_imagenet.py`.  Prints ONE JSON line.
+on V100 fp32, bs=32 — MXNet 1.2 `train_imagenet.py`.
+
+Un-losable by construction (round-3 postmortem: one slow sub-gate starved
+the whole record): the primary metric is PRINTED the moment it is measured,
+and a progressively extended full-JSON line is re-printed after every
+sub-bench — every printed line is complete JSON, so whichever line is last
+when the driver's clock runs out is a valid record (the reference's
+benchmark_score.py prints per-model lines as it goes for the same reason).
+Each sub-bench is time-boxed against a global budget
+(MXTPU_BENCH_BUDGET_S); SIGTERM/SIGINT re-print the latest record before
+exiting.
 """
 from __future__ import annotations
 
 import json
 import os
+import signal
 import time
 
 import numpy as np
 
 BASELINE_IMGS_PER_SEC = 298.51
+
+
+class _Record:
+    """Accumulates the result dict; re-prints the full line after every
+    update so the tail of stdout is always the most complete record."""
+
+    def __init__(self, budget_s):
+        self.result = {}
+        self.t0 = time.monotonic()
+        self.budget = budget_s
+        self.stage_s = {}
+        # prebuilt line for the signal handler: print() is not
+        # signal-safe (a SIGTERM landing mid-emit would raise
+        # "reentrant call inside BufferedWriter" and tear the tail line)
+        self.last_line = b""
+
+    def remaining(self):
+        return self.budget - (time.monotonic() - self.t0)
+
+    def emit(self):
+        line = json.dumps(self.result)
+        self.last_line = (line + "\n").encode()
+        print(line, flush=True)
+
+    def stage(self, name, est_s, fn):
+        """Run one time-boxed sub-bench.  A stage that would not fit in the
+        remaining budget is skipped (recorded, so the gap is visible); a
+        stage that raises records its error; either way the record is
+        re-emitted and later stages still run."""
+        if self.remaining() < est_s:
+            self.result.setdefault("skipped_stages", []).append(name)
+            self.emit()
+            return
+        t = time.monotonic()
+        try:
+            self.result.update(fn() or {})
+        except Exception as e:  # never lose earlier numbers
+            self.result[name + "_error"] = str(e)[:200]
+        self.stage_s[name] = round(time.monotonic() - t, 1)
+        self.result["stage_s"] = self.stage_s
+        self.emit()
 
 
 def main():
@@ -21,6 +73,30 @@ def main():
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    # persistent XLA compilation cache: the round-3 record died to compile
+    # time (231s train-step + 355s infer compiles over the tunnel); a warm
+    # cache turns every re-run into minutes.  Repo-local so the driver's
+    # run hits the cache this session warmed.
+    cache_dir = os.environ.get(
+        "MXTPU_BENCH_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    rec = _Record(float(os.environ.get("MXTPU_BENCH_BUDGET_S", "780")))
+
+    def _bail(signum, frame):
+        # async-signal-safe re-emit: raw write of the last complete line
+        # (preceded by a newline in case a print was torn mid-line)
+        if rec.last_line:
+            os.write(1, b"\n" + rec.last_line)
+        os._exit(1)
+
+    signal.signal(signal.SIGTERM, _bail)
+    signal.signal(signal.SIGINT, _bail)
 
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
@@ -66,6 +142,7 @@ def main():
              "multi_precision": dtype != "float32"}, mesh=mesh)
 
     # warmup (compile); halve the batch on OOM so the metric always prints
+    t_warm = time.monotonic()
     while True:
         try:
             trainer = build_trainer()
@@ -78,6 +155,7 @@ def main():
                 raise
             batch //= 2
             global_batch = batch * n_dev
+    rec.stage_s["train_compile"] = round(time.monotonic() - t_warm, 1)
 
     iters = int(os.environ.get("MXTPU_BENCH_ITERS", "10"))
     t0 = time.perf_counter()
@@ -88,12 +166,15 @@ def main():
 
     imgs_per_sec_per_chip = global_batch * iters / dt / n_dev
 
-    result = {
+    rec.result.update({
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec_per_chip, 2),
         "unit": "img/s/chip",
-        "vs_baseline": round(imgs_per_sec_per_chip / BASELINE_IMGS_PER_SEC, 3),
-    }
+        "vs_baseline": round(imgs_per_sec_per_chip / BASELINE_IMGS_PER_SEC,
+                             3),
+        "stage_s": rec.stage_s,
+    })
+    rec.emit()  # the primary metric is now on the wire, whatever follows
 
     # -- pipeline-fed measurement (reference: train_imagenet.py feeds the
     # trainer through ImageRecordIter, src/io/iter_image_recordio_2.cc).
@@ -102,17 +183,13 @@ def main():
     # host the decode path is CPU-bound (os.cpu_count() cores drive
     # libjpeg), so the pipeline rate is a host property, not a chip one.
     if os.environ.get("MXTPU_BENCH_PIPELINE", "1") == "1":
-        try:
-            result.update(_pipeline_bench(
-                trainer, batch, layout, dtype,
-                synth_rate=imgs_per_sec_per_chip * n_dev))
-        except Exception as e:  # never lose the primary metric
-            result["pipeline_error"] = str(e)[:200]
+        rec.stage("pipeline", 45, lambda: _pipeline_bench(
+            trainer, batch, layout, dtype,
+            synth_rate=imgs_per_sec_per_chip * n_dev))
 
     # -- inference: bf16 denominator + int8 (reference: benchmark_score.py
     # fp32/fp16 table in docs/faq/perf.md:156,170, and quantized resnet via
     # quantize_graph_pass.cc + quantized_conv/pooling/fc kernels).
-    # Each bench guards itself: one failing must not drop the other.
     run_bf16 = os.environ.get("MXTPU_BENCH_BF16", "1") == "1"
     run_int8 = os.environ.get("MXTPU_BENCH_INT8", "1") == "1"
     if run_bf16 or run_int8:
@@ -122,25 +199,20 @@ def main():
         import gc
         gc.collect()
     if run_bf16:
-        try:
-            result.update(_bf16_infer_bench())
-        except Exception as e:
-            result["bf16_infer_error"] = str(e)[:200]
+        rec.stage("bf16_infer", 60, _bf16_infer_bench)
     if run_int8:
-        try:
-            import gc
-            gc.collect()
-            result.update(_int8_bench())
-        except Exception as e:
-            result["int8_error"] = str(e)[:200]
-
-    print(json.dumps(result))
+        # perf first (cheap: quantize with naive calibration on an untrained
+        # net would skew accuracy, so the full gate below re-quantizes with
+        # entropy calibration on a trained net — but the THROUGHPUT number
+        # does not depend on the weights' values, so it is measured first
+        # and survives even if the accuracy gate is cut off)
+        rec.stage("int8_infer", 90, _int8_infer_bench)
+        rec.stage("int8_acc", 150, _int8_accuracy_gate)
 
 
 def _bf16_infer_bench(batch=None, iters=20):
     """bf16 inference denominator (reference: benchmark_score.py, the fp16
     row of docs/faq/perf.md:170) — NHWC bf16 jitted forward, bs>=64."""
-    import jax
     import numpy as np
 
     import mxnet_tpu as mx
@@ -174,23 +246,78 @@ def _blob_images(rng, n, nclass=8, size=224):
                             noise=0.3, base=0.8)
 
 
-def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
-                train_images=2048):
-    import numpy as np
-
+def _quantized_resnet50(arg=None, aux=None, calib_it=None, calib_batch=64,
+                        calib_mode="entropy"):
+    """Quantize a ResNet-50 symbol (NHWC end to end so the int8 convs/dots
+    land on the MXU int8 path without transposes)."""
     import mxnet_tpu as mx
     from mxnet_tpu.symbol.models import resnet_symbol
 
+    net = resnet_symbol(50, num_classes=8, layout="NHWC")
+    if arg is None:
+        # shape-only init: threshold values don't change the compiled
+        # int8 program's speed, just its scales.  Random (not zero) calib
+        # data so every activation range is non-degenerate.
+        mod = mx.mod.Module(net)
+        rng = np.random.RandomState(0)
+        it = mx.io.NDArrayIter(
+            rng.rand(calib_batch, 224, 224, 3).astype(np.float32),
+            np.zeros(calib_batch, np.float32), calib_batch)
+        mod.bind(it.provide_data, it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        arg, aux = mod.get_params()
+        calib_it = it
+    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
+        net, arg, aux, calib_data=calib_it,
+        num_calib_examples=calib_batch, calib_mode=calib_mode,
+        excluded_sym_names=["stem_conv"])
+    return net, arg, aux, qsym, qarg, qaux
+
+
+def _int8_infer_bench(batch=None, iters=20):
+    """int8 inference throughput only — Xavier weights, naive calibration
+    (the compiled program and hence the rate are weight-independent)."""
+    import gc
+
+    import mxnet_tpu as mx
+
+    gc.collect()  # drop the bf16 executor's HBM (Block cycles) first
     batch = batch or int(os.environ.get("MXTPU_BENCH_INFER_BATCH", "256"))
     rng = np.random.RandomState(0)
-    # NHWC end to end: the quantized graph keeps the TPU-native layout so
-    # the int8 convs/dots land on the MXU int8 path without transposes.
-    # Train briefly on separable synthetic data first: the VERDICT r2
-    # accuracy gate ("int8 top-1 within 1% of fp32 on 1000+ images") needs
-    # a model whose predictions mean something.
+    _, _, _, qsym, qarg, qaux = _quantized_resnet50(calib_mode="naive")
+    Xb = rng.rand(batch, 224, 224, 3).astype(np.float32)
+    it = mx.io.NDArrayIter(Xb, np.zeros(batch, np.float32), batch)
+    qmod = mx.mod.Module(qsym)
+    qmod.bind(it.provide_data, it.provide_label, for_training=False)
+    qmod.init_params(arg_params=qarg, aux_params=qaux)
+    b = next(iter(it))
+    qmod.forward(b, is_train=False)
+    qmod.get_outputs()[0].asnumpy()  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        qmod.forward(b, is_train=False)
+    qmod.get_outputs()[0].asnumpy()
+    dt = time.perf_counter() - t0
+    return {"int8_infer_imgs_per_sec": round(batch * iters / dt, 2)}
+
+
+def _int8_accuracy_gate(batch=None, calib_batch=64, eval_images=1024,
+                        train_images=2048, epochs=5):
+    """Accuracy gate: train ResNet-50 to competence on separable synthetic
+    data, quantize with entropy calibration + BN folding, check int8 top-1
+    within 1% of fp32 on 1000+ images (VERDICT r2 gate).  Runs AFTER the
+    throughput stages so its cost can never starve them."""
+    import gc
+
+    import mxnet_tpu as mx
+
+    gc.collect()  # drop the previous stage's executors before binding
+    batch = batch or int(os.environ.get("MXTPU_BENCH_INFER_BATCH", "256"))
+    rng = np.random.RandomState(0)
     Xtr, ytr = _blob_images(rng, train_images)
     train_it = mx.io.NDArrayIter(Xtr, ytr, 128, shuffle=True,
                                  shuffle_seed=3)
+    from mxnet_tpu.symbol.models import resnet_symbol
     net = resnet_symbol(50, num_classes=8, layout="NHWC")
     mod = mx.mod.Module(net)
     # adam + seeded shuffle + seeded init: short from-scratch sgd on
@@ -198,15 +325,16 @@ def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
     # whether the gate's classifier converged at all
     mx.random.seed(11)
     np.random.seed(11)
-    mod.fit(train_it, num_epoch=5, optimizer="adam",
+    mod.fit(train_it, num_epoch=epochs, optimizer="adam",
             optimizer_params={"learning_rate": 1e-3})
     arg, aux = mod.get_params()
     calib_it = mx.io.NDArrayIter(Xtr[:calib_batch], ytr[:calib_batch],
                                  calib_batch)
-    # entropy (KL) calibration + BN folding — the round-3 int8 pipeline
-    qsym, qarg, qaux = mx.contrib.quantization.quantize_model(
-        net, arg, aux, calib_data=calib_it, num_calib_examples=calib_batch,
-        calib_mode="entropy", excluded_sym_names=["stem_conv"])
+    # entropy (KL) calibration + BN folding — the round-3 int8 pipeline;
+    # same recipe as the throughput stage (shared helper) so the gated
+    # accuracy describes the benchmarked program
+    net, arg, aux, qsym, qarg, qaux = _quantized_resnet50(
+        arg, aux, calib_it, calib_batch=calib_batch)
 
     # fp32 eval predictions captured BEFORE the fp32 executor is dropped
     # so it never coexists with the int8 one in HBM
@@ -229,21 +357,10 @@ def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
     import gc
     gc.collect()
 
-    Xb = rng.rand(batch, 224, 224, 3).astype(np.float32)
-    it = mx.io.NDArrayIter(Xb, np.zeros(batch, np.float32), batch)
+    it = mx.io.NDArrayIter(Xev[:batch], yev[:batch], batch)
     qmod = mx.mod.Module(qsym)
     qmod.bind(it.provide_data, it.provide_label, for_training=False)
     qmod.init_params(arg_params=qarg, aux_params=qaux)
-    b = next(iter(it))
-    qmod.forward(b, is_train=False)
-    qmod.get_outputs()[0].asnumpy()  # compile + sync
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        qmod.forward(b, is_train=False)
-    qmod.get_outputs()[0].asnumpy()
-    dt = time.perf_counter() - t0
-    out = {"int8_infer_imgs_per_sec": round(batch * iters / dt, 2)}
-
     agree = tot = int8_correct = 0
     for (Xe, ye), ref in zip(eval_sets, fp32_preds):
         eb = mx.io.DataBatch(data=[mx.nd.array(Xe)], label=[])
@@ -252,14 +369,15 @@ def _int8_bench(batch=None, iters=20, calib_batch=64, eval_images=1024,
         agree += int((ref == got).sum())
         int8_correct += int((got == ye).sum())
         tot += len(got)
-    out["int8_top1_agreement"] = round(agree / tot, 4)
-    out["fp32_top1_acc"] = round(fp32_correct / tot, 4)
-    out["int8_top1_acc"] = round(int8_correct / tot, 4)
-    out["int8_top1_drop"] = round((fp32_correct - int8_correct) / tot, 4)
-    return out
+    return {
+        "int8_top1_agreement": round(agree / tot, 4),
+        "fp32_top1_acc": round(fp32_correct / tot, 4),
+        "int8_top1_acc": round(int8_correct / tot, 4),
+        "int8_top1_drop": round((fp32_correct - int8_correct) / tot, 4),
+    }
 
 
-def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024,
+def _pipeline_bench(trainer, batch, layout, dtype, n_records=None,
                     synth_rate=None):
     import io as _pyio
     import tempfile
@@ -272,6 +390,8 @@ def _pipeline_bench(trainer, batch, layout, dtype, n_records=1024,
     import mxnet_tpu as mx
     from mxnet_tpu import recordio
 
+    n_records = n_records or int(os.environ.get("MXTPU_BENCH_PIPELINE_N",
+                                                "1024"))
     tmpdir = tempfile.mkdtemp(prefix="mxtpu_bench_rec_")
     rec_path = os.path.join(tmpdir, "synth.rec")
     idx_path = os.path.join(tmpdir, "synth.idx")

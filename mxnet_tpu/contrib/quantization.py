@@ -63,7 +63,9 @@ def optimal_threshold(hist, hist_edges,
     # out the clipping cost of every candidate (TensorRT's calibration
     # skips bin 0 for the same reason)
     hist[zero] = 0.0
-    half_start = num_quantized_bins // 2 + 1
+    # start at num_quantized_bins//2 like the reference
+    # (_get_optimal_threshold:253) so the tightest candidate is considered
+    half_start = num_quantized_bins // 2
     best = (np.inf, float(hist_edges[-1]))
     for i in range(half_start, zero + 1):
         lo, hi = zero - i, zero + i + 1
@@ -189,25 +191,30 @@ def _graph_rewrite(symbol, hook):
     return Symbol([(clone(n), i) for n, i in symbol._outputs])
 
 
-def _consumer_sets(symbol):
+def _consumer_sets(symbol, with_indices=False):
     """{id(node): set of distinct consumers} with ``"head"`` marking graph
     outputs.  A multi-output producer feeding one consumer through several
-    edges still counts as a single consumer."""
+    edges still counts as a single consumer.  With ``with_indices`` also
+    returns {id(node): set of output indices read by any consumer} so
+    rewrites can tell a data-output edge from a stats-output edge."""
     consumers = {}
+    out_idx = {}
     seen = set()
 
     def walk(node):
         if id(node) in seen:
             return
         seen.add(id(node))
-        for child, _ in node.inputs:
+        for child, i in node.inputs:
             consumers.setdefault(id(child), set()).add(id(node))
+            out_idx.setdefault(id(child), set()).add(i)
             walk(child)
 
-    for n, _ in symbol._outputs:
+    for n, i in symbol._outputs:
         consumers.setdefault(id(n), set()).add("head")
+        out_idx.setdefault(id(n), set()).add(i)
         walk(n)
-    return consumers
+    return (consumers, out_idx) if with_indices else consumers
 
 
 def fold_batch_norms(symbol, arg_params, aux_params):
@@ -221,7 +228,7 @@ def fold_batch_norms(symbol, arg_params, aux_params):
 
     arg_params = dict(arg_params)
     aux_params = dict(aux_params)
-    consumers = _consumer_sets(symbol)
+    consumers, out_idx = _consumer_sets(symbol, with_indices=True)
 
     def hook(node, new, clone):
         if node.op != "BatchNorm" or not node.inputs:
@@ -229,6 +236,10 @@ def fold_batch_norms(symbol, arg_params, aux_params):
         src, _src_out = node.inputs[0]
         if src.op != "Convolution" or \
                 len(consumers.get(id(src), ())) != 1:
+            return None
+        # a consumer wired to BN output 1/2 (mean/var) would be silently
+        # rewired to a nonexistent conv output — only fold data-only BNs
+        if out_idx.get(id(node), {0}) != {0}:
             return None
         # the BN must normalize the conv's channel axis: channels-last
         # convs carry channels on the minor axis, channels-first on axis 1

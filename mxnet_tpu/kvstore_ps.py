@@ -140,7 +140,7 @@ class PSServer:
         # snapshots.  Keeping them here (not on the server) means two
         # workers chunk-pushing the same key never interleave, and a
         # client that dies mid-transfer leaks nothing.
-        ctx = {"staging": {}, "snapshots": {}}
+        ctx = {"staging": {}, "snapshots": {}, "claimed_inits": set()}
         try:
             while True:
                 msg = _recv(conn)
@@ -163,6 +163,15 @@ class PSServer:
                     if self._live_ranks.get(rank_box[0]) is conn:
                         del self._live_ranks[rank_box[0]]
                         self._dead_ranks.add(rank_box[0])
+            # a client that dies mid-chunked-init must release its claim,
+            # or the key stays pending forever: other workers' init_meta
+            # returns fresh=False (never retried) and every push/pull on
+            # the key blocks in _await_init
+            if ctx["claimed_inits"]:
+                with self._pending_cv:
+                    self._pending_init.difference_update(
+                        ctx["claimed_inits"])
+                    self._pending_cv.notify_all()
             conn.close()
 
     def _await_init(self, key, timeout=60):
@@ -176,7 +185,8 @@ class PSServer:
             return self._locks.setdefault(key, threading.Lock())
 
     def _handle(self, msg, ctx=None):
-        ctx = ctx if ctx is not None else {"staging": {}, "snapshots": {}}
+        ctx = ctx if ctx is not None else {
+            "staging": {}, "snapshots": {}, "claimed_inits": set()}
         cmd = msg[0]
         if cmd == "init":
             _, key, arr = msg
@@ -196,7 +206,17 @@ class PSServer:
                     fresh = key not in self._store and                         key not in self._pending_init
                     if fresh:
                         self._pending_init.add(key)
-            return ("ok", fresh)
+                        ctx["claimed_inits"].add(key)
+                    installed = key in self._store
+            return ("ok", fresh, installed)
+        if cmd == "wait_init":
+            # block while the key has an init in flight, then report
+            # whether it actually got installed (the owner may have died:
+            # losers use this to decide between done and re-claiming)
+            _, key = msg
+            self._await_init(key)
+            with self._key_lock(key):
+                return ("ok", key in self._store)
         if cmd == "init_chunk":
             _, key, shape, start, stop, payload, last = msg
             buf = ctx["staging"].get(("init", key))
@@ -212,6 +232,7 @@ class PSServer:
                     if key not in self._store:
                         self._store[key] = arr
                     self._pending_init.discard(key)
+                    ctx["claimed_inits"].discard(key)
                     self._pending_cv.notify_all()
             return ("ok",)
         if cmd == "set_optimizer":
@@ -247,8 +268,12 @@ class PSServer:
                     self._store[key] = np.asarray(g, np.float32)
             return ("ok",)
         if cmd == "pull":
+            # kept as the simple (unchunked) wire surface: pull_array no
+            # longer sends it, but external probes and tests may
             _, key = msg
             self._await_init(key)
+            # a plain pull supersedes any staged snapshot for the key
+            ctx["snapshots"].pop(key, None)
             with self._key_lock(key):
                 arr = self._store.get(key)
             if arr is None:
@@ -268,18 +293,22 @@ class PSServer:
                 return ("ok", len(self._dead_ranks))
         if cmd == "pull_meta":
             # snapshot under the key lock: chunked pulls must never see a
-            # torn mix of pre- and post-update halves.  Unconditional —
-            # the client's chunking threshold may differ from the
-            # server's (per-process env), so any pull_meta may be
-            # followed by pull_chunks.
-            _, key = msg
+            # torn mix of pre- and post-update halves.  The client sends
+            # ITS chunking bound (per-process env, may differ from the
+            # server's): a small array is returned inline — one round
+            # trip, no snapshot left behind — and only arrays the client
+            # will actually chunk are staged.
+            key = msg[1]
+            bound = msg[2] if len(msg) > 2 else BIGARRAY_BOUND
             self._await_init(key)
             with self._key_lock(key):
                 arr = self._store.get(key)
                 if arr is None:
                     return ("err", "key %r not initialized" % (key,))
+                if arr.size <= bound:
+                    return ("ok", tuple(arr.shape), int(arr.size), arr)
                 ctx["snapshots"][key] = arr.reshape(-1).copy()
-            return ("ok", tuple(arr.shape), int(arr.size))
+            return ("ok", tuple(arr.shape), int(arr.size), None)
         if cmd == "pull_chunk":
             _, key, start, stop = msg
             snap = ctx["snapshots"].get(key)
@@ -385,12 +414,25 @@ class PSClient:
         return ("ok",)
 
     def init_array(self, key, arr):
-        """Init, chunked above BIGARRAY_BOUND (first init wins either way)."""
+        """Init, chunked above BIGARRAY_BOUND (first init wins either way).
+
+        A loser of the init_meta race does not just walk away: the winner
+        may die mid-chunks (its claim is then released server-side), so
+        losers wait for the install and re-contend if it never landed."""
         if arr.size <= BIGARRAY_BOUND:
             return self.request("init", key, arr)
-        _, fresh = self.request("init_meta", key, tuple(arr.shape))
-        if not fresh:
-            return ("ok",)
+        while True:
+            reply = self.request("init_meta", key, tuple(arr.shape))
+            fresh, installed = reply[1], reply[2]
+            if fresh:
+                break
+            if installed:
+                return ("ok",)
+            # an init is in flight elsewhere: block until it installs or
+            # the owner's death releases the claim, then re-contend
+            _, installed = self.request("wait_init", key)
+            if installed:
+                return ("ok",)
         flat = arr.reshape(-1)
         for start in range(0, arr.size, BIGARRAY_BOUND):
             stop = min(start + BIGARRAY_BOUND, arr.size)
@@ -399,10 +441,12 @@ class PSClient:
         return ("ok",)
 
     def pull_array(self, key):
-        """Dense pull, chunked above BIGARRAY_BOUND elements."""
-        _, shape, size = self.request("pull_meta", key)
-        if size <= BIGARRAY_BOUND:
-            return self.request("pull", key)[1]
+        """Dense pull, chunked above BIGARRAY_BOUND elements.  Small
+        arrays come back inline with the meta — one round trip."""
+        _, shape, size, arr = self.request("pull_meta", key,
+                                           BIGARRAY_BOUND)
+        if arr is not None:
+            return arr
         import numpy as _np
         out = _np.empty(size, _np.float32)
         for start in range(0, size, BIGARRAY_BOUND):

@@ -27,11 +27,16 @@ def quantize(data, min_range, max_range, out_type="uint8"):
     mn = min_range.reshape(())
     mx = max_range.reshape(())
     if out_type == "uint8":
-        scale = _UINT8_MAX / (mx - mn)
+        # degenerate (mx==mn) range → scale 0 not inf: constant data
+        # quantizes to code 0 instead of NaN-saturating the graph
+        span = mx - mn
+        scale = jnp.where(span > 0, _UINT8_MAX / jnp.where(span > 0, span,
+                                                           1.0), 0.0)
         q = jnp.clip(jnp.round((data - mn) * scale), 0, 255).astype(jnp.uint8)
     else:
         amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
-        scale = _INT8_MAX / amax
+        scale = jnp.where(amax > 0, _INT8_MAX / jnp.where(amax > 0, amax,
+                                                          1.0), 0.0)
         q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
     return q, mn.reshape(1), mx.reshape(1)
 
@@ -83,8 +88,9 @@ def requantize(data, min_range, max_range, min_calib_range=None,
         mn = jnp.min(real)
         mx = jnp.max(real)
     amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
-    q = jnp.clip(jnp.round(real * (_INT8_MAX / amax)), -127, 127) \
-        .astype(jnp.int8)
+    scale = jnp.where(amax > 0, _INT8_MAX / jnp.where(amax > 0, amax, 1.0),
+                      0.0)
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
     return q, mn.reshape(1), mx.reshape(1)
 
 

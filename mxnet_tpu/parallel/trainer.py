@@ -90,7 +90,7 @@ class DataParallelTrainer:
             p._data._set_data(jax.device_put(p.data()._data, sh))
 
         # group parameters into fused update buckets (reference precedent:
-        # multi-tensor optimizer launches, docs/faq/perf.md:214-216 的
+        # multi-tensor optimizer launches, docs/faq/perf.md:214-216
         # "grouped updates" lever): every elementwise optimizer applies the
         # identical per-scalar rule, so same-hyper same-dtype replicated
         # params can be updated as ONE flat concatenated vector — dozens of

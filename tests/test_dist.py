@@ -300,3 +300,63 @@ def test_checkpoint_kill_resume_matches_uninterrupted(tmp_path):
         np.testing.assert_allclose(res_arg[k].asnumpy(),
                                    ref_arg[k].asnumpy(), rtol=1e-5,
                                    atol=1e-5)
+
+
+def test_abandoned_chunked_init_released_on_disconnect(monkeypatch):
+    """A client that dies mid-chunked-init must release its claim so
+    another worker's init can proceed instead of every push/pull on the
+    key blocking forever (ADVICE r3: _pending_init leak)."""
+    import time
+
+    import numpy as np
+    from mxnet_tpu import kvstore_ps
+
+    monkeypatch.setattr(kvstore_ps, "BIGARRAY_BOUND", 1000)
+    server = kvstore_ps.PSServer(port=0, num_workers=2)
+    try:
+        c1 = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+        big = np.arange(5003, dtype=np.float32)
+        # claim the key, send ONE chunk, then die
+        reply = c1.request("init_meta", "w", big.shape)
+        assert reply[1] and not reply[2]  # fresh, not installed
+        c1.request("init_chunk", "w", big.shape, 0, 1000, big[:1000],
+                   False)
+        c1.close()
+        time.sleep(0.2)  # let the serve thread's finally release the claim
+        # the second worker goes through the REAL client path: init_array
+        # must wait out / re-contend the abandoned claim and install
+        c2 = kvstore_ps.PSClient("127.0.0.1", server.port, rank=1)
+        assert c2.init_array("w", big) == ("ok",)
+        np.testing.assert_allclose(c2.pull_array("w"), big)
+        c2.close()
+    finally:
+        server.stop()
+
+
+def test_small_pull_single_round_trip_no_snapshot(monkeypatch):
+    """pull_meta carries the client's chunk bound: a small key comes back
+    inline (one round trip) and leaves no server-side snapshot behind
+    (ADVICE r3: unconditional snapshot retention)."""
+    import numpy as np
+    from mxnet_tpu import kvstore_ps
+
+    monkeypatch.setattr(kvstore_ps, "BIGARRAY_BOUND", 1000)
+    server = kvstore_ps.PSServer(port=0, num_workers=1)
+    try:
+        client = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+        small = np.arange(10, dtype=np.float32)
+        client.request("init", "s", small)
+        reply = client.request("pull_meta", "s", 1000)
+        assert reply[3] is not None  # inline payload
+        np.testing.assert_allclose(reply[3], small)
+        np.testing.assert_allclose(client.pull_array("s"), small)
+        # a big key still chunks: meta stages a snapshot, payload is None
+        big = np.arange(5003, dtype=np.float32)
+        client.request("init", "b", np.zeros_like(big))
+        client.push_array("b", big)
+        reply = client.request("pull_meta", "b", 1000)
+        assert reply[3] is None
+        np.testing.assert_allclose(client.pull_array("b"), big)
+        client.close()
+    finally:
+        server.stop()
