@@ -1,0 +1,143 @@
+"""Fully-convolutional semantic segmentation (FCN-32s / FCN-16s / FCN-8s).
+
+Reference: ``example/fcn-xs/`` — ``symbol_fcnxs.py`` builds a VGG trunk
+with per-stage score heads fused through Deconvolution upsampling + Crop
+alignment, ``init_fcnxs.py`` gives the deconv weights a bilinear-
+interpolation init, and training scores every pixel with a multi-output
+softmax.  This compact analogue exercises the same capability chain —
+Deconvolution upsampling, Crop, skip-connection fusion, Bilinear/Mixed
+initializers, per-pixel SoftmaxOutput(multi_output) — on synthetic
+rectangle scenes, end to end on the Symbol/Module API.
+
+TPU notes: static shapes throughout (one bucket, 32x32); the whole
+forward/backward is one XLA program — the deconvs lower to
+conv_transpose on the MXU.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+NCLASS = 4  # background + 3 rectangle classes
+
+
+def make_scenes(rng, n, size=32):
+    """Images with 1-2 axis-aligned colored rectangles; the label map
+    marks each pixel with its rectangle's class (0 = background)."""
+    X = np.zeros((n, 3, size, size), np.float32)
+    Y = np.zeros((n, size, size), np.float32)
+    for i in range(n):
+        X[i] = rng.rand(3, size, size) * 0.15
+        for _ in range(rng.randint(1, 3)):
+            cls = rng.randint(1, NCLASS)
+            h, w = rng.randint(8, 20, size=2)
+            r, c = rng.randint(0, size - h), rng.randint(0, size - w)
+            X[i, :, r:r + h, c:c + w] = 0.15
+            X[i, cls - 1, r:r + h, c:c + w] = 0.9
+            Y[i, r:r + h, c:c + w] = cls
+    return X, Y
+
+
+def _conv_stage(sym, data, num_filter, name):
+    body = sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                           num_filter=num_filter, name=name + "_conv")
+    body = sym.Activation(body, act_type="relu", name=name + "_relu")
+    return sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                       pool_type="max", name=name + "_pool")
+
+
+def fcn_symbol(variant="8s"):
+    """Trunk with three /2 pooling stages (so the deepest features sit at
+    /8) and score heads fused exactly like symbol_fcnxs.py: deeper scores
+    are deconv-upsampled 2x, Crop-aligned onto the shallower score, and
+    summed; the fused map is deconv-upsampled back to full resolution."""
+    sym = mx.sym
+    data = sym.Variable("data")
+    p1 = _conv_stage(sym, data, 16, "s1")      # /2
+    p2 = _conv_stage(sym, p1, 32, "s2")        # /4
+    p3 = _conv_stage(sym, p2, 64, "s3")        # /8
+
+    score3 = sym.Convolution(p3, kernel=(1, 1), num_filter=NCLASS,
+                             name="score3")
+    if variant == "32s":
+        # single-shot x8 upsample of the deepest score (FCN-32s analogue)
+        big = sym.Deconvolution(score3, kernel=(16, 16), stride=(8, 8),
+                                pad=(4, 4), num_filter=NCLASS,
+                                no_bias=True, name="upsample_final")
+        fused = big
+    else:
+        score2 = sym.Convolution(p2, kernel=(1, 1), num_filter=NCLASS,
+                                 name="score2")
+        up3 = sym.Deconvolution(score3, kernel=(4, 4), stride=(2, 2),
+                                num_filter=NCLASS, no_bias=True,
+                                name="upsample3")
+        up3c = sym.Crop(up3, score2, offset=(1, 1), name="crop3")
+        fused = score2 + up3c                  # /4 skip fusion
+        if variant == "8s":
+            score1 = sym.Convolution(p1, kernel=(1, 1), num_filter=NCLASS,
+                                     name="score1")
+            up2 = sym.Deconvolution(fused, kernel=(4, 4), stride=(2, 2),
+                                    num_filter=NCLASS, no_bias=True,
+                                    name="upsample2")
+            up2c = sym.Crop(up2, score1, offset=(1, 1), name="crop2")
+            fused = score1 + up2c              # /2 skip fusion
+            stride = 2
+        else:
+            stride = 4
+        fused = sym.Deconvolution(fused, kernel=(2 * stride, 2 * stride),
+                                  stride=(stride, stride),
+                                  pad=(stride // 2, stride // 2),
+                                  num_filter=NCLASS, no_bias=True,
+                                  name="upsample_final")
+    # per-pixel softmax over the class axis (multi_output: axis 1)
+    return sym.SoftmaxOutput(fused, sym.Variable("softmax_label"),
+                             multi_output=True, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="8s", choices=["32s", "16s", "8s"])
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    X, Y = make_scenes(rng, 256)
+    Xe, Ye = make_scenes(np.random.RandomState(1), 64)
+
+    net = fcn_symbol(args.variant)
+    mod = mx.mod.Module(net, label_names=["softmax_label"])
+    it = mx.io.NDArrayIter(X, Y, args.batch, shuffle=True,
+                           label_name="softmax_label")
+    mod.bind(it.provide_data, it.provide_label)
+    # init_fcnxs.py posture: bilinear interpolation for every deconv
+    # upsampling weight, Xavier for the trunk
+    mod.init_params(mx.init.Mixed(
+        ["upsample.*", ".*"], [mx.init.Bilinear(), mx.init.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 3e-3})
+    for _ in range(args.epochs):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+
+    # pixel accuracy on held-out scenes
+    eb = mx.io.DataBatch(data=[mx.nd.array(Xe)], label=[])
+    mod.forward(eb, is_train=False)
+    pred = mod.get_outputs()[0].asnumpy().argmax(1)
+    acc = float((pred == Ye).mean())
+    base = float((Ye == 0).mean())  # all-background predictor
+    print("fcn-%s pixel acc %.3f (all-background baseline %.3f)"
+          % (args.variant, acc, base))
+    # the skip-connection ladder (FCN paper): finer fusion, better pixels
+    floor = {"32s": base + 0.03, "16s": base + 0.06, "8s": 0.90}
+    assert acc > floor[args.variant], (acc, floor[args.variant])
+    print("FCN OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Deep Q-Network on a gridworld.
+
+Reference: ``example/reinforcement-learning/dqn/`` — the ingredients that
+make DQN a distinct framework workload: an experience replay buffer
+(``replay_memory.py``), a SEPARATE target network refreshed by parameter
+copy every N steps (``dqn_demo.py`` qnet/target sync), epsilon-greedy
+exploration, and the non-stationary TD(0) regression target
+``r + gamma * max_a Q_target(s', a)``.  Exercises imperative control
+flow + cross-network parameter copies, which no supervised example does.
+
+The environment is a deterministic 5x5 gridworld (start corner to goal
+corner, -0.01 step cost, +1 at the goal): small enough to verify the
+learned greedy policy is optimal, not just improved.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+SIZE = 5
+N_STATE = SIZE * SIZE
+ACTIONS = [(-1, 0), (1, 0), (0, -1), (0, 1)]  # up down left right
+
+
+class Grid:
+    def reset(self):
+        self.pos = (0, 0)
+        return self.pos
+
+    def step(self, a):
+        dr, dc = ACTIONS[a]
+        r = min(max(self.pos[0] + dr, 0), SIZE - 1)
+        c = min(max(self.pos[1] + dc, 0), SIZE - 1)
+        self.pos = (r, c)
+        done = self.pos == (SIZE - 1, SIZE - 1)
+        return self.pos, (1.0 if done else -0.01), done
+
+
+def onehot(pos):
+    v = np.zeros(N_STATE, np.float32)
+    v[pos[0] * SIZE + pos[1]] = 1.0
+    return v
+
+
+def qnet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(len(ACTIONS)))
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, N_STATE)))  # materialize deferred shapes for copying
+    return net
+
+
+def copy_params(src, dst):
+    """Target-network sync (reference: dqn_demo.py copy qnet->target).
+    The nets are structurally identical clones, so parameters pair up in
+    declaration order (their auto-generated name indices differ)."""
+    sp, dp = src.collect_params(), dst.collect_params()
+    for p, d in zip(sp.values(), dp.values()):
+        assert p.shape == d.shape, (p.name, d.name)
+        d.set_data(p.data())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=200)
+    ap.add_argument("--gamma", type=float, default=0.95)
+    ap.add_argument("--sync-every", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+
+    q, target = qnet(), qnet()
+    copy_params(q, target)
+    trainer = gluon.Trainer(q.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    env = Grid()
+    replay = []  # (s, a, r, s2, done) ring buffer
+    steps = 0
+    eps = 1.0
+    for ep in range(args.episodes):
+        s = onehot(env.reset())
+        for _ in range(40):
+            if rng.rand() < eps:
+                a = rng.randint(len(ACTIONS))
+            else:
+                a = int(q(nd.array(s[None])).asnumpy().argmax())
+            pos, r, done = env.step(a)
+            s2 = onehot(pos)
+            replay.append((s, a, r, s2, done))
+            if len(replay) > 5000:
+                replay.pop(0)
+            s = s2
+            steps += 1
+            if len(replay) >= args.batch:
+                idx = rng.randint(0, len(replay), args.batch)
+                S = nd.array(np.stack([replay[i][0] for i in idx]))
+                A = np.array([replay[i][1] for i in idx])
+                R = np.array([replay[i][2] for i in idx], np.float32)
+                S2 = nd.array(np.stack([replay[i][3] for i in idx]))
+                D = np.array([float(replay[i][4]) for i in idx],
+                             np.float32)
+                # TD target through the FROZEN network (no gradient)
+                q2 = target(S2).asnumpy().max(1)
+                y = nd.array(R + args.gamma * q2 * (1.0 - D))
+                with autograd.record():
+                    qs = q(S)
+                    qa = nd.pick(qs, nd.array(A), axis=1)
+                    loss = ((qa - y) ** 2).mean()
+                loss.backward()
+                trainer.step(args.batch)
+            if steps % args.sync_every == 0:
+                copy_params(q, target)
+            if done:
+                break
+        eps = max(0.05, eps * 0.98)
+
+    # greedy rollout must be optimal: 8 steps corner to corner
+    s = onehot(env.reset())
+    path = 0
+    done = False
+    while not done and path < 40:
+        a = int(q(nd.array(s[None])).asnumpy().argmax())
+        pos, r, done = env.step(a)
+        s = onehot(pos)
+        path += 1
+    print("greedy rollout: reached goal=%s in %d steps (optimal 8)"
+          % (done, path))
+    assert done and path == 2 * (SIZE - 1), (done, path)
+    print("DQN OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,8 @@ zeros, ``*gamma``/``running_var`` get ones, unless an attribute override
 from __future__ import annotations
 
 import json
+import logging
+import re
 
 import numpy as np
 
@@ -234,6 +236,59 @@ _REG.alias(One, "ones")
 _REG.alias(Normal, "gaussian")
 _REG.alias(Xavier, "xavier")
 
-# convenience aliases matching `mx.init.*`
-Load = None
-Mixed = None
+class Mixed(Initializer):
+    """Route parameters to initializers by name regex (reference:
+    python/mxnet/initializer.py Mixed; used by fcn-xs init_fcnxs.py to
+    give deconv upsampling weights a Bilinear init while the trunk gets
+    Xavier).  Patterns are tried in order; ``".*"`` as the last pattern
+    gives a default."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must pair up")
+        self._map = [(re.compile(p), create(i))
+                     for p, i in zip(patterns, initializers)]
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        for prog, init in self._map:
+            if prog.match(desc):
+                init(desc, arr)
+                return
+        raise ValueError(
+            "parameter %r did not match any pattern; add \".*\" as the "
+            "last pattern for a default" % str(desc))
+
+
+class Load:
+    """Initialize from a dict of saved arrays, falling back to
+    ``default_init`` for params not in the dict (reference:
+    initializer.py Load — the FeedForward fine-tune path)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {
+            (k[4:] if k.startswith(("arg:", "aux:")) else k): v
+            for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, desc, arr):
+        name = str(desc)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError(
+                    "shape mismatch for %r: saved %s vs expected %s"
+                    % (name, tuple(src.shape), tuple(arr.shape)))
+            arr[:] = src.asnumpy() if hasattr(src, "asnumpy") else src
+        else:
+            if self.default_init is None:
+                raise ValueError("no saved value for %r and no "
+                                 "default_init" % name)
+            if self.verbose:
+                logging.getLogger(__name__).info(
+                    "Load: %s not found in saved params, using "
+                    "default_init", name)
+            self.default_init(desc, arr)

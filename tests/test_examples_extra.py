@@ -83,3 +83,40 @@ def test_custom_softmax_example():
     example/numpy-ops/custom_softmax.py)."""
     _run(os.path.join(_EXAMPLES, "numpy_ops", "custom_softmax.py"),
          ["--epochs", "10"])
+
+# -- round 4 (VERDICT r3 #4): segmentation + VAE + RL + style + text-cnn --
+def test_fcn_segmentation_example():
+    """FCN-8s: Deconvolution upsampling + Crop alignment + Bilinear/Mixed
+    init + per-pixel SoftmaxOutput (reference: example/fcn-xs/)."""
+    _run(os.path.join(_EXAMPLES, "fcn_xs", "train_fcn.py"),
+         ["--epochs", "8"])
+
+
+def test_vae_example():
+    """Reparameterized stochastic latent + analytic KL inside autograd
+    (reference: example/vae/VAE.py)."""
+    _run(os.path.join(_EXAMPLES, "vae", "train_vae.py"),
+         ["--epochs", "30"])
+
+
+def test_dqn_example():
+    """Replay buffer + frozen target net + epsilon-greedy; asserts the
+    greedy policy is optimal (reference:
+    example/reinforcement-learning/dqn/)."""
+    _run(os.path.join(_EXAMPLES, "reinforcement_learning", "dqn.py"),
+         ["--episodes", "80"])
+
+
+def test_neural_style_example():
+    """Optimize-the-input: gradients w.r.t. data through a frozen
+    extractor, optimizer driving a raw NDArray (reference:
+    example/neural-style/nstyle.py)."""
+    _run(os.path.join(_EXAMPLES, "neural_style", "nstyle.py"),
+         ["--steps", "150"])
+
+
+def test_text_cnn_example():
+    """Kim-style multi-width conv + max-over-time text classifier
+    (reference: example/cnn_text_classification/text_cnn.py)."""
+    _run(os.path.join(_EXAMPLES, "cnn_text_classification",
+                      "text_cnn.py"), ["--epochs", "12"])
