@@ -1,0 +1,12 @@
+"""Text vocab + pretrained embeddings
+(reference: python/mxnet/contrib/text/__init__.py — same submodule
+layout: vocab, embedding, utils)."""
+from . import embedding, utils, vocab
+from .embedding import (CompositeEmbedding, CustomEmbedding, FastText,
+                        GloVe)
+from .utils import count_tokens_from_str
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary",
+           "count_tokens_from_str", "CustomEmbedding", "GloVe",
+           "FastText", "CompositeEmbedding"]

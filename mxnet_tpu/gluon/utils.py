@@ -67,18 +67,72 @@ def check_sha1(filename, sha1_hash):
     return sha1.hexdigest() == sha1_hash
 
 
-def download(url, path=None, overwrite=False, sha1_hash=None):
-    """Download helper (reference: utils.py download).  This environment has
-    no egress; only file:// and existing local paths are honored."""
-    fname = url.split("/")[-1] if path is None else path
-    if os.path.isdir(fname):
-        fname = os.path.join(fname, url.split("/")[-1])
-    if os.path.exists(fname) and not overwrite:
-        return fname
-    if url.startswith("file://"):
-        import shutil
-        shutil.copyfile(url[7:], fname)
-        return fname
-    raise IOError(
-        "cannot download %r: no network egress in this environment; place the "
-        "file at %r manually" % (url, fname))
+def get_repo_url():
+    """Hosted-artifact repo base URL, MXNET_GLUON_REPO-overridable with a
+    guaranteed trailing slash (shared by model_store and contrib.text;
+    reference: gluon/utils.py:243 _get_repo_url)."""
+    repo = os.environ.get(
+        "MXNET_GLUON_REPO",
+        "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/")
+    if not repo.endswith("/"):
+        repo += "/"
+    return repo
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True, timeout=30):
+    """Download ``url`` to ``path`` with SHA-1 verification and retries
+    (reference: gluon/utils.py:178 download).
+
+    ``file://`` URLs ride the same urllib code path, so the full
+    download+verify+retry logic is unit-testable in this zero-egress
+    environment; http(s) URLs raise after exhausting retries."""
+    import shutil
+    import tempfile
+    import urllib.request
+
+    if path is None:
+        fname = url.split("/")[-1]
+        assert fname, "can't construct file-name from %r" % url
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    assert retries >= 0, "number of retries should be at least 0"
+
+    if overwrite or not os.path.exists(fname) or \
+            (sha1_hash and not check_sha1(fname, sha1_hash)):
+        dirname = os.path.dirname(os.path.abspath(os.path.expanduser(fname)))
+        if not os.path.exists(dirname):
+            os.makedirs(dirname)
+        last_err = None
+        while retries + 1 > 0:
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    # write to a temp file then move: a killed transfer
+                    # must never leave a truncated file at fname that a
+                    # later call would trust
+                    fd, tmp = tempfile.mkstemp(dir=dirname)
+                    try:
+                        with os.fdopen(fd, "wb") as out:
+                            shutil.copyfileobj(resp, out)
+                        shutil.move(tmp, fname)
+                    finally:
+                        if os.path.exists(tmp):
+                            os.remove(tmp)
+                if sha1_hash and not check_sha1(fname, sha1_hash):
+                    raise IOError(
+                        "downloaded file %r sha1 mismatch: expected %s. "
+                        "The repo may be out of sync with the catalog; "
+                        "try overwrite=True or update the hash."
+                        % (fname, sha1_hash))
+                return fname
+            except Exception as e:
+                last_err = e
+                retries -= 1
+                if retries < 0:
+                    raise IOError(
+                        "failed to download %r: %s (no network egress in "
+                        "this environment for http(s); file:// works)"
+                        % (url, e)) from last_err
+    return fname
