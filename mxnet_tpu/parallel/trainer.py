@@ -39,6 +39,13 @@ _ELEMENTWISE_OPTIMIZERS = {
 class DataParallelTrainer:
     """Train a Gluon block data-parallel (optionally tensor-parallel) on a mesh.
 
+    With ``kvstore`` set to a multi-worker ``dist_sync`` store, gradients
+    are additionally averaged across processes each step (one fused
+    collective over a single flat key).  Aux states (BatchNorm running
+    statistics) stay per-worker, exactly like the reference's dist
+    training — the kvstore moves gradients/weights only and rank 0's aux
+    is what a checkpoint records (python/mxnet/model.py:157).
+
     Parameters
     ----------
     block : gluon.Block — the model; will be run in train mode.
@@ -48,10 +55,20 @@ class DataParallelTrainer:
     param_spec_fn : callable(name, shape)->PartitionSpec for tensor
         parallelism; default replicates every parameter.
     data_axis : mesh axis name the batch is sharded over.
+    kvstore : str or KVStore, optional — a ``dist_sync`` store for
+        multi-process gradient averaging (every process must construct
+        its trainers in the same order).
     """
 
+    # distinct flat-gradient key per trainer instance (same construction
+    # order on every rank, which the collectives require anyway), so two
+    # trainers on one store never collide
+    _KV_UID = 0
+
     def __init__(self, block, loss, optimizer, optimizer_params=None,
-                 mesh=None, param_spec_fn=None, data_axis="data"):
+                 mesh=None, param_spec_fn=None, data_axis="data",
+                 kvstore=None):
+        from .. import kvstore as kvs
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss
@@ -62,8 +79,60 @@ class DataParallelTrainer:
         self._param_spec_fn = param_spec_fn or (lambda name, shape:
                                                 PartitionSpec())
         self._data_axis = data_axis
+        # multi-process data parallelism (reference: dist_sync training in
+        # python/mxnet/model.py:157 — grads pushed to the PS, summed across
+        # workers, pulled back, updater applied locally).  Within a process
+        # the mesh psum rides ICI; across processes the kvstore rides the
+        # network: the two compose exactly like the reference's
+        # device-comm + dist-kvstore split (src/kvstore/comm.h:451).
+        if isinstance(kvstore, str):
+            kvstore = kvs.create(kvstore)
+        self._kv = kvstore if (kvstore is not None
+                               and kvstore.num_workers > 1) else None
+        if self._kv is not None:
+            # the split-step protocol needs replace-with-sum push semantics:
+            # dist_async applies pushes per-arrival on the PS (no
+            # cross-worker sum) and a store-side updater would apply the
+            # optimizer to the gradient keys — both would silently train
+            # unsynchronized
+            if self._kv.type not in ("dist_sync", "dist_device_sync",
+                                     "tpu_dist"):
+                raise ValueError(
+                    "DataParallelTrainer needs a synchronous kvstore "
+                    "(dist_sync/dist_device_sync/tpu_dist), got %r"
+                    % self._kv.type)
+            if self._kv._updater is not None:
+                raise ValueError(
+                    "kvstore has an updater/optimizer set; the trainer "
+                    "applies its own optimizer — use a plain dist_sync "
+                    "store for gradient aggregation")
+            if self._kv._compression is not None:
+                raise ValueError(
+                    "kvstore gradient compression would quantize the "
+                    "trainer's fused flat gradient (and the loss scalar "
+                    "riding on it) — use an uncompressed store here")
+            DataParallelTrainer._KV_UID += 1
+            self._kv_prefix = "dpt%d::" % DataParallelTrainer._KV_UID
+            # the kvstore already owns the cross-process reduction; the
+            # mesh must therefore stay process-local or the collective
+            # would be counted twice (and device_put would target
+            # non-addressable devices)
+            if mesh is None:
+                local = jax.local_devices()
+                self._mesh = mesh_mod.make_mesh(
+                    (len(local),), (data_axis,), local)
+            else:
+                pidx = jax.process_index()
+                if any(d.process_index != pidx
+                       for d in self._mesh.devices.flat):
+                    raise ValueError(
+                        "with kvstore set the mesh must span only this "
+                        "process's devices (cross-process reduction rides "
+                        "the kvstore, not the mesh)")
         self._ready = False
         self._step_fn = None
+        self._grad_fn = None
+        self._update_fn = None
         self._step_count = 0
 
     # -- setup -------------------------------------------------------------
@@ -151,13 +220,62 @@ class DataParallelTrainer:
         self._fwd = functionalize_forward(
             run, self._params_by_name, self._train_names, self._aux_names,
             train=True)
+
+        # dist: ONE flat key holds every gradient plus the loss scalar, so
+        # each step is a single cross-worker collective instead of one per
+        # parameter (no server-side updater: each sync round replaces the
+        # value with the sum of that round's pushes, which is exactly
+        # gradient aggregation)
+        if self._kv is not None:
+            sizes = []
+            for name in self._train_names:
+                p = self._params_by_name[name]
+                n = 1
+                for d in p.shape:
+                    n *= int(d)
+                sizes.append(n)
+            self._flat_sizes = sizes
+            self._flat_key = self._kv_prefix + "flat"
+            total = sum(sizes) + 1  # +1: the loss scalar rides along
+            self._kv.init(self._flat_key, NDArray(jnp.zeros((total,),
+                                                            jnp.float32)))
+            self._flat_out = NDArray(jnp.zeros((total,), jnp.float32))
         self._ready = True
 
     # -- the compiled step -------------------------------------------------
-    def _build_step(self):
-        fwd, opt = self._fwd, self._opt
-        groups = self._groups
+    def _apply_groups(self, train_vals, states, grads, lr, t):
+        """Optimizer update for every group — traced inside the step jit
+        (single-process) or the update jit (dist split-step)."""
+        opt, groups = self._opt, self._groups
         name_to_idx = {n: i for i, n in enumerate(self._train_names)}
+        new_vals = [None] * len(train_vals)
+        new_states = []
+        for gi, names in enumerate(groups):
+            idxs = [name_to_idx[n] for n in names]
+            if len(idxs) == 1:
+                i = idxs[0]
+                nw, ns = functional_optimizer_update(
+                    opt, gi, train_vals[i], grads[i], states[gi], lr, t)
+                new_vals[i] = nw
+            else:
+                # fused bucket: one flat elementwise update for the
+                # whole group instead of len(group) small fusions
+                wf = jnp.concatenate(
+                    [train_vals[i].ravel() for i in idxs])
+                gf = jnp.concatenate([grads[i].ravel() for i in idxs])
+                nwf, ns = functional_optimizer_update(
+                    opt, gi, wf, gf, states[gi], lr, t)
+                off = 0
+                for i in idxs:
+                    sz = train_vals[i].size
+                    new_vals[i] = nwf[off:off + sz].reshape(
+                        train_vals[i].shape)
+                    off += sz
+            new_states.append(ns)
+        return tuple(new_vals), tuple(new_states)
+
+    def _build_step(self):
+        fwd = self._fwd
 
         def pure_step(train_vals, states, aux_vals, x, y, key, lr, t):
             def loss_of(tv):
@@ -166,33 +284,54 @@ class DataParallelTrainer:
 
             (loss_val, muts), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_vals)
-            new_vals = [None] * len(train_vals)
-            new_states = []
-            for gi, names in enumerate(groups):
-                idxs = [name_to_idx[n] for n in names]
-                if len(idxs) == 1:
-                    i = idxs[0]
-                    nw, ns = functional_optimizer_update(
-                        opt, gi, train_vals[i], grads[i], states[gi], lr, t)
-                    new_vals[i] = nw
-                else:
-                    # fused bucket: one flat elementwise update for the
-                    # whole group instead of len(group) small fusions
-                    wf = jnp.concatenate(
-                        [train_vals[i].ravel() for i in idxs])
-                    gf = jnp.concatenate([grads[i].ravel() for i in idxs])
-                    nwf, ns = functional_optimizer_update(
-                        opt, gi, wf, gf, states[gi], lr, t)
-                    off = 0
-                    for i in idxs:
-                        sz = train_vals[i].size
-                        new_vals[i] = nwf[off:off + sz].reshape(
-                            train_vals[i].shape)
-                        off += sz
-                new_states.append(ns)
-            return loss_val, tuple(new_vals), tuple(new_states), muts
+            new_vals, new_states = self._apply_groups(
+                train_vals, states, grads, lr, t)
+            return loss_val, new_vals, new_states, muts
 
         return jax.jit(pure_step, donate_argnums=(0, 1))
+
+    def _build_grad_step(self):
+        """Dist split-step, part 1: loss + local gradients (no update) —
+        the grads cross the process boundary through the kvstore between
+        the two jits (reference: executor backward -> kv.push,
+        python/mxnet/module/executor_group.py:583)."""
+        fwd = self._fwd
+
+        def pure_grads(train_vals, aux_vals, x, y, key):
+            def loss_of(tv):
+                outs, muts = fwd(tv, aux_vals, (x, y), key)
+                return outs[0], muts
+
+            (loss_val, muts), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            # flatten inside the jit: the host sees one fused f32 vector
+            # (grads + the loss scalar riding along) ready to push
+            flat = jnp.concatenate(
+                [g.ravel().astype(jnp.float32) for g in grads]
+                + [loss_val.reshape(1).astype(jnp.float32)])
+            return flat, muts
+
+        return jax.jit(pure_grads)
+
+    def _build_update_step(self):
+        """Dist split-step, part 2: scale the pulled grad-sum, split it
+        back per-param, apply the optimizer — all in one jit (reference:
+        kv.pull -> updater, python/mxnet/model.py:157)."""
+        sizes = self._flat_sizes
+        scale = 1.0 / self._kv.num_workers
+
+        def pure_update(train_vals, states, flat_sum, lr, t):
+            mean = flat_sum * scale
+            grads, off = [], 0
+            for tv, n in zip(train_vals, sizes):
+                grads.append(mean[off:off + n].reshape(tv.shape)
+                             .astype(tv.dtype))
+                off += n
+            new_vals, new_states = self._apply_groups(
+                train_vals, states, tuple(grads), lr, t)
+            return mean[-1], new_vals, new_states
+
+        return jax.jit(pure_update, donate_argnums=(0, 1))
 
     # -- public API --------------------------------------------------------
     @property
@@ -211,11 +350,6 @@ class DataParallelTrainer:
         x = jax.device_put(x, batch_sh)
         y = jax.device_put(y, batch_sh)
 
-        # jax.jit itself retraces and caches per input shape/dtype
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
-        jitted = self._step_fn
-
         self._step_count += 1
         self._opt.num_update = self._step_count
         lr_host = (self._opt.lr_scheduler(self._step_count)
@@ -226,9 +360,16 @@ class DataParallelTrainer:
                          for n in self._aux_names)
         rng = _rng.next_key()
 
-        loss_val, new_vals, new_states, muts = jitted(
-            train_vals, tuple(self._states_raw), aux_vals, x, y, rng,
-            jnp.float32(lr_host), jnp.int32(self._step_count))
+        if self._kv is not None:
+            loss_val, new_vals, new_states, muts = self._dist_step(
+                train_vals, aux_vals, x, y, rng, lr_host)
+        else:
+            # jax.jit itself retraces and caches per input shape/dtype
+            if self._step_fn is None:
+                self._step_fn = self._build_step()
+            loss_val, new_vals, new_states, muts = self._step_fn(
+                train_vals, tuple(self._states_raw), aux_vals, x, y, rng,
+                jnp.float32(lr_host), jnp.int32(self._step_count))
 
         for name, val in zip(self._train_names, new_vals):
             self._params_by_name[name]._data._set_data(val)
@@ -236,6 +377,28 @@ class DataParallelTrainer:
         for name, val in zip(self._fwd.mut_names or (), muts):
             self._params_by_name[name]._data._set_data(val)
         return NDArray(loss_val)
+
+    def _dist_step(self, train_vals, aux_vals, x, y, rng, lr_host):
+        """Split step for multi-process data parallelism: local grads ->
+        kvstore push/pull (summed across workers by the PS sync round) ->
+        average -> donated optimizer update.  Averaging the per-worker
+        mean-loss gradients reproduces the single-process full-batch
+        gradient exactly (equal shards), so N workers with batch B/N match
+        one process with batch B to float tolerance — the property
+        tests/test_dist.py asserts (reference: tests/nightly/dist_lenet.py)."""
+        if self._grad_fn is None:
+            self._grad_fn = self._build_grad_step()
+            self._update_fn = self._build_update_step()
+        flat, muts = self._grad_fn(train_vals, aux_vals, x, y, rng)
+        self._kv.push(self._flat_key, NDArray(flat))
+        self._kv.pull(self._flat_key, out=self._flat_out)
+        # global-batch mean loss comes back out of the update jit, so
+        # every rank's callbacks see the number the single-process run
+        # would (a local loss would diverge across ranks)
+        loss_val, new_vals, new_states = self._update_fn(
+            train_vals, tuple(self._states_raw), self._flat_out._data,
+            jnp.float32(lr_host), jnp.int32(self._step_count))
+        return loss_val, new_vals, new_states, muts
 
     def set_learning_rate(self, lr):
         self._opt.set_learning_rate(lr)

@@ -360,3 +360,104 @@ def test_small_pull_single_round_trip_no_snapshot(monkeypatch):
         client.close()
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Round 4: 2-process DataParallelTrainer training run (VERDICT r3 #7;
+# reference: tests/nightly/dist_lenet.py — a real model trained dist_sync)
+# ---------------------------------------------------------------------------
+def _trainer_data():
+    import numpy as np
+    rng = np.random.RandomState(42)
+    X = rng.randn(64, 16).astype(np.float32)
+    w_true = rng.randn(16, 4).astype(np.float32)
+    y = (X @ w_true).argmax(1).astype(np.int64)
+    return X, y
+
+
+def _trainer_net_and_trainer(kv=None):
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+
+    mx.random.seed(7)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    # local_devices: under a 2-process cluster jax.devices() is global and
+    # [0] would be rank 0's device on BOTH workers (cross-host device_put)
+    mesh = make_mesh((1,), ("data",), jax.local_devices()[:1])
+    tr = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh, kvstore=kv)
+    return net, tr
+
+
+_TRAINER_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, %r)
+    sys.path.insert(0, %r)
+    outdir = %r
+    import numpy as np
+    import mxnet_tpu as mx
+    from test_dist import _trainer_data, _trainer_net_and_trainer
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    X, y = _trainer_data()
+    net, tr = _trainer_net_and_trainer(kv)
+
+    B = 32
+    losses = []
+    for step in range(30):
+        b0 = (step * B) %% len(X)
+        lo = b0 + rank * (B // nw)
+        hi = lo + B // nw
+        losses.append(float(tr.step(mx.nd.array(X[lo:hi]),
+                                    mx.nd.array(y[lo:hi])).asscalar()))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+    np.savez(os.path.join(outdir, "trainer_params_%%d.npz" %% rank),
+             **{n: p.data().asnumpy()
+                for n, p in net.collect_params().items()})
+    kv.barrier()
+    print("TRAINER WORKER %%d OK" %% rank)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
+                    reason="dist test disabled")
+def test_dist_trainer_convergence_matches_single_process(tmp_path):
+    """2 processes x half batch under dist_sync converge AND land on
+    exactly the params a single process sees on the full batch: pulled
+    grad-sum / num_workers == full-batch gradient, so the whole training
+    trajectory matches to float tolerance (reference:
+    tests/nightly/dist_lenet.py asserts the same single-vs-dist parity)."""
+    import numpy as np
+
+    script = _TRAINER_WORKER % (_ROOT, os.path.dirname(__file__),
+                                str(tmp_path))
+    proc, out = _launch(tmp_path, script, "trainer", timeout=420)
+    assert proc.returncode == 0, out[-3000:]
+    assert "TRAINER WORKER 0 OK" in out and "TRAINER WORKER 1 OK" in out, \
+        out[-3000:]
+
+    # single-process reference trajectory: full batch, no kvstore
+    X, y = _trainer_data()
+    import mxnet_tpu as mx
+    net, tr = _trainer_net_and_trainer()
+    B = 32
+    for step in range(30):
+        b0 = (step * B) % len(X)
+        tr.step(mx.nd.array(X[b0:b0 + B]), mx.nd.array(y[b0:b0 + B]))
+    ref = {n: p.data().asnumpy() for n, p in net.collect_params().items()}
+
+    for rank in (0, 1):
+        got = np.load(tmp_path / ("trainer_params_%d.npz" % rank))
+        assert set(got.files) == set(ref)
+        for n in ref:
+            np.testing.assert_allclose(got[n], ref[n], rtol=2e-4, atol=2e-5,
+                                       err_msg="rank %d param %s" % (rank, n))

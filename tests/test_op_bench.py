@@ -1,0 +1,45 @@
+"""tools/op_bench.py harness smoke tests.
+
+Reference: benchmark/python/sparse/sparse_op.py (per-op timing with
+measure_cost) — here the harness itself is unit-tested so the A/B lever
+tables in docs/perf_resnet50_tpu.md stay reproducible artifacts.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_op_bench_records_and_summary(tmp_path):
+    out = tmp_path / "ops.jsonl"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_bench.py"),
+         "--ops", "relu", "sum", "--iters", "3", "--grad",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(l) for l in r.stdout.splitlines() if l.strip()]
+    recs = [l for l in lines if "op" in l]
+    summary = [l for l in lines if l.get("summary")]
+    assert {x["op"] for x in recs} == {"relu", "sum"}
+    for x in recs:
+        assert x["fwd_us"] > 0 and x["bwd_us"] > 0 and x["compile_s"] > 0
+    assert summary and summary[0]["timed"] == 2
+    assert summary[0]["errors"] == 0
+    # the JSONL sink mirrors stdout records
+    sunk = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(sunk) == len(recs) + 1
+
+
+def test_op_bench_scale_inflates_batch(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "op_bench.py"),
+         "--ops", "relu", "--iters", "2", "--scale", "4"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.splitlines()[0])
+    assert rec["shapes"][0][0] == 12  # base case is (3, 4)

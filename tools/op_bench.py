@@ -1,0 +1,199 @@
+"""Per-operator microbenchmark harness.
+
+Reference: benchmark/python/sparse/sparse_op.py, benchmark/python/
+control_flow/, benchmark/python/quantization/benchmark_op.py — the
+reference can regression-time individual operators; this harness does the
+same for every registered op, reusing the declarative sweep case table
+(tests/test_op_sweep.py CASES) so benchmark coverage tracks test coverage
+for free.
+
+Usage:
+    python tools/op_bench.py                       # every op, first case
+    python tools/op_bench.py --ops Convolution dot # named ops, all cases
+    python tools/op_bench.py --all-cases --grad    # every case + backward
+    python tools/op_bench.py --scale 8             # inflate case shapes 8x
+                                                   # (batch axis) for
+                                                   # device-resident timing
+
+One JSON line per (op, case) is printed the moment it is measured —
+partial runs always leave a valid record (same posture as bench.py).  A
+final summary line aggregates total ops timed and the slowest entries.
+
+Timing method: jit-compile the op once (compile time reported
+separately), then wall-time `iters` dispatches fenced by a single
+block_until_ready on the last output — the steady-state async-dispatch
+rate, which is what regression tracking needs.  Eager (per-call
+dispatch+fence) timing is available with --eager for overhead studies.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "tests"))
+
+
+def _leaves(out):
+    import jax
+    return [x for x in jax.tree_util.tree_leaves(out)
+            if hasattr(x, "block_until_ready")]
+
+
+def _scale_case(case, factor):
+    """Inflate the leading (batch) axis of every generated input by
+    `factor` — sweep cases use tiny correctness shapes; benchmarks want
+    shapes big enough that device time dominates dispatch."""
+    base_inputs = case.inputs
+
+    def gen(rng):
+        outs = []
+        for x in base_inputs(rng):
+            if x.ndim == 0:
+                outs.append(x)
+            else:
+                reps = (factor,) + (1,) * (x.ndim - 1)
+                outs.append(np.tile(x, reps))
+        return outs
+    return case._replace(inputs=gen)
+
+
+def bench_case(name, case, iters=50, grad=False, eager=False):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import registry
+
+    op = registry.get(name)
+    rng = np.random.RandomState(0)
+    np_inputs = case.inputs(rng)
+    inputs = [jnp.asarray(x) for x in np_inputs]
+    params = dict(case.params)
+    if op.needs_train:
+        params["_train"] = True
+
+    rec = {
+        "op": name,
+        "shapes": [list(x.shape) for x in np_inputs],
+        "dtypes": [str(x.dtype) for x in np_inputs],
+        "bytes_in": int(sum(x.nbytes for x in np_inputs)),
+        "iters": iters,
+    }
+
+    fn = jax.jit(functools.partial(op.fn, **params))
+    t0 = time.perf_counter()
+    out = fn(*inputs)
+    for x in _leaves(out):
+        x.block_until_ready()
+    rec["compile_s"] = round(time.perf_counter() - t0, 4)
+
+    if eager:
+        ef = functools.partial(op.fn, **params)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = ef(*inputs)
+            for x in _leaves(out):
+                x.block_until_ready()
+        rec["eager_us"] = round((time.perf_counter() - t0) / iters * 1e6, 2)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*inputs)
+    for x in _leaves(out):
+        x.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    rec["fwd_us"] = round(dt * 1e6, 2)
+    if rec["bytes_in"] and dt > 0:
+        rec["fwd_gbps_in"] = round(rec["bytes_in"] / dt / 1e9, 3)
+
+    if grad:
+        float_idx = tuple(i for i, x in enumerate(np_inputs)
+                          if np.issubdtype(x.dtype, np.floating))
+        if float_idx:
+            def scalar_fn(*xs):
+                o = op.fn(*xs, **params)
+                o = o[0] if isinstance(o, tuple) else o
+                return jnp.sum(o.astype(jnp.float32))
+            gfn = jax.jit(jax.grad(scalar_fn, argnums=float_idx))
+            try:
+                g = gfn(*inputs)
+                for x in _leaves(g):
+                    x.block_until_ready()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    g = gfn(*inputs)
+                for x in _leaves(g):
+                    x.block_until_ready()
+                rec["bwd_us"] = round(
+                    (time.perf_counter() - t0) / iters * 1e6, 2)
+            except Exception as e:
+                rec["bwd_error"] = str(e)[:120]
+    return rec
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--ops", nargs="*", default=None,
+                   help="op names to time (default: every op in CASES)")
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--all-cases", action="store_true",
+                   help="time every sweep case, not just the first")
+    p.add_argument("--grad", action="store_true", help="also time backward")
+    p.add_argument("--eager", action="store_true",
+                   help="also time eager (per-call fenced) dispatch")
+    p.add_argument("--scale", type=int, default=1,
+                   help="inflate case batch axes by this factor")
+    p.add_argument("--out", default=None,
+                   help="also append JSONL records to this file")
+    args = p.parse_args(argv)
+
+    import test_op_sweep  # tests/ is on sys.path; merges deep cases
+
+    names = args.ops or sorted(test_op_sweep.CASES)
+    sink = open(args.out, "a") if args.out else None
+    n_ok = n_err = 0
+    slowest = []
+    for name in names:
+        cases = test_op_sweep.CASES.get(name)
+        if not cases:
+            print(json.dumps({"op": name, "error": "no sweep case"}),
+                  flush=True)
+            n_err += 1
+            continue
+        for i, case in enumerate(cases if args.all_cases else cases[:1]):
+            if args.scale > 1:
+                case = _scale_case(case, args.scale)
+            try:
+                rec = bench_case(name, case, iters=args.iters,
+                                 grad=args.grad, eager=args.eager)
+                rec["case"] = i
+                n_ok += 1
+                slowest.append((rec["fwd_us"], "%s-%d" % (name, i)))
+            except Exception as e:
+                rec = {"op": name, "case": i, "error": str(e)[:200]}
+                n_err += 1
+            line = json.dumps(rec)
+            print(line, flush=True)
+            if sink:
+                sink.write(line + "\n")
+                sink.flush()
+    slowest.sort(reverse=True)
+    summary = {"summary": True, "timed": n_ok, "errors": n_err,
+               "slowest": [{"case": c, "fwd_us": us}
+                           for us, c in slowest[:10]]}
+    print(json.dumps(summary), flush=True)
+    if sink:
+        sink.write(json.dumps(summary) + "\n")
+        sink.close()
+
+
+if __name__ == "__main__":
+    main()
