@@ -1,0 +1,86 @@
+"""Sequence sorting with a bidirectional LSTM.
+
+Reference: ``example/bi-lstm-sort/`` (lstm_sort.py, sort_io.py) — train a
+BiLSTM to emit the sorted version of its input token sequence, the
+classic "program induction" smoke test for bidirectional recurrence
+(every output position depends on the WHOLE input, so a unidirectional
+model cannot solve it).
+
+TPU notes: the LSTM runs as a ``lax.scan`` in both directions; one
+jitted program per (batch, seq) shape — no bucketing needed at fixed
+length.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+VOCAB = 12
+SEQ = 8
+
+
+def make_data(rng, n):
+    X = rng.randint(0, VOCAB, (n, SEQ)).astype(np.float32)
+    y = np.sort(X, axis=1)
+    return X, y
+
+
+class SortNet(gluon.Block):
+    def __init__(self, embed=32, hidden=80, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embedding = gluon.nn.Embedding(VOCAB, embed)
+            self.lstm = gluon.rnn.LSTM(hidden, bidirectional=True,
+                                       layout="NTC")
+            self.out = gluon.nn.Dense(VOCAB, flatten=False)
+
+    def forward(self, x):
+        return self.out(self.lstm(self.embedding(x)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    Xtr, ytr = make_data(rng, 1024)
+    Xte, yte = make_data(np.random.RandomState(1), 256)
+
+    net = SortNet()
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 4e-3})
+
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for s in range(0, len(Xtr), args.batch):
+            xb = nd.array(Xtr[s:s + args.batch])
+            yb = nd.array(ytr[s:s + args.batch])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+        if epoch % 10 == 0:
+            print("epoch", epoch, "loss", tot / (len(Xtr) // args.batch))
+
+    pred = net(nd.array(Xte)).asnumpy().argmax(-1)
+    acc = float((pred == yte).mean())
+    print("sorted-token accuracy", acc)
+    assert acc > 0.85, acc
+    # a unidirectional readout cannot know future tokens; sanity: the
+    # FIRST output position (needs the global min) is already right
+    first = float((pred[:, 0] == yte[:, 0]).mean())
+    assert first > 0.85, first
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""CapsNet: capsule layers with dynamic routing + margin loss.
+
+Reference: ``example/capsnet/capsulelayers.py`` + ``capsulenet.py``
+(Sabour et al. 2017) — primary capsules from a conv stem, digit capsules
+via routing-by-agreement, class = capsule length, margin loss.
+
+TPU notes: the routing loop has a STATIC iteration count, so it unrolls
+into the jitted program (no host round trips); the capsule transform and
+agreement are broadcast-multiply-reduce chains XLA fuses into batched
+matmuls on the MXU.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+NCLASS = 4
+SIZE = 16
+PD, OD = 4, 8          # primary / digit capsule dims
+NCAPS = 2 * 2 * 8      # 16x16 -> conv5/s2 -> 6 -> conv3/s2 -> 2; 8 caps/pos
+
+
+def make_data(rng, n):
+    from mxnet_tpu.test_utils import separable_images
+    X, y = separable_images(rng, n, nclass=NCLASS, size=SIZE, channels=1,
+                            noise=0.25, base=0.8)
+    return X, y
+
+
+def squash(s, axis=-1):
+    n2 = nd.sum(s * s, axis=axis, keepdims=True)
+    return s * (n2 / (1.0 + n2)) / nd.sqrt(n2 + 1e-9)
+
+
+class CapsNet(gluon.Block):
+    """conv stem -> primary capsules -> dynamic routing -> NCLASS digit
+    capsules; prediction = capsule length."""
+
+    def __init__(self, routings=3, **kw):
+        super().__init__(**kw)
+        self._routings = routings
+        with self.name_scope():
+            self.conv = gluon.nn.Conv2D(32, 5, strides=2,
+                                        activation="relu", layout="NHWC")
+            self.pcaps = gluon.nn.Conv2D(8 * PD, 3, strides=2,
+                                         layout="NHWC")
+            self.W = self.params.get("W", shape=(NCAPS, NCLASS, OD, PD),
+                                     init=mx.init.Xavier())
+
+    def forward(self, x):
+        h = self.pcaps(self.conv(x))
+        b = h.shape[0]
+        u = squash(h.reshape(b, NCAPS, PD))
+        # u_hat[b,i,j,o] = sum_p W[i,j,o,p] * u[b,i,p]
+        u_hat = nd.sum(self.W.data().expand_dims(0)
+                       * u.reshape(b, NCAPS, 1, 1, PD), axis=-1)
+        bij = nd.zeros((b, NCAPS, NCLASS))
+        for r in range(self._routings):  # static unroll
+            c_ij = nd.softmax(bij, axis=2)
+            s = nd.sum(c_ij.reshape(b, NCAPS, NCLASS, 1) * u_hat, axis=1)
+            v = squash(s)                           # (b, NCLASS, OD)
+            if r + 1 < self._routings:
+                # agreement: <u_hat[b,i,j,:], v[b,j,:]>
+                bij = bij + nd.sum(u_hat * v.reshape(b, 1, NCLASS, OD),
+                                   axis=-1)
+        return nd.sqrt(nd.sum(v * v, axis=-1) + 1e-9)  # caps lengths
+
+
+def margin_loss(lengths, y, m_pos=0.9, m_neg=0.1, lam=0.5):
+    onehot = nd.one_hot(y, NCLASS)
+    pos = onehot * nd.relu(m_pos - lengths) ** 2
+    neg = (1 - onehot) * nd.relu(lengths - m_neg) ** 2
+    return nd.sum(pos + lam * neg, axis=1).mean()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    Xtr, ytr = make_data(rng, 512)
+    Xte, yte = make_data(np.random.RandomState(1), 256)
+
+    net = CapsNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for s in range(0, len(Xtr), args.batch):
+            xb = nd.array(Xtr[s:s + args.batch])
+            yb = nd.array(ytr[s:s + args.batch])
+            with autograd.record():
+                loss = margin_loss(net(xb), yb)
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+        if epoch % 4 == 0:
+            print("epoch", epoch, "margin loss", tot)
+
+    pred = net(nd.array(Xte)).asnumpy().argmax(1)
+    acc = float((pred == yte).mean())
+    print("capsule accuracy", acc)
+    assert acc > 0.9, acc
+
+
+if __name__ == "__main__":
+    main()

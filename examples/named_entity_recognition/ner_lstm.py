@@ -1,0 +1,112 @@
+"""Named-entity tagging with a bidirectional LSTM.
+
+Reference: ``example/named_entity_recognition/src/`` — token sequences
+to per-token BIO tags with a recurrent tagger; entity spans must be
+consistent (B opens, I continues), so the tagger needs left AND right
+context.
+
+Synthetic task: an entity span is a trigger token followed by 1-3
+payload tokens drawn from an entity sub-vocabulary; payload tokens also
+appear OUTSIDE spans (where they must be tagged O), so per-token lookup
+cannot solve it — context can.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+VOCAB = 30
+SEQ = 16
+TRIGGER = 1          # token that opens an entity
+ENT_LO, ENT_HI = 2, 8   # payload sub-vocabulary
+O, B, I = 0, 1, 2    # BIO tags
+
+
+def make_data(rng, n):
+    X = rng.randint(ENT_LO, VOCAB, (n, SEQ))
+    y = np.zeros((n, SEQ), np.int64)
+    for i in range(n):
+        pos = rng.randint(0, SEQ - 4)
+        ln = rng.randint(1, 4)
+        X[i, pos] = TRIGGER
+        X[i, pos + 1:pos + 1 + ln] = rng.randint(ENT_LO, ENT_HI, ln)
+        y[i, pos] = B
+        y[i, pos + 1:pos + 1 + ln] = I
+        # a decoy payload token outside any span (must be O)
+        decoy = (pos + 1 + ln + 2) % SEQ
+        if decoy < pos or decoy > pos + ln:
+            X[i, decoy] = rng.randint(ENT_LO, ENT_HI)
+    return X.astype(np.float32), y
+
+
+class Tagger(gluon.Block):
+    def __init__(self, embed=24, hidden=32, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embedding = gluon.nn.Embedding(VOCAB, embed)
+            self.lstm = gluon.rnn.LSTM(hidden, bidirectional=True,
+                                       layout="NTC")
+            self.out = gluon.nn.Dense(3, flatten=False)
+
+    def forward(self, x):
+        return self.out(self.lstm(self.embedding(x)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    Xtr, ytr = make_data(rng, 1024)
+    Xte, yte = make_data(np.random.RandomState(1), 256)
+
+    net = Tagger()
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for s in range(0, len(Xtr), args.batch):
+            xb = nd.array(Xtr[s:s + args.batch])
+            yb = nd.array(ytr[s:s + args.batch].astype(np.float32))
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+        if epoch % 5 == 0:
+            print("epoch", epoch, "loss", tot)
+
+    pred = net(nd.array(Xte)).asnumpy().argmax(-1)
+    acc = float((pred == yte).mean())
+    # entity-level: every gold span fully matched
+    spans_ok = spans_all = 0
+    for i in range(len(yte)):
+        j = 0
+        while j < SEQ:
+            if yte[i, j] == B:
+                k = j + 1
+                while k < SEQ and yte[i, k] == I:
+                    k += 1
+                spans_all += 1
+                spans_ok += int((pred[i, j:k] == yte[i, j:k]).all())
+                j = k
+            else:
+                j += 1
+    span_acc = spans_ok / max(1, spans_all)
+    print("token acc", acc, "span acc", span_acc)
+    assert acc > 0.9, acc
+    assert span_acc > 0.7, span_acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Stochastic depth: residual blocks whose compute branch randomly
+drops during training.
+
+Reference: ``example/stochastic-depth/sd_module.py`` + sd_mnist.py
+(Huang et al. 2016) — train-time Bernoulli gate on each residual
+branch (identity survives), inference scales the branch by its
+survival probability.
+
+TPU notes: the reference gates by swapping executors per batch; here
+the gate is a traced 0/1 draw inside the jitted step — one program,
+no retrace, the branch's FLOPs are spent but its *gradient signal*
+matches stochastic depth exactly (the XLA-friendly formulation).
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+NCLASS = 4
+SIZE = 16
+
+
+def make_data(rng, n):
+    from mxnet_tpu.test_utils import separable_images
+    return separable_images(rng, n, nclass=NCLASS, size=SIZE, channels=3,
+                            noise=0.3, base=0.9)
+
+
+class SDBlock(gluon.Block):
+    """Residual block with a train-time Bernoulli gate on the compute
+    branch: out = x + gate/survival * branch(x) (inverted scaling, so
+    inference needs no rescale — the Dropout convention)."""
+
+    def __init__(self, channels, survival, **kw):
+        super().__init__(**kw)
+        self.survival = float(survival)
+        with self.name_scope():
+            self.conv1 = gluon.nn.Conv2D(channels, 3, padding=1,
+                                         activation="relu", layout="NHWC")
+            self.conv2 = gluon.nn.Conv2D(channels, 3, padding=1,
+                                         layout="NHWC")
+
+    def forward(self, x):
+        branch = self.conv2(self.conv1(x))
+        if autograd.is_training():
+            gate = (nd.random.uniform(shape=(1,)) < self.survival)
+            branch = branch * (gate.astype("float32") / self.survival)
+        return nd.relu(x + branch)
+
+
+class SDNet(gluon.Block):
+    def __init__(self, n_blocks=4, channels=24, death_rate=0.3, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.stem = gluon.nn.Conv2D(channels, 3, padding=1,
+                                        activation="relu", layout="NHWC")
+            self.blocks = []
+            for i in range(n_blocks):
+                # linear decay rule: deeper blocks die more often
+                survival = 1.0 - death_rate * (i + 1) / n_blocks
+                blk = SDBlock(channels, survival)
+                self.register_child(blk)
+                self.blocks.append(blk)
+            self.pool = gluon.nn.GlobalAvgPool2D(layout="NHWC")
+            self.out = gluon.nn.Dense(NCLASS)
+
+    def forward(self, x):
+        h = self.stem(x)
+        for blk in self.blocks:
+            h = blk(h)
+        return self.out(self.pool(h))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    mx.random.seed(0)
+    Xtr, ytr = make_data(rng, 512)
+    Xte, yte = make_data(np.random.RandomState(1), 256)
+
+    net = SDNet()
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+
+    for epoch in range(args.epochs):
+        tot = 0.0
+        for s in range(0, len(Xtr), args.batch):
+            xb = nd.array(Xtr[s:s + args.batch])
+            yb = nd.array(ytr[s:s + args.batch])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asscalar())
+        if epoch % 4 == 0:
+            print("epoch", epoch, "loss", tot)
+
+    # inference is deterministic (no gate outside record)
+    p1 = net(nd.array(Xte)).asnumpy()
+    p2 = net(nd.array(Xte)).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+    acc = float((p1.argmax(1) == yte).mean())
+    print("stochastic-depth accuracy", acc)
+    assert acc > 0.9, acc
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,16 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     pad = _tup(pad, nsp) if pad else (0,) * nsp
     dimnum, channels_last = _conv_layout(layout, nsp)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, dimnum)
+    # mixed float dtypes reconcile to the DATA's dtype (reference fp16
+    # path: fp32 master weights cast at the kernel boundary) — lets a
+    # bf16 activation rail run against fp32 checkpoint params
+    if weight.dtype != data.dtype and jnp.issubdtype(data.dtype, jnp.floating) \
+            and jnp.issubdtype(weight.dtype, jnp.floating):
+        weight = weight.astype(data.dtype)
+    if bias is not None and bias.dtype != data.dtype and \
+            jnp.issubdtype(data.dtype, jnp.floating) and \
+            jnp.issubdtype(bias.dtype, jnp.floating):
+        bias = bias.astype(data.dtype)
     # no preferred_element_type upcast for bf16: the MXU accumulates bf16
     # convs in fp32 natively, and jax 0.9's conv transpose rule rejects the
     # fp32-cotangent/bf16-operand mix it would create

@@ -581,3 +581,129 @@ def _qcr_range(out_scale, lo, hi):
         lo = -hi
     return (jnp.asarray([float(lo)], jnp.float32),
             jnp.asarray([float(hi)], jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# implicit-GEMM 3x3 conv with fused epilogue (reference equivalence:
+# src/operator/quantization/quantized_conv.cu — cuDNN's implicit-GEMM int8
+# conv — and src/operator/nn/convolution.cu for the float path).  The
+# kernel stages an im2col patch matrix in VMEM (K = 9*Cin feeds the MXU a
+# full-depth contraction instead of nine K=Cin dots), accumulates in
+# int32/f32, and runs the epilogue (requantize, or BN-scale+relu) before
+# the tile ever leaves VMEM — the accumulator never touches HBM.
+# ---------------------------------------------------------------------------
+def _conv3x3_kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref, xpatch, col,
+                    sem, *, nb, th, w_out, cin, relu, out_dtype, acc_dtype):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, h, co = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    # DMA the (nb, th+2, Wp, Cin) input patch once per (n, h); reuse it
+    # across the Cout grid axis (co is innermost, scratch persists).
+    # Wp/Cin are pre-padded by the wrapper to sublane (8) / lane (128)
+    # multiples — Mosaic rejects misaligned second-minor/minor dims here.
+    @pl.when(co == 0)
+    def _load():
+        dma = pltpu.make_async_copy(
+            x_ref.at[pl.ds(n * nb, nb), pl.ds(h * th, th + 2)],
+            xpatch, sem)
+        dma.start()
+        dma.wait()
+        # build the im2col matrix: rows = output positions of this tile,
+        # cols = the 3x3xCin receptive field
+        xp = xpatch[...]
+        for dy in range(3):
+            for dx in range(3):
+                tap = xp[:, dy:dy + th, dx:dx + w_out, :]
+                col[:, (dy * 3 + dx) * cin:(dy * 3 + dx + 1) * cin] = \
+                    tap.reshape(nb * th * w_out, cin)
+
+    acc = jax.lax.dot_general(
+        col[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=acc_dtype)
+    real = acc.astype(jnp.float32) * scale_ref[...] + shift_ref[...]
+    if relu:
+        real = jnp.maximum(real, 0.0)
+    if out_dtype == jnp.int8:
+        real = jnp.clip(jnp.round(real), -127, 127)
+    o_ref[...] = real.reshape(nb, th, w_out, -1).astype(out_dtype)
+
+
+def conv3x3_epilogue(x, w, scale, shift, relu=True, out_dtype=None,
+                     nb=None, th=None, tn=None, interpret=None):
+    """3x3 stride-1 same-pad NHWC conv with a fused affine epilogue:
+    ``out = cast(relu(conv(x, w) * scale + shift))``.
+
+    - int8 x / int8 w: MXU s8xs8->s32; ``scale`` folds the requantize
+      (s_x*s_w/s_out), ``shift`` the bias; out_dtype int8 (rounded).
+    - bf16 x / bf16 w: f32 accumulate; ``scale``/``shift`` fold inference
+      BatchNorm; out_dtype bf16.
+
+    x: (N, H, W, Cin); w: (3, 3, Cin, Cout); scale/shift: (Cout,).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = not _on_tpu()
+    N, H, W, Cin = x.shape
+    Cout = w.shape[-1]
+    is_int8 = x.dtype == jnp.int8
+    acc_dtype = jnp.int32 if is_int8 else jnp.float32
+    if out_dtype is None:
+        out_dtype = jnp.int8 if is_int8 else x.dtype
+
+    # tile choices: rows-per-tile scales down as W grows so the GEMM's M
+    # stays ~mxu-sized; images-per-tile then batches M up to ~1k rows
+    # (fewer, fatter grid steps — each step amortizes its DMA + epilogue)
+    if th is None:
+        th = max(1, min(H, 448 // W))
+    while H % th:
+        th -= 1
+    if nb is None:
+        nb = max(1, 1024 // (th * W))
+        while N % nb:
+            nb -= 1
+    if tn is None:
+        tn = min(max(Cout, 128), 256)
+    tn = -(-tn // 128) * 128  # full 128-lane multiple (Mosaic minor dim)
+
+    # Mosaic alignment: the scratch's second-minor dim (patch width) must
+    # be a sublane multiple and its minor dims (channels in / out) full
+    # 128-lane multiples — pad with zeros (padded channels contribute 0
+    # to the dot, padded columns are never addressed by any tap)
+    Wp = -(-(W + 2) // 8) * 8
+    Cp = -(-Cin // 128) * 128
+    Cop = -(-Cout // tn) * tn
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, Wp - W - 1), (0, Cp - Cin)))
+    wp = jnp.pad(w, ((0, 0), (0, 0), (0, Cp - Cin), (0, Cop - Cout)))
+    wcol = wp.reshape(9 * Cp, Cop)
+    scale = jnp.pad(jnp.asarray(scale, jnp.float32),
+                    (0, Cop - Cout)).reshape(1, Cop)
+    shift = jnp.pad(jnp.asarray(shift, jnp.float32),
+                    (0, Cop - Cout)).reshape(1, Cop)
+
+    kernel = functools.partial(
+        _conv3x3_kernel, nb=nb, th=th, w_out=W, cin=Cp, relu=bool(relu),
+        out_dtype=out_dtype, acc_dtype=acc_dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N // nb, H // th, Cop // tn),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # manual halo DMA
+            pl.BlockSpec((9 * Cp, tn), lambda n, h, co: (0, co)),
+            pl.BlockSpec((1, tn), lambda n, h, co: (0, co)),
+            pl.BlockSpec((1, tn), lambda n, h, co: (0, co)),
+        ],
+        out_specs=pl.BlockSpec((nb, th, W, tn),
+                               lambda n, h, co: (n, h, 0, co)),
+        out_shape=_sds((N, H, W, Cop), out_dtype, x),
+        scratch_shapes=[
+            pltpu.VMEM((nb, th + 2, Wp, Cp), x.dtype),
+            pltpu.VMEM((nb * th * W, 9 * Cp), x.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(xp, wcol, scale, shift)
+    return out if Cop == Cout else out[..., :Cout]

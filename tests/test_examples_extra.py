@@ -120,3 +120,32 @@ def test_text_cnn_example():
     (reference: example/cnn_text_classification/text_cnn.py)."""
     _run(os.path.join(_EXAMPLES, "cnn_text_classification",
                       "text_cnn.py"), ["--epochs", "12"])
+
+
+# -- round 4: bi-lstm-sort + capsnet + stochastic-depth + NER -------------
+def test_bi_lstm_sort_example():
+    """BiLSTM learns to emit its input sorted — every output position
+    needs global context (reference: example/bi-lstm-sort/)."""
+    _run(os.path.join(_EXAMPLES, "bi_lstm_sort", "sort_lstm.py"),
+         ["--epochs", "25"])
+
+
+def test_capsnet_example():
+    """Dynamic routing-by-agreement + margin loss (reference:
+    example/capsnet/capsulenet.py)."""
+    _run(os.path.join(_EXAMPLES, "capsnet", "capsnet.py"),
+         ["--epochs", "10"])
+
+
+def test_stochastic_depth_example():
+    """Bernoulli-gated residual branches, deterministic inference
+    (reference: example/stochastic-depth/sd_module.py)."""
+    _run(os.path.join(_EXAMPLES, "stochastic_depth", "sd_resnet.py"),
+         ["--epochs", "8"])
+
+
+def test_ner_example():
+    """BiLSTM BIO tagger with span-level scoring (reference:
+    example/named_entity_recognition/)."""
+    _run(os.path.join(_EXAMPLES, "named_entity_recognition",
+                      "ner_lstm.py"), ["--epochs", "15"])

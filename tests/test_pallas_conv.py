@@ -1,0 +1,74 @@
+"""conv3x3_epilogue (implicit-GEMM Pallas conv) vs the XLA conv oracle,
+interpret mode on CPU (reference equivalence:
+src/operator/quantization/quantized_conv.cu — implicit-GEMM int8 conv
+with fused requantize; the bf16 variant folds inference BN + relu)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mxnet_tpu.ops.pallas_kernels import conv3x3_epilogue
+
+
+def _oracle(x, w, scale, shift, relu, out_int8):
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    acc = lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)], dimension_numbers=dn,
+        preferred_element_type=jnp.int32 if out_int8 else jnp.float32)
+    real = np.asarray(acc).astype(np.float32) * scale + shift
+    if relu:
+        real = np.maximum(real, 0.0)
+    if out_int8:
+        return np.clip(np.round(real), -127, 127).astype(np.int8)
+    return real
+
+
+@pytest.mark.parametrize("shape", [(2, 8, 8, 16), (4, 6, 6, 16),
+                                   (1, 14, 14, 8)])
+@pytest.mark.parametrize("relu", [True, False])
+def test_int8_exact_vs_xla(shape, relu):
+    """int8 path is BIT-exact vs XLA's s8xs8->s32 conv + requantize."""
+    rng = np.random.RandomState(0)
+    N, H, W, C = shape
+    x = jnp.asarray(rng.randint(-127, 128, shape), jnp.int8)
+    w = jnp.asarray(rng.randint(-16, 16, (3, 3, C, 2 * C)), jnp.int8)
+    scale = (rng.rand(2 * C) * 0.01 + 1e-3).astype(np.float32)
+    shift = rng.randn(2 * C).astype(np.float32)
+    out = conv3x3_epilogue(x, w, scale, shift, relu=relu)
+    ref = _oracle(x, w, scale, shift, relu, out_int8=True)
+    assert out.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_bf16_close_vs_xla():
+    """bf16 path (fused BN-scale/shift + relu) within bf16 rounding."""
+    rng = np.random.RandomState(1)
+    x32 = rng.randn(2, 8, 8, 16).astype(np.float32)
+    w32 = (rng.randn(3, 3, 16, 32) * 0.1).astype(np.float32)
+    scale = (rng.rand(32) + 0.5).astype(np.float32)
+    shift = rng.randn(32).astype(np.float32)
+    x = jnp.asarray(x32, jnp.bfloat16)
+    w = jnp.asarray(w32, jnp.bfloat16)
+    out = conv3x3_epilogue(x, w, scale, shift, relu=True)
+    ref = _oracle(x.astype(jnp.float32), w.astype(jnp.float32),
+                  scale, shift, relu=True, out_int8=False)
+    assert out.dtype == jnp.bfloat16
+    got = np.asarray(out).astype(np.float32)
+    assert np.max(np.abs(got - ref)) < 0.05 * max(1.0, np.abs(ref).max())
+
+
+def test_padded_cout_slice():
+    """Cout below the 128-lane tile comes back exactly (zero-pad + slice
+    round trip: the tn-lane padding never leaks into the result)."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randint(-5, 5, (2, 6, 6, 8)), jnp.int8)
+    w = jnp.asarray(rng.randint(-4, 4, (3, 3, 8, 24)), jnp.int8)
+    scale = np.full(24, 0.02, np.float32)
+    shift = np.zeros(24, np.float32)
+    out = conv3x3_epilogue(x, w, scale, shift, relu=False)
+    assert out.shape == (2, 6, 6, 24)
+    ref = _oracle(x, w, scale, shift, relu=False, out_int8=True)
+    np.testing.assert_array_equal(np.asarray(out), ref)
