@@ -15,14 +15,20 @@ exiting.
 """
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 BASELINE_IMGS_PER_SEC = 298.51
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+_LKG_PATH = os.path.join(_REPO_DIR, "bench_lkg.json")
 
 
 class _Record:
@@ -34,6 +40,10 @@ class _Record:
         self.t0 = time.monotonic()
         self.budget = budget_s
         self.stage_s = {}
+        # carried-forward measurement keys not yet replaced by a live
+        # value this run; mirrored into result["stale_keys"]
+        self.stale_keys = set()
+        self.measured_round = None
         # prebuilt line for the signal handler: print() is not
         # signal-safe (a SIGTERM landing mid-emit would raise
         # "reentrant call inside BufferedWriter" and tear the tail line)
@@ -42,10 +52,38 @@ class _Record:
     def remaining(self):
         return self.budget - (time.monotonic() - self.t0)
 
+    def update_live(self, d):
+        """Merge live measurements, clearing their staleness markers."""
+        self.result.update(d)
+        if self.stale_keys:
+            self.stale_keys -= set(d)
+            if self.stale_keys:
+                self.result["stale_keys"] = sorted(self.stale_keys)
+            else:
+                self.result.pop("stale_keys", None)
+        if not self.stale_keys and "stale" not in self.result:
+            self.result.pop("stale_from_round", None)
+
     def emit(self):
         line = json.dumps(self.result)
         self.last_line = (line + "\n").encode()
         print(line, flush=True)
+        # persist as last-known-good whenever the primary metric is live;
+        # later stages keep refreshing the file so live inference numbers
+        # reach it too (stale carried keys stay marked via stale_keys).
+        # CPU runs never qualify — a validation run on the host must not
+        # displace a TPU-measured record.
+        if self.result.get("value") and not self.result.get("stale") \
+                and self.result.get("backend_platform") != "cpu":
+            try:
+                lkg = {k: v for k, v in self.result.items()
+                       if k != "stage_s"}
+                if self.measured_round is not None:
+                    lkg["measured_round"] = self.measured_round
+                with open(_LKG_PATH, "w") as f:
+                    json.dump(lkg, f)
+            except OSError:
+                pass
 
     def stage(self, name, est_s, fn):
         """Run one time-boxed sub-bench.  A stage that would not fit in the
@@ -58,7 +96,7 @@ class _Record:
             return
         t = time.monotonic()
         try:
-            self.result.update(fn() or {})
+            self.update_live(fn() or {})
         except Exception as e:  # never lose earlier numbers
             self.result[name + "_error"] = str(e)[:200]
         self.stage_s[name] = round(time.monotonic() - t, 1)
@@ -66,8 +104,168 @@ class _Record:
         self.emit()
 
 
-def main():
+def _bench_rounds_on_disk():
+    rounds = [0]
+    for p in glob.glob(os.path.join(_REPO_DIR, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r0*(\d+)", p)
+        if m:
+            rounds.append(int(m.group(1)))
+    return max(rounds)
+
+
+def _load_last_good():
+    """Last-known-good numbers: freshest of bench_lkg.json (written by the
+    most recent successful run, possibly this session) and the driver's
+    BENCH_r*.json records.  Records that are themselves pure carry-forwards
+    (primary metric stale) are skipped — only measured values qualify as
+    "known good".  Returns (round_or_None, parsed_dict) or None."""
+    best = None  # key = (round, prefer_lkg)
+    for p in glob.glob(os.path.join(_REPO_DIR, "BENCH_r*.json")):
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except Exception:
+            continue
+        parsed = d.get("parsed")
+        if parsed and parsed.get("value") and not parsed.get("stale") \
+                and parsed.get("backend_platform") != "cpu":
+            m = re.search(r"BENCH_r0*(\d+)", p)
+            rnd = int(m.group(1)) if m else -1
+            if best is None or (rnd, 0) > best[0]:
+                best = ((rnd, 0), parsed)
+    try:
+        with open(_LKG_PATH) as f:
+            d = json.load(f)
+        if d.get("value") and not d.get("stale") \
+                and d.get("backend_platform") != "cpu":
+            # within the same round a bench_lkg postdates the BENCH file
+            rnd = d.get("measured_round", -1)
+            if best is None or (rnd, 1) > best[0]:
+                best = ((rnd, 1), d)
+    except Exception:
+        pass
+    return (best[0][0], best[1]) if best else None
+
+
+_PROBE_SRC = (
+    # an explicit JAX_PLATFORMS must win over the site plugin's config
+    # override (the tunnel plugin force-registers the TPU backend via
+    # jax.config, which outranks the env var — tests/conftest.py note)
+    "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "d = jax.devices(); print(d[0].platform, len(d))"
+)
+
+
+def _acquire_devices(rec, max_wait):
+    """Backend acquisition that survives both failure modes seen in
+    BENCH_r03/r04: a hard UNAVAILABLE raise and an indefinite hang inside
+    the PJRT client init.  A subprocess probe (timeboxed, killable) is
+    retried with backoff until the chip answers; only then does the main
+    process initialise its own backend.  Returns a device list or None."""
     import jax
+
+    t0 = time.monotonic()
+    delay = 5.0
+    attempt = 0
+    probe_timeout = float(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT", "150"))
+    while True:
+        attempt += 1
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC], capture_output=True,
+                text=True, timeout=min(probe_timeout,
+                                       max(30.0, rec.remaining() - 30)))
+            if out.returncode == 0:
+                break
+            err = (out.stderr or "").strip()[-300:]
+        except subprocess.TimeoutExpired:
+            err = "probe timeout (backend init hang)"
+        except Exception as e:
+            err = str(e)[:300]
+        waited = time.monotonic() - t0
+        rec.result["backend_error"] = err
+        rec.result["backend_wait_s"] = round(waited, 1)
+        rec.result["backend_attempts"] = attempt
+        rec.emit()
+        if waited + delay > max_wait or rec.remaining() < 120:
+            return None
+        time.sleep(delay)
+        delay = min(delay * 1.7, 60.0)
+    # chip answered a fresh process; now init in-process (fast path)
+    try:
+        devices = jax.devices()
+    except Exception as e:
+        rec.result["backend_error"] = str(e)[:300]
+        rec.emit()
+        return None
+    rec.result.pop("backend_error", None)
+    rec.result["backend_attempts"] = attempt
+    rec.result["backend_wait_s"] = round(time.monotonic() - t0, 1)
+    rec.result["backend_platform"] = devices[0].platform
+    return devices
+
+
+def main():
+    rec = _Record(float(os.environ.get("MXTPU_BENCH_BUDGET_S", "780")))
+
+    def _bail(signum, frame):
+        # async-signal-safe re-emit: raw write of the last complete line
+        # (preceded by a newline in case a print was torn mid-line).
+        # Exit 0: the tail line is a valid record by construction.
+        if rec.last_line:
+            os.write(1, b"\n" + rec.last_line)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _bail)
+    signal.signal(signal.SIGINT, _bail)
+
+    # last-known-good carried forward FIRST, before any jax/backend work:
+    # whatever happens downstream, the driver's tail-line parse finds a
+    # complete record (r03 rc=124 and r04 rc=1 both produced parsed:null
+    # because nothing had been printed when the run died)
+    lkg = _load_last_good()
+    if lkg:
+        rnd, parsed = lkg
+        bookkeeping = {"measured_round", "stage_s", "backend_attempts",
+                       "backend_wait_s", "skipped_stages", "error"}
+        carried = {k: v for k, v in parsed.items()
+                   if not k.startswith("stale") and not k.endswith("_error")
+                   and k not in bookkeeping}
+        carried["stale"] = True
+        if rnd is not None and rnd >= 0:
+            carried["stale_from_round"] = rnd
+        # every carried measurement stays marked until a live value
+        # replaces it (the global "stale" flag covers only the primary
+        # metric once training lands)
+        rec.stale_keys = {k for k in carried
+                          if k not in ("stale", "stale_from_round",
+                                       "metric", "unit", "value",
+                                       "vs_baseline")}
+        if rec.stale_keys:
+            carried["stale_keys"] = sorted(rec.stale_keys)
+        rec.result.update(carried)
+        rec.emit()
+    # the round being measured: the driver writes BENCH_r{N} after this
+    # run, so N = newest on disk + 1 (tags LKG provenance)
+    rec.measured_round = _bench_rounds_on_disk() + 1
+
+    try:
+        _run_benches(rec)
+    except Exception as e:  # never lose the tail record to a crash
+        rec.result["fatal_error"] = str(e)[:300]
+        rec.emit()
+    sys.exit(0)
+
+
+def _run_benches(rec):
+    import jax
+
+    # honor an explicit JAX_PLATFORMS over the site plugin's config-level
+    # backend registration (same dance as the probe and tests/conftest.py)
+    _plat = os.environ.get("JAX_PLATFORMS")
+    if _plat:
+        jax.config.update("jax_platforms", _plat)
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
@@ -79,24 +277,10 @@ def main():
     # cache turns every re-run into minutes.  Repo-local so the driver's
     # run hits the cache this session warmed.
     cache_dir = os.environ.get(
-        "MXTPU_BENCH_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"))
+        "MXTPU_BENCH_CACHE_DIR", os.path.join(_REPO_DIR, ".jax_cache"))
     if cache_dir:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
-    rec = _Record(float(os.environ.get("MXTPU_BENCH_BUDGET_S", "780")))
-
-    def _bail(signum, frame):
-        # async-signal-safe re-emit: raw write of the last complete line
-        # (preceded by a newline in case a print was torn mid-line)
-        if rec.last_line:
-            os.write(1, b"\n" + rec.last_line)
-        os._exit(1)
-
-    signal.signal(signal.SIGTERM, _bail)
-    signal.signal(signal.SIGINT, _bail)
 
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
@@ -104,7 +288,15 @@ def main():
     batch = int(os.environ.get("MXTPU_BENCH_BATCH", "256"))
     # keep the per-chip metric honest: batch is per chip, and the device
     # count matches the mesh the trainer actually spans
-    devices = jax.devices()
+    devices = _acquire_devices(
+        rec, max_wait=float(os.environ.get("MXTPU_BENCH_BACKEND_WAIT_S",
+                                           "600")))
+    if devices is None:
+        # backend never came up: the carried-forward record (already on
+        # the wire) is the round's result; say so and stop cleanly
+        rec.result["error"] = "backend unavailable after retries"
+        rec.emit()
+        return
     n_dev = len(devices)
     mesh = make_mesh((n_dev,), ("data",), devices)
     global_batch = batch * n_dev
@@ -141,40 +333,54 @@ def main():
             {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4,
              "multi_precision": dtype != "float32"}, mesh=mesh)
 
-    # warmup (compile); halve the batch on OOM so the metric always prints
+    # warmup (compile); halve the batch on OOM so the metric always prints.
+    # Any other failure records the error and falls through to the infer
+    # stages — the carried-forward train number stays on the wire.
+    trainer = None
+    imgs_per_sec_per_chip = None
     t_warm = time.monotonic()
-    while True:
-        try:
-            trainer = build_trainer()
-            x, y = make_batch(global_batch)
-            for _ in range(3):
-                trainer.step(x, y).asscalar()
-            break
-        except Exception as e:  # RESOURCE_EXHAUSTED etc.
-            if "RESOURCE_EXHAUSTED" not in str(e) or batch <= 8:
-                raise
-            batch //= 2
-            global_batch = batch * n_dev
-    rec.stage_s["train_compile"] = round(time.monotonic() - t_warm, 1)
+    try:
+        while True:
+            try:
+                trainer = build_trainer()
+                x, y = make_batch(global_batch)
+                for _ in range(3):
+                    trainer.step(x, y).asscalar()
+                break
+            except Exception as e:  # RESOURCE_EXHAUSTED etc.
+                if "RESOURCE_EXHAUSTED" not in str(e) or batch <= 8:
+                    raise
+                batch //= 2
+                global_batch = batch * n_dev
+        rec.stage_s["train_compile"] = round(time.monotonic() - t_warm, 1)
 
-    iters = int(os.environ.get("MXTPU_BENCH_ITERS", "10"))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = trainer.step(x, y)
-    loss.asscalar()  # sync
-    dt = time.perf_counter() - t0
+        iters = int(os.environ.get("MXTPU_BENCH_ITERS", "10"))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = trainer.step(x, y)
+        loss.asscalar()  # sync
+        dt = time.perf_counter() - t0
+        imgs_per_sec_per_chip = global_batch * iters / dt / n_dev
+    except Exception as e:
+        rec.result["train_error"] = str(e)[:300]
+        rec.emit()
 
-    imgs_per_sec_per_chip = global_batch * iters / dt / n_dev
-
-    rec.result.update({
-        "metric": "resnet50_train_imgs_per_sec_per_chip",
-        "value": round(imgs_per_sec_per_chip, 2),
-        "unit": "img/s/chip",
-        "vs_baseline": round(imgs_per_sec_per_chip / BASELINE_IMGS_PER_SEC,
-                             3),
-        "stage_s": rec.stage_s,
-    })
-    rec.emit()  # the primary metric is now on the wire, whatever follows
+    if imgs_per_sec_per_chip is not None:
+        # a live primary metric replaces the carried-forward one; the
+        # remaining carried sub-bench numbers stay listed in stale_keys
+        # until their stages refresh them
+        for k in ("stale", "error", "train_error", "fatal_error",
+                  "backend_error"):
+            rec.result.pop(k, None)
+        rec.update_live({
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": round(imgs_per_sec_per_chip, 2),
+            "unit": "img/s/chip",
+            "vs_baseline": round(
+                imgs_per_sec_per_chip / BASELINE_IMGS_PER_SEC, 3),
+        })
+        rec.result["stage_s"] = rec.stage_s
+        rec.emit()  # primary metric on the wire (and into bench_lkg.json)
 
     # -- pipeline-fed measurement (reference: train_imagenet.py feeds the
     # trainer through ImageRecordIter, src/io/iter_image_recordio_2.cc).
@@ -183,9 +389,10 @@ def main():
     # host the decode path is CPU-bound (os.cpu_count() cores drive
     # libjpeg), so the pipeline rate is a host property, not a chip one.
     if os.environ.get("MXTPU_BENCH_PIPELINE", "1") == "1":
+        synth = (imgs_per_sec_per_chip * n_dev
+                 if imgs_per_sec_per_chip else None)
         rec.stage("pipeline", 45, lambda: _pipeline_bench(
-            trainer, batch, layout, dtype,
-            synth_rate=imgs_per_sec_per_chip * n_dev))
+            trainer, batch, layout, dtype, synth_rate=synth))
 
     # -- inference: bf16 denominator + int8 (reference: benchmark_score.py
     # fp32/fp16 table in docs/faq/perf.md:156,170, and quantized resnet via
@@ -505,18 +712,21 @@ def _pipeline_bench(trainer, batch, layout, dtype, n_records=None,
 
     # fed rate: trainer consumes the double-buffered device feed — the
     # worker fences one transfer at a time while the previous step's
-    # compute runs on device (iter_prefetcher.h:47 analogue)
+    # compute runs on device (iter_prefetcher.h:47 analogue).  Skipped
+    # when the train stage failed (trainer is None): the decode/feed
+    # rates above are host properties and still stand.
     loss = None
     n = 0
     t0 = time.perf_counter()
-    fed = mx.io.DeviceFeedIter(make_it(), transform=prep)
-    for b in fed:
-        if b.data[0].shape[0] != batch:
-            break
-        loss = trainer.step(b.data[0], b.label[0])
-        n += batch
-    if loss is not None:
-        loss.asscalar()
+    if trainer is not None:
+        fed = mx.io.DeviceFeedIter(make_it(), transform=prep)
+        for b in fed:
+            if b.data[0].shape[0] != batch:
+                break
+            loss = trainer.step(b.data[0], b.label[0])
+            n += batch
+        if loss is not None:
+            loss.asscalar()
     dt_fed = time.perf_counter() - t0
     fed_rate = n / dt_fed if n else 0.0
 
@@ -529,14 +739,18 @@ def _pipeline_bench(trainer, batch, layout, dtype, n_records=None,
 
     import shutil
     shutil.rmtree(tmpdir, ignore_errors=True)
-    return {
+    out = {
         "pipeline_decode_imgs_per_sec": round(decode_rate, 2),
         "pipeline_iter_imgs_per_sec": round(feed_rate, 2),
-        "pipeline_fed_imgs_per_sec": round(fed_rate, 2),
-        "pipeline_stall_pct": round(stall * 100, 2),
         "pipeline_decode_thread_scaling": scaling,
         "pipeline_host_cores": os.cpu_count(),
     }
+    if trainer is not None:
+        # only report the trainer-fed numbers when they were measured —
+        # a fake 0.0 here would displace a carried-forward real value
+        out["pipeline_fed_imgs_per_sec"] = round(fed_rate, 2)
+        out["pipeline_stall_pct"] = round(stall * 100, 2)
+    return out
 
 
 if __name__ == "__main__":

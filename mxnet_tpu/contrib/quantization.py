@@ -526,6 +526,15 @@ def quantize_model(sym_in, arg_params, aux_params, data_names=("data",),
     the conv weights first so the int8 convs carry their scale/shift as a
     fused epilogue instead of a separate fp32 BN pass.
 
+    ``excluded_sym_names``: ops to keep on the float rail.  The reference
+    excludes the stem conv (conv0) by default for accuracy
+    (quantize_graph_pass.cc); here nothing is excluded implicitly — pass
+    the stem name (or set ``MXTPU_INT8_EXCLUDE=name1,name2`` where a tool
+    honors it, e.g. bench.py) to restore the reference's
+    accuracy-motivated default, and validate any quantize-everything
+    recipe with an accuracy gate (bench.py's ≤1% top-1 drop bound is the
+    model to copy).
+
     Returns (symbol, qarg_params, aux_params): weights stored quantized as
     (int8 data, min, max) triples under their original names + suffixes."""
     excluded = set(excluded_sym_names or [])

@@ -158,11 +158,12 @@ class Block:
             ret.update(child._collect_params_with_prefix(prefix + name))
         return ret
 
-    def save_parameters(self, filename):
-        """Reference: gluon/block.py:313."""
+    def save_parameters(self, filename, format="mxtpu"):
+        """Reference: gluon/block.py:313.  format="mxnet" writes the
+        reference dmlc-stream .params layout."""
         params = self._collect_params_with_prefix()
         nd.save(filename, {k: v.data() for k, v in params.items()
-                           if v._data is not None})
+                           if v._data is not None}, format=format)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False):

@@ -104,6 +104,18 @@ class KVStore:
     def num_workers(self):
         return self._num_workers
 
+    @property
+    def has_updater(self):
+        """True when a store-side updater/optimizer is set (public surface
+        so wrappers/duck-typed stores can be validated without reaching
+        into private attributes)."""
+        return self._updater is not None
+
+    @property
+    def compression(self):
+        """The active gradient-compression config dict, or None."""
+        return self._compression
+
     # -- core ops ----------------------------------------------------------
     def init(self, key, value):
         keys, values = _key_value(key, value)

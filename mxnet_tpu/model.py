@@ -53,10 +53,10 @@ def _create_kvstore(kvstore, num_device, arg_params):
     return kv, update_on_kvstore
 
 
-def save_params(fname, arg_params, aux_params=None):
+def save_params(fname, arg_params, aux_params=None, format="mxtpu"):
     data = {"arg:%s" % k: v for k, v in (arg_params or {}).items()}
     data.update({"aux:%s" % k: v for k, v in (aux_params or {}).items()})
-    save_ndarrays(fname, data)
+    save_ndarrays(fname, data, format=format)
 
 
 def load_params(fname):
@@ -72,12 +72,15 @@ def load_params(fname):
     return arg_params, aux_params
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    format="mxtpu"):
     """Write prefix-symbol.json + prefix-####.params
-    (reference: model.py:384)."""
+    (reference: model.py:384).  format="mxnet" emits the reference
+    dmlc-stream .params so stock MXNet can load the checkpoint."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
-    save_params("%s-%04d.params" % (prefix, epoch), arg_params, aux_params)
+    save_params("%s-%04d.params" % (prefix, epoch), arg_params, aux_params,
+                format=format)
     logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch)
 
 

@@ -110,10 +110,12 @@ def load(fname):
     return load_ndarrays(fname)
 
 
-def save(fname, data):
-    """Save list or dict of NDArrays (reference: NDArray::Save, ndarray.cc)."""
+def save(fname, data, format="mxtpu"):
+    """Save list or dict of NDArrays (reference: NDArray::Save, ndarray.cc).
+    format="mxnet" writes the reference dmlc-stream layout so stock MXNet
+    ``mx.nd.load`` can read the file."""
     from ..serialization import save_ndarrays
-    save_ndarrays(fname, data)
+    save_ndarrays(fname, data, format=format)
 
 
 # random namespace ----------------------------------------------------------

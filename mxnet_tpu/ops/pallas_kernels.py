@@ -19,6 +19,7 @@ recomputation scheme.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -657,6 +658,7 @@ def conv3x3_epilogue(x, w, scale, shift, relu=True, out_dtype=None,
     # tile choices: rows-per-tile scales down as W grows so the GEMM's M
     # stays ~mxu-sized; images-per-tile then batches M up to ~1k rows
     # (fewer, fatter grid steps — each step amortizes its DMA + epilogue)
+    explicit_th, explicit_nb = th is not None, nb is not None
     if th is None:
         th = max(1, min(H, 448 // W))
     while H % th:
@@ -668,6 +670,46 @@ def conv3x3_epilogue(x, w, scale, shift, relu=True, out_dtype=None,
     if tn is None:
         tn = min(max(Cout, 128), 256)
     tn = -(-tn // 128) * 128  # full 128-lane multiple (Mosaic minor dim)
+
+    # VMEM budget clamp: the col scratch (nb*th*W, 9*Cp) dominates and
+    # grows with Cin, so H/W-only tile sizing could overflow VMEM at
+    # large channel counts (Cin=512 bf16 ≈ 12MB+) and die at Mosaic
+    # compile time.  Auto-chosen tiles shrink to fit; explicit tiles
+    # that cannot fit fail loudly here instead.
+    Wp_est = -(-(W + 2) // 8) * 8
+    Cp_est = -(-Cin // 128) * 128
+    itemsize = jnp.dtype(x.dtype).itemsize
+    osize = jnp.dtype(out_dtype).itemsize
+
+    def _tile_bytes(nb_, th_):
+        xpatch = nb_ * (th_ + 2) * Wp_est * Cp_est * itemsize
+        col = nb_ * th_ * W * 9 * Cp_est * itemsize
+        wblk = 9 * Cp_est * tn * itemsize
+        outblk = nb_ * th_ * W * tn * osize
+        accblk = nb_ * th_ * W * tn * 4  # f32/i32 accumulator
+        return xpatch + col + wblk + outblk + accblk
+
+    budget = int(os.environ.get("MXTPU_PALLAS_VMEM_BUDGET",
+                                12 * 1024 * 1024))
+    # auto-chosen tiles shrink to fit; only user-passed ones fail loudly
+    if not explicit_nb:
+        while _tile_bytes(nb, th) > budget and nb > 1:
+            nb -= 1
+            while N % nb:
+                nb -= 1
+    if not explicit_th:
+        while _tile_bytes(nb, th) > budget and th > 1:
+            th -= 1
+            while H % th:
+                th -= 1
+    if _tile_bytes(nb, th) > budget:
+        raise ValueError(
+            "conv3x3_epilogue tiles nb=%d th=%d need %d bytes of VMEM "
+            "(budget %d) at W=%d Cin=%d Cout=%d%s — shrink nb/th or raise "
+            "MXTPU_PALLAS_VMEM_BUDGET" %
+            (nb, th, _tile_bytes(nb, th), budget, W, Cin, Cout,
+             "" if (explicit_nb or explicit_th)
+             else " even at the smallest auto tiling"))
 
     # Mosaic alignment: the scratch's second-minor dim (patch width) must
     # be a sublane multiple and its minor dims (channels in / out) full

@@ -18,6 +18,22 @@ from .registry import register
 _INT8_MAX = 127.0
 _UINT8_MAX = 255.0
 
+_INT8_FLOAT_CHOICES = ("float32", "bfloat16", "float16")
+
+
+def _int8_float_env():
+    """The MXTPU_INT8_FLOAT float-rail dtype, validated on first read so a
+    typo fails here with the legal choices instead of as an opaque dtype
+    error deep inside a traced op.  Re-read per call (not cached) — but
+    note any jitted graph captures the value at trace time."""
+    import os
+    v = os.environ.get("MXTPU_INT8_FLOAT", "float32")
+    if v not in _INT8_FLOAT_CHOICES:
+        raise ValueError(
+            "MXTPU_INT8_FLOAT=%r invalid; choose one of %s"
+            % (v, ", ".join(_INT8_FLOAT_CHOICES)))
+    return v
+
 
 @register("_contrib_quantize", arg_names=["data", "min_range", "max_range"],
           num_outputs=3, differentiable=False, aliases=("quantize",))
@@ -64,9 +80,15 @@ def dequantize(data, min_range, max_range, out_type="float32"):
     quantized convs) to the TPU-native half type — the int8 noise floor
     (1/127 per tensor) dwarfs bf16 rounding, and the fp32 elementwise
     round trips are the measured e2e drag of the int8 graph (the scale
-    arithmetic itself stays fp32)."""
-    import os as _os
-    fdt = jnp.dtype(_os.environ.get("MXTPU_INT8_FLOAT", out_type))
+    arithmetic itself stays fp32).
+
+    The env override applies only when ``out_type`` is the float32
+    default (an explicit out_type wins), is validated by
+    ``_int8_float_env`` at first use, and is captured at TRACE time: a
+    graph jitted before the env changes keeps the dtype it compiled
+    with."""
+    fdt = jnp.dtype(_int8_float_env() if out_type == "float32"
+                    else out_type)
     mn = min_range.reshape(())
     mx = max_range.reshape(())
     if data.dtype == jnp.uint8:

@@ -101,12 +101,12 @@ class DataParallelTrainer:
                     "DataParallelTrainer needs a synchronous kvstore "
                     "(dist_sync/dist_device_sync/tpu_dist), got %r"
                     % self._kv.type)
-            if self._kv._updater is not None:
+            if getattr(self._kv, "has_updater", False):
                 raise ValueError(
                     "kvstore has an updater/optimizer set; the trainer "
                     "applies its own optimizer — use a plain dist_sync "
                     "store for gradient aggregation")
-            if self._kv._compression is not None:
+            if getattr(self._kv, "compression", None) is not None:
                 raise ValueError(
                     "kvstore gradient compression would quantize the "
                     "trainer's fused flat gradient (and the loss scalar "
@@ -240,7 +240,43 @@ class DataParallelTrainer:
             self._kv.init(self._flat_key, NDArray(jnp.zeros((total,),
                                                             jnp.float32)))
             self._flat_out = NDArray(jnp.zeros((total,), jnp.float32))
+            self._validate_flat_key(total)
         self._ready = True
+
+    def _validate_flat_key(self, total):
+        """Detect cross-rank trainer desync before any gradient mixes.
+
+        The flat-key scheme assumes identical trainer construction order
+        on every rank; two equal-length flat keys from *different*
+        trainers would otherwise sum silently (the cross-process
+        collective is unkeyed).  One signature round catches it: every
+        rank pushes a layout fingerprint in slot 0; the pulled sum must be
+        num_workers * sig (sig < 2^16 keeps k*sig inside fp32's 24
+        significand bits for k <= 256 workers, so healthy sums compare
+        exactly; a desync shifts the sum by ~|sigA - sigB| >> 1)."""
+        if self._kv.num_workers <= 1:
+            return
+        import zlib
+        sig = float(zlib.crc32(repr(
+            (self._flat_key, tuple(self._flat_sizes))).encode())
+            % (1 << 16) + 1)
+        probe = jnp.zeros((total,), jnp.float32).at[0].set(sig)
+        self._kv.push(self._flat_key, NDArray(probe))
+        out = NDArray(jnp.zeros((total,), jnp.float32))
+        self._kv.pull(self._flat_key, out=out)
+        got = float(out.asnumpy()[0])
+        want = sig * self._kv.num_workers
+        # tolerant compare: beyond 256 workers the fp32 partial sums may
+        # round by a few ulps; any real desync moves the sum by >= ~1
+        if abs(got - want) > 0.5:
+            raise RuntimeError(
+                "DataParallelTrainer flat-key desync: rank %d pushed "
+                "signature %.0f for key %r sizes %r but the cross-worker "
+                "sum was %.0f (expected %.0f) — trainers were constructed "
+                "in a different order on some rank, which would silently "
+                "sum gradients from different models"
+                % (self._kv.rank, sig, self._flat_key,
+                   tuple(self._flat_sizes), got, want))
 
     # -- the compiled step -------------------------------------------------
     def _apply_groups(self, train_vals, states, grads, lr, t):

@@ -1,11 +1,28 @@
 """Binary serialization of NDArrays (.params files).
 
-Reference format: ``NDArray::Save/Load`` (src/ndarray/ndarray.cc) — dmlc
-Stream with kMXAPINDArrayListMagic, arrays as (shape, context, dtype, data)
-records with an optional list of names; ``python/mxnet/model.py:384``
-prefixes keys with ``arg:``/``aux:``.  We keep the *file role and key
-conventions* (a single file mapping names to arrays, arg:/aux: prefixes)
-with a self-describing container: magic + JSON index + raw buffers.
+Two on-disk formats, auto-detected by magic on load:
+
+1. The reference MXNet dmlc-stream format — ``NDArray::Save/Load``
+   (reference src/ndarray/ndarray.cc:1537-1761): little-endian
+   ``uint64 0x112`` list magic + ``uint64`` reserved, a dmlc
+   ``vector<NDArray>`` (``uint64`` count, then per-array records:
+   ``uint32 0xF993FAC9`` V2 magic, ``int32`` storage type, TShape as
+   ``uint32 ndim`` + ``int64`` dims (nnvm::Tuple::Save), context as
+   ``int32`` dev_type + ``int32`` dev_id, ``int32`` mshadow type flag,
+   raw C-order data; sparse records carry storage shape and aux
+   type/shape/data), then a dmlc ``vector<string>`` of names (``uint64``
+   count, each ``uint64`` length + bytes).  ``python/mxnet/model.py:384``
+   prefixes keys with ``arg:``/``aux:``.  Legacy V1 (0xF993FAC8) and
+   pre-V1 (magic = ndim, uint32 dims) records load too
+   (reference LegacyLoad, ndarray.cc:1603-1648).
+
+2. A self-describing TPU-native container (``MXTPUND1``: magic + JSON
+   index + raw buffers) — the default write format, because it
+   round-trips dtypes the reference format cannot (bfloat16).
+
+``save_ndarrays(..., format="mxnet")`` writes the reference format so
+checkpoints flow both directions; bfloat16 is widened to float32 there
+(the mshadow type table has no bf16 slot).
 """
 from __future__ import annotations
 
@@ -16,6 +33,17 @@ import numpy as np
 
 _MAGIC = b"MXTPUND1"
 
+# reference constants: src/ndarray/ndarray.cc:1531-1535,1733 and
+# python/mxnet/ndarray/ndarray.py:51-66
+_MXNET_LIST_MAGIC = 0x112
+_NDARRAY_V2_MAGIC = 0xF993FAC9
+_NDARRAY_V1_MAGIC = 0xF993FAC8
+_STYPE_DEFAULT, _STYPE_ROW_SPARSE, _STYPE_CSR = 0, 1, 2
+_MX_FLAG_TO_NP = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "int8", 6: "int64", 7: "bool"}
+_NP_TO_MX_FLAG = {v: k for k, v in _MX_FLAG_TO_NP.items()}
+_KCPU = 1  # Context dev_type (reference include/mxnet/base.h DeviceType)
+
 
 def _to_numpy(arr):
     from .ndarray import NDArray
@@ -24,16 +52,20 @@ def _to_numpy(arr):
     return np.asarray(arr)
 
 
-def save_ndarrays(fname, data):
+def save_ndarrays(fname, data, format="mxtpu"):
+    """Save a dict/list of arrays.  format="mxnet" writes the reference
+    dmlc-stream layout (readable by stock MXNet ``mx.nd.load``)."""
     if isinstance(data, dict):
         names = list(data.keys())
-        arrays = [_to_numpy(v) for v in data.values()]
+        values = list(data.values())
     elif isinstance(data, (list, tuple)):
-        names = None
-        arrays = [_to_numpy(v) for v in data]
+        names, values = None, list(data)
     else:
-        names = None
-        arrays = [_to_numpy(data)]
+        names, values = None, [data]
+    if format == "mxnet":
+        _save_mxnet(fname, values, names)
+        return
+    arrays = [_to_numpy(v) for v in values]
     index = {
         "names": names,
         "arrays": [
@@ -50,21 +82,191 @@ def save_ndarrays(fname, data):
 
 
 def load_ndarrays(fname):
-    from .ndarray import array
-
     with open(fname, "rb") as f:
         magic = f.read(8)
-        if magic != _MAGIC:
-            raise ValueError("not a %s params file: %r" % (_MAGIC.decode(), fname))
-        (n,) = struct.unpack("<Q", f.read(8))
-        index = json.loads(f.read(n).decode("utf-8"))
-        arrays = []
-        for meta in index["arrays"]:
-            dt = np.dtype(meta["dtype"])
-            count = int(np.prod(meta["shape"])) if meta["shape"] else 1
-            buf = f.read(count * dt.itemsize)
-            a = np.frombuffer(buf, dtype=dt).reshape(meta["shape"])
-            arrays.append(array(a, dtype=dt))
+        if magic == _MAGIC:
+            return _load_mxtpu(f)
+        if len(magic) == 8 and \
+                struct.unpack("<Q", magic)[0] == _MXNET_LIST_MAGIC:
+            return _load_mxnet(f)
+    raise ValueError(
+        "not an NDArray params file (neither %s nor MXNet list magic "
+        "0x%x): %r" % (_MAGIC.decode(), _MXNET_LIST_MAGIC, fname))
+
+
+def _load_mxtpu(f):
+    from .ndarray import array
+
+    (n,) = struct.unpack("<Q", f.read(8))
+    index = json.loads(f.read(n).decode("utf-8"))
+    arrays = []
+    for meta in index["arrays"]:
+        dt = np.dtype(meta["dtype"])
+        count = int(np.prod(meta["shape"])) if meta["shape"] else 1
+        buf = f.read(count * dt.itemsize)
+        a = np.frombuffer(buf, dtype=dt).reshape(meta["shape"])
+        arrays.append(array(a, dtype=dt))
     if index["names"] is None:
         return arrays
     return dict(zip(index["names"], arrays))
+
+
+# ---------------------------------------------------------------- mxnet fmt
+
+def _read(f, n):
+    buf = f.read(n)
+    if len(buf) != n:
+        raise ValueError("truncated MXNet params file")
+    return buf
+
+
+def _read_tshape_v1(f):
+    (ndim,) = struct.unpack("<I", _read(f, 4))
+    return struct.unpack("<%dq" % ndim, _read(f, 8 * ndim)) if ndim else ()
+
+
+def _write_tshape(f, shape):
+    f.write(struct.pack("<I", len(shape)))
+    if shape:
+        f.write(struct.pack("<%dq" % len(shape), *[int(d) for d in shape]))
+
+
+def _read_raw(f, shape, type_flag):
+    if type_flag not in _MX_FLAG_TO_NP:
+        raise ValueError("unknown mshadow type flag %d" % type_flag)
+    dt = np.dtype(_MX_FLAG_TO_NP[type_flag])
+    count = int(np.prod(shape)) if shape else 1
+    return np.frombuffer(_read(f, count * dt.itemsize), dtype=dt) \
+        .reshape(shape)
+
+
+def _load_mxnet_one(f):
+    """One NDArray record (reference NDArray::Load, ndarray.cc:1650)."""
+    from .ndarray import array
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+    (magic,) = struct.unpack("<I", _read(f, 4))
+    if magic != _NDARRAY_V2_MAGIC:
+        # LegacyLoad (ndarray.cc:1619): V1 = int64 TShape, older = the
+        # magic word itself is ndim and dims are uint32
+        if magic == _NDARRAY_V1_MAGIC:
+            shape = _read_tshape_v1(f)
+        else:
+            ndim = magic
+            if ndim > 32:  # not a plausible legacy ndim — wrong file
+                raise ValueError("bad NDArray record magic 0x%x" % magic)
+            shape = struct.unpack("<%dI" % ndim, _read(f, 4 * ndim)) \
+                if ndim else ()
+        if not shape:
+            return None
+        _read(f, 8)  # context (dev_type, dev_id) — ignored, TPU decides
+        (type_flag,) = struct.unpack("<i", _read(f, 4))
+        data = _read_raw(f, shape, type_flag)
+        return array(data, dtype=data.dtype)
+
+    (stype,) = struct.unpack("<i", _read(f, 4))
+    nad = {_STYPE_DEFAULT: 0, _STYPE_CSR: 2, _STYPE_ROW_SPARSE: 1}.get(stype)
+    if nad is None:
+        raise ValueError("unknown storage type %d in params file" % stype)
+    sshape = _read_tshape_v1(f) if nad else None
+    shape = _read_tshape_v1(f)
+    if not shape:
+        return None
+    _read(f, 8)  # context — ignored
+    (type_flag,) = struct.unpack("<i", _read(f, 4))
+    aux = []
+    for _ in range(nad):
+        (aux_flag,) = struct.unpack("<i", _read(f, 4))
+        aux.append((aux_flag, _read_tshape_v1(f)))
+    data = _read_raw(f, sshape if nad else shape, type_flag)
+    aux_data = [_read_raw(f, ashape, aflag) for aflag, ashape in aux]
+    # dtype passed explicitly: nd.array defaults non-NDArray input to
+    # float32 (reference semantics); jax narrows int64/float64 when x64
+    # is off — value-preserving, documented
+    if stype == _STYPE_DEFAULT:
+        return array(data, dtype=data.dtype)
+    if stype == _STYPE_ROW_SPARSE:  # aux 0 = row indices (kIdx)
+        return RowSparseNDArray(array(data, dtype=data.dtype),
+                                array(aux_data[0], dtype=aux_data[0].dtype),
+                                shape)
+    # csr: aux 0 = indptr, aux 1 = column indices
+    return CSRNDArray(array(data, dtype=data.dtype),
+                      array(aux_data[1], dtype=aux_data[1].dtype),
+                      array(aux_data[0], dtype=aux_data[0].dtype),
+                      shape)
+
+
+def _load_mxnet(f):
+    """dmlc vector<NDArray> + vector<string> (ndarray.cc:1745)."""
+    (reserved,) = struct.unpack("<Q", _read(f, 8))
+    if reserved != 0:
+        raise ValueError("bad reserved field in MXNet params file")
+    (count,) = struct.unpack("<Q", _read(f, 8))
+    arrays = [_load_mxnet_one(f) for _ in range(count)]
+    (n_names,) = struct.unpack("<Q", _read(f, 8))
+    names = []
+    for _ in range(n_names):
+        (ln,) = struct.unpack("<Q", _read(f, 8))
+        names.append(_read(f, ln).decode("utf-8"))
+    if not names:
+        return arrays
+    if len(names) != len(arrays):
+        raise ValueError("name/array count mismatch in MXNet params file")
+    return dict(zip(names, arrays))
+
+
+def _save_mxnet_one(f, v):
+    from .ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+    f.write(struct.pack("<I", _NDARRAY_V2_MAGIC))
+    if isinstance(v, RowSparseNDArray):
+        stype, data = _STYPE_ROW_SPARSE, _to_numpy(v.data)
+        aux = [np.ascontiguousarray(_to_numpy(v.indices), np.int64)]
+        shape = v.shape
+    elif isinstance(v, CSRNDArray):
+        stype, data = _STYPE_CSR, _to_numpy(v.data)
+        aux = [np.ascontiguousarray(_to_numpy(v.indptr), np.int64),
+               np.ascontiguousarray(_to_numpy(v.indices), np.int64)]
+        shape = v.shape
+    else:
+        stype, data, aux = _STYPE_DEFAULT, _to_numpy(v), []
+        if data.ndim == 0:
+            # the reference format cannot represent 0-d (ndim==0 means a
+            # "none" array and terminates the record — ndarray.cc:1554);
+            # MXNet scalars are shape (1,), so widen like bf16→f32 below
+            data = data.reshape(1)
+        shape = data.shape
+    if data.dtype.name not in _NP_TO_MX_FLAG:
+        if data.dtype.kind == "f" or data.dtype.name == "bfloat16":
+            # bfloat16: no mshadow slot — widen to f32 (lossless up-cast)
+            data = data.astype(np.float32)
+        else:
+            raise TypeError(
+                "dtype %s has no slot in the reference .params format; "
+                "cast explicitly before saving with format='mxnet'"
+                % data.dtype.name)
+    f.write(struct.pack("<i", stype))
+    if aux:
+        _write_tshape(f, data.shape)  # storage shape
+    _write_tshape(f, shape)
+    f.write(struct.pack("<ii", _KCPU, 0))  # context: cpu(0)
+    f.write(struct.pack("<i", _NP_TO_MX_FLAG[data.dtype.name]))
+    for a in aux:
+        f.write(struct.pack("<i", _NP_TO_MX_FLAG[a.dtype.name]))
+        _write_tshape(f, a.shape)
+    f.write(np.ascontiguousarray(data).tobytes())
+    for a in aux:
+        f.write(a.tobytes())
+
+
+def _save_mxnet(fname, values, names):
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _MXNET_LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(values)))
+        for v in values:
+            _save_mxnet_one(f, v)
+        f.write(struct.pack("<Q", len(names) if names else 0))
+        for name in names or []:
+            b = name.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)

@@ -72,3 +72,31 @@ def test_padded_cout_slice():
     assert out.shape == (2, 6, 6, 24)
     ref = _oracle(x, w, scale, shift, relu=False, out_int8=True)
     np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_vmem_budget_clamp_auto():
+    """Auto tile heuristic shrinks nb/th to the VMEM byte budget at large
+    Cin instead of handing Mosaic an oversized scratch (ADVICE r4):
+    Cin=512 bf16 at 28x28 would be ~13MB of col scratch with the H/W-only
+    sizing; the clamped call must still run and match the oracle."""
+    rng = np.random.RandomState(0)
+    N, H, W, C = 2, 28, 28, 512
+    x = jnp.asarray(rng.randn(N, H, W, C), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, C, 128) * 0.05, jnp.float32)
+    scale = np.ones(128, np.float32)
+    shift = np.zeros(128, np.float32)
+    out = conv3x3_epilogue(x, w, scale, shift, relu=False)
+    ref = _oracle(x, w, scale, shift, relu=False, out_int8=False)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_vmem_budget_explicit_tiles_fail_loudly():
+    """Explicit nb/th that cannot fit the budget raise with the byte
+    arithmetic in the message, not at Mosaic compile time."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 32, 32, 512), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 512, 128) * 0.05, jnp.float32)
+    ones, zeros = np.ones(128, np.float32), np.zeros(128, np.float32)
+    with pytest.raises(ValueError, match="VMEM"):
+        conv3x3_epilogue(x, w, ones, zeros, nb=8, th=32)

@@ -1,0 +1,231 @@
+"""Reference .params dmlc-stream format: byte-level fixtures + round trips.
+
+The fixture builder below packs the reference layout independently of the
+library writer (reference src/ndarray/ndarray.cc:1537-1761 NDArray::Save /
+Load, python/mxnet/model.py:384 arg:/aux: key prefixes), so reader and
+writer are each checked against the spec, not just against each other.
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.ndarray.sparse import CSRNDArray, RowSparseNDArray
+
+LIST_MAGIC = 0x112
+V2_MAGIC = 0xF993FAC9
+V1_MAGIC = 0xF993FAC8
+NP_TO_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+              "int32": 4, "int8": 5, "int64": 6}
+
+
+def _tshape(shape):
+    return struct.pack("<I", len(shape)) + \
+        struct.pack("<%dq" % len(shape), *shape)
+
+
+def _dense_record(a):
+    a = np.ascontiguousarray(a)
+    return (struct.pack("<I", V2_MAGIC) + struct.pack("<i", 0) +
+            _tshape(a.shape) + struct.pack("<ii", 1, 0) +
+            struct.pack("<i", NP_TO_FLAG[a.dtype.name]) + a.tobytes())
+
+
+def _fixture_bytes(named_arrays, records=None):
+    names = list(named_arrays.keys())
+    recs = records or [_dense_record(a) for a in named_arrays.values()]
+    out = struct.pack("<QQ", LIST_MAGIC, 0) + struct.pack("<Q", len(recs))
+    out += b"".join(recs)
+    out += struct.pack("<Q", len(names))
+    for n in names:
+        b = n.encode()
+        out += struct.pack("<Q", len(b)) + b
+    return out
+
+
+@pytest.mark.smoke
+def test_reference_fixture_loads(tmp_path):
+    arrays = {
+        "arg:fc1_weight": np.random.randn(4, 3).astype(np.float32),
+        "arg:fc1_bias": np.arange(4, dtype=np.float64),
+        "aux:bn_mean": np.random.rand(3).astype(np.float16),
+        "arg:idx": np.array([1, 2, 7], np.int64),
+        "arg:bytes": np.array([[0, 255], [7, 9]], np.uint8),
+    }
+    p = tmp_path / "ref.params"
+    p.write_bytes(_fixture_bytes(arrays))
+    loaded = nd.load(str(p))
+    assert set(loaded) == set(arrays)
+    # jax (x64 off) narrows 64-bit dtypes on device; values must survive
+    narrowed = {"float64": "float32", "int64": "int32"}
+    for k, v in arrays.items():
+        got = loaded[k].asnumpy()
+        want_dt = narrowed.get(v.dtype.name, v.dtype.name)
+        assert got.dtype.name == want_dt and got.shape == v.shape
+        np.testing.assert_array_equal(got, v.astype(want_dt))
+
+
+def test_reference_fixture_list_no_names(tmp_path):
+    a = np.random.randn(2, 2).astype(np.float32)
+    raw = struct.pack("<QQQ", LIST_MAGIC, 0, 1) + _dense_record(a) + \
+        struct.pack("<Q", 0)
+    p = tmp_path / "anon.params"
+    p.write_bytes(raw)
+    loaded = nd.load(str(p))
+    assert isinstance(loaded, list) and len(loaded) == 1
+    np.testing.assert_array_equal(loaded[0].asnumpy(), a)
+
+
+def test_legacy_v1_and_pre_v1_records(tmp_path):
+    """LegacyLoad (ndarray.cc:1619): V1 = int64 TShape after magic;
+    pre-V1 = the magic word is ndim, dims are uint32."""
+    a = np.random.randn(3, 2).astype(np.float32)
+    v1 = (struct.pack("<I", V1_MAGIC) + _tshape(a.shape) +
+          struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a.tobytes())
+    pre = (struct.pack("<I", 2) + struct.pack("<II", 3, 2) +
+           struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a.tobytes())
+    p = tmp_path / "legacy.params"
+    p.write_bytes(_fixture_bytes({"arg:v1": a, "arg:pre": a},
+                                 records=[v1, pre]))
+    loaded = nd.load(str(p))
+    np.testing.assert_array_equal(loaded["arg:v1"].asnumpy(), a)
+    np.testing.assert_array_equal(loaded["arg:pre"].asnumpy(), a)
+
+
+def test_sparse_fixture_loads(tmp_path):
+    """V2 sparse records: row_sparse (aux=[row idx]) and csr
+    (aux=[indptr, col idx]) — ndarray.cc:1546-1600."""
+    vals = np.array([[1., 2.], [3., 4.]], np.float32)
+    idx = np.array([0, 3], np.int64)
+    rsp = (struct.pack("<I", V2_MAGIC) + struct.pack("<i", 1) +
+           _tshape(vals.shape) + _tshape((4, 2)) +
+           struct.pack("<ii", 1, 0) + struct.pack("<i", 0) +
+           struct.pack("<i", 6) + _tshape(idx.shape) +
+           vals.tobytes() + idx.tobytes())
+    data = np.array([5., 6., 7.], np.float32)
+    indptr = np.array([0, 2, 2, 3], np.int64)
+    col = np.array([0, 2, 1], np.int64)
+    csr = (struct.pack("<I", V2_MAGIC) + struct.pack("<i", 2) +
+           _tshape(data.shape) + _tshape((3, 3)) +
+           struct.pack("<ii", 1, 0) + struct.pack("<i", 0) +
+           struct.pack("<i", 6) + _tshape(indptr.shape) +
+           struct.pack("<i", 6) + _tshape(col.shape) +
+           data.tobytes() + indptr.tobytes() + col.tobytes())
+    p = tmp_path / "sparse.params"
+    p.write_bytes(_fixture_bytes({"arg:rsp": None, "arg:csr": None},
+                                 records=[rsp, csr]))
+    loaded = nd.load(str(p))
+    assert isinstance(loaded["arg:rsp"], RowSparseNDArray)
+    dense = np.zeros((4, 2), np.float32)
+    dense[[0, 3]] = vals
+    np.testing.assert_array_equal(loaded["arg:rsp"].asnumpy(), dense)
+    assert isinstance(loaded["arg:csr"], CSRNDArray)
+    want = np.array([[5., 0., 6.], [0., 0., 0.], [0., 7., 0.]], np.float32)
+    np.testing.assert_array_equal(loaded["arg:csr"].asnumpy(), want)
+
+
+@pytest.mark.smoke
+def test_writer_matches_fixture_bytes(tmp_path):
+    """The mxnet-format writer must produce the spec bytes, not merely
+    bytes its own reader accepts."""
+    arrays = {"arg:w": np.random.randn(2, 3).astype(np.float32),
+              "aux:m": np.arange(6, dtype=np.int32)}
+    p = tmp_path / "w.params"
+    nd.save(str(p), {k: mx.nd.array(v, dtype=v.dtype)
+                     for k, v in arrays.items()}, format="mxnet")
+    assert p.read_bytes() == _fixture_bytes(arrays)
+
+
+def test_writer_reader_roundtrip_sparse(tmp_path):
+    rsp = mx.nd.sparse.row_sparse_array(
+        (np.array([[1., 2.]], np.float32), np.array([2], np.int64)),
+        shape=(5, 2))
+    p = tmp_path / "rt.params"
+    nd.save(str(p), {"arg:g": rsp}, format="mxnet")
+    back = nd.load(str(p))["arg:g"]
+    assert isinstance(back, RowSparseNDArray)
+    np.testing.assert_array_equal(back.asnumpy(), rsp.asnumpy())
+
+
+def test_bf16_widens_to_f32_in_mxnet_format(tmp_path):
+    x = mx.nd.array(np.random.randn(3, 3).astype(np.float32)) \
+        .astype("bfloat16")
+    p = tmp_path / "bf16.params"
+    nd.save(str(p), {"arg:w": x}, format="mxnet")
+    back = nd.load(str(p))["arg:w"]
+    assert back.dtype == np.float32
+    np.testing.assert_allclose(back.asnumpy(),
+                               x.asnumpy().astype(np.float32))
+
+
+@pytest.mark.smoke
+def test_gluon_load_parameters_from_reference_params(tmp_path):
+    """A reference-format zoo checkpoint imports through
+    Block.load_parameters (VERDICT r4 item 2's done condition)."""
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(4, in_units=3), gluon.nn.Dense(2, in_units=4))
+    net.initialize(mx.init.Xavier())
+    names = list(net._collect_params_with_prefix())
+    arrays = {n: np.random.randn(
+        *net._collect_params_with_prefix()[n].shape).astype(np.float32)
+        for n in names}
+    p = tmp_path / "net.params"
+    p.write_bytes(_fixture_bytes(arrays))
+    net.load_parameters(str(p))
+    for n, want in arrays.items():
+        got = net._collect_params_with_prefix()[n].data().asnumpy()
+        np.testing.assert_array_equal(got, want)
+    # and the gluon writer round-trips through the same reference format
+    p2 = tmp_path / "net2.params"
+    net.save_parameters(str(p2), format="mxnet")
+    net2 = gluon.nn.Sequential()
+    net2.add(gluon.nn.Dense(4, in_units=3), gluon.nn.Dense(2, in_units=4))
+    net2.load_parameters(str(p2))
+    for n, want in arrays.items():
+        got = net2._collect_params_with_prefix()[n].data().asnumpy()
+        np.testing.assert_array_equal(got, want)
+
+
+def test_model_checkpoint_reference_format(tmp_path):
+    """save_checkpoint(format="mxnet") + load_checkpoint round trip with
+    arg:/aux: prefixes (reference model.py:384)."""
+    x = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    arg = {"fc_weight": mx.nd.array(np.random.randn(2, 3)),
+           "fc_bias": mx.nd.array(np.zeros(2, np.float32))}
+    aux = {"stat": mx.nd.array(np.ones(2, np.float32))}
+    prefix = str(tmp_path / "ckpt")
+    mx.model.save_checkpoint(prefix, 3, net, arg, aux, format="mxnet")
+    # byte-level: the file must carry the reference list magic
+    with open(prefix + "-0003.params", "rb") as f:
+        assert struct.unpack("<Q", f.read(8))[0] == LIST_MAGIC
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    assert set(arg2) == set(arg) and set(aux2) == set(aux)
+    for k in arg:
+        np.testing.assert_array_equal(arg2[k].asnumpy(), arg[k].asnumpy())
+    np.testing.assert_array_equal(aux2["stat"].asnumpy(),
+                                  aux["stat"].asnumpy())
+
+
+def test_scalar_widens_to_shape1(tmp_path):
+    """0-d arrays widen to (1,) — the reference format has no 0-d (a
+    zero-ndim shape marks a 'none' array, ndarray.cc:1554), and a naive
+    full record after ndim=0 would desync every later record."""
+    p = tmp_path / "scalar.params"
+    nd.save(str(p), {"arg:w": mx.nd.array(np.float32(3.5)),
+                     "arg:after": mx.nd.array(np.arange(2, dtype=np.float32))},
+            format="mxnet")
+    loaded = nd.load(str(p))
+    assert loaded["arg:w"].shape == (1,)
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(), [3.5])
+    np.testing.assert_array_equal(loaded["arg:after"].asnumpy(), [0., 1.])
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "junk.params"
+    p.write_bytes(b"\x00" * 64)
+    with pytest.raises(ValueError):
+        nd.load(str(p))
