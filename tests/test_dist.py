@@ -102,16 +102,42 @@ _COMPRESSED_WORKER = textwrap.dedent("""
 """ % _ROOT)
 
 
-def _launch(tmp_path, script, tag, timeout=240):
+_FAKE_SSH = '''#!/usr/bin/env python3
+"""Faithful ssh stand-in (no sshd in this image): receives the exact argv
+real ssh would — option pairs, host, remote command words joined with
+spaces and handed to the remote login shell — and executes that command
+locally via sh -c.  The launcher's quoting/env/cwd contract is exercised
+unchanged; only the transport is simulated."""
+import subprocess, sys
+args = sys.argv[1:]
+while args and args[0].startswith("-"):
+    flag = args.pop(0)
+    if flag in ("-o", "-p", "-i", "-l", "-F"):
+        args.pop(0)
+host = args.pop(0)
+with open(__file__ + ".log", "a") as f:
+    f.write(host + "\\n")
+sys.exit(subprocess.call(["/bin/sh", "-c", " ".join(args)]))
+'''
+
+
+def _launch(tmp_path, script, tag, timeout=240, launcher="local"):
     worker = tmp_path / ("worker_%s.py" % tag)
     worker.write_text(script)
     env = dict(os.environ)
     env.pop("JAX_COORDINATOR_ADDRESS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # no forced 8-device mesh in workers
+    if launcher == "ssh":
+        bindir = tmp_path / "bin"
+        bindir.mkdir(exist_ok=True)
+        shim = bindir / "ssh"
+        shim.write_text(_FAKE_SSH)
+        shim.chmod(0o755)
+        env["PATH"] = "%s%s%s" % (bindir, os.pathsep, env.get("PATH", ""))
     proc = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
-         "-n", "2", "--launcher", "local", sys.executable, str(worker)],
+         "-n", "2", "--launcher", launcher, sys.executable, str(worker)],
         env=env, capture_output=True, text=True, timeout=timeout)
     return proc, proc.stdout + proc.stderr
 
@@ -122,6 +148,21 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     proc, out = _launch(tmp_path, _WORKER, "sync")
     assert proc.returncode == 0, out[-3000:]
     assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-3000:]
+
+
+def test_dist_sync_kvstore_two_processes_ssh(tmp_path):
+    """The same 2-worker dist_sync convergence through `--launcher ssh`
+    against localhost (VERDICT r4 item 7; reference: the dmlc ssh tracker,
+    ci/docker/runtime_functions.sh:732).  The image ships no sshd, so a
+    faithful `ssh` shim on PATH receives the launcher's real ssh argv and
+    runs the remote command locally — quoting, env handshake and cwd all
+    cross the simulated transport."""
+    proc, out = _launch(tmp_path, _WORKER, "sync_ssh", launcher="ssh")
+    assert proc.returncode == 0, out[-3000:]
+    assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-3000:]
+    log = tmp_path / "bin" / "ssh.log"
+    assert log.exists(), "ssh shim never invoked — launcher bypassed ssh"
+    assert log.read_text().splitlines().count("localhost") == 2
 
 
 @pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",

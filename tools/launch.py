@@ -4,18 +4,25 @@
 The reference spawns N workers + N servers through the dmlc-core tracker
 (local/ssh/mpi/...).  Multi-host jax needs one *worker* process per host
 pointed at a coordinator — no servers (the PS collapses into mesh
-collectives).  This launcher reproduces the reference CLI for the local
-case: ``launch.py -n 4 --launcher local python train.py`` spawns 4
-processes with JAX distributed env wired, each seeing a slice of a CPU
-device mesh (the dist_sync_kvstore-test pattern, SURVEY.md §4).
+collectives).  This launcher reproduces the reference CLI:
 
-For real pods, GKE/metadata provides the same variables; this tool then
-only prints them (``--launcher echo``).
+- ``launch.py -n 4 --launcher local python train.py`` spawns 4 local
+  processes with JAX distributed env wired, each seeing a slice of a CPU
+  device mesh (the dist_sync_kvstore-test pattern, SURVEY.md §4).
+- ``launch.py -n 4 --launcher ssh -H hostfile python train.py`` drives
+  the same env handshake over ssh, one rank per hostfile line
+  (round-robin), mirroring the dmlc ssh tracker the reference CI
+  exercises (reference ci/docker/runtime_functions.sh:732-735,
+  dmlc-core tracker/dmlc_tracker/ssh.py): env exported on the remote
+  command line, cwd preserved, same coordinator address everywhere.
+- ``--launcher echo`` only prints the per-rank environment (real pods:
+  GKE/metadata provides the same variables).
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -29,12 +36,81 @@ def free_port():
     return port
 
 
+_LOCAL_HOSTS = {"localhost", "127.0.0.1", "::1"}
+
+
+def read_hostfile(path):
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                hosts.append(line.split()[0])  # "host [slots]" — host only
+    if not hosts:
+        raise SystemExit("hostfile %r lists no hosts" % path)
+    return hosts
+
+
+def coordinator_address(hosts):
+    """host:port for the JAX coordinator (and rank-0 PS).
+
+    Rank 0 — the process that BINDS the coordinator — runs on hosts[0],
+    so that is the address every rank must dial, not the launcher's.
+    When hosts[0] is this machine the port is probed free locally; for a
+    remote hosts[0] no probe is possible, so a high random port is used
+    (collisions are rare; pass --coordinator to pin one explicitly)."""
+    if hosts[0] in _LOCAL_HOSTS:
+        return "127.0.0.1:%d" % free_port()
+    import random
+    return "%s:%d" % (hosts[0], random.randint(20000, 59999))
+
+
+def worker_env(coordinator, n, rank, ps_port):
+    """The per-rank env handshake (shared by every launcher)."""
+    return {
+        # jax.distributed.initialize() reads these
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(n),
+        "JAX_PROCESS_ID": str(rank),
+        # reference-compatible names (kvstore scripts read these)
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_WORKER_ID": str(rank),
+        # rank-0-hosted async parameter server (kvstore dist_async)
+        "MXTPU_PS_PORT": str(ps_port),
+    }
+
+
+def ssh_command(host, env, command, cwd):
+    """One rank's ssh invocation: env exported on the remote command line
+    (a remote shell inherits nothing), cwd preserved, command exec'd —
+    the dmlc ssh tracker's contract (dmlc_tracker/ssh.py)."""
+    exports = "".join("export %s=%s; " % (k, shlex.quote(str(v)))
+                      for k, v in sorted(env.items()))
+    # `cd || exit`: a missing remote cwd must kill the rank, not silently
+    # run the worker from $HOME with wrong relative paths
+    remote = "cd %s || exit 1; %sexec %s" % (
+        shlex.quote(cwd), exports,
+        " ".join(shlex.quote(c) for c in command))
+    return ["ssh", "-o", "StrictHostKeyChecking=no",
+            "-o", "PasswordAuthentication=no", host, remote]
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed training job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("--launcher", default="local",
-                        choices=["local", "echo"])
+                        choices=["local", "ssh", "echo"])
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="one host per line (ssh launcher); every "
+                             "rank runs on localhost when omitted")
+    parser.add_argument("--coordinator", default=None,
+                        help="override the coordinator host:port all "
+                             "ranks connect to")
+    parser.add_argument("--env", action="append", default=[],
+                        help="extra K=V forwarded to every worker "
+                             "(reference launch.py --env)")
     parser.add_argument("--env-server", default=None,
                         help="unused; kept for reference CLI parity")
     parser.add_argument("command", nargs=argparse.REMAINDER)
@@ -42,33 +118,47 @@ def main():
     if not args.command:
         parser.error("no command given")
 
-    port = free_port()
-    coordinator = "127.0.0.1:%d" % port
+    hosts = (read_hostfile(args.hostfile) if args.hostfile
+             else ["localhost"] * args.num_workers)
+    if args.coordinator:
+        coordinator = args.coordinator
+    elif args.launcher == "ssh":
+        coordinator = coordinator_address(hosts)
+    else:
+        coordinator = "127.0.0.1:%d" % free_port()
     ps_port = free_port()
+    for kv in args.env:
+        if "=" not in kv:
+            parser.error("--env expects K=V, got %r" % kv)
+    extra = dict(kv.split("=", 1) for kv in args.env)
 
     if args.launcher == "echo":
         for rank in range(args.num_workers):
-            print("JAX_COORDINATOR_ADDRESS=%s JAX_NUM_PROCESSES=%d "
-                  "JAX_PROCESS_ID=%d %s" % (coordinator, args.num_workers,
-                                            rank, " ".join(args.command)))
+            env = worker_env(coordinator, args.num_workers, rank, ps_port)
+            env.update(extra)
+            print("%s %s" % (" ".join("%s=%s" % kv
+                                      for kv in sorted(env.items())),
+                             " ".join(args.command)))
         return
 
     procs = []
     for rank in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            # jax.distributed.initialize() reads these
-            "JAX_COORDINATOR_ADDRESS": coordinator,
-            "JAX_NUM_PROCESSES": str(args.num_workers),
-            "JAX_PROCESS_ID": str(rank),
-            # reference-compatible names (kvstore scripts read these)
-            "DMLC_ROLE": "worker",
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_WORKER_ID": str(rank),
-            # rank-0-hosted async parameter server (kvstore dist_async)
-            "MXTPU_PS_PORT": str(ps_port),
-        })
-        procs.append(subprocess.Popen(args.command, env=env))
+        renv = worker_env(coordinator, args.num_workers, rank, ps_port)
+        renv.update(extra)
+        if args.launcher == "ssh":
+            # remote shells inherit nothing: forward the runtime-relevant
+            # locals alongside the handshake (the dmlc tracker forwards
+            # its env lists the same way)
+            for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH"):
+                if k in os.environ and k not in renv:
+                    renv[k] = os.environ[k]
+            cmd = ssh_command(hosts[rank % len(hosts)], renv,
+                              args.command, os.getcwd())
+            procs.append(subprocess.Popen(cmd))
+        else:
+            env = dict(os.environ)
+            env.update(renv)
+            procs.append(subprocess.Popen(args.command, env=env))
     rc = 0
     for p in procs:
         p.wait()
