@@ -1,0 +1,78 @@
+"""MLP classifier trained with a multiclass hinge loss (SVMOutput).
+
+Reference: ``example/svm_mnist/svm_mnist.py`` — the only example that
+trains through ``mx.symbol.SVMOutput`` (src/operator/svm_output.cc):
+forward is identity over the scores, backward is the margin-violation
+subgradient (squared hinge by default, ``use_linear`` for L1 hinge).
+
+Synthetic stand-in for MNIST (zero-egress): class-separable gaussian
+blobs in 64-d.  Asserts both hinge variants reach high train accuracy
+through the Module/Symbol path.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_blobs(rng, n, nclass, dim):
+    centers = rng.randn(nclass, dim).astype(np.float32) * 2.0
+    y = rng.randint(0, nclass, n)
+    X = centers[y] + rng.randn(n, dim).astype(np.float32) * 0.6
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def build_net(nclass, use_linear):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=nclass, name="fc2")
+    # regularization_coefficient scales the hinge subgradient (the
+    # reference's C); label enters through the loss only
+    return mx.sym.SVMOutput(net, name="svm", margin=1.0,
+                            regularization_coefficient=1.0,
+                            use_linear=use_linear)
+
+
+def train_one(use_linear, X, y, nclass, epochs, batch):
+    it = mx.io.NDArrayIter(X, y, batch, shuffle=True, shuffle_seed=1,
+                           label_name="svm_label")
+    mod = mx.mod.Module(build_net(nclass, use_linear),
+                        label_names=("svm_label",))
+    mod.fit(it, num_epoch=epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9})
+    it.reset()
+    correct = total = 0
+    for b in it:
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(1)
+        lab = b.label[0].asnumpy()[: len(pred)]
+        correct += int((pred[: len(lab)] == lab).sum())
+        total += len(lab)
+    return correct / total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    nclass, dim = 8, 64
+    X, y = make_blobs(rng, args.n, nclass, dim)
+
+    acc_sq = train_one(False, X, y, nclass, args.epochs, args.batch)
+    acc_l1 = train_one(True, X, y, nclass, args.epochs, args.batch)
+    print("train acc: squared hinge %.3f | linear hinge %.3f"
+          % (acc_sq, acc_l1))
+    assert acc_sq > 0.9, "squared-hinge SVM failed to learn: %.3f" % acc_sq
+    assert acc_l1 > 0.9, "linear-hinge SVM failed to learn: %.3f" % acc_l1
+
+
+if __name__ == "__main__":
+    main()

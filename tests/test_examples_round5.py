@@ -1,0 +1,71 @@
+"""Round-5 example families run end-to-end and learn (VERDICT r4 item 6:
+SGLD, dsd, svm_mnist, deep-embedded-clustering, memcost, captcha,
+multivariate_time_series, cnn_visualization — each exercises an
+already-implemented op/optimizer/feature that previously had no
+end-to-end user)."""
+import importlib.util
+import os
+import sys
+
+_EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(path, argv):
+    spec = importlib.util.spec_from_file_location(
+        "ex5_mod_%s" % os.path.basename(path).replace(".", "_"), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    saved = sys.argv
+    sys.argv = ["x"] + argv
+    try:
+        mod.main()   # each example asserts its own learning criterion
+    finally:
+        sys.argv = saved
+
+
+def test_svm_mnist_example():
+    """SVMOutput end-to-end through Module.fit, both hinge variants."""
+    _run(os.path.join(_EXAMPLES, "svm_mnist", "train_svm.py"),
+         ["--epochs", "5"])
+
+
+def test_sgld_example():
+    """SGLD samples the exact conjugate posterior, not just the MAP."""
+    _run(os.path.join(_EXAMPLES, "bayesian_methods", "sgld_regression.py"),
+         ["--steps", "2500", "--burnin", "800"])
+
+
+def test_dsd_example():
+    """Dense->Sparse->Dense keeps sparsity in phase 2 and final accuracy."""
+    _run(os.path.join(_EXAMPLES, "dsd", "train_dsd.py"),
+         ["--epochs", "4"])
+
+
+def test_dec_example():
+    """DEC beats raw-space kmeans via the learned embedding."""
+    _run(os.path.join(_EXAMPLES, "deep_embedded_clustering", "dec.py"),
+         ["--pretrain-epochs", "10", "--dec-iters", "50"])
+
+
+def test_memcost_remat_example():
+    """remat shrinks XLA temp buffers and preserves numerics."""
+    _run(os.path.join(_EXAMPLES, "memcost", "remat_demo.py"),
+         ["--steps", "12"])
+
+
+def test_captcha_example():
+    """Multi-head OCR: per-char and full-string accuracy."""
+    _run(os.path.join(_EXAMPLES, "captcha", "train_captcha.py"),
+         ["--epochs", "6", "--n", "640"])
+
+
+def test_lstnet_example():
+    """LSTNet conv+GRU+AR-highway beats the naive forecaster."""
+    _run(os.path.join(_EXAMPLES, "multivariate_time_series", "lstnet.py"),
+         ["--epochs", "8"])
+
+
+def test_gradcam_example():
+    """Grad-CAM localizes the class-information quadrant."""
+    _run(os.path.join(_EXAMPLES, "cnn_visualization", "gradcam_demo.py"),
+         ["--epochs", "5", "--eval-images", "48"])
