@@ -50,6 +50,7 @@ from . import symbol as sym
 from .symbol import Symbol
 from . import executor
 from .executor import Executor
+from . import analysis
 from . import autograd
 from . import random
 from . import initializer

@@ -1,0 +1,55 @@
+"""mxnet_tpu.analysis — "mxlint", static graph/registry analysis.
+
+The reference stack proves graph attributes with dedicated nnvm passes
+(``src/executor/infer_graph_attr_pass.cc``); the JAX reproduction had no
+analogue, so a malformed op registration or a recompile-forcing pattern
+only failed deep inside ``jax.jit``.  This package closes that gap with
+three cooperating passes:
+
+- **registry lint** (:mod:`.registry_lint`): per-op metadata vs. the real
+  fn signature — slot counts/order, scalar/optional/aux/mutates indices,
+  ``num_outputs`` totality, alias shadowing, docstrings, test coverage;
+- **graph lint** (:mod:`.graph_lint`): whole-Symbol checks — dead
+  outputs, gradient-cutting ops on loss paths, aux misuse, float64
+  promotion, static reshapes, oversized baked-in constants;
+- **source lint** (:mod:`.source_lint`): AST heuristics over driver
+  scripts for trace-time scalar captures and shape-dependent branching.
+
+Entry points: ``python -m mxnet_tpu.analysis`` (CLI), ``Symbol.lint()``,
+``Module.lint()`` and ``Executor.simple_bind(..., lint=True)``.
+"""
+from __future__ import annotations
+
+from .findings import (Finding, RULES, ERROR, WARNING, INFO,
+                       filter_findings, suppressed_rules)
+from .registry_lint import lint_registry, unique_ops
+from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
+from .source_lint import lint_source, lint_file
+from .coverage import load_test_map, generate_coverage_md
+from .report import render_text, render_json, exit_code, worst_severity
+
+__all__ = [
+    "Finding", "RULES", "ERROR", "WARNING", "INFO",
+    "lint_registry", "lint_graph", "lint_source", "lint_file",
+    "lint_symbol", "self_check", "load_test_map", "generate_coverage_md",
+    "render_text", "render_json", "exit_code", "worst_severity",
+    "filter_findings", "suppressed_rules", "unique_ops",
+    "LOSS_OPS", "LARGE_CONST_BYTES",
+]
+
+
+def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
+                check_consts=True):
+    """Graph-lint a Symbol (the ``Symbol.lint()`` implementation)."""
+    return lint_graph(symbol, shapes=shapes, type_dict=type_dict,
+                      disable=disable, check_consts=check_consts)
+
+
+def self_check(disable=(), with_coverage=True):
+    """Registry lint over the live registry — what CI runs.
+
+    Returns the findings list; clean means the shipped registry is sound
+    (every severity counts: ``--self-check`` exits non-zero on warnings).
+    """
+    coverage_map = load_test_map() if with_coverage else None
+    return lint_registry(coverage_map=coverage_map, disable=disable)

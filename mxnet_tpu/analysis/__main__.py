@@ -1,0 +1,110 @@
+"""CLI: ``python -m mxnet_tpu.analysis [target] [options]``.
+
+Targets:
+  ``--self-check``        registry lint over the live registry (CI tier-1)
+  ``--coverage``          regenerate tests/OP_COVERAGE.md from the registry
+                          + test map; fails if any op has zero coverage
+  ``script.py``           AST source lint for trace-time traps
+  ``symbol.json``         graph lint a saved Symbol (``Symbol.save``)
+
+Options:
+  ``--json``              machine-readable output (schema in docs/analysis.md)
+  ``--strict``            exit 1 on warnings (default for --self-check)
+  ``--disable R1,R2``     mute rules globally
+  ``--shapes "data=(1,3,224,224),label=(1,)"``
+                          argument shapes for the graph pass (enables the
+                          large-constant trace check)
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import sys
+
+
+def _parse_shapes(text):
+    if not text:
+        return None
+    out = {}
+    # "name=(1,2),other=(3,)" — split on commas not inside parens
+    depth, start, parts = 0, 0, []
+    for i, ch in enumerate(text):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(text[start:i])
+            start = i + 1
+    parts.append(text[start:])
+    for part in parts:
+        if not part.strip():
+            continue
+        name, _, val = part.partition("=")
+        out[name.strip()] = tuple(ast.literal_eval(val.strip()))
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="mxlint: static graph/registry linter for mxnet_tpu")
+    p.add_argument("target", nargs="?",
+                   help="a .py script (source lint) or .json symbol "
+                        "(graph lint)")
+    p.add_argument("--self-check", action="store_true",
+                   help="registry lint over the live registry")
+    p.add_argument("--coverage", action="store_true",
+                   help="regenerate tests/OP_COVERAGE.md and fail on "
+                        "uncovered ops")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on warnings too")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rule ids to mute")
+    p.add_argument("--shapes", default="",
+                   help="arg shapes for graph lint, e.g. "
+                        "\"data=(1,3,224,224)\"")
+    p.add_argument("--no-consts", action="store_true",
+                   help="skip the trace-based large-constant check")
+    args = p.parse_args(argv)
+
+    from . import (self_check, lint_file, lint_symbol, generate_coverage_md,
+                   render_text, render_json, exit_code)
+    disable = tuple(r.strip() for r in args.disable.split(",") if r.strip())
+
+    if args.coverage:
+        rows, uncovered = generate_coverage_md()
+        n = len(rows)
+        print("OP_COVERAGE.md: %d ops, %d uncovered" % (n, len(uncovered)))
+        for name in uncovered:
+            print("  NOT COVERED: %s" % name)
+        return 1 if uncovered else 0
+
+    if args.self_check:
+        findings = self_check(disable=disable)
+        print(render_json(findings) if args.as_json
+              else render_text(findings, title="mxlint --self-check"))
+        # the shipped registry must be clean: warnings fail too
+        return exit_code(findings, strict=True)
+
+    if not args.target:
+        p.error("give a target script/symbol, --self-check, or --coverage")
+
+    if args.target.endswith(".json"):
+        from ..symbol import load
+        sym = load(args.target)
+        findings = lint_symbol(sym, shapes=_parse_shapes(args.shapes),
+                               disable=disable,
+                               check_consts=not args.no_consts)
+        title = "mxlint graph %s" % args.target
+    else:
+        findings = lint_file(args.target, disable=disable)
+        title = "mxlint source %s" % args.target
+    print(render_json(findings) if args.as_json
+          else render_text(findings, title=title))
+    return exit_code(findings, strict=args.strict)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Test-coverage map: which test exercises each registered op.
+
+The sweep cases live in ``tests/test_op_sweep.py`` (``CASES`` +
+``ALSO_COVERED``); this module loads them without pytest, builds the
+{op_name: coverage description} map REG010 lints against, and generates
+``tests/OP_COVERAGE.md`` (``python -m mxnet_tpu.analysis --coverage``) —
+the table is a build artifact of the registry + test map, never
+hand-maintained.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from ..ops import registry as _reg
+from .registry_lint import unique_ops
+
+__all__ = ["find_tests_dir", "load_test_map", "generate_coverage_md",
+           "build_rows"]
+
+_TEST_MOD_NAME = "_mxlint_op_sweep_map"
+
+
+def find_tests_dir(start=None):
+    """Locate the repo's tests/ directory by walking up from this package
+    (site-installs without the test tree return None; REG010 then skips)."""
+    here = start or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for _ in range(4):
+        cand = os.path.join(here, "tests")
+        if os.path.isfile(os.path.join(cand, "test_op_sweep.py")):
+            return cand
+        here = os.path.dirname(here)
+    return None
+
+
+def _load_sweep_module(tests_dir):
+    if _TEST_MOD_NAME in sys.modules:
+        return sys.modules[_TEST_MOD_NAME]
+    path = os.path.join(tests_dir, "test_op_sweep.py")
+    spec = importlib.util.spec_from_file_location(_TEST_MOD_NAME, path)
+    mod = importlib.util.module_from_spec(spec)
+    # registered under a private name so pytest's own import of
+    # tests.test_op_sweep is not clobbered; tests_dir goes on sys.path for
+    # the sweep's sibling imports (op_sweep_deep_cases)
+    sys.modules[_TEST_MOD_NAME] = mod
+    # the sweep's sibling (op_sweep_deep_cases) does `from test_op_sweep
+    # import ...`; alias the real name too so that import resolves to this
+    # very module instead of restarting the import cycle
+    alias_real = "test_op_sweep" not in sys.modules
+    if alias_real:
+        sys.modules["test_op_sweep"] = mod
+    sys.path.insert(0, tests_dir)
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(_TEST_MOD_NAME, None)
+        if alias_real:
+            sys.modules.pop("test_op_sweep", None)
+        raise
+    finally:
+        sys.path.remove(tests_dir)
+    return mod
+
+
+def load_test_map(tests_dir=None):
+    """{op_name: coverage description} or None when tests aren't present."""
+    tests_dir = tests_dir or find_tests_dir()
+    if tests_dir is None:
+        return None
+    try:
+        mod = _load_sweep_module(tests_dir)
+    except Exception:
+        return None
+    return build_map(mod.CASES, mod.ALSO_COVERED)
+
+
+def build_map(cases, also_covered):
+    out = {}
+    for name, case_list in cases.items():
+        out[name] = "sweep (%d cases)" % len(case_list)
+    for name, where in also_covered.items():
+        out.setdefault(name, where)
+    return out
+
+
+def lookup(coverage_map, op, registry=None):
+    """Coverage entry for ``op``, matching any of its registered aliases
+    (sweep cases are keyed by whichever name the sweep exercises)."""
+    registry = registry or _reg
+    if op.name in coverage_map:
+        return coverage_map[op.name]
+    for name in registry.list_ops():
+        if registry.get(name) is op and name in coverage_map:
+            where = coverage_map[name]
+            return "%s (as %s)" % (where, name) if "(as " not in where \
+                else where
+    return None
+
+
+def build_rows(cases, also_covered, registry=None):
+    """[(op, coverage)] over unique ops + the uncovered subset."""
+    registry = registry or _reg
+    cov = build_map(cases, also_covered)
+    rows, uncovered = [], []
+    for name, op in sorted(unique_ops(registry).items()):
+        where = lookup(cov, op, registry)
+        if where is None:
+            rows.append((name, "NOT COVERED"))
+            uncovered.append(name)
+        else:
+            rows.append((name, where))
+    return rows, uncovered
+
+
+def generate_coverage_md(path=None, cases=None, also_covered=None,
+                         registry=None):
+    """Write tests/OP_COVERAGE.md; returns (rows, uncovered).
+
+    ``cases``/``also_covered`` default to the live test map (loaded from
+    tests/test_op_sweep.py); the coverage test passes its own so the file
+    it asserts on is built from the module pytest actually collected.
+    """
+    registry = registry or _reg
+    if cases is None or also_covered is None:
+        tests_dir = find_tests_dir()
+        if tests_dir is None:
+            raise RuntimeError("tests/test_op_sweep.py not found; cannot "
+                               "build the coverage map")
+        mod = _load_sweep_module(tests_dir)
+        cases = cases if cases is not None else mod.CASES
+        also_covered = also_covered if also_covered is not None \
+            else mod.ALSO_COVERED
+    rows, uncovered = build_rows(cases, also_covered, registry)
+    if path is None:
+        path = os.path.join(find_tests_dir(), "OP_COVERAGE.md")
+    n_sweep = len([r for r in rows if r[1].startswith("sweep")])
+    n_dedicated = len(rows) - n_sweep - len(uncovered)
+    with open(path, "w") as f:
+        f.write("# Operator test coverage\n\n")
+        f.write("Generated by `python -m mxnet_tpu.analysis --coverage` "
+                "— do not edit by hand.\n\n")
+        f.write("%d unique ops (%d registered names); %d swept, %d covered "
+                "by dedicated files, %d uncovered.\n\n"
+                % (len(rows), len(registry.list_ops()), n_sweep,
+                   n_dedicated, len(uncovered)))
+        f.write("| op | covered by |\n|---|---|\n")
+        for name, where in rows:
+            f.write("| %s | %s |\n" % (name, where))
+    return rows, uncovered

@@ -1,0 +1,129 @@
+"""Finding records and rule metadata for mxlint.
+
+The reference stack validates graphs with dedicated nnvm passes
+(``src/executor/infer_graph_attr_pass.cc`` fixpoints, op registration
+checks in ``nnvm/src/core/op.cc``); here every check is a pure function
+over the registry / Symbol DAG that emits structured ``Finding`` records
+instead of aborting, so tooling (CLI, CI, ``Executor.simple_bind(lint=
+True)``) can decide how hard to fail.
+"""
+from __future__ import annotations
+
+import inspect
+import re
+
+__all__ = ["Finding", "ERROR", "WARNING", "INFO", "RULES", "severity_rank",
+           "suppressed_rules", "filter_findings"]
+
+# severity levels, ordered: findings at ERROR break binding/CI, WARNING
+# fails --self-check (the shipped registry must be clean), INFO is advice
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEV_RANK = {INFO: 0, WARNING: 1, ERROR: 2}
+
+
+def severity_rank(sev):
+    return _SEV_RANK[sev]
+
+
+# rule_id -> (default severity, one-line description).  docs/analysis.md
+# is the user-facing companion of this table; keep the two in sync.
+RULES = {
+    # registry pass (mxnet_tpu/analysis/registry_lint.py)
+    "REG001": (ERROR, "fn cannot accept every declared tensor slot "
+                      "(arg_names + aux) positionally"),
+    "REG002": (ERROR, "arg_names order contradicts fn's positional "
+                      "parameter order"),
+    "REG003": (ERROR, "scalar_args entry is not a keyword parameter of fn "
+                      "(or collides with a tensor slot)"),
+    "REG004": (ERROR, "optional_args entry names no tensor slot, or the "
+                      "optional_args callable is not total over defaults"),
+    "REG005": (ERROR, "aux input indices are not the contiguous range "
+                      "following arg_names"),
+    "REG006": (ERROR, "mutates maps an out-of-range input or fn-output "
+                      "index"),
+    "REG007": (ERROR, "num_outputs callable is not total over fn's "
+                      "registered defaults"),
+    "REG008": (ERROR, "alias/registration shadows a different op"),
+    "REG009": (WARNING, "op has no docstring"),
+    "REG010": (WARNING, "op has no entry in the test-coverage map"),
+    "REG011": (WARNING, "fn_params introspection failed; positional "
+                        "scalar args will map onto arg_names blindly"),
+    # graph pass (mxnet_tpu/analysis/graph_lint.py)
+    "GRF001": (WARNING, "op output is never consumed and is not a head "
+                        "(dead subgraph)"),
+    "GRF002": (ERROR, "non-differentiable op sits between a trainable "
+                      "argument and a loss head (gradient is cut)"),
+    "GRF003": (WARNING, "auxiliary state is read through a non-aux input "
+                        "slot (value silently differs train vs. infer)"),
+    "GRF004": (WARNING, "float64 appears through dtype promotion from "
+                        "narrower inputs (weak-type surprise)"),
+    "GRF005": (WARNING, "Reshape bakes a fully-static target shape; any "
+                        "batch-size change breaks or recompiles"),
+    "GRF006": (WARNING, "constant folded into the compiled graph exceeds "
+                        "the size threshold (bloats every executable)"),
+    # source pass (mxnet_tpu/analysis/source_lint.py)
+    "SRC001": (WARNING, "python scalar capture of array data "
+                        "(.item()/.asscalar()/int()/float()) forces a "
+                        "trace-time sync and bakes the value in"),
+    "SRC002": (WARNING, "python branch on a runtime shape retraces per "
+                        "shape (recompile on every new input geometry)"),
+}
+
+
+class Finding:
+    """One lint finding: ``(rule_id, severity, subject, message)``.
+
+    ``subject`` names what the finding is about — an op name for the
+    registry pass, a node name for the graph pass, ``file:line`` for the
+    source pass.
+    """
+    __slots__ = ("rule_id", "severity", "subject", "message")
+
+    def __init__(self, rule_id, subject, message, severity=None):
+        if rule_id not in RULES:
+            raise ValueError("unknown rule_id %r" % (rule_id,))
+        self.rule_id = rule_id
+        self.severity = severity or RULES[rule_id][0]
+        self.subject = subject
+        self.message = message
+
+    def as_dict(self):
+        return {"rule": self.rule_id, "severity": self.severity,
+                "subject": self.subject, "message": self.message}
+
+    def __repr__(self):
+        return "Finding(%s, %s, %s)" % (self.rule_id, self.subject,
+                                        self.severity)
+
+    def __str__(self):
+        return "%-7s %s  %s: %s" % (self.severity.upper(), self.rule_id,
+                                    self.subject, self.message)
+
+
+# ---------------------------------------------------------------------------
+# per-op suppression: a ``# mxlint: disable=REG009,GRF005`` comment anywhere
+# in the op fn's source (decorator lines included) mutes those rules for
+# that op, mirroring pylint's inline pragmas.
+# ---------------------------------------------------------------------------
+_DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Z0-9,\s]+)")
+
+
+def suppressed_rules(fn):
+    """Rule ids disabled via ``# mxlint: disable=...`` in fn's source."""
+    try:
+        src = inspect.getsource(fn)
+    except (OSError, TypeError):
+        return frozenset()
+    out = set()
+    for m in _DISABLE_RE.finditer(src):
+        out.update(r.strip() for r in m.group(1).split(",") if r.strip())
+    return frozenset(out)
+
+
+def filter_findings(findings, disable=()):
+    """Drop findings whose rule_id is in ``disable``."""
+    disable = set(disable)
+    return [f for f in findings if f.rule_id not in disable]

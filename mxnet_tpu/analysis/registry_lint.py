@@ -1,0 +1,187 @@
+"""Registry lint: static consistency checks over every registered Op.
+
+Reference: nnvm asserts these invariants at registration time (op.cc) or
+lets them explode inside ``jax.jit`` here — a wrong ``arg_names`` order
+produces silently-transposed operands, an out-of-range ``aux`` index
+corrupts the executor's input packing, and a partial ``num_outputs``
+callable kills ``Symbol.list_outputs``.  This pass proves them all before
+any trace runs.
+"""
+from __future__ import annotations
+
+import inspect
+
+from ..ops import registry as _reg
+from .findings import Finding, suppressed_rules, filter_findings
+
+__all__ = ["lint_registry", "unique_ops"]
+
+
+def unique_ops(registry=None):
+    """{canonical_name: Op} over unique implementations (aliases folded)."""
+    registry = registry or _reg
+    seen = {}
+    for name in registry.list_ops():
+        op = registry.get(name)
+        if id(op) not in seen:
+            seen[id(op)] = op
+    return {op.name: op for op in seen.values()}
+
+
+def _fn_signature(fn):
+    """(positional names, keyword-accepted names, has *args, has **kw) or
+    None when introspection fails even through partial/wrapped chains."""
+    for candidate in (fn, getattr(fn, "func", None),
+                      getattr(fn, "__wrapped__", None)):
+        if candidate is None:
+            continue
+        try:
+            sig = inspect.signature(candidate)
+        except (TypeError, ValueError):
+            continue
+        params = list(sig.parameters.values())
+        pos = [p.name for p in params
+               if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+        kw = {p.name for p in params
+              if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)}
+        has_var = any(p.kind == p.VAR_POSITIONAL for p in params)
+        has_kw = any(p.kind == p.VAR_KEYWORD for p in params)
+        return pos, kw, has_var, has_kw
+    return None
+
+
+def _fn_defaults(fn):
+    """Keyword defaults of fn — the 'registered defaults' that num_outputs/
+    optional_args callables must be total over."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return {}
+    return {p.name: p.default for p in sig.parameters.values()
+            if p.default is not p.empty}
+
+
+def _lint_op(op):
+    out = []
+    slots = list(op.arg_names) + [op.aux[k] for k in sorted(op.aux)]
+    variadic = op.arg_names == ["args"]
+    sig = _fn_signature(op.fn)
+
+    if op.fn_params_fallback or sig is None:
+        out.append(Finding("REG011", op.name,
+                           "could not introspect fn %r; scalar positional "
+                           "args map onto arg_names %r as a guess"
+                           % (op.fn, op.arg_names)))
+
+    if sig is not None:
+        pos, kw, has_var, has_kw = sig
+        if variadic:
+            if not has_var:
+                out.append(Finding("REG001", op.name,
+                                   "variadic op (arg_names=['args']) but fn "
+                                   "has no *args parameter"))
+        else:
+            if not has_var and len(pos) < len(slots):
+                out.append(Finding("REG001", op.name,
+                                   "fn takes %d positional parameters %r but "
+                                   "%d tensor slots are declared %r"
+                                   % (len(pos), pos, len(slots), slots)))
+            # slot names that fn also uses must keep their relative order —
+            # a swap means bound tensors land in transposed parameters
+            inter = [n for n in slots if n in pos]
+            idx = [pos.index(n) for n in inter]
+            if idx != sorted(idx):
+                out.append(Finding("REG002", op.name,
+                                   "declared slot order %r contradicts fn "
+                                   "parameter order %r" % (slots, pos)))
+        for s in op.scalar_args:
+            if s in slots:
+                out.append(Finding("REG003", op.name,
+                                   "scalar_args entry %r is also a tensor "
+                                   "slot" % (s,)))
+            elif s not in kw and not has_kw:
+                out.append(Finding("REG003", op.name,
+                                   "scalar_args entry %r is not a keyword "
+                                   "parameter of fn (params: %r)"
+                                   % (s, sorted(kw))))
+
+    defaults = _fn_defaults(op.fn)
+    if callable(op.optional_args):
+        try:
+            opt = set(op.optional_args(defaults))
+        except Exception as e:
+            opt = None
+            out.append(Finding("REG004", op.name,
+                               "optional_args callable raised %s: %s over "
+                               "registered defaults" % (type(e).__name__, e)))
+    else:
+        opt = set(op.optional_args)
+    if opt and not variadic:
+        bad = sorted(opt - set(slots))
+        if bad:
+            out.append(Finding("REG004", op.name,
+                               "optional_args %r name no declared tensor "
+                               "slot %r" % (bad, slots)))
+
+    aux_keys = sorted(op.aux)
+    want = list(range(len(op.arg_names), len(op.arg_names) + len(op.aux)))
+    if aux_keys and aux_keys != want:
+        out.append(Finding("REG005", op.name,
+                           "aux indices %r must be the contiguous range %r "
+                           "after arg_names (input packing order)"
+                           % (aux_keys, want)))
+
+    try:
+        n_out = op.n_outputs(defaults)
+        if not isinstance(n_out, int) or n_out < 1:
+            out.append(Finding("REG007", op.name,
+                               "num_outputs(defaults) returned %r, expected "
+                               "a positive int" % (n_out,)))
+            n_out = 1
+    except Exception as e:
+        out.append(Finding("REG007", op.name,
+                           "num_outputs callable raised %s: %s over "
+                           "registered defaults" % (type(e).__name__, e)))
+        n_out = 1
+
+    # mutated fn outputs sit after the public ones (see Op docstring)
+    total_outs = n_out + len(op.mutates)
+    for in_idx, out_idx in op.mutates.items():
+        if not 0 <= in_idx < len(slots):
+            out.append(Finding("REG006", op.name,
+                               "mutates input index %d out of range for %d "
+                               "tensor slots" % (in_idx, len(slots))))
+        if not 0 <= out_idx < total_outs:
+            out.append(Finding("REG006", op.name,
+                               "mutates fn-output index %d out of range "
+                               "(%d public + %d mutated outputs)"
+                               % (out_idx, n_out, len(op.mutates))))
+
+    if not op.doc.strip():
+        out.append(Finding("REG009", op.name, "op has no docstring"))
+    return out
+
+
+def lint_registry(registry=None, coverage_map=None, disable=()):
+    """Run every registry rule over every unique op.
+
+    ``coverage_map``: {op_name: description} enabling REG010 (pass
+    ``mxnet_tpu.analysis.coverage.load_test_map()``); None skips the rule.
+    """
+    registry = registry or _reg
+    findings = []
+    for name, op in sorted(unique_ops(registry).items()):
+        per_op = _lint_op(op)
+        if coverage_map is not None:
+            from .coverage import lookup
+            if lookup(coverage_map, op, registry) is None:
+                per_op.append(Finding("REG010", op.name,
+                                      "no sweep case or dedicated test "
+                                      "file claims this op"))
+        muted = suppressed_rules(op.fn)
+        findings.extend(f for f in per_op if f.rule_id not in muted)
+    for name, old, new in getattr(registry, "shadowed", lambda: [])():
+        findings.append(Finding("REG008", name,
+                                "registration of %r overwrote %r already "
+                                "bound to this name" % (new, old)))
+    return filter_findings(findings, disable)

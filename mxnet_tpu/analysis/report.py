@@ -1,0 +1,56 @@
+"""Reporters: render Findings as text or JSON.
+
+The JSON schema is stable tooling surface (documented in
+docs/analysis.md): ``{"version": 1, "findings": [{"rule", "severity",
+"subject", "message"}], "counts": {severity: n}}``.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+from .findings import ERROR, WARNING, severity_rank
+
+__all__ = ["render_text", "render_json", "worst_severity", "exit_code"]
+
+
+def _sorted(findings):
+    return sorted(findings, key=lambda f: (-severity_rank(f.severity),
+                                           f.rule_id, f.subject))
+
+
+def render_text(findings, title="mxlint"):
+    if not findings:
+        return "%s: clean (0 findings)" % title
+    lines = ["%s: %d finding(s)" % (title, len(findings))]
+    lines += ["  %s" % f for f in _sorted(findings)]
+    counts = Counter(f.severity for f in findings)
+    lines.append("  -- %s" % ", ".join(
+        "%d %s" % (counts[s], s) for s in (ERROR, WARNING, "info")
+        if counts[s]))
+    return "\n".join(lines)
+
+
+def render_json(findings):
+    counts = Counter(f.severity for f in findings)
+    return json.dumps({
+        "version": 1,
+        "findings": [f.as_dict() for f in _sorted(findings)],
+        "counts": dict(counts),
+    }, indent=2)
+
+
+def worst_severity(findings):
+    if not findings:
+        return None
+    return max((f.severity for f in findings), key=severity_rank)
+
+
+def exit_code(findings, strict=False):
+    """2 on errors, 1 on warnings when strict (self-check/CI), else 0."""
+    worst = worst_severity(findings)
+    if worst == ERROR:
+        return 2
+    if worst == WARNING and strict:
+        return 1
+    return 0

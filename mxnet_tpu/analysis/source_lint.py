@@ -1,0 +1,107 @@
+"""Source lint: AST checks over user scripts for trace-time traps.
+
+A Symbol graph is static, but the python driving it is not: pulling a
+scalar out of an array (``.item()``, ``.asscalar()``, ``int(x)``) blocks
+on the device and bakes the value into the next trace, and branching on a
+runtime ``.shape`` retraces the jit cache per input geometry — the exact
+recompile bugs ``jax.jit`` only reveals as slowness.  These rules are
+heuristic (python is dynamic); they point at lines worth reading, they do
+not prove bugs.
+"""
+from __future__ import annotations
+
+import ast
+
+from .findings import Finding, filter_findings
+
+__all__ = ["lint_source", "lint_file"]
+
+# method calls that materialize device data into python scalars
+_SYNC_METHODS = {"item", "asscalar", "asnumpy", "tolist"}
+# builtins that, applied to array expressions, capture a python scalar
+_CAST_BUILTINS = {"int", "float", "bool"}
+
+
+def _contains_shape(node):
+    return any(isinstance(sub, ast.Attribute) and sub.attr in ("shape", "size",
+                                                               "ndim")
+               for sub in ast.walk(node))
+
+
+def _is_arrayish(node):
+    """Conservative guess that an expression produces array data: a call
+    result, subscript, or attribute chain — not a bare literal/name."""
+    return isinstance(node, (ast.Call, ast.Subscript, ast.Attribute,
+                             ast.BinOp))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, filename):
+        self.filename = filename
+        self.findings = []
+        self.suppressed = {}   # lineno -> set(rule_ids), filled by caller
+
+    def _emit(self, rule, node, msg):
+        muted = self.suppressed.get(node.lineno, ())
+        if rule not in muted:
+            self.findings.append(Finding(
+                rule, "%s:%d" % (self.filename, node.lineno), msg))
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+            self._emit("SRC001", node,
+                       ".%s() synchronizes with the device and captures a "
+                       "python value; inside a training loop this blocks "
+                       "dispatch and can force retraces" % fn.attr)
+        elif isinstance(fn, ast.Name) and fn.id in _CAST_BUILTINS and \
+                node.args and _is_arrayish(node.args[0]) and \
+                not _contains_shape(node.args[0]):
+            self._emit("SRC001", node,
+                       "%s(...) of an array expression captures a python "
+                       "scalar at trace time; the traced graph bakes this "
+                       "value in" % fn.id)
+        self.generic_visit(node)
+
+    def _check_branch(self, node, kind):
+        if _contains_shape(node.test):
+            self._emit("SRC002", node,
+                       "%s on a runtime .shape/.size/.ndim: each distinct "
+                       "geometry traces a new program; prefer shape codes "
+                       "(0/-1) or pad to a fixed bucket" % kind)
+
+    def visit_If(self, node):
+        self._check_branch(node, "if-branch")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while-loop")
+        self.generic_visit(node)
+
+
+def _line_suppressions(source):
+    """{lineno: rule_ids} for ``# mxlint: disable=...`` trailing comments."""
+    from .findings import _DISABLE_RE
+    out = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def lint_source(source, filename="<string>", disable=()):
+    """Lint python source text; returns a list of Findings."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        raise ValueError("cannot parse %s: %s" % (filename, e))
+    v = _Visitor(filename)
+    v.suppressed = _line_suppressions(source)
+    v.visit(tree)
+    return filter_findings(v.findings, disable)
+
+
+def lint_file(path, disable=()):
+    with open(path) as f:
+        return lint_source(f.read(), filename=path, disable=disable)

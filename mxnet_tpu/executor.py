@@ -212,8 +212,23 @@ class Executor:
     # ------------------------------------------------------------------
     @classmethod
     def simple_bind(cls, symbol, ctx=None, grad_req="write", type_dict=None,
-                    shapes=None, data_names=None, group2ctx=None):
+                    shapes=None, data_names=None, group2ctx=None,
+                    lint=False):
         shapes = shapes or {}
+        if lint:
+            # opt-in static pass (mxnet_tpu.analysis) before any trace:
+            # error findings abort the bind, warnings go through warnings
+            from .analysis import ERROR as _LINT_ERROR
+            from .analysis import lint_symbol, render_text
+            findings = lint_symbol(symbol, shapes=shapes,
+                                   type_dict=type_dict)
+            errors = [f for f in findings if f.severity == _LINT_ERROR]
+            if errors:
+                raise MXNetError("simple_bind lint failed:\n%s"
+                                 % render_text(errors))
+            if findings:
+                import warnings
+                warnings.warn("simple_bind lint:\n%s" % render_text(findings))
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
         if arg_shapes is None:
             raise MXNetError(

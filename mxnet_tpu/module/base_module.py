@@ -68,6 +68,22 @@ class BaseModule:
     def symbol(self):
         return self._symbol
 
+    def lint(self, disable=(), check_consts=True):
+        """Static graph lint of this module's symbol (mxnet_tpu.analysis).
+
+        Uses the bound data/label shapes when available so the
+        trace-based checks (oversized constants) can run; callable before
+        bind too, with the shape-dependent rules skipped."""
+        if self._symbol is None:
+            raise MXNetError("module has no symbol to lint")
+        shapes = {}
+        for desc in (getattr(self, "_data_shapes", None) or []):
+            shapes[desc.name] = desc.shape
+        for desc in (getattr(self, "_label_shapes", None) or []):
+            shapes[desc.name] = desc.shape
+        return self._symbol.lint(shapes=shapes or None, disable=disable,
+                                 check_consts=check_consts)
+
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
         self.backward()

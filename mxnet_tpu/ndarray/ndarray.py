@@ -429,6 +429,8 @@ class NDArray:
 
 @_reg.register("_getitem")
 def _getitem_op(data, _key=None):
+    """Basic/advanced indexing kernel behind NDArray.__getitem__ (reference:
+    python/mxnet/ndarray/ndarray.py slicing)."""
     return data[_key]
 
 

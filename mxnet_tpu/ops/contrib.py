@@ -432,6 +432,8 @@ def fft(data, compute_size=128):
 
 @register("_contrib_ifft", arg_names=["data"], aliases=("ifft",))
 def ifft(data, compute_size=128):
+    """Inverse FFT over the last axis in interleaved real/imag layout
+    (reference: src/operator/contrib/ifft.cc)."""
     n = data.shape[-1] // 2
     comp = data.reshape(data.shape[:-1] + (n, 2))
     z = comp[..., 0] + 1j * comp[..., 1]
@@ -449,6 +451,8 @@ def khatri_rao(*args):
 
 @register("_contrib_getnnz", arg_names=["data"], differentiable=False)
 def getnnz(data, axis=None):
+    """Count non-zero entries (CSR nnz analogue) (reference:
+    src/operator/contrib/nnz.cc)."""
     return jnp.sum((data != 0).astype(jnp.int64), axis=axis)
 
 
@@ -809,6 +813,8 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
           aliases=("SparseEmbedding",))
 def sparse_embedding(data, weight, input_dim=0, output_dim=0,
                      dtype="float32", deterministic=False):
+    """Embedding lookup for a row-sparse weight table (reference:
+    src/operator/tensor/indexing_op.cc SparseEmbedding)."""
     idx = data.astype(jnp.int32)
     return jnp.take(weight, idx, axis=0)
 

@@ -19,17 +19,25 @@ _f = jnp.asarray
 
 
 def _binary(name, fn, aliases=()):
-    register(name, arg_names=["lhs", "rhs"], aliases=aliases)(fn)
+    register(name, arg_names=["lhs", "rhs"], aliases=aliases,
+             doc="Elementwise binary %s(lhs, rhs) with numpy broadcasting "
+                 "(reference: src/operator/tensor/elemwise_binary_op.cc); "
+                 "XLA fuses chains into one VPU loop." % name.lstrip("_"))(fn)
 
 
 def _unary(name, fn, aliases=(), differentiable=True):
     register(name, arg_names=["data"], aliases=aliases,
-             differentiable=differentiable)(fn)
+             differentiable=differentiable,
+             doc="Elementwise unary %s(data) (reference: src/operator/"
+                 "tensor/elemwise_unary_op.cc)." % name.lstrip("_"))(fn)
 
 
 def _scalar_op(name, fn, aliases=()):
     register(name, arg_names=["data"], scalar_args=("scalar",),
-             aliases=aliases)(fn)
+             aliases=aliases,
+             doc="Elementwise %s(data, scalar) against a python scalar "
+                 "(reference: src/operator/tensor/elemwise_binary_scalar_"
+                 "op.cc)." % name.lstrip("_"))(fn)
 
 
 # -- elementwise binary (same-shape in the reference; we allow broadcasting
@@ -105,7 +113,10 @@ _scalar_op("_lesser_equal_scalar", lambda d, scalar=0.0: (d <= scalar).astype(d.
 _scalar_op("_logical_and_scalar", lambda d, scalar=0.0: jnp.logical_and(d, scalar).astype(d.dtype))
 _scalar_op("_logical_or_scalar", lambda d, scalar=0.0: jnp.logical_or(d, scalar).astype(d.dtype))
 _scalar_op("_logical_xor_scalar", lambda d, scalar=0.0: jnp.logical_xor(d, scalar).astype(d.dtype))
-register("smooth_l1", scalar_args=("scalar",))(
+register("smooth_l1", scalar_args=("scalar",),
+         doc="Smooth L1 loss kernel with transition point 1/scalar^2 "
+             "(reference: src/operator/tensor/elemwise_unary_op.cc "
+             "SmoothL1).")(
     lambda data, scalar=1.0: jnp.where(
         jnp.abs(data) < 1.0 / (scalar * scalar),
         0.5 * (scalar * data) ** 2,
@@ -169,6 +180,8 @@ def block_grad(data):
 
 @register("Cast", aliases=("cast",), scalar_args=("dtype",))
 def cast(data, dtype="float32"):
+    """Cast to `dtype` (reference: src/operator/tensor/elemwise_unary_op.cc
+    Cast)."""
     import numpy as np
     from ..base import np_dtype
     return data.astype(np_dtype(dtype))
@@ -176,6 +189,8 @@ def cast(data, dtype="float32"):
 
 @register("clip", scalar_args=("a_min", "a_max"))
 def clip(data, a_min=0.0, a_max=1.0):
+    """Clamp values into [a_min, a_max] (reference:
+    src/operator/tensor/matrix_op.cc clip)."""
     return jnp.clip(data, a_min, a_max)
 
 
@@ -226,9 +241,14 @@ def scatter_plus_scalar(data, scalar=0.0):
 
 @register("_scatter_minus_scalar")
 def scatter_minus_scalar(data, scalar=0.0):
+    """Sparse-aware scalar subtraction writing only touched rows (reference:
+    src/operator/tensor/elemwise_binary_scalar_op_basic.cc)."""
     return data - data.dtype.type(scalar)
 
 
 @register("_scatter_elemwise_div", arg_names=["lhs", "rhs"])
 def scatter_elemwise_div(lhs, rhs):
+    """Sparse-aware elementwise division used by the sparse optimizer path
+    (reference: src/operator/tensor/elemwise_binary_op_basic.cc
+    _scatter_elemwise_div)."""
     return lhs / rhs

@@ -17,11 +17,15 @@ from .registry import register
 @register("Embedding", arg_names=["data", "weight"])
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
+    """Integer-index lookup into a (input_dim, output_dim) weight table
+    (reference: src/operator/tensor/indexing_op.cc Embedding)."""
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 
 @register("take", arg_names=["a", "indices"])
 def take(a, indices, axis=0, mode="clip"):
+    """Select slices of data along `axis` by integer indices with clip/wrap
+    modes (reference: src/operator/tensor/indexing_op.cc take)."""
     idx = indices.astype(jnp.int32)
     if mode == "wrap":
         idx = jnp.mod(idx, a.shape[axis])
@@ -32,11 +36,15 @@ def take(a, indices, axis=0, mode="clip"):
 
 @register("batch_take", arg_names=["a", "indices"])
 def batch_take(a, indices):
+    """Per-row element selection: out[i] = a[i, indices[i]] (reference:
+    src/operator/tensor/indexing_op.cc batch_take)."""
     return jnp.take_along_axis(a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
 
 
 @register("pick", arg_names=["data", "index"])
 def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """Pick one element per row along `axis` by integer index (reference:
+    src/operator/tensor/broadcast_reduce_op_index.cc pick)."""
     idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
     idxe = jnp.expand_dims(idx, axis if axis >= 0 else data.ndim + axis)
     out = jnp.take_along_axis(data, idxe, axis=axis)
@@ -47,6 +55,8 @@ def pick(data, index, axis=-1, keepdims=False, mode="clip"):
 
 @register("one_hot", differentiable=False)
 def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    """Expand integer indices into one-hot vectors of `depth` (reference:
+    src/operator/tensor/indexing_op.cc one_hot)."""
     from ..base import np_dtype
     oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth))
     out = oh * (on_value - off_value) + off_value
@@ -55,12 +65,16 @@ def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
 
 @register("gather_nd", arg_names=["data", "indices"])
 def gather_nd(data, indices):
+    """Gather slices addressed by leading index tuples (reference:
+    src/operator/tensor/indexing_op.cc gather_nd)."""
     idx = tuple(indices.astype(jnp.int32))
     return data[idx]
 
 
 @register("scatter_nd", arg_names=["data", "indices"])
 def scatter_nd(data, indices, shape=()):
+    """Scatter values into a zeros tensor of `shape` by index tuples
+    (reference: src/operator/tensor/indexing_op.cc scatter_nd)."""
     out = jnp.zeros(tuple(shape), dtype=data.dtype)
     idx = tuple(indices.astype(jnp.int32))
     return out.at[idx].set(data)
@@ -68,12 +82,16 @@ def scatter_nd(data, indices, shape=()):
 
 @register("_scatter_set_nd", arg_names=["lhs", "rhs", "indices"])
 def scatter_set_nd(lhs, rhs, indices, shape=()):
+    """Indexed assignment kernel behind NDArray.__setitem__ (reference:
+    src/operator/tensor/indexing_op.cc scatter_set_nd)."""
     idx = tuple(indices.astype(jnp.int32))
     return lhs.at[idx].set(rhs)
 
 
 @register("where", arg_names=["condition", "x", "y"])
 def where(condition, x, y):
+    """Elementwise select from x/y by condition (reference:
+    src/operator/tensor/control_flow_op.cc where)."""
     return jnp.where(condition.astype(bool), x, y)
 
 
@@ -103,6 +121,8 @@ def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0
 @register("SequenceLast", arg_names=["data", "sequence_length"],
           optional_args=_seq_len_optional)
 def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    """Select the last valid step of a (seq, batch, ...) tensor per
+    sequence_length (reference: src/operator/sequence_last.cc)."""
     if not use_sequence_length or sequence_length is None:
         return jnp.take(data, -1, axis=axis)
     idx = (sequence_length.astype(jnp.int32) - 1)  # (batch,)
@@ -118,6 +138,8 @@ def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0)
 @register("SequenceReverse", arg_names=["data", "sequence_length"],
           optional_args=_seq_len_optional)
 def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    """Reverse the time axis up to sequence_length per batch element
+    (reference: src/operator/sequence_reverse.cc)."""
     if not use_sequence_length or sequence_length is None:
         return jnp.flip(data, axis=0)
     seq_len = data.shape[0]
@@ -131,5 +153,7 @@ def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis
 
 @register("sparse_retain", arg_names=["data", "indices"])
 def sparse_retain_dense(data, indices):
+    """Keep only the selected rows of a matrix, zeroing the rest (reference:
+    src/operator/tensor/sparse_retain.cc)."""
     mask = jnp.zeros((data.shape[0],), dtype=bool).at[indices.astype(jnp.int32)].set(True)
     return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)

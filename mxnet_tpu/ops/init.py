@@ -9,6 +9,8 @@ from .registry import register
 
 @register("_zeros", arg_names=[], differentiable=False)
 def zeros(shape=(), dtype="float32", ctx=None):
+    """Zeros-filled tensor of `shape` (reference:
+    src/operator/tensor/init_op.cc zeros)."""
     return jnp.zeros(shape, dtype=np_dtype(dtype or "float32"))
 
 
@@ -25,17 +27,23 @@ def state_zeros_like(ref, shape=(), batch_axis=0, dtype="float32"):
 
 @register("_ones", arg_names=[], differentiable=False)
 def ones(shape=(), dtype="float32", ctx=None):
+    """Ones-filled tensor of `shape` (reference:
+    src/operator/tensor/init_op.cc ones)."""
     return jnp.ones(shape, dtype=np_dtype(dtype or "float32"))
 
 
 @register("_full", arg_names=[], differentiable=False)
 def full(shape=(), value=0.0, dtype="float32", ctx=None):
+    """Constant-filled tensor of `shape` (reference:
+    src/operator/tensor/init_op.cc full)."""
     return jnp.full(shape, value, dtype=np_dtype(dtype or "float32"))
 
 
 @register("_arange", arg_names=[], differentiable=False)
 def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None,
            infer_range=False):
+    """Evenly spaced values in [start, stop) with step and repeat (reference:
+    src/operator/tensor/init_op.cc arange)."""
     out = jnp.arange(start, stop, step, dtype=np_dtype(dtype or "float32"))
     if repeat != 1:
         out = jnp.repeat(out, repeat)
@@ -44,10 +52,14 @@ def arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None,
 
 @register("_linspace", arg_names=[], differentiable=False)
 def linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", ctx=None):
+    """num evenly spaced samples from start to stop (reference:
+    src/operator/tensor/init_op.cc linspace)."""
     return jnp.linspace(start, stop, int(num), endpoint=endpoint,
                         dtype=np_dtype(dtype or "float32"))
 
 
 @register("_eye", arg_names=[], differentiable=False)
 def eye(N=0, M=0, k=0, dtype="float32", ctx=None):
+    """Identity-matrix constructor (reference: src/operator/tensor/init_op.cc
+    eye)."""
     return jnp.eye(int(N), int(M) or None, int(k), dtype=np_dtype(dtype or "float32"))

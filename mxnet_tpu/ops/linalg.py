@@ -26,6 +26,8 @@ def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
 @register("_linalg_gemm2", arg_names=["A", "B"], aliases=("linalg_gemm2",))
 def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
                  axis=-2):
+    """Batched GEMM without accumulate input: alpha * op(A) op(B) (reference:
+    src/operator/tensor/la_op.cc gemm2)."""
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * (a @ b)
@@ -48,6 +50,8 @@ def linalg_potri(A):
 @register("_linalg_trmm", arg_names=["A", "B"], aliases=("linalg_trmm",))
 def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
                 alpha=1.0):
+    """Triangular matrix multiply op(L) * B (reference:
+    src/operator/tensor/la_op.cc trmm)."""
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     out = (B @ a) if rightside else (a @ B)
     return alpha * out
@@ -70,6 +74,8 @@ def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
 @register("_linalg_sumlogdiag", arg_names=["A"],
           aliases=("linalg_sumlogdiag",))
 def linalg_sumlogdiag(A):
+    """Sum of log of the diagonal entries (Cholesky log-det building block)
+    (reference: src/operator/tensor/la_op.cc sumlogdiag)."""
     diag = jnp.diagonal(A, axis1=-2, axis2=-1)
     return jnp.sum(jnp.log(diag), axis=-1)
 
@@ -77,11 +83,15 @@ def linalg_sumlogdiag(A):
 @register("_linalg_extractdiag", arg_names=["A"],
           aliases=("linalg_extractdiag",))
 def linalg_extractdiag(A, offset=0):
+    """Extract the k-th diagonal of batched matrices (reference:
+    src/operator/tensor/la_op.cc extractdiag)."""
     return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
 
 
 @register("_linalg_makediag", arg_names=["A"], aliases=("linalg_makediag",))
 def linalg_makediag(A, offset=0):
+    """Embed a vector as the k-th diagonal of a matrix (reference:
+    src/operator/tensor/la_op.cc makediag)."""
     n = A.shape[-1] + abs(offset)
     base = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
     idx = jnp.arange(A.shape[-1])
@@ -93,6 +103,8 @@ def linalg_makediag(A, offset=0):
 @register("_linalg_extracttrian", arg_names=["A"],
           aliases=("linalg_extracttrian",))
 def linalg_extracttrian(A, offset=0, lower=True):
+    """Extract the lower/upper triangle as a packed vector (reference:
+    src/operator/tensor/la_op.cc extracttrian)."""
     import numpy as _np
     n = A.shape[-1]
     r = _np.arange(n)
@@ -106,6 +118,8 @@ def linalg_extracttrian(A, offset=0, lower=True):
 
 @register("_linalg_syrk", arg_names=["A"], aliases=("linalg_syrk",))
 def linalg_syrk(A, transpose=False, alpha=1.0):
+    """Symmetric rank-k update alpha * A A^T (reference:
+    src/operator/tensor/la_op.cc syrk)."""
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     return alpha * (a @ jnp.swapaxes(a, -1, -2))
 
@@ -121,22 +135,30 @@ def linalg_gelqf(A):
 @register("_linalg_syevd", arg_names=["A"], num_outputs=2,
           aliases=("linalg_syevd",))
 def linalg_syevd(A):
+    """Symmetric eigendecomposition: eigenvectors and eigenvalues (reference:
+    src/operator/tensor/la_op.cc syevd)."""
     w, u = jnp.linalg.eigh(A)
     return jnp.swapaxes(u, -1, -2), w
 
 
 @register("_linalg_inverse", arg_names=["A"], aliases=("linalg_inverse",))
 def linalg_inverse(A):
+    """Batched matrix inverse (reference: src/operator/tensor/la_op.cc
+    inverse)."""
     return jnp.linalg.inv(A)
 
 
 @register("_linalg_det", arg_names=["A"], aliases=("linalg_det",))
 def linalg_det(A):
+    """Determinant of batched square matrices (reference:
+    src/operator/tensor/la_op.cc det)."""
     return jnp.linalg.det(A)
 
 
 @register("_linalg_slogdet", arg_names=["A"], num_outputs=2,
           aliases=("linalg_slogdet",))
 def linalg_slogdet(A):
+    """Sign and log|det| of batched matrices (reference:
+    src/operator/tensor/la_op.cc slogdet)."""
     sign, logdet = jnp.linalg.slogdet(A)
     return sign, logdet

@@ -55,16 +55,22 @@ def reshape(data, shape=None, reverse=False):
 
 @register("reshape_like", arg_names=["lhs", "rhs"])
 def reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (reference:
+    src/operator/tensor/elemwise_unary_op_basic.cc reshape_like)."""
     return jnp.reshape(lhs, rhs.shape)
 
 
 @register("Flatten", aliases=("flatten",))
 def flatten(data):
+    """Collapse all trailing axes into one: (N, prod(rest)) (reference:
+    src/operator/tensor/matrix_op.cc Flatten)."""
     return jnp.reshape(data, (data.shape[0], -1))
 
 
 @register("transpose")
 def transpose(data, axes=None):
+    """Permute axes; reverses them when `axes` is empty (reference:
+    src/operator/tensor/matrix_op.cc transpose)."""
     if axes is None or axes == ():
         axes = tuple(reversed(range(data.ndim)))
     return jnp.transpose(data, axes)
@@ -72,36 +78,49 @@ def transpose(data, axes=None):
 
 @register("expand_dims")
 def expand_dims(data, axis=0):
+    """Insert a size-1 axis at `axis` (reference:
+    src/operator/tensor/matrix_op.cc expand_dims)."""
     return jnp.expand_dims(data, axis)
 
 
 @register("squeeze")
 def squeeze(data, axis=None):
+    """Drop size-1 axes, all or those listed in `axis` (reference:
+    src/operator/tensor/matrix_op.cc squeeze)."""
     return jnp.squeeze(data, axis)
 
 
 @register("swapaxes", aliases=("SwapAxis",))
 def swapaxes(data, dim1=0, dim2=0):
+    """Exchange axes dim1 and dim2 (reference: src/operator/swapaxis.cc)."""
     return jnp.swapaxes(data, dim1, dim2)
 
 
 @register("flip", aliases=("reverse",))
 def flip(data, axis=None):
+    """Reverse along `axis` (reference: src/operator/tensor/matrix_op.cc
+    reverse)."""
     return jnp.flip(data, axis)
 
 
 @register("tile")
 def tile(data, reps=()):
+    """Repeat the whole tensor `reps` times per axis (reference:
+    src/operator/tensor/matrix_op.cc tile)."""
     return jnp.tile(data, reps)
 
 
 @register("repeat")
 def repeat(data, repeats=1, axis=None):
+    """Repeat each element `repeats` times along `axis` (reference:
+    src/operator/tensor/matrix_op.cc repeat)."""
     return jnp.repeat(data, repeats, axis)
 
 
 @register("Pad", aliases=("pad",))
 def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """Pad spatial axes in constant/edge/reflect mode (reference:
+    src/operator/pad.cc)."""
     pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
     if mode == "constant":
         return jnp.pad(data, pw, mode="constant", constant_values=constant_value)
@@ -114,11 +133,14 @@ def pad(data, mode="constant", pad_width=(), constant_value=0.0):
 
 @register("Concat", arg_names=["args"], aliases=("concat",))
 def concat(*args, dim=1, num_args=None):
+    """Join inputs along `dim` (reference: src/operator/nn/concat.cc)."""
     return jnp.concatenate(args, axis=dim)
 
 
 @register("stack", arg_names=["args"])
 def stack(*args, axis=0, num_args=None):
+    """Stack inputs along a new `axis` (reference:
+    src/operator/tensor/matrix_op.cc stack)."""
     return jnp.stack(args, axis=axis)
 
 
@@ -131,6 +153,8 @@ def _split_num_outputs(params):
 
 @register("SliceChannel", aliases=("split",), num_outputs=_split_num_outputs)
 def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """Split along `axis` into num_outputs equal parts (reference:
+    src/operator/slice_channel.cc)."""
     parts = jnp.split(data, int(num_outputs), axis=axis)
     if squeeze_axis:
         parts = [jnp.squeeze(p, axis=axis) for p in parts]
@@ -141,6 +165,8 @@ def split(data, num_outputs=1, axis=1, squeeze_axis=False):
 
 @register("slice", aliases=("crop",))
 def slice_op(data, begin=(), end=(), step=()):
+    """Slice with begin/end/step per axis (reference:
+    src/operator/tensor/matrix_op.cc slice)."""
     ndim = data.ndim
     begin = list(begin) + [None] * (ndim - len(begin))
     end = list(end) + [None] * (ndim - len(end))
@@ -154,6 +180,8 @@ def slice_op(data, begin=(), end=(), step=()):
 
 @register("slice_axis")
 def slice_axis(data, axis=0, begin=0, end=None):
+    """Slice [begin, end) along a single axis (reference:
+    src/operator/tensor/matrix_op.cc slice_axis)."""
     idx = [slice(None)] * data.ndim
     idx[axis] = slice(begin, end)
     return data[tuple(idx)]
@@ -161,6 +189,8 @@ def slice_axis(data, axis=0, begin=0, end=None):
 
 @register("slice_like", arg_names=["data", "shape_like"])
 def slice_like(data, shape_like, axes=()):
+    """Crop data to shape_like's extent on `axes` (reference:
+    src/operator/tensor/matrix_op.cc slice_like)."""
     axes = axes or tuple(range(data.ndim))
     idx = [slice(None)] * data.ndim
     for ax in axes:
@@ -170,6 +200,8 @@ def slice_like(data, shape_like, axes=()):
 
 @register("broadcast_axis", aliases=("broadcast_axes",))
 def broadcast_axis(data, axis=(), size=()):
+    """Broadcast size-1 axes to `size` (reference:
+    src/operator/tensor/broadcast_reduce_op_value.cc broadcast_axis)."""
     if isinstance(axis, int):
         axis, size = (axis,), (size,)
     shape = list(data.shape)
@@ -180,12 +212,16 @@ def broadcast_axis(data, axis=(), size=()):
 
 @register("broadcast_to")
 def broadcast_to(data, shape=()):
+    """Broadcast to `shape`; a 0 entry keeps the source dim (reference:
+    src/operator/tensor/broadcast_reduce_op_value.cc broadcast_to)."""
     tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
     return jnp.broadcast_to(data, tgt)
 
 
 @register("broadcast_like", arg_names=["lhs", "rhs"])
 def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to rhs's shape on selected axes (reference:
+    src/operator/tensor/broadcast_reduce_op_value.cc broadcast_like)."""
     if lhs_axes is None:
         return jnp.broadcast_to(lhs, rhs.shape)
     shape = list(lhs.shape)
@@ -212,6 +248,8 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
 
 @register("batch_dot", arg_names=["lhs", "rhs"])
 def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matrix product over leading batch dims (reference:
+    src/operator/tensor/dot.cc batch_dot)."""
     if transpose_a:
         lhs = jnp.swapaxes(lhs, -1, -2)
     if transpose_b:
@@ -221,6 +259,8 @@ def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
 
 @register("depth_to_space")
 def depth_to_space(data, block_size=1):
+    """Rearrange channel blocks into spatial blocks by block_size (reference:
+    src/operator/tensor/matrix_op.cc depth_to_space)."""
     n, c, h, w = data.shape
     b = block_size
     x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
@@ -230,6 +270,8 @@ def depth_to_space(data, block_size=1):
 
 @register("space_to_depth")
 def space_to_depth(data, block_size=1):
+    """Fold spatial blocks into channels; inverse of depth_to_space
+    (reference: src/operator/tensor/matrix_op.cc space_to_depth)."""
     n, c, h, w = data.shape
     b = block_size
     x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
@@ -239,6 +281,8 @@ def space_to_depth(data, block_size=1):
 
 @register("diag")
 def diag(data, k=0):
+    """Extract a diagonal (2-D+) or build a diagonal matrix (1-D) (reference:
+    src/operator/tensor/diag_op.cc)."""
     if data.ndim == 1:
         return jnp.diag(data, k)
     return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
@@ -246,21 +290,29 @@ def diag(data, k=0):
 
 @register("shape_array", differentiable=False)
 def shape_array(data):
+    """Shape of data as a 1-D int tensor (reference:
+    src/operator/tensor/elemwise_unary_op_basic.cc shape_array)."""
     return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
 
 
 @register("size_array", differentiable=False)
 def size_array(data):
+    """Element count of data as a 1-D int tensor (reference:
+    src/operator/tensor/elemwise_unary_op_basic.cc size_array)."""
     return jnp.asarray([data.size], dtype=jnp.int32)
 
 
 @register("zeros_like")
 def zeros_like(data):
+    """Zeros with the shape/dtype of `data` (reference:
+    src/operator/tensor/elemwise_unary_op_basic.cc)."""
     return jnp.zeros_like(data)
 
 
 @register("ones_like")
 def ones_like(data):
+    """Ones with the shape/dtype of `data` (reference:
+    src/operator/tensor/elemwise_unary_op_basic.cc)."""
     return jnp.ones_like(data)
 
 

@@ -453,6 +453,8 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
 # ---------------------------------------------------------------------------
 @register("Activation")
 def activation(data, act_type="relu"):
+    """Apply the `act_type` nonlinearity (relu/sigmoid/tanh/softrelu/softsign)
+    (reference: src/operator/nn/activation.cc)."""
     if act_type == "relu":
         return jax.nn.relu(data)
     if act_type == "sigmoid":
@@ -506,6 +508,8 @@ def _softmax_io(data, dtype):
 
 @register("softmax")
 def softmax(data, axis=-1, temperature=None, dtype=None):
+    """Normalized exponentials along `axis` with optional temperature
+    (reference: src/operator/nn/softmax.cc)."""
     data, out_dtype = _softmax_io(data, dtype)
     x = data / temperature if temperature else data
     return jax.nn.softmax(x, axis=axis).astype(out_dtype)
@@ -513,6 +517,8 @@ def softmax(data, axis=-1, temperature=None, dtype=None):
 
 @register("log_softmax")
 def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    """Numerically stable log(softmax(data)) along `axis` (reference:
+    src/operator/nn/softmax.cc log_softmax)."""
     data, out_dtype = _softmax_io(data, dtype)
     x = data / temperature if temperature else data
     return jax.nn.log_softmax(x, axis=axis).astype(out_dtype)
@@ -520,12 +526,16 @@ def log_softmax(data, axis=-1, temperature=None, dtype=None):
 
 @register("softmin")
 def softmin(data, axis=-1, temperature=None, dtype=None):
+    """softmax of the negated input (reference: src/operator/nn/softmax.cc
+    softmin)."""
     data, out_dtype = _softmax_io(data, dtype)
     return jax.nn.softmax(-data, axis=axis).astype(out_dtype)
 
 
 @register("SoftmaxActivation")
 def softmax_activation(data, mode="instance"):
+    """Softmax over the channel (or flattened instance) axis; deprecated alias
+    family of softmax (reference: src/operator/nn/softmax_activation.cc)."""
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
     return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
@@ -610,16 +620,22 @@ _logistic_reg = _regression_output(jax.nn.sigmoid, lambda o, l: o - l)
 
 @register("LinearRegressionOutput", arg_names=["data", "label"])
 def linear_regression_output(data, label, grad_scale=1.0):
+    """L2 regression head: forward is identity, gradient is data - label
+    (reference: src/operator/regression_output-inl.h)."""
     return _linear_reg(data, label, grad_scale)
 
 
 @register("MAERegressionOutput", arg_names=["data", "label"])
 def mae_regression_output(data, label, grad_scale=1.0):
+    """L1 regression head with sign(data - label) gradient (reference:
+    src/operator/regression_output-inl.h)."""
     return _mae_reg(data, label, grad_scale)
 
 
 @register("LogisticRegressionOutput", arg_names=["data", "label"])
 def logistic_regression_output(data, label, grad_scale=1.0):
+    """Sigmoid regression head with sigmoid(data) - label gradient (reference:
+    src/operator/regression_output-inl.h)."""
     return _logistic_reg(data, label, grad_scale)
 
 
@@ -651,6 +667,8 @@ def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
 
 @register("softmax_cross_entropy", arg_names=["data", "label"])
 def softmax_cross_entropy(data, label):
+    """Fused softmax + cross-entropy scalar loss (reference:
+    src/operator/loss_binary_op.cc)."""
     lp = jax.nn.log_softmax(data, axis=-1)
     picked = jnp.take_along_axis(lp, label.astype(jnp.int32)[:, None], axis=-1)
     return -jnp.sum(picked)
@@ -766,6 +784,8 @@ def bilinear_sampler(data, grid, cudnn_off=False):
 @register("SpatialTransformer", arg_names=["data", "loc"])
 def spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
                         sampler_type="bilinear", cudnn_off=False):
+    """Affine spatial transformer: grid generation + bilinear sampling
+    (reference: src/operator/spatial_transformer.cc)."""
     grid = grid_generator(loc, "affine", target_shape)
     return bilinear_sampler(data, grid)
 

@@ -114,6 +114,9 @@ def signum_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
 def adam_update(weight, grad, mean, var, lr=None, beta1=0.9, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
+    """Adam step: update m/v moments and apply the bias-corrected step,
+    mutating weight in place (reference: src/operator/optimizer_op.cc
+    adam_update)."""
     g = _clip(rescale_grad * grad + wd * weight, clip_gradient)
     new_mean = beta1 * mean + (1.0 - beta1) * g
     new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
@@ -136,6 +139,8 @@ def _clip_weights(w, cw):
 def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                    clip_weights=-1.0):
+    """RMSProp step over the squared-gradient running average, in place
+    (reference: src/operator/optimizer_op.cc rmsprop_update)."""
     g = _clip(rescale_grad * grad + wd * weight, clip_gradient)
     new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
     out = weight - lr * g / jnp.sqrt(new_n + epsilon)
@@ -148,6 +153,8 @@ def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
 def rmspropalex_update(weight, grad, n, g, delta, lr=None, gamma1=0.95,
                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0, clip_weights=-1.0):
+    """RMSProp (Graves' variant) step with n/g/delta state, in place
+    (reference: src/operator/optimizer_op.cc rmspropalex_update)."""
     gr = _clip(rescale_grad * grad + wd * weight, clip_gradient)
     new_n = (1.0 - gamma1) * jnp.square(gr) + gamma1 * n
     new_g = (1.0 - gamma1) * gr + gamma1 * g
@@ -164,6 +171,8 @@ def rmspropalex_update(weight, grad, n, g, delta, lr=None, gamma1=0.95,
           differentiable=False, mutates={2: 1, 3: 2})
 def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0):
+    """FTRL optimizer step with z/n state, mutating weight in place
+    (reference: src/operator/optimizer_op.cc ftrl_update)."""
     g = _clip(rescale_grad * grad, clip_gradient)
     new_z = z + g - (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) * weight / lr
     new_n = n + jnp.square(g)
@@ -181,6 +190,8 @@ def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
           differentiable=False, mutates={2: 1, 3: 2, 4: 3})
 def ftml_update(weight, grad, d, v, z, lr=None, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    """FTML optimizer step mutating weight in place (reference:
+    src/operator/optimizer_op.cc ftml_update)."""
     g = _clip(rescale_grad * grad + wd * weight, clip_grad)
     new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
     d_t = (1.0 - beta1 ** t) / lr \

@@ -24,35 +24,47 @@ def _dt(dtype):
 @register("_random_uniform", arg_names=[], differentiable=False,
           aliases=("uniform", "random_uniform"))
 def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None):
+    """Draw `shape` samples uniform in [low, high) (reference:
+    src/operator/random/sample_op.cc)."""
     return jax.random.uniform(_rng.next_key(), tuple(shape), _dt(dtype), low, high)
 
 
 @register("_random_normal", arg_names=[], differentiable=False,
           aliases=("normal", "random_normal"))
 def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None):
+    """Draw `shape` samples from Normal(loc, scale) (reference:
+    src/operator/random/sample_op.cc)."""
     return jax.random.normal(_rng.next_key(), tuple(shape), _dt(dtype)) * scale + loc
 
 
 @register("_random_gamma", arg_names=[], differentiable=False, aliases=("random_gamma",))
 def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None):
+    """Draw `shape` samples from Gamma(alpha, beta) (reference:
+    src/operator/random/sample_op.cc)."""
     return jax.random.gamma(_rng.next_key(), alpha, tuple(shape), _dt(dtype)) * beta
 
 
 @register("_random_exponential", arg_names=[], differentiable=False,
           aliases=("random_exponential",))
 def random_exponential(lam=1.0, shape=(), dtype="float32", ctx=None):
+    """Draw `shape` samples from Exponential(lam) (reference:
+    src/operator/random/sample_op.cc)."""
     return jax.random.exponential(_rng.next_key(), tuple(shape), _dt(dtype)) / lam
 
 
 @register("_random_poisson", arg_names=[], differentiable=False,
           aliases=("random_poisson",))
 def random_poisson(lam=1.0, shape=(), dtype="float32", ctx=None):
+    """Draw `shape` samples from Poisson(lam) (reference:
+    src/operator/random/sample_op.cc)."""
     return jax.random.poisson(_rng.next_key(), lam, tuple(shape)).astype(_dt(dtype))
 
 
 @register("_random_negative_binomial", arg_names=[], differentiable=False,
           aliases=("random_negative_binomial",))
 def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None):
+    """Draw `shape` samples from NegBinomial(k, p) via the gamma-Poisson
+    mixture (reference: src/operator/random/sample_op.cc)."""
     g = jax.random.gamma(_rng.next_key(), float(k), tuple(shape)) * ((1 - p) / p)
     return jax.random.poisson(_rng.next_key(), g, tuple(shape)).astype(_dt(dtype))
 
@@ -60,6 +72,8 @@ def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None):
 @register("_random_generalized_negative_binomial", arg_names=[], differentiable=False,
           aliases=("random_generalized_negative_binomial",))
 def random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None):
+    """Draw `shape` samples from the gamma-Poisson mixture GNB(mu, alpha)
+    (reference: src/operator/random/sample_op.cc)."""
     if alpha == 0:
         return jax.random.poisson(_rng.next_key(), mu, tuple(shape)).astype(_dt(dtype))
     r = 1.0 / alpha
@@ -69,6 +83,8 @@ def random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=No
 
 @register("_random_randint", arg_names=[], differentiable=False, aliases=("random_randint",))
 def random_randint(low=0, high=1, shape=(), dtype="int32", ctx=None):
+    """Draw `shape` integer samples uniform in [low, high) (reference:
+    src/operator/random/sample_op.cc)."""
     return jax.random.randint(_rng.next_key(), tuple(shape), int(low), int(high),
                               _dt(dtype or "int32"))
 
@@ -77,6 +93,8 @@ def random_randint(low=0, high=1, shape=(), dtype="int32", ctx=None):
           aliases=("sample_multinomial",),
           num_outputs=lambda p: 2 if p.get("get_prob") else 1)
 def sample_multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    """Categorical draws from probability rows, optional log-prob second
+    output (reference: src/operator/random/sample_multinomial_op.cc)."""
     n = 1
     if shape:
         shape = (shape,) if isinstance(shape, int) else tuple(shape)
@@ -111,7 +129,10 @@ def _shape_tuple(shape):
 
 
 def _elem_sample(name, draw):
-    @register(name, arg_names=["low", "high"], differentiable=False)
+    @register(name, arg_names=["low", "high"], differentiable=False,
+              doc="Per-element %s sampler: draws `shape` samples for each "
+                  "parameter pair (reference: src/operator/random/"
+                  "multisample_op.cc)." % name.replace("_sample_", ""))
     def fn(a, b, shape=(), dtype=None, __draw=draw):
         s = _shape_tuple(shape)
         return __draw(a, b, a.shape + s)
@@ -134,11 +155,16 @@ def _bshape(x, shape):
 
 @register("_shuffle", differentiable=False, aliases=("shuffle",))
 def shuffle(data):
+    """Random permutation along the first axis (reference:
+    src/operator/random/shuffle_op.cc)."""
     return jax.random.permutation(_rng.next_key(), data, axis=0)
 
 
 def _one_param_sample(name, draw):
-    @register(name, arg_names=["data"], differentiable=False)
+    @register(name, arg_names=["data"], differentiable=False,
+              doc="Per-element %s sampler over a rate/parameter tensor "
+                  "(reference: src/operator/random/multisample_op.cc)."
+                  % name.replace("_sample_", ""))
     def fn(lam, shape=(), dtype=None, __draw=draw):
         s = _shape_tuple(shape)
         return __draw(lam, lam.shape + s).astype(_dt(dtype or "float32"))

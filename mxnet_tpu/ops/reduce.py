@@ -27,7 +27,10 @@ def _axes(data, axis, exclude=False):
 
 
 def _reduce(name, jfn):
-    @register(name)
+    @register(name,
+              doc="Reduce %s over `axis` with keepdims/exclude (reference: "
+                  "src/operator/tensor/broadcast_reduce_op_value.cc); "
+                  "lowers to one XLA reduce." % name)
     def fn(data, axis=None, keepdims=False, exclude=False, __jfn=jfn):
         return __jfn(data, axis=_axes(data, axis, exclude), keepdims=keepdims)
     fn.__name__ = name
@@ -50,6 +53,8 @@ alias("min", "min_axis")
 
 @register("norm")
 def norm(data, ord=2, axis=None, keepdims=False):
+    """L1/L2 norm over `axis`, accumulating low-precision inputs in float32
+    (reference: src/operator/tensor/broadcast_reduce_op_value.cc norm)."""
     ax = None if axis is None or axis == () else axis
     if ord == 1:
         return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
@@ -60,6 +65,8 @@ def norm(data, ord=2, axis=None, keepdims=False):
 
 @register("argmax", differentiable=False)
 def argmax(data, axis=None, keepdims=False):
+    """Index of the maximum along `axis`, returned as float32 (reference:
+    src/operator/tensor/broadcast_reduce_op_index.cc)."""
     out = jnp.argmax(data, axis=axis).astype(jnp.float32)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
@@ -68,6 +75,8 @@ def argmax(data, axis=None, keepdims=False):
 
 @register("argmin", differentiable=False)
 def argmin(data, axis=None, keepdims=False):
+    """Index of the minimum along `axis`, returned as float32 (reference:
+    src/operator/tensor/broadcast_reduce_op_index.cc)."""
     out = jnp.argmin(data, axis=axis).astype(jnp.float32)
     if keepdims and axis is not None:
         out = jnp.expand_dims(out, axis)
@@ -76,6 +85,8 @@ def argmin(data, axis=None, keepdims=False):
 
 @register("argmax_channel", differentiable=False)
 def argmax_channel(data):
+    """argmax over axis 1, the channel axis (reference:
+    src/operator/tensor/broadcast_reduce_op_index.cc argmax_channel)."""
     return jnp.argmax(data, axis=1).astype(jnp.float32)
 
 
@@ -124,12 +135,16 @@ import jax  # noqa: E402  (used by topk mask path)
 
 @register("sort")
 def sort(data, axis=-1, is_ascend=True):
+    """Sorted copy along `axis` (reference: src/operator/tensor/ordering_op.cc
+    sort)."""
     out = jnp.sort(data, axis=axis)
     return out if is_ascend else jnp.flip(out, axis=axis)
 
 
 @register("argsort", differentiable=False)
 def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    """Indices that would sort along `axis` (reference:
+    src/operator/tensor/ordering_op.cc argsort)."""
     from ..base import np_dtype
     idx = jnp.argsort(data, axis=axis)
     if not is_ascend:

@@ -20,23 +20,63 @@ scheduling.  Metadata kept per-op:
 from __future__ import annotations
 
 import ast
+import functools
 import inspect
 
 __all__ = ["Op", "register", "get", "list_ops", "alias"]
 
 _OPS: dict[str, "Op"] = {}
 
+# registration names that overwrote a *different* already-registered op:
+# [(name, old_op_name, new_op_name)] — consumed by mxnet_tpu.analysis
+# (the nnvm registry aborts on double registration; we record and lint)
+_SHADOWS: list[tuple[str, str, str]] = []
+
+
+def _introspect_fn_params(fn):
+    """Positional parameter names of ``fn`` → (names, ok).
+
+    Unwraps ``functools.partial`` chains (dropping already-bound
+    positionals and keyword-bound names) and ``__wrapped__`` decorator
+    chains before giving up, so partial-registered ops still map scalar
+    positional call args onto the right kwargs.  ``ok`` is False only
+    when no signature could be recovered at all; the caller falls back
+    to ``arg_names`` and mxnet_tpu.analysis reports the fallback.
+    """
+    drop, bound_kw = 0, set()
+    base = fn
+    while isinstance(base, functools.partial):
+        drop += len(base.args)
+        bound_kw |= set(base.keywords or ())
+        base = base.func
+    for candidate in (fn, base, getattr(base, "__wrapped__", None),
+                      getattr(base, "__call__", None)):
+        if candidate is None:
+            continue
+        try:
+            sig = inspect.signature(candidate)
+        except (TypeError, ValueError):
+            continue
+        names = [p.name for p in sig.parameters.values()
+                 if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
+        if candidate is not fn:
+            # signature came from under the partial: drop bound params
+            names = [n for n in names[drop:] if n not in bound_kw]
+        return names, True
+    return None, False
+
 
 class Op:
     __slots__ = (
         "name", "fn", "arg_names", "aux", "aux_update", "num_outputs",
         "differentiable", "scalar_args", "doc", "needs_train",
-        "optional_args", "fn_params", "mutates",
+        "optional_args", "fn_params", "fn_params_fallback", "mutates",
     )
 
     def __init__(self, name, fn, arg_names=None, aux=None, aux_update=None,
                  num_outputs=1, differentiable=True, scalar_args=(),
-                 needs_train=False, optional_args=(), mutates=None):
+                 needs_train=False, optional_args=(), mutates=None,
+                 doc=None):
         self.name = name
         self.fn = fn
         self.arg_names = list(arg_names) if arg_names else ["data"]
@@ -54,15 +94,14 @@ class Op:
         # fn outputs are written back into the inputs and only the first
         # num_outputs outputs are public
         self.mutates = dict(mutates) if mutates else {}
-        try:
-            # positional parameter names of fn, so scalar positional call
-            # args (nd.swapaxes(x, 0, 1)) map onto the right kwargs
-            self.fn_params = [
-                p.name for p in inspect.signature(fn).parameters.values()
-                if p.kind in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY)]
-        except (TypeError, ValueError):
-            self.fn_params = list(self.arg_names)
-        self.doc = fn.__doc__ or ""
+        # positional parameter names of fn, so scalar positional call
+        # args (nd.swapaxes(x, 0, 1)) map onto the right kwargs
+        params, ok = _introspect_fn_params(fn)
+        self.fn_params = params if ok else list(self.arg_names)
+        self.fn_params_fallback = not ok
+        # doc= overrides for generated families (lambdas, partials) whose
+        # fn docstring is absent or shared
+        self.doc = doc or fn.__doc__ or ""
 
     def optional(self, params):
         if callable(self.optional_args):
@@ -80,25 +119,37 @@ class Op:
 
 def register(name, *, arg_names=None, aux=None, aux_update=None, num_outputs=1,
              differentiable=True, scalar_args=(), aliases=(), needs_train=False,
-             optional_args=(), mutates=None):
+             optional_args=(), mutates=None, doc=None):
     """Decorator registering a pure jax function as an operator."""
 
     def deco(fn):
         op = Op(name, fn, arg_names, aux, aux_update, num_outputs,
                 differentiable, scalar_args, needs_train, optional_args,
-                mutates)
-        _OPS[name] = op
+                mutates, doc)
+        _register_name(name, op)
         for a in aliases:
-            _OPS[a] = op
+            _register_name(a, op)
         return fn
 
     return deco
 
 
+def _register_name(name, op):
+    old = _OPS.get(name)
+    if old is not None and old is not op:
+        _SHADOWS.append((name, old.name, op.name))
+    _OPS[name] = op
+
+
 def alias(name, *extra):
     op = _OPS[name]
     for a in extra:
-        _OPS[a] = op
+        _register_name(a, op)
+
+
+def shadowed():
+    """Alias/registration collisions recorded so far (for the linter)."""
+    return list(_SHADOWS)
 
 
 def get(name) -> Op:

@@ -517,12 +517,23 @@ class Symbol:
 
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     shared_arg_names=None, shared_exec=None,
-                    shared_buffer=None, **kwargs):
+                    shared_buffer=None, lint=False, **kwargs):
         """Allocate argument/grad/aux arrays from inferred shapes and bind
-        (reference: symbol.py:1289 → MXExecutorSimpleBindEx)."""
+        (reference: symbol.py:1289 → MXExecutorSimpleBindEx).  ``lint=True``
+        runs the mxlint graph pass before binding (error findings raise)."""
         from ..executor import Executor
         return Executor.simple_bind(self, ctx, grad_req=grad_req,
-                                    type_dict=type_dict, shapes=kwargs)
+                                    type_dict=type_dict, shapes=kwargs,
+                                    lint=lint)
+
+    def lint(self, shapes=None, type_dict=None, disable=(),
+             check_consts=True):
+        """Static graph lint (mxnet_tpu.analysis): dead outputs, gradient
+        cuts, aux misuse, float64 promotion, recompile traps, oversized
+        constants.  Returns a list of ``Finding`` records."""
+        from ..analysis import lint_symbol
+        return lint_symbol(self, shapes=shapes, type_dict=type_dict,
+                           disable=disable, check_consts=check_consts)
 
     # gradient of this symbol's outputs — handled inside Executor via vjp
     def grad(self, wrt):

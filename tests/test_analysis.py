@@ -1,0 +1,412 @@
+"""mxnet_tpu.analysis (mxlint) — registry, graph and source passes.
+
+Every rule_id fires at least once on a crafted fixture and stays silent
+on a clean op/graph; the self-check CLI (what CI runs) passes on the
+shipped registry.
+"""
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+pytestmark = pytest.mark.analysis
+from mxnet_tpu.analysis import (lint_graph, lint_registry, lint_source,
+                                render_json, render_text, exit_code)
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops import registry
+from mxnet_tpu.symbol.symbol import Symbol, _sym_invoke
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules(findings):
+    return {f.rule_id for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# registry pass — against an isolated fake registry (the real one must stay
+# clean, which test_self_check_cli proves)
+# ---------------------------------------------------------------------------
+class FakeReg:
+    def __init__(self):
+        self._ops = {}
+        self._shadows = []
+
+    def add(self, op, *names):
+        for n in (op.name,) + names:
+            self._ops[n] = op
+        return op
+
+    def list_ops(self):
+        return sorted(self._ops)
+
+    def get(self, name):
+        return self._ops[name]
+
+    def shadowed(self):
+        return list(self._shadows)
+
+
+def _good_fn(data, weight, alpha=1.0):
+    """A well-formed fixture op."""
+    return data * weight * alpha
+
+
+def test_clean_op_is_silent():
+    reg = FakeReg()
+    reg.add(registry.Op("good", _good_fn, arg_names=["data", "weight"],
+                        scalar_args=("alpha",)))
+    assert lint_registry(registry=reg) == []
+
+
+def test_reg001_missing_tensor_slot():
+    reg = FakeReg()
+    reg.add(registry.Op("bad", lambda data: data,
+                        arg_names=["data", "weight"],
+                        doc="fn has one positional param, two slots."))
+    assert rules(lint_registry(registry=reg)) == {"REG001"}
+
+
+def test_reg001_variadic_without_star_args():
+    reg = FakeReg()
+    reg.add(registry.Op("badvar", lambda data: data, arg_names=["args"],
+                        doc="variadic registration over a unary fn."))
+    assert "REG001" in rules(lint_registry(registry=reg))
+
+
+def test_reg002_slot_order_swap():
+    reg = FakeReg()
+    reg.add(registry.Op("swapped", lambda weight, data: data @ weight,
+                        arg_names=["data", "weight"],
+                        doc="slots transposed vs fn params."))
+    assert rules(lint_registry(registry=reg)) == {"REG002"}
+
+
+def test_reg003_unknown_scalar_arg():
+    reg = FakeReg()
+    reg.add(registry.Op("badscalar", lambda data: data,
+                        scalar_args=("alpha",),
+                        doc="alpha is not a parameter of fn."))
+    assert rules(lint_registry(registry=reg)) == {"REG003"}
+
+
+def test_reg004_unknown_optional_arg():
+    reg = FakeReg()
+    reg.add(registry.Op("badopt", lambda data, bias=None: data,
+                        arg_names=["data", "bias"],
+                        optional_args=("nonexistent",),
+                        doc="optional names no slot."))
+    assert rules(lint_registry(registry=reg)) == {"REG004"}
+
+
+def test_reg005_aux_index_gap():
+    reg = FakeReg()
+    reg.add(registry.Op("badaux",
+                        lambda data, gamma, mean=None, var=None: data,
+                        arg_names=["data", "gamma"],
+                        aux={3: "mean", 4: "var"},   # should start at 2
+                        doc="aux range leaves a hole at index 2."))
+    assert rules(lint_registry(registry=reg)) == {"REG005"}
+
+
+def test_reg006_mutates_out_of_range():
+    reg = FakeReg()
+    reg.add(registry.Op("badmut", lambda w, g: (w, w - g),
+                        arg_names=["weight", "grad"], mutates={5: 1},
+                        doc="mutated input index 5 does not exist."))
+    assert rules(lint_registry(registry=reg)) == {"REG006"}
+
+
+def test_reg007_num_outputs_not_total():
+    reg = FakeReg()
+    reg.add(registry.Op("badnout", lambda data: data,
+                        num_outputs=lambda p: p["k"],   # KeyError on {}
+                        doc="num_outputs requires an undefaulted param."))
+    assert rules(lint_registry(registry=reg)) == {"REG007"}
+
+
+def test_reg008_alias_shadow():
+    reg = FakeReg()
+    a = reg.add(registry.Op("first", lambda data: data, doc="original."))
+    reg.add(registry.Op("second", lambda data: -data, doc="usurper."))
+    reg._shadows.append(("first", "first", "second"))
+    assert "REG008" in rules(lint_registry(registry=reg))
+
+
+def test_register_records_shadows():
+    before = list(registry.shadowed())
+    ops_before = dict(registry._OPS)
+    try:
+        registry.register("_lintfix_shadow_victim",
+                          doc="victim.")(lambda data: data)
+        registry.register("_lintfix_other",
+                          aliases=("_lintfix_shadow_victim",),
+                          doc="shadows the victim via alias.")(
+                              lambda data: -data)
+        new = [s for s in registry.shadowed() if s not in before]
+        assert ("_lintfix_shadow_victim", "_lintfix_shadow_victim",
+                "_lintfix_other") in new
+    finally:
+        registry._OPS.clear()
+        registry._OPS.update(ops_before)
+        registry._SHADOWS[:] = before
+
+
+def test_reg009_missing_docstring_and_suppression():
+    reg = FakeReg()
+    reg.add(registry.Op("nodoc", lambda data: data))
+    assert rules(lint_registry(registry=reg)) == {"REG009"}
+
+    def suppressed_fn(data):
+        # mxlint: disable=REG009
+        return data
+
+    reg2 = FakeReg()
+    reg2.add(registry.Op("nodoc2", suppressed_fn))
+    assert lint_registry(registry=reg2) == []
+
+
+def test_reg010_zero_coverage():
+    reg = FakeReg()
+    reg.add(registry.Op("uncovered", lambda data: data, doc="fixture."))
+    assert rules(lint_registry(registry=reg, coverage_map={})) == {"REG010"}
+    # an alias entry in the map covers the canonical name too
+    reg.add(reg.get("uncovered"), "uncovered_alias")
+    assert lint_registry(
+        registry=reg,
+        coverage_map={"uncovered_alias": "somewhere"}) == []
+
+
+def test_reg011_introspection_fallback():
+    class Weird:
+        __signature__ = "not-a-signature"
+
+        def __call__(self, data):
+            return data
+
+    reg = FakeReg()
+    reg.add(registry.Op("weird", Weird(), doc="uninspectable callable."))
+    assert "REG011" in rules(lint_registry(registry=reg))
+
+
+def test_fn_params_robust_to_partial():
+    def base(data, other, alpha=1.0, beta=2.0):
+        """Partial-registered fixture."""
+        return data + other * alpha * beta
+
+    op = registry.Op("partial_op", functools.partial(base, beta=3.0),
+                     arg_names=["data", "other"], scalar_args=("alpha",))
+    assert op.fn_params == ["data", "other", "alpha"]
+    assert not op.fn_params_fallback
+    reg = FakeReg()
+    reg.add(op)
+    assert lint_registry(registry=reg) == []
+
+
+# ---------------------------------------------------------------------------
+# graph pass
+# ---------------------------------------------------------------------------
+def test_grf001_dead_output():
+    data = sym.var("data")
+    parts = sym.SliceChannel(data, num_outputs=3, name="dead_split")
+    findings = lint_graph(parts[0], check_consts=False)
+    assert [f.rule_id for f in findings] == ["GRF001", "GRF001"]
+    # consuming every output silences the rule
+    s = parts[0] + parts[1] + parts[2]
+    assert lint_graph(s, check_consts=False) == []
+
+
+def test_grf002_nondiff_on_grad_path():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="g2_fc")
+    cut = sym.argmax(fc, axis=1, name="g2_argmax")
+    loss = sym.MakeLoss(cut, name="g2_loss")
+    findings = lint_graph(loss, check_consts=False)
+    assert rules(findings) == {"GRF002"}
+    assert findings[0].subject == "g2_argmax"
+    # no loss head -> predict-only graph, rule stays quiet
+    assert lint_graph(cut, check_consts=False) == []
+    # differentiable path to the loss head is fine
+    assert lint_graph(sym.MakeLoss(fc, name="g2_ok"),
+                      check_consts=False) == []
+
+
+def test_grf003_aux_read_outside_train():
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, name="g3_bn")
+    aux_nodes = [n for n in bn._nodes() if n.op is None and n._is_aux]
+    assert aux_nodes
+    leaked = bn + Symbol([(aux_nodes[0], 0)])
+    findings = lint_graph(leaked, check_consts=False)
+    assert rules(findings) == {"GRF003"}
+    assert lint_graph(bn, check_consts=False) == []
+
+
+def test_grf004_float64_promotion():
+    a = sym.var("a", dtype="float64")
+    b = sym.var("b")
+    findings = lint_graph(a * b, check_consts=False)
+    assert rules(findings) == {"GRF004"}
+    # all-f32 graph is silent
+    assert lint_graph(sym.var("x") * sym.var("y"), check_consts=False) == []
+    # explicit f64 Cast from f32 is flagged too
+    assert rules(lint_graph(sym.Cast(sym.var("z"), dtype="float64"),
+                            check_consts=False)) == {"GRF004"}
+
+
+def test_grf005_static_reshape():
+    data = sym.var("data")
+    bad = sym.Reshape(data, shape=(32, 100), name="g5_bad")
+    assert rules(lint_graph(bad, check_consts=False)) == {"GRF005"}
+    ok = sym.Reshape(data, shape=(0, -1), name="g5_ok")
+    assert lint_graph(ok, check_consts=False) == []
+
+
+def test_grf005_node_level_suppression():
+    data = sym.var("data")
+    bad = sym.Reshape(data, shape=(32, 100), name="g5_muted")
+    bad._set_attr(__mxlint_disable__="GRF005")
+    assert lint_graph(bad, check_consts=False) == []
+
+
+def test_grf006_large_baked_constant():
+    big = np.ones((512, 600), np.float32)   # ~1.2 MiB
+    ops_before = dict(registry._OPS)
+    try:
+        registry.register("_lintfix_bigconst",
+                          doc="adds a >1MiB closure constant.")(
+                              lambda data: data + jnp.asarray(big).sum())
+        s = _sym_invoke(registry.get("_lintfix_bigconst"),
+                        "_lintfix_bigconst", (sym.var("data"),), {})
+        findings = lint_graph(s, shapes={"data": (4, 8)})
+        assert rules(findings) == {"GRF006"}
+        assert "MiB" in findings[0].message
+    finally:
+        registry._OPS.clear()
+        registry._OPS.update(ops_before)
+
+
+# ---------------------------------------------------------------------------
+# source pass
+# ---------------------------------------------------------------------------
+def test_src001_scalar_capture():
+    src = "loss = net.forward(batch)\nval = loss.item()\n"
+    findings = lint_source(src, filename="train.py")
+    assert rules(findings) == {"SRC001"}
+    assert findings[0].subject == "train.py:2"
+    # float() over an array expression is the same trap
+    assert rules(lint_source("x = float(net(y))\n")) == {"SRC001"}
+
+
+def test_src002_shape_branch():
+    src = "if x.shape[0] > 16:\n    y = f(x)\nwhile x.size > 1:\n    x = g(x)\n"
+    findings = lint_source(src)
+    assert [f.rule_id for f in findings] == ["SRC002", "SRC002"]
+
+
+def test_src_inline_suppression_and_clean():
+    src = "v = loss.item()  # mxlint: disable=SRC001\n"
+    assert lint_source(src) == []
+    clean = "y = net(x)\nz = y + 1\n"
+    assert lint_source(clean) == []
+
+
+# ---------------------------------------------------------------------------
+# hooks: Symbol.lint / Module.lint / simple_bind(lint=True)
+# ---------------------------------------------------------------------------
+def _mlp():
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=8, name="lint_fc1")
+    a = sym.Activation(h, act_type="relu", name="lint_relu")
+    out = sym.FullyConnected(a, num_hidden=4, name="lint_fc2")
+    return sym.SoftmaxOutput(out, name="lint_softmax")
+
+
+def test_clean_graph_is_silent_end_to_end():
+    net = _mlp()
+    assert net.lint(shapes={"data": (2, 16)}) == []
+
+
+def test_module_lint_uses_bound_shapes():
+    mod = mx.module.Module(_mlp(), data_names=("data",),
+                           label_names=("lint_softmax_label",))
+    findings = mod.lint()          # unbound: shape-dependent rules skip
+    assert findings == []
+    mod.bind(data_shapes=[("data", (2, 16))],
+             label_shapes=[("lint_softmax_label", (2,))])
+    assert mod.lint() == []
+
+
+def test_simple_bind_lint_raises_on_error():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=4, name="sb_fc")
+    loss = sym.MakeLoss(sym.argmax(fc, axis=1, name="sb_argmax"),
+                        name="sb_loss")
+    with pytest.raises(MXNetError, match="GRF002"):
+        loss.simple_bind(mx.cpu(), lint=True, data=(2, 8))
+    # without lint the (broken) graph still binds as before
+    ex = loss.simple_bind(mx.cpu(), data=(2, 8))
+    assert ex is not None
+
+
+def test_simple_bind_lint_warns_on_warning():
+    data = sym.var("data")
+    r = sym.Reshape(data, shape=(2, 16), name="sb_reshape")
+    with pytest.warns(UserWarning, match="GRF005"):
+        ex = r.simple_bind(mx.cpu(), lint=True, data=(2, 4, 4))
+    assert ex.forward()[0].shape == (2, 16)
+
+
+# ---------------------------------------------------------------------------
+# reporters + CLI (satellite: CI tier-1 self-check)
+# ---------------------------------------------------------------------------
+def test_reporters_and_exit_codes():
+    reg = FakeReg()
+    reg.add(registry.Op("nodoc", lambda data: data))
+    findings = lint_registry(registry=reg)
+    text = render_text(findings)
+    assert "REG009" in text and "nodoc" in text
+    payload = json.loads(render_json(findings))
+    assert payload["version"] == 1
+    assert payload["findings"][0]["rule"] == "REG009"
+    assert payload["counts"] == {"warning": 1}
+    assert exit_code(findings, strict=False) == 0
+    assert exit_code(findings, strict=True) == 1
+    assert exit_code([], strict=True) == 0
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, "-m", "mxnet_tpu.analysis"]
+                          + list(args), capture_output=True, text=True,
+                          cwd=REPO, env=env, timeout=300)
+
+
+def test_self_check_cli_clean_on_shipped_registry():
+    """CI gate: new op registrations that break a registry invariant (or
+    land without docs/coverage) fail here before anything executes."""
+    proc = _run_cli("--self-check")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_source_lint_json(tmp_path):
+    script = tmp_path / "bad_train.py"
+    script.write_text("for b in loader:\n"
+                      "    v = model(b).item()\n"
+                      "    if b.shape[0] < 8:\n"
+                      "        break\n")
+    proc = _run_cli(str(script), "--json", "--strict")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    got = {f["rule"] for f in payload["findings"]}
+    assert got == {"SRC001", "SRC002"}

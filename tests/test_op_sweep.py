@@ -586,15 +586,6 @@ def _merge_deep_cases():
 _merge_deep_cases()
 
 
-def _unique_ops():
-    seen = {}
-    for name in registry.list_ops():
-        op = registry.get(name)
-        if id(op) not in seen:
-            seen[id(op)] = name
-    return dict((v, registry.get(v)) for v in seen.values())
-
-
 ALL_CASES = [(name, i, case) for name, cases in sorted(CASES.items())
              for i, case in enumerate(cases)]
 
@@ -778,28 +769,12 @@ ALSO_COVERED = {
 
 
 def test_coverage_report():
-    """Regenerate tests/OP_COVERAGE.md; every unique op must be covered by
-    the sweep or a named dedicated test file."""
-    unique = _unique_ops()
-    swept = set(CASES)
-    rows, uncovered = [], []
-    for name in sorted(unique):
-        if name in swept:
-            rows.append((name, "sweep (%d cases)" % len(CASES[name])))
-        elif name in ALSO_COVERED:
-            rows.append((name, ALSO_COVERED[name]))
-        else:
-            rows.append((name, "NOT COVERED"))
-            uncovered.append(name)
+    """Regenerate tests/OP_COVERAGE.md via mxnet_tpu.analysis (same code
+    path as ``python -m mxnet_tpu.analysis --coverage``); every unique op
+    must be covered by the sweep or a named dedicated test file."""
+    from mxnet_tpu.analysis import generate_coverage_md
     path = os.path.join(os.path.dirname(__file__), "OP_COVERAGE.md")
-    with open(path, "w") as f:
-        f.write("# Operator test coverage\n\n")
-        f.write("%d unique ops (%d registered names); %d swept, %d covered "
-                "by dedicated files, %d uncovered.\n\n"
-                % (len(unique), len(registry.list_ops()), len(swept & set(unique)),
-                   len([r for r in rows if r[1] not in ("NOT COVERED",)
-                        and not r[1].startswith("sweep")]), len(uncovered)))
-        f.write("| op | covered by |\n|---|---|\n")
-        for name, cov in rows:
-            f.write("| %s | %s |\n" % (name, cov))
+    # pass this module's maps so the table reflects what pytest collected
+    _rows, uncovered = generate_coverage_md(
+        path=path, cases=CASES, also_covered=ALSO_COVERED)
     assert not uncovered, "ops without any test: %s" % uncovered
