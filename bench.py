@@ -11,7 +11,10 @@ when the driver's clock runs out is a valid record (the reference's
 benchmark_score.py prints per-model lines as it goes for the same reason).
 Each sub-bench is time-boxed against a global budget
 (MXTPU_BENCH_BUDGET_S); SIGTERM/SIGINT re-print the latest record before
-exiting.
+exiting.  Exit code contract: 0 only when at least one measurement was
+taken live THIS run — a run that only re-emitted carried-forward (stale)
+numbers exits 1, so return-code consumers cannot mistake a dead run for
+success (the in-record `stale`/`stale_keys` flags carry the detail).
 """
 from __future__ import annotations
 
@@ -44,6 +47,11 @@ class _Record:
         # value this run; mirrored into result["stale_keys"]
         self.stale_keys = set()
         self.measured_round = None
+        # True once any measurement was taken THIS run (not carried
+        # forward).  The exit code keys on it: a run killed before any
+        # live measurement exits non-zero instead of reporting success
+        # with a purely stale record (ADVICE r5 item 4).
+        self.live = False
         # prebuilt line for the signal handler: print() is not
         # signal-safe (a SIGTERM landing mid-emit would raise
         # "reentrant call inside BufferedWriter" and tear the tail line)
@@ -54,6 +62,8 @@ class _Record:
 
     def update_live(self, d):
         """Merge live measurements, clearing their staleness markers."""
+        if d:
+            self.live = True
         self.result.update(d)
         if self.stale_keys:
             self.stale_keys -= set(d)
@@ -212,10 +222,12 @@ def main():
     def _bail(signum, frame):
         # async-signal-safe re-emit: raw write of the last complete line
         # (preceded by a newline in case a print was torn mid-line).
-        # Exit 0: the tail line is a valid record by construction.
+        # Exit 0 only when something was measured live this run: a run
+        # killed on a purely carried-forward record must not report
+        # success to anything keying on the return code.
         if rec.last_line:
             os.write(1, b"\n" + rec.last_line)
-        os._exit(0)
+        os._exit(0 if rec.live else 1)
 
     signal.signal(signal.SIGTERM, _bail)
     signal.signal(signal.SIGINT, _bail)
@@ -255,7 +267,9 @@ def main():
     except Exception as e:  # never lose the tail record to a crash
         rec.result["fatal_error"] = str(e)[:300]
         rec.emit()
-    sys.exit(0)
+    # success == at least one live measurement this run; the record on
+    # stdout is valid either way (stale flags say which)
+    sys.exit(0 if rec.live else 1)
 
 
 def _run_benches(rec):
@@ -281,6 +295,13 @@ def _run_benches(rec):
     if cache_dir:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    # -- serving micro-bench FIRST: host-runnable (Runner->Batcher reqs/s
+    # + p50/p99 latency on a JAX_PLATFORMS=cpu subprocess), so the key
+    # refreshes even when the TPU backend never comes up (the r5 failure
+    # mode: every key starved behind backend acquisition)
+    if os.environ.get("MXTPU_BENCH_SERVING", "1") == "1":
+        rec.stage("serving", 90, _serving_bench)
 
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
@@ -415,6 +436,25 @@ def _run_benches(rec):
         # and survives even if the accuracy gate is cut off)
         rec.stage("int8_infer", 90, _int8_infer_bench)
         rec.stage("int8_acc", 150, _int8_accuracy_gate)
+
+
+def _serving_bench():
+    """serving_reqs_per_sec + request-latency percentiles through the full
+    ModelRunner->Batcher path (mxnet_tpu/serving/bench.py).  Runs as a
+    JAX_PLATFORMS=cpu subprocess: host-capable by construction, and a
+    hung TPU backend in THIS process can never starve it."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual test mesh in the child
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.serving.bench"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("serving bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def _bf16_infer_bench(batch=None, iters=20):

@@ -1,0 +1,173 @@
+"""End-to-end serving demo: train -> checkpoint -> serve -> concurrent load.
+
+The deployment story the reference's example tree never had: a small MLP
+classifier is trained through Module, checkpointed, reloaded as an
+inference Module, and stood behind ``mxnet_tpu.serving`` — bucketed
+recompile-free execution, dynamic batching, HTTP front end.  Concurrent
+clients then hammer ``/predict`` and the demo asserts the serving
+contract end to end:
+
+- served predictions are numerically identical to a direct forward;
+- accuracy through the server matches the direct accuracy (>90%);
+- a 40-request concurrent load triggers ZERO jit recompiles after the
+  load-time warmup (checked through the exposed jit-cache counter);
+- ``/stats`` reports the traffic; graceful drain completes everything.
+
+Run: ``JAX_PLATFORMS=cpu python examples/serving/serve_demo.py``
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import tempfile
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.serving import Draining, ModelRunner, Server
+
+
+def make_blobs(rng, n, centers):
+    nclass, dim = centers.shape
+    y = rng.randint(0, nclass, n)
+    X = centers[y] + rng.randn(n, dim).astype(np.float32) * 0.5
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def build_net(nclass):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=nclass, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def train_and_checkpoint(X, y, nclass, epochs, batch, prefix):
+    it = mx.io.NDArrayIter(X, y, batch, shuffle=True, shuffle_seed=5)
+    mod = mx.mod.Module(build_net(nclass))
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-2})
+    mod.save_checkpoint(prefix, epochs)
+    return mod
+
+
+def serve_checkpoint(prefix, epoch, dim, buckets):
+    """Reload the checkpoint the way a serving process would."""
+    sym, arg, aux = mx.model.load_checkpoint(prefix, epoch)
+    mod = mx.mod.Module(sym, label_names=("softmax_label",))
+    max_b = max(buckets)
+    mod.bind(data_shapes=[("data", (max_b, dim))],
+             label_shapes=[("softmax_label", (max_b,))],
+             for_training=False)
+    mod.set_params(arg, aux)
+    return ModelRunner(mod, buckets=buckets)
+
+
+def hammer(host, port, X, n_clients, per_client):
+    """Concurrent single-example clients; returns (rows, preds) in request
+    order."""
+    results = {}
+    errors = []
+
+    def client(cid):
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            for i in range(per_client):
+                row = (cid * per_client + i) % len(X)
+                conn.request("POST", "/predict",
+                             json.dumps({"data": X[row].tolist()}),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+                assert resp.status == 200, (resp.status, body)
+                results[(cid, i)] = (row, np.asarray(body["outputs"]))
+            conn.close()
+        except Exception as e:  # surface in the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--per-client", type=int, default=5)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    nclass, dim = 5, 32
+    centers = rng.randn(nclass, dim).astype(np.float32) * 2.5
+    X, y = make_blobs(rng, 600, centers)
+    Xte, yte = make_blobs(np.random.RandomState(1), 200, centers)
+
+    with tempfile.TemporaryDirectory(prefix="mxtpu_serve_demo_") as tmp:
+        prefix = tmp + "/blobmlp"
+        mx.random.seed(7)
+        train_and_checkpoint(X, y, nclass, args.epochs, 64, prefix)
+        runner = serve_checkpoint(prefix, args.epochs, dim,
+                                  buckets=(1, 4, 8))
+
+    # direct (unserved) reference predictions + accuracy
+    direct = runner.forward_batch(Xte)
+    direct_acc = float((direct.argmax(1) == yte).mean())
+    assert direct_acc > 0.9, "classifier did not train: acc=%.3f" % direct_acc
+    warm_keys = runner.jit_cache_keys()
+
+    server = Server(runner, port=0, batch_timeout_ms=2.0, max_queue=128)
+    host, port = server.start()
+    print("serving on http://%s:%d" % (host, port))
+
+    results = hammer(host, port, Xte, args.clients, args.per_client)
+    n_req = args.clients * args.per_client
+    assert len(results) == n_req, (len(results), n_req)
+
+    # served == direct, row for row (the bucket-padding equivalence)
+    correct = 0
+    for row, out in results.values():
+        np.testing.assert_allclose(out, direct[row], rtol=1e-5, atol=1e-6)
+        correct += int(np.argmax(out) == yte[row])
+    print("served %d requests, served-side accuracy %.3f (direct %.3f)"
+          % (n_req, correct / n_req, direct_acc))
+
+    # zero steady-state recompiles: the warmup key set did not grow
+    assert runner.jit_cache_keys() == warm_keys, \
+        "serving traffic recompiled: %r" % (
+            runner.jit_cache_keys() - warm_keys)
+    assert runner.recompiles_since_warmup() == 0
+
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    conn.request("GET", "/stats")
+    stats = json.loads(conn.getresponse().read())
+    print("stats: %d reqs, fill=%.2f, p50=%.2fms p99=%.2fms, recompiles=%d"
+          % (stats["requests_total"], stats["batch_fill_ratio"],
+             stats["p50_ms"], stats["p99_ms"], stats["recompiles"]))
+    assert stats["requests_total"] >= n_req
+    assert stats["recompiles"] == 0
+    assert stats["rejected_total"] == 0
+    conn.request("GET", "/healthz")
+    assert json.loads(conn.getresponse().read())["status"] == "ok"
+    conn.close()
+
+    # graceful drain: everything in flight completes, then no admissions
+    server.drain()
+    try:
+        server.batcher.submit(Xte[0])
+        raise AssertionError("drained server accepted a request")
+    except Draining:
+        pass
+    print("drained cleanly; all assertions passed")
+
+
+if __name__ == "__main__":
+    main()

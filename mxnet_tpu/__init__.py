@@ -78,6 +78,7 @@ from . import profiler
 from . import engine
 from . import rtc
 from . import contrib
+from . import serving
 from . import operator
 from . import kvstore_server
 from . import attribute

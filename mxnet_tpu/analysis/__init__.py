@@ -25,13 +25,15 @@ from .findings import (Finding, RULES, ERROR, WARNING, INFO,
 from .registry_lint import lint_registry, unique_ops
 from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
 from .source_lint import lint_source, lint_file
+from .serving_lint import lint_serving
 from .coverage import load_test_map, generate_coverage_md
 from .report import render_text, render_json, exit_code, worst_severity
 
 __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "INFO",
     "lint_registry", "lint_graph", "lint_source", "lint_file",
-    "lint_symbol", "self_check", "load_test_map", "generate_coverage_md",
+    "lint_symbol", "lint_serving", "self_check", "load_test_map",
+    "generate_coverage_md",
     "render_text", "render_json", "exit_code", "worst_severity",
     "filter_findings", "suppressed_rules", "unique_ops",
     "LOSS_OPS", "LARGE_CONST_BYTES",

@@ -14,6 +14,9 @@ Options:
   ``--shapes "data=(1,3,224,224),label=(1,)"``
                           argument shapes for the graph pass (enables the
                           large-constant trace check)
+  ``--serving``           with a symbol target: also run the SRV rules
+                          (recompile-free bucket serving; --shapes feeds
+                          the batch-polymorphism probe)
 """
 from __future__ import annotations
 
@@ -67,10 +70,15 @@ def main(argv=None):
                         "\"data=(1,3,224,224)\"")
     p.add_argument("--no-consts", action="store_true",
                    help="skip the trace-based large-constant check")
+    p.add_argument("--serving", action="store_true",
+                   help="with a .json symbol target: also run the SRV "
+                        "serving rules (recompile-free bucket execution; "
+                        "needs --shapes for the batch-polymorphism probe)")
     args = p.parse_args(argv)
 
-    from . import (self_check, lint_file, lint_symbol, generate_coverage_md,
-                   render_text, render_json, exit_code)
+    from . import (self_check, lint_file, lint_symbol, lint_serving,
+                   generate_coverage_md, render_text, render_json,
+                   exit_code)
     disable = tuple(r.strip() for r in args.disable.split(",") if r.strip())
 
     if args.coverage:
@@ -94,9 +102,12 @@ def main(argv=None):
     if args.target.endswith(".json"):
         from ..symbol import load
         sym = load(args.target)
-        findings = lint_symbol(sym, shapes=_parse_shapes(args.shapes),
-                               disable=disable,
+        shapes = _parse_shapes(args.shapes)
+        findings = lint_symbol(sym, shapes=shapes, disable=disable,
                                check_consts=not args.no_consts)
+        if args.serving:
+            findings += lint_serving(sym, data_shapes=shapes,
+                                     disable=disable)
         title = "mxlint graph %s" % args.target
     else:
         findings = lint_file(args.target, disable=disable)

@@ -70,6 +70,12 @@ RULES = {
                         "trace-time sync and bakes the value in"),
     "SRC002": (WARNING, "python branch on a runtime shape retraces per "
                         "shape (recompile on every new input geometry)"),
+    # serving pass (mxnet_tpu/analysis/serving_lint.py)
+    "SRV001": (ERROR, "symbol is not batch-polymorphic: shapes are "
+                      "data-dependent or baked, so padded-bucket serving "
+                      "cannot be recompile-free"),
+    "SRV002": (WARNING, "Reshape bakes a static batch dimension; every "
+                        "serving bucket compiles (or breaks) separately"),
 }
 
 

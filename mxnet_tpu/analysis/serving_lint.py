@@ -1,0 +1,113 @@
+"""Serving lint: can this Symbol be served recompile-free from buckets?
+
+``mxnet_tpu.serving.ModelRunner`` pads every request batch up to a fixed
+bucket ladder so steady-state traffic hits a finite, pre-compiled program
+family.  That contract only holds for *batch-polymorphic* graphs: scaling
+the data batch axis must scale every downstream shape proportionally.
+Two classes break it —
+
+- **data-dependent / baked shapes** (SRV001, error): shape inference
+  fails when the batch size changes, or an output's batch axis does not
+  follow the input's (a static Reshape collapsed it, a value-dependent
+  geometry leaked in).  Such a symbol recompiles — or silently mixes
+  rows — per request geometry; the runner refuses to serve it.
+- **static Reshape on the batch path** (SRV002, warning): the graph may
+  still infer, but each bucket traces a distinct program through the
+  baked shape; use 0/-1 dim codes.
+
+The probe is pure shape inference (no tracing), so it is safe to run at
+model-load time inside the server.
+"""
+from __future__ import annotations
+
+from .findings import Finding, filter_findings
+
+__all__ = ["lint_serving"]
+
+# mirrors graph_lint._RESHAPE_OPS; serving cares about the batch axis
+_RESHAPE_OPS = frozenset({"Reshape", "reshape"})
+
+
+def _scaled(shapes, factor):
+    return {name: (int(s[0]) * factor,) + tuple(s[1:])
+            for name, s in shapes.items()}
+
+
+def _infer(symbol, shapes):
+    try:
+        arg_shapes, out_shapes, _aux = symbol.infer_shape(**shapes)
+    except Exception as e:
+        return None, str(e)
+    if arg_shapes is None or out_shapes is None:
+        return None, "shape inference is underdetermined"
+    return out_shapes, None
+
+
+def _lint_batch_polymorphism(symbol, data_shapes):
+    """Scale the data batch axis and require every output batch axis to
+    follow proportionally (the padded-bucket execution model)."""
+    base = {name: tuple(s) for name, s in data_shapes.items()}
+    if not base or any(len(s) == 0 for s in base.values()):
+        return []
+    subject = symbol.name or "<graph>"
+    out0, err = _infer(symbol, base)
+    if err is not None:
+        return [Finding("SRV001", subject,
+                        "shape inference fails at the declared data "
+                        "shapes %r: %s" % (base, err))]
+    factor = 2
+    out1, err = _infer(symbol, _scaled(base, factor))
+    if err is not None:
+        return [Finding("SRV001", subject,
+                        "scaling the batch axis by %d breaks shape "
+                        "inference (%s) — requests of different sizes "
+                        "cannot share padded buckets" % (factor, err))]
+    findings = []
+    names = symbol.list_outputs()
+    for i, (s0, s1) in enumerate(zip(out0, out1)):
+        if not s0:
+            continue
+        want = (int(s0[0]) * factor,) + tuple(s0[1:])
+        if tuple(s1) != want:
+            findings.append(Finding(
+                "SRV001", names[i] if i < len(names) else subject,
+                "output %d has shape %r at batch %r but %r at batch x%d "
+                "(expected %r): the batch axis is baked or data-"
+                "dependent, so bucket padding would mix rows or "
+                "recompile per request size"
+                % (i, tuple(s0), {k: v[0] for k, v in base.items()},
+                   tuple(s1), factor, want)))
+    return findings
+
+
+def _lint_static_batch_reshape(symbol):
+    out = []
+    for n in symbol._nodes():
+        if n.op not in _RESHAPE_OPS:
+            continue
+        from ..ops import registry as _reg
+        shape = _reg.canonicalize(n.attrs.get("shape", ()))
+        if not isinstance(shape, (tuple, list)) or not shape:
+            continue
+        lead = shape[0]
+        if isinstance(lead, int) and lead > 0:
+            out.append(Finding(
+                "SRV002", n.name,
+                "Reshape target %r bakes the batch dimension to %d; each "
+                "serving bucket traces its own program (or fails) — use "
+                "dim code 0 (copy) or -1 (infer) for the batch axis"
+                % (tuple(shape), lead)))
+    return out
+
+
+def lint_serving(symbol, data_shapes=None, disable=()):
+    """Run the serving rules over ``symbol``.
+
+    ``data_shapes``: {data_name: full shape incl. batch axis}.  Without
+    it only the structural SRV002 scan runs (the polymorphism probe
+    needs a concrete batch axis to scale).
+    """
+    findings = _lint_static_batch_reshape(symbol)
+    if data_shapes:
+        findings += _lint_batch_polymorphism(symbol, data_shapes)
+    return filter_findings(findings, disable)

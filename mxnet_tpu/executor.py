@@ -179,6 +179,10 @@ class Executor:
         self.outputs = []
         self._out_raw = None
         self._last_key = _fresh_key()
+        # executed jit signatures: one entry per compiled program variant
+        # (shape/dtype of every arg + aux, train flag).  The serving layer
+        # asserts recompile-free steady state against this set.
+        self._jit_cache_keys = set()
 
     # ------------------------------------------------------------------
     def _sharding(self, name):
@@ -265,6 +269,12 @@ class Executor:
             self.arg_dict[n]._set_data(raw)
         arg_vals = {n: a._data for n, a in self.arg_dict.items()}
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}
+        self._jit_cache_keys.add((
+            bool(is_train),
+            tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                         for n, v in arg_vals.items())),
+            tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                         for n, v in aux_vals.items()))))
         fn = self._jit_train if is_train else self._jit_infer
         # draw the key eagerly; backward reuses it so dropout masks match
         # between the forward pass and the rematerialized one in the vjp
@@ -303,6 +313,24 @@ class Executor:
                 dst._set_data(g)
         self.grad_arrays = [self.grad_dict.get(n) for n in self._arg_names]
         return [self.grad_dict.get(n) for n in self._wrt]
+
+    # ------------------------------------------------------------------
+    def jit_cache_keys(self):
+        """Signatures executed so far — the jit-cache keys.  jax.jit caches
+        one compiled program per signature, so a stable set across a load
+        window proves zero steady-state recompiles (serving contract)."""
+        return set(self._jit_cache_keys)
+
+    def jit_cache_size(self):
+        """Number of compiled program variants.  Prefers the jit's own
+        cache counter (counts actual XLA traces) and falls back to the
+        tracked signature set."""
+        try:
+            return int(self._jit_infer._cache_size()
+                       + self._jit_train._cache_size()
+                       + self._jit_bwd._cache_size())
+        except AttributeError:
+            return len(self._jit_cache_keys)
 
     # ------------------------------------------------------------------
     @property

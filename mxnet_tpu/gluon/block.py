@@ -233,6 +233,14 @@ class CachedOp:
         self._cache = {}
         self._remat = bool(getattr(block, "_remat", False))
 
+    def cache_keys(self):
+        """The jit-cache keys compiled so far: one per (input shapes/dtypes,
+        train flag, kwargs) signature.  Stable set == no recompiles."""
+        return set(self._cache.keys())
+
+    def cache_size(self):
+        return len(self._cache)
+
     def _make_body(self, params, param_names, kwargs, train):
         block = self._block
 
@@ -379,6 +387,22 @@ class HybridBlock(Block):
             return self._cached_op(all_params, list(args),
                                    autograd.is_training(), kwargs)
         return self.hybrid_forward_wrapper(*args, **kwargs)
+
+    def jit_cache_keys(self):
+        """Jit-cache keys across this block and its hybridized children
+        (reference: the CachedOp signature cache, cached_op.cc:94).  A
+        serving ModelRunner snapshots this after warmup; any growth under
+        traffic is a steady-state recompile."""
+        keys = set()
+        if self._cached_op is not None:
+            keys |= {(self.name, k) for k in self._cached_op.cache_keys()}
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                keys |= child.jit_cache_keys()
+        return keys
+
+    def jit_cache_size(self):
+        return len(self.jit_cache_keys())
 
     def _collect_all_reg_params(self):
         out = {}

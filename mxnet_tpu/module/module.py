@@ -274,6 +274,21 @@ class Module(BaseModule):
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs
 
+    # ------------------------------------------------------------------
+    def jit_cache_keys(self):
+        """Executed jit signatures of the bound executor (one per compiled
+        program).  The serving layer snapshots this after bucket warmup and
+        asserts the set never grows under steady-state traffic."""
+        if not self.binded:
+            return set()
+        return self._exec.jit_cache_keys()
+
+    def jit_cache_size(self):
+        """Number of compiled program variants behind this module."""
+        if not self.binded:
+            return 0
+        return self._exec.jit_cache_size()
+
     def get_input_grads(self, merge_multi_context=True):
         if not self.inputs_need_grad:
             raise MXNetError("bind with inputs_need_grad=True")

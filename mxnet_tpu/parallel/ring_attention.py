@@ -246,22 +246,32 @@ def _seq_sharded_spec(mesh, axis):
     return NamedSharding(mesh, PartitionSpec(None, axis, None, None))
 
 
+def _shard_map(fn, mesh, in_specs, out_specs, check=False):
+    """Version-tolerant shard_map: jax>=0.5 exports jax.shard_map with a
+    check_vma kwarg; 0.4.x has jax.experimental.shard_map with check_rep.
+    check=False either way: the Pallas interpret-mode lowering slices
+    blocks with non-varying program-id indices, which the replication/vma
+    checker rejects; the kernels are correct under manual sharding."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check)
+
+
 def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False):
     """jit-able global entry: q/k/v are global (B, T, H, D) arrays; the
     function shards T over `axis` and runs ring attention."""
     spec = PartitionSpec(None, axis, None, None)
-    # check_vma=False: the Pallas interpret-mode lowering slices blocks with
-    # non-varying program-id indices, which the vma checker rejects; the
-    # kernels are correct under manual sharding either way
-    fn = jax.shard_map(partial(ring_attention, axis_name=axis, causal=causal),
-                       mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                       check_vma=False)
+    fn = _shard_map(partial(ring_attention, axis_name=axis, causal=causal),
+                    mesh, (spec, spec, spec), spec)
     return fn(q, k, v)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, axis="sp", causal=False):
-    from jax.experimental.shard_map import shard_map
     spec = PartitionSpec(None, axis, None, None)
-    fn = shard_map(partial(ulysses_attention, axis_name=axis, causal=causal),
-                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = _shard_map(partial(ulysses_attention, axis_name=axis,
+                            causal=causal),
+                    mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

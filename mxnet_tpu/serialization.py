@@ -21,8 +21,9 @@ Two on-disk formats, auto-detected by magic on load:
    round-trips dtypes the reference format cannot (bfloat16).
 
 ``save_ndarrays(..., format="mxnet")`` writes the reference format so
-checkpoints flow both directions; bfloat16 is widened to float32 there
-(the mshadow type table has no bf16 slot).
+checkpoints flow both directions; bfloat16 is widened to float32 and
+bool is cast to uint8 there (the mshadow type table has no slot for
+either — flag 7 = bool is accepted on load only, for newer producers).
 """
 from __future__ import annotations
 
@@ -236,6 +237,13 @@ def _save_mxnet_one(f, v):
             # MXNet scalars are shape (1,), so widen like bf16→f32 below
             data = data.reshape(1)
         shape = data.shape
+    if data.dtype == np.bool_:
+        # flag 7 (bool) exists only in OUR loader: the targeted stock
+        # MXNet's mshadow table stops at flag 6 (ndarray.py:56-66), so
+        # emitting 7 would break the interop guarantee this format exists
+        # for.  Cast to uint8 (value-preserving); 7 stays accepted on load
+        # for newer producers.
+        data = data.astype(np.uint8)
     if data.dtype.name not in _NP_TO_MX_FLAG:
         if data.dtype.kind == "f" or data.dtype.name == "bfloat16":
             # bfloat16: no mshadow slot — widen to f32 (lossless up-cast)

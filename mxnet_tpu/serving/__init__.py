@@ -1,0 +1,32 @@
+"""mxnet_tpu.serving — dynamic-batching inference with bucketed,
+recompile-free execution.
+
+The training side of this framework reached parity rounds ago; this
+package is the deployment half the reference papers treat as first-class
+(TensorFlow ships serving beside training, and MXNet motivates its
+symbolic executor with packaged inference).  Three layers:
+
+- :class:`~mxnet_tpu.serving.runner.ModelRunner` — a bound Module or
+  hybridized Gluon block behind a fixed ladder of padded batch buckets
+  (default 1/4/16/64), all compiled ahead of time at load, with the
+  jit-cache key set exposed so steady-state traffic provably never
+  recompiles;
+- :class:`~mxnet_tpu.serving.batcher.Batcher` — a thread that coalesces
+  concurrent requests up to ``max_batch``/``batch_timeout_ms``, pads to
+  the nearest bucket, splits results per request, and rejects (never
+  stalls) when its bounded queue fills;
+- :class:`~mxnet_tpu.serving.server.Server` — a stdlib-HTTP front end
+  with ``/predict``, ``/healthz`` and ``/stats`` plus graceful drain.
+
+See ``docs/serving.md``, ``tools/serve.py`` (CLI) and
+``examples/serving/`` (end-to-end demo).
+"""
+from __future__ import annotations
+
+from .runner import ModelRunner, DEFAULT_BUCKETS
+from .batcher import Batcher, ServerBusy, Draining
+from .server import Server
+from .stats import ServingStats, percentile
+
+__all__ = ["ModelRunner", "DEFAULT_BUCKETS", "Batcher", "ServerBusy",
+           "Draining", "Server", "ServingStats", "percentile"]

@@ -1,0 +1,210 @@
+"""Batcher: coalesce concurrent single requests into bucketed batches.
+
+The dynamic-batching core of the serving layer (the reference analogue is
+the server-side request coalescing TF-Serving ships; MXNet's
+BucketingModule solved the same compile-explosion problem for training).
+A bounded queue feeds one worker thread: the worker takes the first
+waiting request, keeps collecting until ``max_batch`` requests are in
+hand or ``batch_timeout_ms`` has elapsed, stacks them, and hands the
+batch to the :class:`~mxnet_tpu.serving.runner.ModelRunner`, which pads
+to the nearest bucket.  Results are split back per-request.
+
+Backpressure: the queue is bounded (``max_queue``); a submit against a
+full queue raises :class:`ServerBusy` immediately — callers (the HTTP
+front end maps this to 429) retry, the server never builds an unbounded
+backlog.  ``drain()`` stops admission, completes everything already
+queued, and joins the worker — the graceful-shutdown half of the
+contract.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from .stats import ServingStats
+
+__all__ = ["Batcher", "ServerBusy", "Draining"]
+
+
+class ServerBusy(MXNetError):
+    """Queue full — reject now rather than stall (HTTP 429)."""
+
+
+class Draining(MXNetError):
+    """Server is draining — no new admissions (HTTP 503)."""
+
+
+class _Pending:
+    """One in-flight request: a tiny future (stdlib-only)."""
+
+    __slots__ = ("example", "_event", "_result", "_exc", "t_submit")
+
+    def __init__(self, example):
+        self.example = example
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+        self.t_submit = time.monotonic()
+
+    def set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def set_exception(self, exc):
+        self._exc = exc
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not served within %ss" % timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+_SENTINEL = object()
+
+
+class Batcher:
+    def __init__(self, runner, max_batch=None, batch_timeout_ms=2.0,
+                 max_queue=256, stats=None):
+        self.runner = runner
+        self.max_batch = int(max_batch or runner.max_batch)
+        if self.max_batch > runner.max_batch:
+            # a coalesced batch larger than the top bucket would be split
+            # by the runner anyway; cap so one batch == one device call
+            self.max_batch = runner.max_batch
+        self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
+        self.stats = stats if stats is not None else \
+            ServingStats(runner.buckets)
+        self._q = _queue.Queue(maxsize=int(max_queue))
+        # serializes admission against drain(): the sentinel must queue
+        # strictly after every admitted request or a submit racing drain
+        # could land behind the sentinel and never be served
+        self._admit_lock = threading.Lock()
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxtpu-batcher", daemon=True)
+        self._thread.start()
+
+    # -- client side -------------------------------------------------------
+    @property
+    def queue_depth(self):
+        return self._q.qsize()
+
+    @property
+    def draining(self):
+        return self._draining.is_set()
+
+    def submit(self, example):
+        """Enqueue one example; returns a future-like with ``.result()``.
+        Raises :class:`ServerBusy` when the queue is full and
+        :class:`Draining` after ``drain()`` — never blocks the caller."""
+        req = _Pending(_np.asarray(example))
+        with self._admit_lock:
+            if self._draining.is_set():
+                raise Draining("server is draining; request rejected")
+            try:
+                self._q.put_nowait(req)
+            except _queue.Full:
+                self.stats.on_reject()
+                raise ServerBusy(
+                    "request queue full (%d deep); retry later"
+                    % self._q.maxsize) from None
+        self.stats.on_submit()
+        return req
+
+    def infer(self, example, timeout=30.0):
+        """Blocking convenience: submit + wait for the result row."""
+        return self.submit(example).result(timeout)
+
+    # -- worker side -------------------------------------------------------
+    def _collect(self, first):
+        """First request in hand: keep collecting until max_batch or the
+        coalescing window closes.  Returns (batch, saw_sentinel)."""
+        batch = [first]
+        deadline = time.monotonic() + self.batch_timeout_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # during drain, whatever is queued should leave in as few
+                # device calls as possible — keep filling without waiting
+                if self._draining.is_set():
+                    try:
+                        nxt = self._q.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if nxt is _SENTINEL:
+                        return batch, True
+                    batch.append(nxt)
+                    continue
+                break
+            try:
+                nxt = self._q.get(timeout=remaining)
+            except _queue.Empty:
+                break
+            if nxt is _SENTINEL:
+                return batch, True
+            batch.append(nxt)
+        return batch, False
+
+    def _run_batch(self, batch):
+        self.stats.on_dequeue(len(batch))
+        n = len(batch)
+        bucket = self.runner.bucket_for(n)
+        try:
+            x = _np.stack([r.example for r in batch])
+            out = self.runner.forward_batch(x)
+        except Exception as e:  # propagate per-request, keep serving
+            for r in batch:
+                r.set_exception(e)
+            self.stats.on_batch(bucket, n, [], error=True)
+            return
+        now = time.monotonic()
+        lat = []
+        for i, r in enumerate(batch):
+            r.set_result(out[i])
+            lat.append((now - r.t_submit) * 1000.0)
+        self.stats.on_batch(bucket, n, lat)
+        self.stats.set_recompiles(self.runner.recompiles_since_warmup())
+
+    def _loop(self):
+        while True:
+            try:
+                req = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if req is _SENTINEL:
+                break
+            batch, saw_sentinel = self._collect(req)
+            self._run_batch(batch)
+            if saw_sentinel:
+                break
+        self._drained.set()
+
+    # -- lifecycle ---------------------------------------------------------
+    def drain(self, timeout=60.0):
+        """Graceful shutdown: stop admitting, finish every queued request,
+        join the worker.  Idempotent."""
+        with self._admit_lock:
+            if not self._draining.is_set():
+                self._draining.set()
+                # the sentinel queues BEHIND all admitted requests (FIFO),
+                # so the worker serves everything in flight before exiting.
+                # Blocking put: on a full queue this waits for the worker
+                # to make room, which it always does.
+                self._q.put(_SENTINEL)
+        if not self._drained.wait(timeout):
+            raise TimeoutError("batcher did not drain within %ss" % timeout)
+        self._thread.join(timeout=5.0)
+        return True
+
+    close = drain

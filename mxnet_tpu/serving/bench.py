@@ -1,0 +1,102 @@
+"""Host-runnable serving micro-benchmark.
+
+Measures ``serving_reqs_per_sec`` plus end-to-end p50/p99 request latency
+through the full Runner→Batcher path on whatever backend is available —
+it is deliberately TPU-independent so ``bench.py`` can refresh the
+serving keys even when the chip never comes up (the r5 failure mode:
+every key starved behind backend acquisition).  ``bench.py`` runs this
+module as a ``JAX_PLATFORMS=cpu`` subprocess; it can also be run
+directly:
+
+    JAX_PLATFORMS=cpu python -m mxnet_tpu.serving.bench
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as _np
+
+__all__ = ["serving_bench"]
+
+
+def _build_runner(buckets, feat):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from .runner import ModelRunner
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return ModelRunner(net, buckets=buckets, example_shape=(feat,),
+                       warmup=True)
+
+
+def serving_bench(n_requests=None, concurrency=None, buckets=(1, 4, 16, 64),
+                  feat=32, batch_timeout_ms=2.0):
+    """Fire ``n_requests`` from ``concurrency`` client threads through a
+    Batcher over a small MLP; returns the stable bench keys."""
+    from .batcher import Batcher
+
+    n_requests = n_requests or int(os.environ.get("MXTPU_SERVING_BENCH_N",
+                                                  "400"))
+    concurrency = concurrency or int(os.environ.get(
+        "MXTPU_SERVING_BENCH_CONCURRENCY", "8"))
+    runner = _build_runner(buckets, feat)
+    batcher = Batcher(runner, batch_timeout_ms=batch_timeout_ms,
+                      max_queue=max(256, n_requests))
+    rng = _np.random.RandomState(0)
+    examples = rng.rand(64, feat).astype(_np.float32)
+
+    latencies = []
+    lat_lock = threading.Lock()
+    per_thread = n_requests // concurrency
+
+    def client(tid):
+        got = []
+        for i in range(per_thread):
+            t0 = time.monotonic()
+            batcher.infer(examples[(tid + i) % len(examples)], timeout=60)
+            got.append((time.monotonic() - t0) * 1000.0)
+        with lat_lock:
+            latencies.extend(got)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    batcher.drain()
+
+    from .stats import percentile
+    served = len(latencies)
+    return {
+        "serving_reqs_per_sec": round(served / wall, 2) if wall else 0.0,
+        "serving_p50_ms": round(percentile(latencies, 50), 3),
+        "serving_p99_ms": round(percentile(latencies, 99), 3),
+        "serving_batch_fill_ratio": round(
+            batcher.stats.batch_fill_ratio(), 4),
+        "serving_recompiles": runner.recompiles_since_warmup(),
+        "serving_requests": served,
+        "serving_concurrency": concurrency,
+    }
+
+
+def main():
+    out = serving_bench()
+    print(json.dumps(out), flush=True)
+    # the contract bench.py's stage relies on: zero steady-state recompiles
+    return 0 if out["serving_recompiles"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,196 @@
+"""ModelRunner: a trained model behind fixed padded batch buckets.
+
+Reference posture: TensorFlow ships serving beside training (Abadi et al.,
+2016) and MXNet's paper motivates the symbolic executor with deployment;
+this runner is the missing piece over our jit caches.  ``jax.jit`` (via
+``Executor`` for Modules, ``CachedOp`` for hybridized Gluon blocks)
+compiles one program per input signature — unconstrained request sizes
+would compile an unbounded program family.  The runner therefore admits
+only a fixed bucket ladder (default 1/4/16/64): every request batch is
+zero-padded up to the smallest bucket that fits, all buckets are compiled
+ahead of time at load (``warmup()``), and the exposed jit-cache key set
+lets callers *assert* that steady-state traffic never triggers a new
+compile (the BucketingModule idea, pointed at inference).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["ModelRunner", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 4, 16, 64)
+
+
+class ModelRunner:
+    """Bucketed, recompile-free forward over a Module or HybridBlock.
+
+    Parameters
+    ----------
+    model : Module (bound, params initialized) or HybridBlock (hybridized)
+    buckets : ascending batch sizes compiled at load; requests pad up to
+        the smallest fitting bucket, larger batches split into max-bucket
+        chunks
+    example_shape : per-example input shape (no batch dim).  Required for
+        Gluon blocks; inferred from ``data_shapes`` for Modules.
+    dtype : input dtype (inferred from the Module's data desc when bound)
+    lint : run the SRV serving lint over a Module's symbol at load;
+        findings at ERROR severity (non-batch-polymorphic graphs) raise
+    warmup : compile every bucket now, so the first request is served by
+        a cache hit, and snapshot the jit-cache baseline
+    """
+
+    def __init__(self, model, buckets=DEFAULT_BUCKETS, example_shape=None,
+                 dtype=None, lint=True, warmup=True):
+        if not buckets:
+            raise MXNetError("ModelRunner needs at least one bucket")
+        self.buckets = tuple(sorted(int(b) for b in set(buckets)))
+        if self.buckets[0] < 1:
+            raise MXNetError("buckets must be positive, got %r"
+                             % (self.buckets,))
+        self._model = model
+        self._lock = threading.Lock()
+        self._is_module = hasattr(model, "bind") and hasattr(model, "binded")
+        if self._is_module:
+            if not model.binded or not model.params_initialized:
+                raise MXNetError(
+                    "ModelRunner needs a bound, initialized Module")
+            desc = model.data_shapes[0]
+            self._data_name = desc.name
+            self.example_shape = tuple(desc.shape[1:]) \
+                if example_shape is None else tuple(example_shape)
+            self.dtype = dtype or getattr(desc, "dtype", _np.float32)
+            if lint:
+                self._lint_symbol()
+        else:
+            if not getattr(model, "_active", False):
+                raise MXNetError(
+                    "ModelRunner needs a hybridized HybridBlock "
+                    "(call block.hybridize()) — an eager block has no jit "
+                    "cache to keep warm")
+            if example_shape is None:
+                raise MXNetError(
+                    "example_shape is required for Gluon blocks")
+            self._data_name = "data"
+            self.example_shape = tuple(example_shape)
+            self.dtype = dtype or _np.float32
+        self._warm_keys = frozenset()
+        self.warmed_up = False
+        if warmup:
+            self.warmup()
+
+    # -- load-time checks --------------------------------------------------
+    def _lint_symbol(self):
+        from ..analysis import ERROR, lint_serving, render_text
+        shapes = {d.name: d.shape for d in self._model.data_shapes}
+        findings = lint_serving(self._model.symbol, data_shapes=shapes)
+        errors = [f for f in findings if f.severity == ERROR]
+        if errors:
+            raise MXNetError(
+                "symbol cannot be served recompile-free:\n%s"
+                % render_text(errors))
+        if findings:
+            import warnings
+            warnings.warn("serving lint:\n%s" % render_text(findings))
+
+    # -- bucket arithmetic -------------------------------------------------
+    @property
+    def max_batch(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        """Smallest bucket that fits ``n`` requests (``n`` capped at the
+        max bucket by the chunking in forward_batch)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    # -- execution ---------------------------------------------------------
+    def _forward_bucket(self, x):
+        """Forward one exactly-bucket-sized array; returns numpy output."""
+        if self._is_module:
+            from .. import io as _io
+            from .. import ndarray as nd
+            data = [nd.array(x)]
+            label = None
+            if self._model.label_shapes:
+                # keep the label feed's batch axis in lockstep with the
+                # data bucket so the traced program family stays one-per-
+                # bucket even for symbols bound with label slots
+                label = [nd.array(_np.zeros((x.shape[0],) + tuple(d.shape[1:]),
+                                            _np.float32))
+                         for d in self._model.label_shapes]
+            batch = _io.DataBatch(data=data, label=label)
+            self._model.forward(batch, is_train=False)
+            return self._model.get_outputs()[0].asnumpy()
+        from .. import ndarray as nd
+        out = self._model(nd.array(x).astype(self.dtype))
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out.asnumpy()
+
+    def forward_batch(self, x):
+        """Run ``x`` of shape ``(n,) + example_shape`` through the model,
+        padding up to the nearest bucket (splitting above the max bucket),
+        and return outputs for exactly the ``n`` real rows."""
+        x = _np.ascontiguousarray(x, dtype=_np.dtype(self.dtype))
+        if x.shape[1:] != self.example_shape:
+            raise MXNetError(
+                "request shape %r does not match example_shape %r"
+                % (x.shape[1:], self.example_shape))
+        n = x.shape[0]
+        if n == 0:
+            raise MXNetError("empty request batch")
+        outs = []
+        with self._lock:
+            for start in range(0, n, self.max_batch):
+                chunk = x[start:start + self.max_batch]
+                bucket = self.bucket_for(chunk.shape[0])
+                if chunk.shape[0] < bucket:
+                    pad = _np.zeros((bucket - chunk.shape[0],)
+                                    + self.example_shape, dtype=x.dtype)
+                    padded = _np.concatenate([chunk, pad], axis=0)
+                else:
+                    padded = chunk
+                out = self._forward_bucket(padded)
+                outs.append(_np.asarray(out)[:chunk.shape[0]])
+        return _np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+    def predict(self, example):
+        """Single-example convenience: ``example_shape`` in, one row out."""
+        example = _np.asarray(example)
+        return self.forward_batch(example[None])[0]
+
+    # -- AOT warmup & the recompile contract -------------------------------
+    def warmup(self):
+        """Compile every bucket now (AOT): one zero-batch forward per
+        bucket, then snapshot the jit-cache key set.  After this, any
+        growth of the set under traffic is a steady-state recompile —
+        ``recompiles_since_warmup()`` must stay 0."""
+        for b in self.buckets:
+            self._forward_bucket(
+                _np.zeros((b,) + self.example_shape,
+                          dtype=_np.dtype(self.dtype)))
+        self._warm_keys = frozenset(self.jit_cache_keys())
+        self.warmed_up = True
+        return self._warm_keys
+
+    def jit_cache_keys(self):
+        return set(self._model.jit_cache_keys())
+
+    def jit_cache_size(self):
+        return self._model.jit_cache_size()
+
+    def recompiles_since_warmup(self):
+        """Number of jit-cache keys added after warmup — the serving
+        contract is that this stays 0 under steady-state traffic."""
+        return len(self.jit_cache_keys() - self._warm_keys)
+
+    def __repr__(self):
+        kind = "Module" if self._is_module else "HybridBlock"
+        return "<ModelRunner %s buckets=%s example=%s>" % (
+            kind, list(self.buckets), self.example_shape)

@@ -1,0 +1,173 @@
+"""HTTP front end: /predict with dynamic batching, /healthz, /stats.
+
+Stdlib ``http.server`` over the :class:`~mxnet_tpu.serving.batcher.Batcher`
+(the socket framing idioms follow ``kvstore_ps.py``: bounded, blocking,
+per-connection threads).  Contract:
+
+- ``POST /predict``  body ``{"data": <nested list>}`` — one example when
+  the shape matches ``example_shape``, else a batch of examples (each
+  coalesced independently).  200 → ``{"outputs": ...}``.
+- ``429`` + ``Retry-After`` when the admission queue is full
+  (backpressure, never an unbounded backlog), ``503`` while draining,
+  ``400`` on malformed bodies, ``500`` on model errors.
+- ``GET /healthz`` — ``{"status": "ok"|"draining"}`` (200/503).
+- ``GET /stats`` — the :meth:`ServingStats.as_dict` JSON: per-bucket
+  p50/p99 latency, queue depth, batch-fill ratio, recompile count.
+- ``drain()`` — stop admissions, finish all in-flight requests, then
+  stop the listener (graceful shutdown; wired to SIGTERM/SIGINT in
+  ``tools/serve.py``).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as _np
+
+from .batcher import Batcher, Draining, ServerBusy
+
+__all__ = ["Server"]
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # the stdlib default TCP accept backlog is 5: a modest connection
+    # burst (tens of clients dialing at once) gets kernel-level RSTs
+    # before the app ever sees the requests.  Admission control belongs
+    # to the Batcher's bounded queue (429), not the SYN queue.
+    request_queue_size = 128
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "mxtpu-serving/0.1"
+
+    # the Server instance is attached to the HTTPServer as `.serving`
+    @property
+    def _srv(self):
+        return self.server.serving
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if self._srv.verbose:
+            super().log_message(fmt, *args)
+
+    def _reply(self, code, payload, headers=()):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv = self._srv
+        if self.path == "/healthz":
+            if srv.draining:
+                self._reply(503, {"status": "draining"})
+            else:
+                self._reply(200, {"status": "ok"})
+        elif self.path == "/stats":
+            stats = srv.batcher.stats.as_dict()
+            stats["recompiles"] = srv.runner.recompiles_since_warmup()
+            stats["buckets_configured"] = list(srv.runner.buckets)
+            self._reply(200, stats)
+        else:
+            self._reply(404, {"error": "unknown path %s" % self.path})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._reply(404, {"error": "unknown path %s" % self.path})
+            return
+        srv = self._srv
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            data = _np.asarray(payload["data"], dtype=_np.float64)
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": "bad request: %s" % e})
+            return
+        single = data.shape == srv.runner.example_shape
+        batch = data[None] if single else data
+        if batch.ndim != len(srv.runner.example_shape) + 1 or \
+                batch.shape[1:] != srv.runner.example_shape:
+            self._reply(400, {
+                "error": "shape %r does not match example_shape %r"
+                         % (data.shape, srv.runner.example_shape)})
+            return
+        try:
+            pending = [srv.batcher.submit(row) for row in batch]
+        except ServerBusy as e:
+            self._reply(429, {"error": str(e)},
+                        headers=[("Retry-After", "1")])
+            return
+        except Draining as e:
+            self._reply(503, {"error": str(e)})
+            return
+        try:
+            outs = [p.result(srv.request_timeout_s) for p in pending]
+        except Exception as e:  # model error / timeout
+            self._reply(500, {"error": str(e)[:500]})
+            return
+        out = _np.stack(outs)
+        self._reply(200, {"outputs": (out[0] if single else out).tolist()})
+
+
+class Server:
+    """Ties Runner + Batcher + HTTP listener into one serving process."""
+
+    def __init__(self, runner, host="127.0.0.1", port=8080, max_batch=None,
+                 batch_timeout_ms=2.0, max_queue=256,
+                 request_timeout_s=30.0, verbose=False):
+        self.runner = runner
+        self.batcher = Batcher(runner, max_batch=max_batch,
+                               batch_timeout_ms=batch_timeout_ms,
+                               max_queue=max_queue)
+        self.request_timeout_s = float(request_timeout_s)
+        self.verbose = verbose
+        self._httpd = _HTTPServer((host, port), _Handler)
+        self._httpd.serving = self
+        self._thread = None
+        self._drained = False
+
+    @property
+    def address(self):
+        """(host, port) actually bound — port 0 resolves to a real one."""
+        return self._httpd.server_address[:2]
+
+    @property
+    def draining(self):
+        return self.batcher.draining
+
+    def start(self):
+        """Serve in a background thread; returns the bound (host, port)."""
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+                name="mxtpu-http", daemon=True)
+            self._thread.start()
+        return self.address
+
+    def serve_forever(self):
+        """Foreground serve (the tools/serve.py path)."""
+        self._httpd.serve_forever(poll_interval=0.1)
+
+    def drain(self, timeout=60.0):
+        """Graceful shutdown: new requests get 503, everything already
+        admitted completes, then the listener stops."""
+        self.batcher.drain(timeout=timeout)
+        if not self._drained:
+            self._drained = True
+            # shutdown() blocks until serve_forever exits; in-flight
+            # handler threads (daemon, already answered by the drained
+            # batcher) finish their writes independently
+            threading.Thread(target=self._httpd.shutdown,
+                             daemon=True).start()
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+            self._httpd.server_close()
+        return True
+
+    stop = drain

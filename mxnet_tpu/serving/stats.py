@@ -1,0 +1,121 @@
+"""Serving observability: per-bucket latency percentiles, queue depth,
+batch-fill ratio and recompile count.
+
+The counters ride :mod:`mxnet_tpu.profiler` ``Domain``/``Counter`` objects,
+so when profiling is on (``profiler.set_state('run')``) every queue-depth
+change and recompile lands in the same chrome://tracing JSON the rest of
+the framework emits; when profiling is off they are plain in-process
+numbers with one-bool-check overhead (the reference profiler contract).
+``as_dict()`` is the stable surface the HTTP ``/stats`` endpoint and
+``bench.py`` serialize.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from .. import profiler
+
+__all__ = ["ServingStats", "percentile"]
+
+# latency samples kept per bucket; old samples age out so /stats reflects
+# recent traffic, not the whole process lifetime
+_WINDOW = 2048
+
+
+def percentile(samples, q):
+    """Nearest-rank percentile of an iterable of floats (no numpy import on
+    the request path)."""
+    data = sorted(samples)
+    if not data:
+        return 0.0
+    rank = max(0, min(len(data) - 1, int(round(q / 100.0 * (len(data) - 1)))))
+    return data[rank]
+
+
+class ServingStats:
+    """Thread-safe serving metrics shared by Batcher/Server/ModelRunner."""
+
+    def __init__(self, buckets=()):
+        self._lock = threading.Lock()
+        self._domain = profiler.Domain("serving")
+        self.queue_depth = self._domain.new_counter("queue_depth", 0)
+        self.recompiles = self._domain.new_counter("recompiles", 0)
+        self._lat_ms = {int(b): deque(maxlen=_WINDOW) for b in buckets}
+        self._fill = deque(maxlen=_WINDOW)
+        self._t0 = time.monotonic()
+        self.requests_total = 0
+        self.rejected_total = 0
+        self.batches_total = 0
+        self.errors_total = 0
+
+    # -- recording ---------------------------------------------------------
+    def on_submit(self):
+        with self._lock:
+            self.requests_total += 1
+        self.queue_depth.increment()
+
+    def on_reject(self):
+        with self._lock:
+            self.rejected_total += 1
+
+    def on_dequeue(self, n=1):
+        self.queue_depth.decrement(n)
+
+    def on_batch(self, bucket, n_real, latencies_ms, error=False):
+        """One executed batch: ``bucket`` padded size, ``n_real`` requests
+        in it, per-request end-to-end latencies."""
+        with self._lock:
+            self.batches_total += 1
+            if error:
+                self.errors_total += n_real
+            if bucket:
+                self._fill.append(n_real / float(bucket))
+                lat = self._lat_ms.setdefault(int(bucket),
+                                              deque(maxlen=_WINDOW))
+                lat.extend(latencies_ms)
+
+    def set_recompiles(self, n):
+        if n != self.recompiles._value:
+            self.recompiles.set_value(n)
+
+    # -- reporting ---------------------------------------------------------
+    def latency_ms(self, bucket=None):
+        """(p50, p99) over one bucket, or over all buckets when None."""
+        with self._lock:
+            if bucket is None:
+                samples = [s for d in self._lat_ms.values() for s in d]
+            else:
+                samples = list(self._lat_ms.get(int(bucket), ()))
+        return percentile(samples, 50), percentile(samples, 99)
+
+    def batch_fill_ratio(self):
+        with self._lock:
+            return (sum(self._fill) / len(self._fill)) if self._fill else 0.0
+
+    def as_dict(self):
+        p50, p99 = self.latency_ms()
+        with self._lock:
+            per_bucket = {}
+            for b, d in sorted(self._lat_ms.items()):
+                samples = list(d)
+                per_bucket[str(b)] = {
+                    "count": len(samples),
+                    "p50_ms": round(percentile(samples, 50), 3),
+                    "p99_ms": round(percentile(samples, 99), 3),
+                }
+            out = {
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "requests_total": self.requests_total,
+                "rejected_total": self.rejected_total,
+                "batches_total": self.batches_total,
+                "errors_total": self.errors_total,
+                "queue_depth": self.queue_depth._value,
+                "recompiles": self.recompiles._value,
+                "p50_ms": round(p50, 3),
+                "p99_ms": round(p99, 3),
+                "buckets": per_bucket,
+            }
+        out["batch_fill_ratio"] = round(self.batch_fill_ratio(), 4)
+        return out

