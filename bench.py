@@ -303,6 +303,13 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_SERVING", "1") == "1":
         rec.stage("serving", 90, _serving_bench)
 
+    # -- input-pipeline micro-bench, ALSO host-only and BEFORE backend
+    # acquisition: pipeline_fed_imgs_per_sec is a host property (decode +
+    # shm transport + fenced feed with the fused uint8 tail), so it must
+    # never starve behind a hung TPU init (the r03-r05 failure mode)
+    if os.environ.get("MXTPU_BENCH_PIPELINE", "1") == "1":
+        rec.stage("pipeline_host", 150, _pipeline_host_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -436,6 +443,25 @@ def _run_benches(rec):
         # and survives even if the accuracy gate is cut off)
         rec.stage("int8_infer", 90, _int8_infer_bench)
         rec.stage("int8_acc", 150, _int8_accuracy_gate)
+
+
+def _pipeline_host_bench():
+    """Host-only pipeline rates through mxnet_tpu.io.bench: legacy float
+    path vs the multi-process uint8 pipeline with the fused device tail,
+    plus the worker-scaling curve.  JAX_PLATFORMS=cpu subprocess — same
+    isolation contract as the serving stage."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.io.bench"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("pipeline bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def _serving_bench():
@@ -750,16 +776,23 @@ def _pipeline_bench(trainer, batch, layout, dtype, n_records=None,
     dt_feed = time.perf_counter() - t0
     feed_rate = n_feed / dt_feed
 
-    # fed rate: trainer consumes the double-buffered device feed — the
-    # worker fences one transfer at a time while the previous step's
-    # compute runs on device (iter_prefetcher.h:47 analogue).  Skipped
+    # fed rate: trainer consumes the multi-process pipeline's device feed
+    # (uint8 over the wire, /255 normalize fused on device) — the worker
+    # pool decodes while the device computes and the feed thread fences
+    # one transfer at a time (iter_prefetcher.h:47 analogue).  Skipped
     # when the train stage failed (trainer is None): the decode/feed
     # rates above are host properties and still stand.
     loss = None
     n = 0
     t0 = time.perf_counter()
     if trainer is not None:
-        fed = mx.io.DeviceFeedIter(make_it(), transform=prep)
+        fed = mx.io.ImageRecordIter(
+            path_imgrec=rec_path, path_imgidx=idx_path,
+            data_shape=(3, 224, 224), batch_size=batch, shuffle=True,
+            dtype=dtype, layout="NHWC" if layout == "NHWC" else "NCHW",
+            device_tail=True, std_r=255.0, std_g=255.0, std_b=255.0,
+            preprocess_threads=min(4, os.cpu_count() or 1),
+            prefetch_buffer=2)
         for b in fed:
             if b.data[0].shape[0] != batch:
                 break
@@ -767,6 +800,8 @@ def _pipeline_bench(trainer, batch, layout, dtype, n_records=None,
             n += batch
         if loss is not None:
             loss.asscalar()
+        if hasattr(fed.base, "close"):
+            fed.base.close()
     dt_fed = time.perf_counter() - t0
     fed_rate = n / dt_fed if n else 0.0
 
@@ -787,9 +822,12 @@ def _pipeline_bench(trainer, batch, layout, dtype, n_records=None,
     }
     if trainer is not None:
         # only report the trainer-fed numbers when they were measured —
-        # a fake 0.0 here would displace a carried-forward real value
-        out["pipeline_fed_imgs_per_sec"] = round(fed_rate, 2)
-        out["pipeline_stall_pct"] = round(stall * 100, 2)
+        # a fake 0.0 here would displace a carried-forward real value.
+        # (pipeline_fed_imgs_per_sec itself is owned by the host-only
+        # pipeline_host stage since PR 3; this one includes the device
+        # step in the loop)
+        out["pipeline_train_fed_imgs_per_sec"] = round(fed_rate, 2)
+        out["pipeline_train_stall_pct"] = round(stall * 100, 2)
     return out
 
 

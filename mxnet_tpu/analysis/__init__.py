@@ -32,7 +32,8 @@ from .report import render_text, render_json, exit_code, worst_severity
 __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "INFO",
     "lint_registry", "lint_graph", "lint_source", "lint_file",
-    "lint_symbol", "lint_serving", "self_check", "load_test_map",
+    "lint_symbol", "lint_serving", "lint_rule_docs", "self_check",
+    "load_test_map",
     "generate_coverage_md",
     "render_text", "render_json", "exit_code", "worst_severity",
     "filter_findings", "suppressed_rules", "unique_ops",
@@ -48,10 +49,36 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 
 
 def self_check(disable=(), with_coverage=True):
-    """Registry lint over the live registry — what CI runs.
+    """Registry lint over the live registry, plus the rule-table docs
+    sync check — what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
     """
     coverage_map = load_test_map() if with_coverage else None
-    return lint_registry(coverage_map=coverage_map, disable=disable)
+    findings = lint_registry(coverage_map=coverage_map, disable=disable)
+    findings += lint_rule_docs(disable=disable)
+    return findings
+
+
+def lint_rule_docs(disable=()):
+    """DOC001: every rule in RULES must have a row in the docs/analysis.md
+    rule table — new rules (e.g. a source-pass addition) land in the docs
+    in the same PR, enforced by ``--self-check``.  Skipped silently when
+    the repo docs are not present (installed package)."""
+    import os
+    import re
+
+    docs = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "docs", "analysis.md")
+    if not os.path.isfile(docs):
+        return []
+    with open(docs) as f:
+        documented = set(re.findall(r"^\|\s*([A-Z]{3}\d{3})\s*\|",
+                                    f.read(), re.M))
+    findings = [Finding("DOC001", rule,
+                        "rule %s is registered but has no row in "
+                        "docs/analysis.md" % rule)
+                for rule in sorted(RULES)
+                if rule not in documented and rule != "DOC001"]
+    return filter_findings(findings, disable)

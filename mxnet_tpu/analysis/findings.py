@@ -70,6 +70,14 @@ RULES = {
                         "trace-time sync and bakes the value in"),
     "SRC002": (WARNING, "python branch on a runtime shape retraces per "
                         "shape (recompile on every new input geometry)"),
+    "SRC003": (WARNING, "host-side mean/std normalization in the input "
+                        "pipeline: float math on the host and a 4x-wider "
+                        "host->device transfer; use the fused device tail "
+                        "(ImageRecordIter(device_tail=True) / "
+                        "mx.io.make_device_tail)"),
+    # meta (mxnet_tpu/analysis/__init__.py self_check)
+    "DOC001": (WARNING, "lint rule has no row in the docs/analysis.md "
+                        "rule table (keep RULES and the docs in sync)"),
     # serving pass (mxnet_tpu/analysis/serving_lint.py)
     "SRV001": (ERROR, "symbol is not batch-polymorphic: shapes are "
                       "data-dependent or baked, so padded-bucket serving "

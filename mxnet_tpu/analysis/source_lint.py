@@ -20,6 +20,14 @@ __all__ = ["lint_source", "lint_file"]
 _SYNC_METHODS = {"item", "asscalar", "asnumpy", "tolist"}
 # builtins that, applied to array expressions, capture a python scalar
 _CAST_BUILTINS = {"int", "float", "bool"}
+# host-side normalization entry points (SRC003): the device tail does the
+# same math fused into the first jitted step, off the host's critical path
+_NORMALIZE_CALLS = {"color_normalize", "ColorNormalizeAug"}
+# iterator factories where mean/std kwargs without device_tail=True pin the
+# normalize (and a float32 transfer) onto the host
+_ITER_FACTORIES = {"ImageRecordIter", "ImageIter", "CreateAugmenter"}
+_MEANSTD_KWARGS = {"mean", "std", "mean_r", "mean_g", "mean_b",
+                   "std_r", "std_g", "std_b"}
 
 
 def _contains_shape(node):
@@ -33,6 +41,28 @@ def _is_arrayish(node):
     result, subscript, or attribute chain — not a bare literal/name."""
     return isinstance(node, (ast.Call, ast.Subscript, ast.Attribute,
                              ast.BinOp))
+
+
+def _mentions(node, word):
+    """Any identifier/attribute under ``node`` whose name contains
+    ``word`` (case-insensitive) — e.g. ``rgb_mean``, ``cfg.std``."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is not None and word in name.lower():
+            return True
+    return False
+
+
+def _call_name(fn):
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
 
 
 class _Visitor(ast.NodeVisitor):
@@ -49,6 +79,7 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_Call(self, node):
         fn = node.func
+        name = _call_name(fn)
         if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
             self._emit("SRC001", node,
                        ".%s() synchronizes with the device and captures a "
@@ -61,6 +92,37 @@ class _Visitor(ast.NodeVisitor):
                        "%s(...) of an array expression captures a python "
                        "scalar at trace time; the traced graph bakes this "
                        "value in" % fn.id)
+        if name in _NORMALIZE_CALLS:
+            self._emit("SRC003", node,
+                       "%s() normalizes on the host (float math per image "
+                       "and a float32-wide transfer); ship uint8 and fuse "
+                       "the normalize on device instead "
+                       "(ImageRecordIter(device_tail=True) or "
+                       "mx.io.make_device_tail)" % name)
+        elif name in _ITER_FACTORIES:
+            kwargs = {k.arg for k in node.keywords if k.arg}
+            if kwargs & _MEANSTD_KWARGS and "device_tail" not in kwargs:
+                self._emit("SRC003", node,
+                           "%s(mean/std=...) without device_tail=True "
+                           "normalizes every batch on the host; pass "
+                           "device_tail=True to fuse the mean/std + cast "
+                           "+ layout tail into the device step and ship "
+                           "raw uint8" % name)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node):
+        # `(x - mean) / std` spelled out over arrays: the host-normalize
+        # idiom every MXNet driver script inherited
+        if isinstance(node.op, ast.Div) and \
+                isinstance(node.left, ast.BinOp) and \
+                isinstance(node.left.op, ast.Sub) and \
+                _mentions(node.left.right, "mean") and \
+                _mentions(node.right, "std"):
+            self._emit("SRC003", node,
+                       "host-side `(x - mean) / std` normalization; the "
+                       "fused device tail does this math on device off "
+                       "the input pipeline's critical path "
+                       "(mx.io.make_device_tail)")
         self.generic_visit(node)
 
     def _check_branch(self, node, kind):

@@ -266,6 +266,16 @@ class Executor:
             target = self._sharding(n) or self._devices[0]
             if not _on_device(raw, self._devices[0]) or self._mesh is not None:
                 raw = jax.device_put(raw, target)
+            # dtype-stable feed: a float-bound slot fed uint8 (the narrow
+            # uint8 pipeline) or a mismatched float width would change the
+            # jit signature and recompile every program — cast on device
+            # AFTER the (narrow) transfer instead.  Integer feeds into
+            # integer slots pass through untouched.
+            bound = self.arg_dict[n]._data.dtype
+            if raw.dtype != bound and jnp.issubdtype(bound, jnp.floating) \
+                    and (raw.dtype == jnp.uint8
+                         or jnp.issubdtype(raw.dtype, jnp.floating)):
+                raw = raw.astype(bound)
             self.arg_dict[n]._set_data(raw)
         arg_vals = {n: a._data for n, a in self.arg_dict.items()}
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}

@@ -574,8 +574,7 @@ class ImageIter(_io.DataIter):
             self.cur += 1
             if self.imgrec is not None:
                 if getattr(self, "_offsets", None) is not None:
-                    self.imgrec.handle.seek(self._offsets[idx])
-                    s = self.imgrec.read()
+                    s = self.imgrec.read_at(self._offsets[idx])
                 else:
                     s = self.imgrec.read_idx(idx)
                 header, img = recordio.unpack(s)
@@ -604,8 +603,19 @@ class ImageIter(_io.DataIter):
         return img
 
     def next(self):
+        data, label, pad = self.next_numpy()
+        d = nd.array(data, dtype=self.dtype)
+        lab = nd.array(label if self.label_width > 1 else label[:, 0])
+        return _io.DataBatch([d], [lab], pad=pad)
+
+    def next_numpy(self):
+        """One batch as ``(data, label, pad)`` *numpy* arrays — the host
+        side of ``next()`` with no device arrays created.  The
+        multi-process pipeline workers (io/pipeline.py) call this so a
+        worker can never initialise a jax backend; ``label`` always has
+        shape (B, label_width)."""
         if self._native_tail is not None:
-            return self._next_native()
+            return self._next_native_numpy()
         c, h, w = self.data_shape
         batch_data = np.zeros((self.batch_size, h, w, c), dtype=np.float32)
         lw = self.label_width
@@ -636,11 +646,9 @@ class ImageIter(_io.DataIter):
                 batch_label[i:] = batch_label[i - 1]
         if self.layout != "NHWC":
             batch_data = batch_data.transpose(0, 3, 1, 2)
-        data = nd.array(batch_data, dtype=self.dtype)
-        label = nd.array(batch_label if lw > 1 else batch_label[:, 0])
-        return _io.DataBatch([data], [label], pad=pad)
+        return (batch_data.astype(self.dtype, copy=False), batch_label, pad)
 
-    def _next_native(self):
+    def _next_native_numpy(self):
         """Batch decode through the C++ runtime (deterministic pipelines)."""
         from .. import _native
         c, h, w = self.data_shape
@@ -687,12 +695,9 @@ class ImageIter(_io.DataIter):
                     batch = batch.astype(aug.typ)
         if self.layout != "NHWC":
             batch = batch.transpose(0, 3, 1, 2)
-        data = nd.array(batch, dtype=self.dtype)
-        lab = np.stack(labels)
-        label = nd.array(lab if lw > 1 else lab[:, 0])
-        return _io.DataBatch([data], [label],
-                             pad=0 if self.last_batch_handle == "keep"
-                             else pad)
+        lab = np.stack(labels).reshape(-1, lw)
+        return (batch.astype(self.dtype, copy=False), lab,
+                0 if self.last_batch_handle == "keep" else pad)
 
     def _decode_python_bufs(self, bufs, labels, pad):
         """cv2-decode pre-collected record buffers (fallback from the
@@ -702,9 +707,6 @@ class ImageIter(_io.DataIter):
             .astype(np.float32)
         if self.layout != "NHWC":
             batch = batch.transpose(0, 3, 1, 2)
-        data = nd.array(batch, dtype=self.dtype)
-        lab = np.stack(labels)
-        label = nd.array(lab if lw > 1 else lab[:, 0])
-        return _io.DataBatch([data], [label],
-                             pad=0 if self.last_batch_handle == "keep"
-                             else pad)
+        lab = np.stack(labels).reshape(-1, lw)
+        return (batch.astype(self.dtype, copy=False), lab,
+                0 if self.last_batch_handle == "keep" else pad)

@@ -18,21 +18,36 @@ import threading
 
 import numpy as _np
 
-from .base import MXNetError
-from .ndarray import NDArray, array as _nd_array
+from ..base import MXNetError
+from ..ndarray import NDArray, array as _nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "DeviceFeedIter", "CSVIter", "MNISTIter",
-           "ImageRecordIter",
+           "ImageRecordIter", "ImagePipelineIter", "make_device_tail",
            "LibSVMIter", "ImageDetRecordIter"]
 
 
 def ImageRecordIter(**kwargs):
-    """Name-parity wrapper over image.ImageIter (the C++ registered iterator
-    `ImageRecordIter`, src/io/iter_image_recordio_2.cc).  Maps the C iterator
-    kwargs (mean_r/g/b, std_r/g/b, preprocess_threads) onto the Python
-    pipeline and wraps it in a PrefetchingIter for decode/compute overlap."""
-    from .image import ImageIter
+    """Name-parity wrapper over the image pipeline (the C++ registered
+    iterator `ImageRecordIter`, src/io/iter_image_recordio_2.cc).
+
+    The C iterator kwargs map onto the TPU-native pipeline:
+
+    - ``preprocess_threads`` — number of decode/augment *worker processes*
+      (io/pipeline.py; the reference's OMP decode team).  0 keeps decoding
+      in-process behind a prefetch thread.
+    - ``prefetch_buffer`` — pipeline ring depth (shared-memory slots per
+      worker), or the prefetch-thread queue depth when in-process.
+    - ``mean_r/g/b``, ``std_r/g/b`` — normalization constants.
+    - ``device_tail=True`` — ship raw uint8 NHWC batches and fuse the
+      mean/std normalize + dtype cast + layout transform on device
+      (io/device_tail.py); the returned iterator then yields
+      device-resident, already-normalized batches.
+    - ``seed`` — deterministic per-batch augmentation (bitwise-identical
+      output for any worker count).
+    """
+    from .device_tail import make_device_tail as _make_tail
+    from .pipeline import ImagePipelineIter, pipeline_available
     import numpy as _np2
     mean = None
     if any(k in kwargs for k in ("mean_r", "mean_g", "mean_b")):
@@ -44,14 +59,68 @@ def ImageRecordIter(**kwargs):
         std = _np2.array([kwargs.pop("std_r", 1.0),
                           kwargs.pop("std_g", 1.0),
                           kwargs.pop("std_b", 1.0)], dtype=_np2.float32)
-    kwargs.pop("prefetch_buffer", None)
+    mean = kwargs.pop("mean", mean)
+    std = kwargs.pop("std", std)
+    prefetch = max(1, int(kwargs.pop("prefetch_buffer", 2)))
+    workers = int(kwargs.pop("preprocess_threads", 0))
+    device_tail = bool(kwargs.pop("device_tail", False))
+    seed = kwargs.pop("seed", None)
     # C++ round_batch: True wraps/pads the tail batch, False emits it partial
     if kwargs.pop("round_batch", True):
         kwargs.setdefault("last_batch_handle", "pad")
     else:
         kwargs.setdefault("last_batch_handle", "keep")
-    inner = ImageIter(mean=mean, std=std, **kwargs)
-    return PrefetchingIter(inner)
+
+    out_dtype = kwargs.get("dtype", "float32")
+    out_layout = kwargs.get("layout", "NCHW")
+    if device_tail:
+        # the host ships what the decoder produces — uint8 NHWC — and the
+        # normalize/cast/layout tail runs fused on device
+        kwargs["dtype"] = "uint8"
+        kwargs["layout"] = "NHWC"
+        host_mean = host_std = None
+    else:
+        host_mean, host_std = mean, std
+
+    if workers > 0 and not pipeline_available():
+        _warn_once(
+            "ImageRecordIter: multiprocessing shared memory is "
+            "unavailable on this platform; preprocess_threads=%d "
+            "falls back to in-process decoding" % workers)
+        workers = 0
+    if workers > 0 or seed is not None:
+        # seeded runs go through the pipeline even in-process: its
+        # per-batch RNG discipline is what makes the output reproducible
+        # (and identical under any worker count)
+        inner = ImagePipelineIter(num_workers=workers,
+                                  prefetch_buffer=prefetch, seed=seed,
+                                  mean=host_mean, std=host_std, **kwargs)
+    else:
+        from ..image import ImageIter
+        inner = PrefetchingIter(
+            ImageIter(mean=host_mean, std=host_std, **kwargs),
+            depth=prefetch)
+    if not device_tail:
+        return inner
+    tail = _make_tail(mean, std, dtype=out_dtype, layout=out_layout,
+                      input_layout="NHWC")
+    d = inner.provide_data[0]
+    bsz, h, w, c = d.shape
+    shape = (bsz, c, h, w) if out_layout == "NCHW" else (bsz, h, w, c)
+    desc = [DataDesc(d.name, shape, _np.dtype(out_dtype)
+                     if out_dtype != "bfloat16" else out_dtype,
+                     layout=out_layout)]
+    return DeviceFeedIter(inner, transform=tail, data_desc=desc)
+
+
+_WARNED = set()
+
+
+def _warn_once(msg):
+    if msg not in _WARNED:
+        _WARNED.add(msg)
+        import warnings
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
 
 class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
@@ -379,12 +448,16 @@ class DeviceFeedIter(DataIter):
     device.  ``depth`` bounds device-resident prefetched batches (HBM).
     """
 
-    def __init__(self, base, transform=None, depth=2):
+    def __init__(self, base, transform=None, depth=2, data_desc=None):
         super().__init__(base.batch_size)
         import jax as _jax
         self._jax = _jax
         self.base = base
         self.transform = transform
+        # post-transform data descriptors: a device-side tail changes the
+        # batch's dtype/layout, so consumers binding from provide_data must
+        # see the transformed geometry, not the host one
+        self._data_desc = data_desc
         self._depth = depth
         self._queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
@@ -398,6 +471,8 @@ class DeviceFeedIter(DataIter):
 
     @property
     def provide_data(self):
+        if self._data_desc is not None:
+            return self._data_desc
         return self.base.provide_data
 
     @property
@@ -405,7 +480,7 @@ class DeviceFeedIter(DataIter):
         return self.base.provide_label
 
     def _to_device(self, batch):
-        from .ndarray import NDArray
+        from ..ndarray import NDArray
         outs = []
         for arr in batch.data:
             raw = arr._data if isinstance(arr, NDArray) else \
@@ -592,7 +667,7 @@ class LibSVMIter(DataIter):
         self._cursor = 0
 
     def next(self):
-        from .ndarray import sparse
+        from ..ndarray import sparse
         if self._cursor >= len(self._rows):
             raise StopIteration
         take = self._rows[self._cursor:self._cursor + self.batch_size]
@@ -624,7 +699,7 @@ def ImageDetRecordIter(**kwargs):
     """Detection record iterator (reference: src/io/
     iter_image_det_recordio.cc).  Name-parity wrapper over
     image.ImageDetIter with the C kwargs mapped (mean_r/g/b etc.)."""
-    from .image.detection import ImageDetIter
+    from ..image.detection import ImageDetIter
     mean = None
     if any(k in kwargs for k in ("mean_r", "mean_g", "mean_b")):
         mean = _np.array([kwargs.pop("mean_r", 0.0),
@@ -635,13 +710,23 @@ def ImageDetRecordIter(**kwargs):
         std = _np.array([kwargs.pop("std_r", 1.0),
                          kwargs.pop("std_g", 1.0),
                          kwargs.pop("std_b", 1.0)], dtype=_np.float32)
-    kwargs.pop("preprocess_threads", None)
-    kwargs.pop("prefetch_buffer", None)
+    threads = kwargs.pop("preprocess_threads", None)
+    if threads:
+        # the detection pipeline decodes in-process (boxes ride the labels
+        # through augmenters the worker pool does not ship yet); say so
+        # once instead of silently eating the knob
+        _warn_once(
+            "ImageDetRecordIter: preprocess_threads=%s is not yet wired "
+            "to the multi-process pipeline for detection records; "
+            "decoding runs in-process (prefetch_buffer is honored)"
+            % threads)
+    prefetch = max(1, int(kwargs.pop("prefetch_buffer", 2)))
     if kwargs.pop("round_batch", True):
         kwargs.setdefault("last_batch_handle", "pad")
     else:
         kwargs.setdefault("last_batch_handle", "keep")
-    return ImageDetIter(mean=mean, std=std, **kwargs)
+    return PrefetchingIter(ImageDetIter(mean=mean, std=std, **kwargs),
+                           depth=prefetch)
 
 
 class MNISTIter(DataIter):
@@ -685,3 +770,9 @@ class MNISTIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+
+# imported at the tail: both modules consume the DataIter/DataBatch/DataDesc
+# definitions above (mxnet_tpu.io is already in sys.modules by then)
+from .device_tail import make_device_tail  # noqa: E402
+from .pipeline import ImagePipelineIter, pipeline_available  # noqa: E402,F401

@@ -58,6 +58,13 @@ class DataParallelTrainer:
     kvstore : str or KVStore, optional — a ``dist_sync`` store for
         multi-process gradient averaging (every process must construct
         its trainers in the same order).
+    input_transform : callable(jnp array)->jnp array, optional — traced
+        INSIDE the step jit and applied to the data batch first, so e.g.
+        the fused uint8 pipeline tail (``mx.io.make_device_tail``) becomes
+        part of the one compiled step program: XLA fuses the normalize/
+        cast/layout into the first layer's prologue, the host ships raw
+        uint8, and the step signature stays fixed (uint8 in — zero added
+        steady-state recompiles, assertable via ``jit_cache_keys`` hooks).
     """
 
     # distinct flat-gradient key per trainer instance (same construction
@@ -67,11 +74,12 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss, optimizer, optimizer_params=None,
                  mesh=None, param_spec_fn=None, data_axis="data",
-                 kvstore=None):
+                 kvstore=None, input_transform=None):
         from .. import kvstore as kvs
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss
+        self._input_transform = input_transform
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self._opt = optimizer
@@ -142,8 +150,13 @@ class DataParallelTrainer:
                for p in block.collect_params().values()):
             x0 = (data if isinstance(data, NDArray)
                   else NDArray(jnp.asarray(np.asarray(data))))
+            x0 = x0[:1]
+            if self._input_transform is not None:
+                # the block only ever sees transformed batches; infer its
+                # shapes from the post-tail geometry
+                x0 = NDArray(self._input_transform(x0._data))
             with autograd.pause():
-                block(x0[:1])
+                block(x0)
         params = block.collect_params()
         self._params_by_name = dict(params.items())
         self._train_names = [n for n, p in params.items()
@@ -213,6 +226,12 @@ class DataParallelTrainer:
                 self._opt.wd_mult.setdefault(gi, ps[0].wd_mult)
 
         def run(x, y):
+            if self._input_transform is not None:
+                # traced here, inside the step jit: the pipeline tail
+                # (normalize/cast/layout) fuses into the step program and
+                # the program's input signature stays the host's narrow
+                # uint8 batch
+                x = NDArray(self._input_transform(x._data))
             out = block(x)
             l = self._loss(out, y)
             return l.mean() if hasattr(l, "mean") else l

@@ -27,6 +27,7 @@ import time
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Domain", "Task", "Frame", "Marker", "Counter",
+           "PipelineStats",
            "profiler_set_config", "profiler_set_state"]
 
 _lock = threading.Lock()
@@ -252,3 +253,64 @@ class Marker:
 
     def mark(self, scope="process"):
         record_instant(self.name, "marker")
+
+
+class PipelineStats:
+    """Per-stage counters for a data pipeline (io/pipeline.py): reorder-
+    queue depth, per-worker busy time, consumer stall time, respawns.
+
+    The reference surfaces the same signals ad hoc (the prefetcher's
+    ``dmlc::ThreadedIter`` queue and per-thread decode timers); here they
+    are one thread-safe accumulator whose ``snapshot()`` feeds both
+    ``ImagePipelineIter.stats`` consumers and the bench's stall accounting.
+    When the profiler is running, queue depth is also emitted as a Counter
+    series so the chrome trace shows the feed pipeline next to the ops.
+    """
+
+    def __init__(self, num_workers=0, name="io.pipeline"):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._busy_s = {}            # worker id -> cumulative decode time
+        self._stall_s = 0.0          # consumer time blocked on the ring
+        self._batches = 0
+        self._depth_max = 0
+        self._respawns = 0
+        self._num_workers = num_workers
+        self._counter = Domain(name).new_counter("queue_depth")
+
+    def on_batch(self, worker, busy_s, queue_depth):
+        with self._lock:
+            self._busy_s[worker] = self._busy_s.get(worker, 0.0) + busy_s
+            self._batches += 1
+            self._depth_max = max(self._depth_max, queue_depth)
+        self._counter.set_value(queue_depth)
+
+    def on_wait(self, stall_s):
+        with self._lock:
+            self._stall_s += stall_s
+
+    def on_respawn(self):
+        with self._lock:
+            self._respawns += 1
+
+    def snapshot(self):
+        """Aggregate view: ``worker_utilization`` is decode time over
+        (workers × wall) — how busy the pool is; ``stall_pct`` is the
+        fraction of wall time the consumer spent blocked waiting for a
+        batch — >0 means the pipeline (not the consumer) is the
+        bottleneck."""
+        with self._lock:
+            wall = max(1e-9, time.perf_counter() - self._t0)
+            busy = sum(self._busy_s.values())
+            util = busy / (wall * self._num_workers) \
+                if self._num_workers else 0.0
+            return {
+                "batches": self._batches,
+                "wall_s": round(wall, 3),
+                "worker_busy_s": round(busy, 3),
+                "worker_utilization": round(util, 4),
+                "stall_s": round(self._stall_s, 3),
+                "stall_pct": round(100.0 * self._stall_s / wall, 2),
+                "queue_depth_max": self._depth_max,
+                "respawns": self._respawns,
+            }

@@ -119,6 +119,30 @@ class MXRecordIO:
     def tell(self):
         return self.handle.tell()
 
+    def read_at(self, offset):
+        """Positional read of the record starting at ``offset`` via
+        ``os.pread`` — the file cursor never moves, so any number of
+        threads (prefetchers, pipeline workers sharing one handle) can
+        read concurrently without a seek lock (the dmlc-core reader gets
+        the same property from its own pread path)."""
+        assert not self.writable
+        fd = self.handle.fileno()
+        head = os.pread(fd, 8, offset)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _KMAGIC:
+            raise IOError("invalid record magic %x at %d in %s"
+                          % (magic, offset, self.uri))
+        if lrec >> _LFLAG_BITS:
+            raise IOError("continuation record (cflag=%d) in %s"
+                          % (lrec >> _LFLAG_BITS, self.uri))
+        length = lrec & _LENGTH_MASK
+        buf = os.pread(fd, length, offset + 8)
+        if len(buf) < length:
+            raise IOError("truncated record at %d in %s" % (offset, self.uri))
+        return buf
+
 
 class MXIndexedRecordIO(MXRecordIO):
     """Random-access reader/writer via a .idx sidecar file
@@ -165,8 +189,9 @@ class MXIndexedRecordIO(MXRecordIO):
         self.handle.seek(self.idx[idx])
 
     def read_idx(self, idx):
-        self.seek(idx)
-        return self.read()
+        # positional read: keyed access never disturbs the sequential
+        # cursor, and concurrent readers need no lock
+        return self.read_at(self.idx[idx])
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

@@ -320,6 +320,43 @@ def test_src_inline_suppression_and_clean():
     assert lint_source(clean) == []
 
 
+def test_src003_host_normalize_variants():
+    """Host-side mean/std normalization is flagged with the fused
+    device-tail suggestion (PR 3)."""
+    # the spelled-out idiom
+    assert rules(lint_source("x = (img - rgb_mean) / rgb_std\n")) == \
+        {"SRC003"}
+    # normalize helpers
+    assert rules(lint_source("y = mx.image.color_normalize(img, m, s)\n")) \
+        == {"SRC003"}
+    assert rules(lint_source("aug = ColorNormalizeAug(mean, std)\n")) == \
+        {"SRC003"}
+    # iterator factories given mean/std without the device tail
+    src = "it = mx.io.ImageRecordIter(path_imgrec=p, mean_r=123.0)\n"
+    findings = lint_source(src)
+    assert rules(findings) == {"SRC003"}
+    assert "device_tail" in findings[0].message
+
+
+def test_src003_clean_cases():
+    # device_tail=True is exactly the fix — no finding
+    ok = "it = ImageRecordIter(path_imgrec=p, mean_r=1.0, " \
+         "device_tail=True)\n"
+    assert lint_source(ok) == []
+    # unrelated subtraction/division
+    assert lint_source("z = (a - b) / c\n") == []
+    # suppression works
+    assert lint_source("x = (v - mean) / std  "
+                       "# mxlint: disable=SRC003\n") == []
+
+
+def test_doc001_rule_table_in_sync():
+    """Every registered rule has a docs/analysis.md row (and the check is
+    part of --self-check, so a new rule cannot land undocumented)."""
+    from mxnet_tpu.analysis import lint_rule_docs
+    assert lint_rule_docs() == []
+
+
 # ---------------------------------------------------------------------------
 # hooks: Symbol.lint / Module.lint / simple_bind(lint=True)
 # ---------------------------------------------------------------------------
