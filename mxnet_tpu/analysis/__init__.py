@@ -27,7 +27,11 @@ from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
 from .source_lint import lint_source, lint_file
 from .serving_lint import lint_serving
 from .coverage import load_test_map, generate_coverage_md
-from .report import render_text, render_json, exit_code, worst_severity
+from .report import (render_text, render_json, exit_code, worst_severity,
+                     SCHEMA_VERSION)
+from .cost import (CostReport, analyze_jaxpr, analyze_fn, analyze_symbol,
+                   XLA_FLOP_RTOL)
+from .dist_lint import lint_dist_step, lint_trainer, dist_summary
 
 __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "INFO",
@@ -38,6 +42,9 @@ __all__ = [
     "render_text", "render_json", "exit_code", "worst_severity",
     "filter_findings", "suppressed_rules", "unique_ops",
     "LOSS_OPS", "LARGE_CONST_BYTES",
+    "CostReport", "analyze_jaxpr", "analyze_fn", "analyze_symbol",
+    "XLA_FLOP_RTOL", "SCHEMA_VERSION",
+    "lint_dist_step", "lint_trainer", "dist_summary", "cost_self_check",
 ]
 
 
@@ -48,9 +55,9 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
                       disable=disable, check_consts=check_consts)
 
 
-def self_check(disable=(), with_coverage=True):
-    """Registry lint over the live registry, plus the rule-table docs
-    sync check — what CI runs.
+def self_check(disable=(), with_coverage=True, with_cost=True):
+    """Registry lint over the live registry, the rule-table docs sync
+    check, and the cost-pass determinism check — what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -58,7 +65,37 @@ def self_check(disable=(), with_coverage=True):
     coverage_map = load_test_map() if with_coverage else None
     findings = lint_registry(coverage_map=coverage_map, disable=disable)
     findings += lint_rule_docs(disable=disable)
+    if with_cost:
+        findings += cost_self_check(disable=disable)
     return findings
+
+
+def cost_self_check(disable=()):
+    """COST003: the cost pass must be deterministic — two analyses of
+    the same fixture program (an MLP forward + a collective step) must
+    produce byte-identical reports, or STATIC_BUDGETS.json gating would
+    flap in CI."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def fixture(w1, w2, x):
+        h = jnp.maximum(x @ w1, 0.0)
+        g = lax.pmean(h @ w2, "data")
+        return jnp.exp(g).sum()
+
+    args = (jnp.zeros((16, 32)), jnp.zeros((32, 8)), jnp.zeros((4, 16)))
+    reports = [analyze_fn(fixture, *args, axis_env=[("data", 8)],
+                          donate_argnums=(0,), host_argnums=(2,))
+               .as_dict() for _ in range(2)]
+    findings = []
+    if reports[0] != reports[1]:
+        diff = sorted(k for k in reports[0]
+                      if reports[0][k] != reports[1].get(k))
+        findings.append(Finding(
+            "COST003", "cost_self_check",
+            "two runs of the cost pass over the same program disagree "
+            "on %s — the budget gate would flap" % (diff,)))
+    return filter_findings(findings, disable)
 
 
 def lint_rule_docs(disable=()):
@@ -74,7 +111,7 @@ def lint_rule_docs(disable=()):
     if not os.path.isfile(docs):
         return []
     with open(docs) as f:
-        documented = set(re.findall(r"^\|\s*([A-Z]{3}\d{3})\s*\|",
+        documented = set(re.findall(r"^\|\s*([A-Z]{3,4}\d{3})\s*\|",
                                     f.read(), re.M))
     findings = [Finding("DOC001", rule,
                         "rule %s is registered but has no row in "

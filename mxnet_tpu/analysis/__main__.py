@@ -2,21 +2,34 @@
 
 Targets:
   ``--self-check``        registry lint over the live registry (CI tier-1)
+                          + docs sync + cost-pass determinism
   ``--coverage``          regenerate tests/OP_COVERAGE.md from the registry
                           + test map; fails if any op has zero coverage
+  ``--cost``              static cost/memory analysis (hardware-free):
+                          over a symbol target, over ``--model`` budget
+                          models, or — with ``--budget FILE`` — the
+                          STATIC_BUDGETS.json CI gate (COST001/COST002)
+                          including each trainer model's DST lint
   ``script.py``           AST source lint for trace-time traps
   ``symbol.json``         graph lint a saved Symbol (``Symbol.save``)
 
 Options:
-  ``--json``              machine-readable output (schema in docs/analysis.md)
+  ``--json``              machine-readable output (schema in docs/analysis.md;
+                          ``schema_version`` 2 adds cost/dist sections)
   ``--strict``            exit 1 on warnings (default for --self-check)
   ``--disable R1,R2``     mute rules globally
   ``--shapes "data=(1,3,224,224),label=(1,)"``
                           argument shapes for the graph pass (enables the
-                          large-constant trace check)
+                          large-constant trace check) and the cost pass
   ``--serving``           with a symbol target: also run the SRV rules
                           (recompile-free bucket serving; --shapes feeds
                           the batch-polymorphism probe)
+  ``--hbm-cap BYTES``     with --serving: SRV003 cap on per-bucket
+                          modeled peak HBM
+  ``--model M1,M2``       with --cost: budget models to analyze
+                          (default: every non-heavy registered model)
+  ``--budget FILE``       with --cost: gate modeled metrics against the
+                          checked-in budgets (exit 2 on COST001/DST001)
 """
 from __future__ import annotations
 
@@ -74,6 +87,19 @@ def main(argv=None):
                    help="with a .json symbol target: also run the SRV "
                         "serving rules (recompile-free bucket execution; "
                         "needs --shapes for the batch-polymorphism probe)")
+    p.add_argument("--cost", action="store_true",
+                   help="static cost/memory analysis: of the symbol "
+                        "target, of --model budget models, or the "
+                        "--budget gate")
+    p.add_argument("--budget", default="",
+                   help="with --cost: STATIC_BUDGETS.json path to gate "
+                        "modeled metrics against (COST001 on regression)")
+    p.add_argument("--model", default="",
+                   help="with --cost: comma-separated budget-model names "
+                        "(see analysis/budget_models.py)")
+    p.add_argument("--hbm-cap", type=int, default=0, dest="hbm_cap",
+                   help="with --serving: flag buckets whose modeled peak "
+                        "HBM exceeds this many bytes (SRV003)")
     args = p.parse_args(argv)
 
     from . import (self_check, lint_file, lint_symbol, lint_serving,
@@ -96,8 +122,12 @@ def main(argv=None):
         # the shipped registry must be clean: warnings fail too
         return exit_code(findings, strict=True)
 
+    if args.cost and not (args.target and args.target.endswith(".json")):
+        return _run_cost(args, disable)
+
     if not args.target:
-        p.error("give a target script/symbol, --self-check, or --coverage")
+        p.error("give a target script/symbol, --self-check, --coverage, "
+                "or --cost")
 
     if args.target.endswith(".json"):
         from ..symbol import load
@@ -107,13 +137,75 @@ def main(argv=None):
                                check_consts=not args.no_consts)
         if args.serving:
             findings += lint_serving(sym, data_shapes=shapes,
-                                     disable=disable)
+                                     disable=disable,
+                                     hbm_cap_bytes=args.hbm_cap or None)
+        cost = None
+        if args.cost:
+            from .cost import analyze_symbol
+            report = analyze_symbol(sym, shapes=shapes)
+            if report is not None:
+                cost = {args.target: report}
         title = "mxlint graph %s" % args.target
-    else:
-        findings = lint_file(args.target, disable=disable)
-        title = "mxlint source %s" % args.target
+        if args.as_json:
+            print(render_json(findings, cost=cost))
+        else:
+            print(render_text(findings, title=title))
+            if cost:
+                for name, rep in sorted(cost.items()):
+                    print(rep.render(title="mxcost %s" % name))
+        return exit_code(findings, strict=args.strict)
+
+    findings = lint_file(args.target, disable=disable)
+    title = "mxlint source %s" % args.target
     print(render_json(findings) if args.as_json
           else render_text(findings, title=title))
+    return exit_code(findings, strict=args.strict)
+
+
+def _run_cost(args, disable):
+    """--cost over budget models / the --budget CI gate."""
+    import os
+
+    # hardware-free by contract: when the caller did not pick a backend,
+    # pin to CPU so a hung TPU init can never starve the static pass
+    # (the BENCH_r05 motivation).  Explicit JAX_PLATFORMS wins.
+    if not os.environ.get("JAX_PLATFORMS"):
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    from . import render_json, render_text, exit_code, filter_findings
+    from .budget_models import BUDGET_MODELS, build_model, check_budgets
+    from .dist_lint import dist_summary
+
+    cost, findings = {}, []
+    if args.budget:
+        findings, reports = check_budgets(args.budget)
+        findings = filter_findings(findings, disable)
+        cost = reports
+        title = "mxcost --budget %s" % args.budget
+    else:
+        names = [m.strip() for m in args.model.split(",") if m.strip()] \
+            or [m for m in sorted(BUDGET_MODELS)
+                if m != "resnet50_train_step"]
+        for name in names:
+            report, dst = build_model(name)
+            cost[name] = report
+            findings += filter_findings(dst, disable)
+        title = "mxcost %s" % ",".join(names)
+    axis_sizes = {}
+    for rep in cost.values():
+        axis_sizes.update(rep.axis_sizes)
+    if args.as_json:
+        print(render_json(findings, cost=cost,
+                          dist=dist_summary(findings,
+                                            axis_sizes=axis_sizes)))
+    else:
+        print(render_text(findings, title=title))
+        for name, rep in sorted(cost.items()):
+            print(rep.render(title="mxcost %s" % name))
     return exit_code(findings, strict=args.strict)
 
 

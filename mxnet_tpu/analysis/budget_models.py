@@ -1,0 +1,217 @@
+"""Budget models: fixed model/step programs whose modeled cost is gated
+by the checked-in ``STATIC_BUDGETS.json``.
+
+Each builder constructs a model at a pinned geometry, runs the static
+cost pass (:mod:`.cost`) and, for training steps, the DST distributed
+lint (:mod:`.dist_lint`) — all hardware-free: meshes are pinned to one
+CPU device (``jax.devices("cpu")``, present even when the TPU backend is
+unreachable) and the data-axis size is *declared* (``DECLARED_AXIS``)
+through ``make_jaxpr(axis_env=...)``, so the numbers are identical on
+the 1-core CI host, the 8-virtual-device test mesh, and a TPU pod.
+
+``python -m mxnet_tpu.analysis --cost --budget STATIC_BUDGETS.json``
+re-analyzes every budgeted model and fails CI (COST001) when a PR blows
+a metric past tolerance — a doubled step FLOP count or a widened
+host→device transfer is caught with no accelerator attached.
+``tools/update_budgets.py`` regenerates the file when a change is
+intentional.
+"""
+from __future__ import annotations
+
+__all__ = ["BUDGET_MODELS", "build_model", "DECLARED_AXIS",
+           "BUDGET_METRICS"]
+
+# the data-axis size every trainer model is analyzed at (collective
+# bytes depend on it; declared, not discovered, for determinism)
+DECLARED_AXIS = 8
+
+# metrics a STATIC_BUDGETS.json row may pin, in gate order
+BUDGET_METRICS = ("flops", "transcendentals", "transfer_bytes",
+                  "peak_hbm_bytes", "collective_bytes")
+
+
+def _cpu_mesh():
+    import jax
+
+    from ..parallel import mesh as mesh_mod
+    return mesh_mod.make_mesh((1,), ("data",), [jax.devices("cpu")[0]])
+
+
+def _mlp_block():
+    from .. import init as mx_init
+    from ..gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx_init.Xavier())
+    return net
+
+
+def mlp_train_step():
+    """DataParallelTrainer step over a 2-layer MLP, batch 64x16."""
+    from ..gluon import loss as gloss
+    from ..parallel.trainer import DataParallelTrainer
+    trainer = DataParallelTrainer(
+        _mlp_block(), gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=_cpu_mesh())
+    report = trainer.cost_report(data_shape=(64, 16), label_shape=(64,),
+                                 declared_axis_size=DECLARED_AXIS)
+    findings = trainer.lint(data_shape=(64, 16), label_shape=(64,),
+                            declared_axis_size=DECLARED_AXIS)
+    return report, findings
+
+
+def mlp_infer():
+    """Symbolic MLP forward (FC-relu-FC-softmax), batch 8x16."""
+    from .. import symbol as sym
+    from .cost import analyze_symbol
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=64, name="bm_fc1")
+    a = sym.Activation(h, act_type="relu", name="bm_relu")
+    out = sym.FullyConnected(a, num_hidden=10, name="bm_fc2")
+    net = sym.SoftmaxOutput(out, name="bm_softmax")
+    report = analyze_symbol(net, shapes={"data": (8, 16)})
+    if report is None:
+        raise RuntimeError("mlp_infer symbol did not trace")
+    return report, []
+
+
+def convnet_infer():
+    """Small conv net (conv-bn-relu-pool-fc), NCHW batch 4x3x32x32 —
+    exercises the conv/reduce_window cost paths."""
+    from .. import symbol as sym
+    from .cost import analyze_symbol
+    data = sym.var("data")
+    c = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                        no_bias=True, name="bm_conv")
+    b = sym.BatchNorm(c, fix_gamma=False, name="bm_bn")
+    r = sym.Activation(b, act_type="relu", name="bm_crelu")
+    p = sym.Pooling(r, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                    name="bm_pool")
+    f = sym.Flatten(p, name="bm_flat")
+    out = sym.FullyConnected(f, num_hidden=10, name="bm_cfc")
+    net = sym.SoftmaxOutput(out, name="bm_csoftmax")
+    report = analyze_symbol(net, shapes={"data": (4, 3, 32, 32)})
+    if report is None:
+        raise RuntimeError("convnet_infer symbol did not trace")
+    return report, []
+
+
+def resnet50_train_step():
+    """ResNet-50 NHWC training step at the bench geometry (batch 32/chip
+    — FLOPs scale linearly in batch, so flops/img is batch-free).  Heavy
+    (~half a minute of tracing on the 1-core host): used by the bench
+    ``static_cost`` stage and on-demand, NOT in STATIC_BUDGETS.json."""
+    from .. import init as mx_init
+    from ..gluon import loss as gloss
+    from ..gluon.model_zoo import vision
+    from ..parallel.trainer import DataParallelTrainer
+    net = vision.resnet50_v1(layout="NHWC")
+    net.initialize(mx_init.Xavier())
+    trainer = DataParallelTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9}, mesh=_cpu_mesh())
+    report = trainer.cost_report(data_shape=(32, 224, 224, 3),
+                                 label_shape=(32,),
+                                 declared_axis_size=DECLARED_AXIS)
+    findings = trainer.lint(data_shape=(32, 224, 224, 3),
+                            label_shape=(32,),
+                            declared_axis_size=DECLARED_AXIS)
+    return report, findings
+
+
+BUDGET_MODELS = {
+    "mlp_train_step": mlp_train_step,
+    "mlp_infer": mlp_infer,
+    "convnet_infer": convnet_infer,
+    "resnet50_train_step": resnet50_train_step,
+}
+
+
+def build_model(name):
+    """(CostReport, [Finding]) for one registered budget model."""
+    if name not in BUDGET_MODELS:
+        raise KeyError("unknown budget model %r (have: %s)"
+                       % (name, ", ".join(sorted(BUDGET_MODELS))))
+    return BUDGET_MODELS[name]()
+
+
+def compute_budgets(models=None):
+    """{model: {metric: value}} for the given (default: all non-heavy)
+    budget models — what ``tools/update_budgets.py`` writes."""
+    out = {}
+    for name in sorted(models if models is not None
+                       else [m for m in BUDGET_MODELS
+                             if m != "resnet50_train_step"]):
+        report, _ = build_model(name)
+        d = report.as_dict()
+        out[name] = {m: int(d[m]) for m in BUDGET_METRICS}
+    return out
+
+
+def check_budgets(budget_path, tolerance_pct=None):
+    """Gate the budget file: rebuild every budgeted model, compare each
+    pinned metric within tolerance, and fold in the models' own DST
+    findings.  Returns (findings, {model: CostReport})."""
+    import json
+
+    from .findings import Finding
+
+    with open(budget_path) as f:
+        budget = json.load(f)
+    tol = float(tolerance_pct if tolerance_pct is not None
+                else budget.get("tolerance_pct", 10)) / 100.0
+    findings, reports = [], {}
+    budgeted = budget.get("models", {})
+    for name in sorted(budgeted):
+        row = budgeted[name]
+        if name not in BUDGET_MODELS:
+            findings.append(Finding(
+                "COST001", name,
+                "STATIC_BUDGETS.json pins %r but no such budget model "
+                "is registered — the gate is checking nothing; remove "
+                "the row or restore the model" % (name,)))
+            continue
+        try:
+            report, dst = build_model(name)
+        except Exception as e:
+            findings.append(Finding(
+                "COST001", name,
+                "budget model %r no longer builds: %s: %s"
+                % (name, type(e).__name__, str(e)[:200])))
+            continue
+        reports[name] = report
+        findings += dst
+        d = report.as_dict()
+        for metric in BUDGET_METRICS:
+            if metric not in row:
+                continue
+            want, got = float(row[metric]), float(d[metric])
+            if want == 0 and got == 0:
+                continue
+            hi = want * (1 + tol)
+            lo = want * (1 - tol)
+            if got > hi:
+                findings.append(Finding(
+                    "COST001", "%s.%s" % (name, metric),
+                    "modeled %s of %s is %d, %.1f%% over the budget %d "
+                    "(tolerance %.0f%%) — a regression, or regenerate "
+                    "via tools/update_budgets.py if intentional"
+                    % (metric, name, int(got),
+                       (got / want - 1) * 100 if want else 0.0,
+                       int(want), tol * 100)))
+            elif got < lo:
+                findings.append(Finding(
+                    "COST002", "%s.%s" % (name, metric),
+                    "modeled %s of %s is %d, %.1f%% under the budget %d "
+                    "— bank the improvement: tools/update_budgets.py"
+                    % (metric, name, int(got),
+                       (1 - got / want) * 100 if want else 0.0,
+                       int(want))))
+    for name in sorted(set(BUDGET_MODELS) - set(budgeted)
+                       - {"resnet50_train_step"}):
+        findings.append(Finding(
+            "COST002", name,
+            "budget model %r has no STATIC_BUDGETS.json row — it is "
+            "not gated; add it via tools/update_budgets.py" % (name,)))
+    return findings, reports

@@ -84,6 +84,36 @@ RULES = {
                       "cannot be recompile-free"),
     "SRV002": (WARNING, "Reshape bakes a static batch dimension; every "
                         "serving bucket compiles (or breaks) separately"),
+    "SRV003": (WARNING, "a serving bucket's modeled peak HBM exceeds the "
+                        "configured cap (static cost model; the bucket "
+                        "would OOM or page at load)"),
+    # distributed-step pass (mxnet_tpu/analysis/dist_lint.py)
+    "DST001": (ERROR, "a trainable parameter's gradient is never "
+                      "psum/pmean-reduced over the data axis: replicas "
+                      "silently diverge after one step"),
+    "DST002": (WARNING, "collective over the data axis applied to an "
+                        "already-invariant value: duplicate reduction "
+                        "(psum scales by the axis size)"),
+    "DST003": (ERROR, "NamedSharding mismatch between the mesh helpers "
+                      "and the step inputs (param spec uses the data "
+                      "axis, names a missing axis, outranks the param, "
+                      "or the batch does not divide the axis)"),
+    "DST004": (WARNING, "collective operand widened (e.g. bf16->f32) "
+                        "immediately before the reduction: the wire "
+                        "carries wider bytes than the math needs"),
+    "DST005": (WARNING, "step program closes over a baked Python "
+                        "constant: iteration-dependent values captured "
+                        "at trace time diverge across hosts"),
+    # cost pass / budget gate (mxnet_tpu/analysis/cost.py, __main__)
+    "COST001": (ERROR, "modeled cost metric exceeds its STATIC_BUDGETS "
+                       "entry beyond tolerance (or a budgeted model no "
+                       "longer builds)"),
+    "COST002": (WARNING, "STATIC_BUDGETS entry is stale: the modeled "
+                         "metric improved beyond tolerance or a model "
+                         "has no budget row — regenerate via "
+                         "tools/update_budgets.py"),
+    "COST003": (ERROR, "cost pass is nondeterministic: two analyses of "
+                       "the same program produced different reports"),
 }
 
 

@@ -1,8 +1,13 @@
 """Reporters: render Findings as text or JSON.
 
 The JSON schema is stable tooling surface (documented in
-docs/analysis.md): ``{"version": 1, "findings": [{"rule", "severity",
-"subject", "message"}], "counts": {severity: n}}``.
+docs/analysis.md): ``{"version": 1, "schema_version": 2, "findings":
+[{"rule", "severity", "subject", "message"}], "counts": {severity: n}}``
+plus, when the cost/dist passes ran, a ``"cost"`` section ({target:
+CostReport.as_dict()}) and a ``"dist"`` section
+(:func:`~mxnet_tpu.analysis.dist_lint.dist_summary`).  ``version`` is
+the original findings-list schema (kept for pre-cost consumers);
+``schema_version`` is bumped when any section's shape changes.
 """
 from __future__ import annotations
 
@@ -11,7 +16,11 @@ from collections import Counter
 
 from .findings import ERROR, WARNING, severity_rank
 
-__all__ = ["render_text", "render_json", "worst_severity", "exit_code"]
+__all__ = ["render_text", "render_json", "worst_severity", "exit_code",
+           "SCHEMA_VERSION"]
+
+# bumped in PR 4: cost/dist sections + schema_version field itself
+SCHEMA_VERSION = 2
 
 
 def _sorted(findings):
@@ -31,13 +40,23 @@ def render_text(findings, title="mxlint"):
     return "\n".join(lines)
 
 
-def render_json(findings):
+def render_json(findings, cost=None, dist=None):
+    """``cost``: {target_name: CostReport-or-dict}; ``dist``: the
+    dist_summary dict.  Both sections appear only when provided."""
     counts = Counter(f.severity for f in findings)
-    return json.dumps({
+    payload = {
         "version": 1,
+        "schema_version": SCHEMA_VERSION,
         "findings": [f.as_dict() for f in _sorted(findings)],
         "counts": dict(counts),
-    }, indent=2)
+    }
+    if cost is not None:
+        payload["cost"] = {
+            name: (rep.as_dict() if hasattr(rep, "as_dict") else rep)
+            for name, rep in sorted(cost.items())}
+    if dist is not None:
+        payload["dist"] = dist
+    return json.dumps(payload, indent=2)
 
 
 def worst_severity(findings):

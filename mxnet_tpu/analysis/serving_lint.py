@@ -100,14 +100,46 @@ def _lint_static_batch_reshape(symbol):
     return out
 
 
-def lint_serving(symbol, data_shapes=None, disable=()):
+def _lint_bucket_hbm(symbol, data_shapes, buckets, cap_bytes):
+    """SRV003: per-bucket modeled peak HBM (static cost pass) vs a
+    configurable cap — catches the bucket ladder OOMing at load, with no
+    device attached."""
+    from .cost import analyze_symbol
+    out = []
+    subject = symbol.name or "<graph>"
+    for b in sorted(set(int(x) for x in buckets)):
+        shapes = {name: (b,) + tuple(s[1:])
+                  for name, s in data_shapes.items()}
+        report = analyze_symbol(symbol, shapes=shapes)
+        if report is None:
+            continue
+        if report.peak_hbm_bytes > cap_bytes:
+            out.append(Finding(
+                "SRV003", "%s[bucket=%d]" % (subject, b),
+                "modeled peak HBM %.1f MiB exceeds the %.1f MiB cap — "
+                "the bucket would OOM (or page) at warmup; shrink the "
+                "bucket ladder or raise the cap"
+                % (report.peak_hbm_bytes / (1 << 20),
+                   cap_bytes / (1 << 20))))
+    return out
+
+
+def lint_serving(symbol, data_shapes=None, disable=(), buckets=None,
+                 hbm_cap_bytes=None):
     """Run the serving rules over ``symbol``.
 
     ``data_shapes``: {data_name: full shape incl. batch axis}.  Without
     it only the structural SRV002 scan runs (the polymorphism probe
-    needs a concrete batch axis to scale).
+    needs a concrete batch axis to scale).  With ``hbm_cap_bytes`` set,
+    the modeled peak HBM of every bucket (``buckets`` defaults to the
+    declared batch axis alone) is checked against the cap (SRV003).
     """
     findings = _lint_static_batch_reshape(symbol)
     if data_shapes:
         findings += _lint_batch_polymorphism(symbol, data_shapes)
+        if hbm_cap_bytes:
+            bk = buckets if buckets else [
+                next(iter(data_shapes.values()))[0]]
+            findings += _lint_bucket_hbm(symbol, data_shapes, bk,
+                                         int(hbm_cap_bytes))
     return filter_findings(findings, disable)

@@ -84,6 +84,23 @@ class BaseModule:
         return self._symbol.lint(shapes=shapes or None, disable=disable,
                                  check_consts=check_consts)
 
+    def cost_report(self, shapes=None):
+        """Static cost/memory model (mxcost) of this module's forward at
+        the bound data/label shapes (or explicit ``shapes``): FLOPs,
+        bytes, transfer, peak HBM — no execution, no device.  Returns a
+        ``CostReport`` or None when shapes are unknown/untraceable."""
+        if self._symbol is None:
+            raise MXNetError("module has no symbol to analyze")
+        if shapes is None:
+            shapes = {}
+            for desc in (getattr(self, "_data_shapes", None) or []):
+                shapes[desc.name] = desc.shape
+            for desc in (getattr(self, "_label_shapes", None) or []):
+                shapes[desc.name] = desc.shape
+        if not shapes:
+            return None
+        return self._symbol.cost_report(shapes=shapes)
+
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
         self.backward()

@@ -345,6 +345,136 @@ class DataParallelTrainer:
 
         return jax.jit(pure_step, donate_argnums=(0, 1))
 
+    def _reduce_grads(self, grads):
+        """Cross-replica gradient mean over the data axis.
+
+        This is the step's ONE reduction point: explicit in the
+        per-replica spelling (``_build_replica_step``, what the DST lint
+        verifies); under ``jax.jit`` + ``NamedSharding`` the compiler
+        inserts the equivalent psum automatically because the loss is a
+        mean over the batch-sharded axis.  Removing this call is exactly
+        the "gradient psum removed" bug class: DST001 fires per
+        parameter (tests/test_analysis.py)."""
+        return tuple(jax.lax.pmean(g, self._data_axis) for g in grads)
+
+    def _build_replica_step(self):
+        """Per-replica spelling of the compiled step for static analysis:
+        the SAME forward/loss/optimizer code as ``_build_step``, seen
+        from one shard of the data axis, with the cross-replica
+        collectives written out (grads, the reported loss, and BatchNorm
+        batch statistics are all global under GSPMD).  Traced with
+        ``jax.make_jaxpr(axis_env=[(data_axis, K)])`` — no hardware, no
+        compilation — by ``lint()``/``cost_report()`` and the
+        ``python -m mxnet_tpu.analysis --cost`` budget models."""
+        fwd = self._fwd
+        axis = self._data_axis
+
+        def replica_step(train_vals, states, aux_vals, x, y, key, lr, t):
+            def loss_of(tv):
+                outs, muts = fwd(tv, aux_vals, (x, y), key)
+                return outs[0], muts
+
+            (loss_val, muts), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            grads = self._reduce_grads(grads)
+            loss_val = jax.lax.pmean(loss_val, axis)
+            muts = tuple(jax.lax.pmean(m, axis) for m in muts)
+            new_vals, new_states = self._apply_groups(
+                train_vals, states, grads, lr, t)
+            return loss_val, new_vals, new_states, muts
+
+        return replica_step
+
+    # -- static analysis hooks (mxnet_tpu.analysis) ------------------------
+    def lint(self, data_shape=None, label_shape=None,
+             data_dtype="float32", label_dtype="int32",
+             declared_axis_size=None, disable=()):
+        """DST lint of the distributed step (analysis/dist_lint.py):
+        every trainable gradient reduced over the data axis exactly
+        once, sharding-spec consistency, collective dtype promotion,
+        baked step constants.  Hardware-free; returns Finding records."""
+        from ..analysis.dist_lint import lint_trainer
+        return lint_trainer(self, data_shape=data_shape,
+                            label_shape=label_shape,
+                            data_dtype=data_dtype,
+                            label_dtype=label_dtype,
+                            declared_axis_size=declared_axis_size,
+                            disable=disable)
+
+    def cost_report(self, data_shape=None, label_shape=None,
+                    data_dtype="float32", label_dtype="int32",
+                    declared_axis_size=None):
+        """Static CostReport of one training step (analysis/cost.py):
+        FLOPs/bytes/peak-HBM of the full-batch program (params + states
+        donated, batch host-fed, loss fetched) plus per-axis collective
+        bytes from the per-replica trace.  Never executes or compiles."""
+        import numpy as _onp
+
+        from ..analysis import cost as _cost
+
+        if not self._ready:
+            if data_shape is None:
+                raise ValueError(
+                    "trainer has not stepped yet: pass data_shape (and "
+                    "label_shape)")
+            x0 = NDArray(jnp.zeros(tuple(data_shape),
+                                   _onp.dtype(data_dtype)))
+            y0 = NDArray(jnp.zeros(
+                tuple(label_shape or (data_shape[0],)),
+                _onp.dtype(label_dtype)))
+            self._setup(x0, y0)
+        data_shape = tuple(data_shape)
+        label_shape = tuple(label_shape or (data_shape[0],))
+        train_vals = tuple(self._params_by_name[n].data()._data
+                           for n in self._train_names)
+        aux_vals = tuple(self._params_by_name[n].data()._data
+                         for n in self._aux_names)
+        states = tuple(self._states_raw)
+        x = jax.ShapeDtypeStruct(data_shape, _onp.dtype(data_dtype))
+        y = jax.ShapeDtypeStruct(label_shape, _onp.dtype(label_dtype))
+        key = jax.ShapeDtypeStruct((2,), _onp.uint32)
+        fwd = self._fwd
+
+        def pure_step(train_vals, states, aux_vals, x, y, key, lr, t):
+            def loss_of(tv):
+                outs, muts = fwd(tv, aux_vals, (x, y), key)
+                return outs[0], muts
+
+            (loss_val, muts), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            new_vals, new_states = self._apply_groups(
+                train_vals, states, grads, lr, t)
+            return loss_val, new_vals, new_states, muts
+
+        report = _cost.analyze_fn(
+            pure_step, train_vals, states, aux_vals, x, y, key,
+            jnp.float32(0.01), jnp.int32(1),
+            donate_argnums=(0, 1), host_argnums=(3, 4))
+        # loss is the only fetched output; new params/states stay put
+        report.transfer_d2h_bytes = 4
+        # collective bytes from the per-replica spelling (the full-batch
+        # jaxpr has no explicit collectives — GSPMD inserts them)
+        axis_sizes = dict(zip(self._mesh.axis_names,
+                              self._mesh.devices.shape))
+        ksize = int(declared_axis_size
+                    or axis_sizes.get(self._data_axis, 1))
+        shard = max(data_shape[0] // max(ksize, 1), 1)
+        xs = jax.ShapeDtypeStruct((shard,) + data_shape[1:],
+                                  _onp.dtype(data_dtype))
+        ys = jax.ShapeDtypeStruct((shard,) + label_shape[1:],
+                                  _onp.dtype(label_dtype))
+        try:
+            rep = _cost.analyze_fn(
+                self._build_replica_step(), train_vals, states, aux_vals,
+                xs, ys, key, jnp.float32(0.01), jnp.int32(1),
+                axis_env=[(self._data_axis, ksize)])
+            report.collective_bytes_per_axis = \
+                rep.collective_bytes_per_axis
+        except Exception:
+            pass
+        report.axis_sizes = {self._data_axis: ksize}
+        return report
+
     def _build_grad_step(self):
         """Dist split-step, part 1: loss + local gradients (no update) —
         the grads cross the process boundary through the kvstore between

@@ -41,10 +41,19 @@ class ModelRunner:
         findings at ERROR severity (non-batch-polymorphic graphs) raise
     warmup : compile every bucket now, so the first request is served by
         a cache hit, and snapshot the jit-cache baseline
+    hbm_cap_bytes : SRV003 cap on per-bucket modeled peak HBM (default:
+        the ``MXTPU_SERVING_HBM_CAP`` env var; 0/unset disables).  The
+        modeled per-bucket cost itself is exposed via ``modeled_cost()``
+        and the HTTP ``/stats`` endpoint.
     """
 
     def __init__(self, model, buckets=DEFAULT_BUCKETS, example_shape=None,
-                 dtype=None, lint=True, warmup=True):
+                 dtype=None, lint=True, warmup=True, hbm_cap_bytes=None):
+        import os
+        if hbm_cap_bytes is None:
+            hbm_cap_bytes = int(os.environ.get(
+                "MXTPU_SERVING_HBM_CAP", "0")) or None
+        self.hbm_cap_bytes = hbm_cap_bytes
         if not buckets:
             raise MXNetError("ModelRunner needs at least one bucket")
         self.buckets = tuple(sorted(int(b) for b in set(buckets)))
@@ -86,7 +95,9 @@ class ModelRunner:
     def _lint_symbol(self):
         from ..analysis import ERROR, lint_serving, render_text
         shapes = {d.name: d.shape for d in self._model.data_shapes}
-        findings = lint_serving(self._model.symbol, data_shapes=shapes)
+        findings = lint_serving(self._model.symbol, data_shapes=shapes,
+                                buckets=self.buckets,
+                                hbm_cap_bytes=self.hbm_cap_bytes)
         errors = [f for f in findings if f.severity == ERROR]
         if errors:
             raise MXNetError(
@@ -95,6 +106,33 @@ class ModelRunner:
         if findings:
             import warnings
             warnings.warn("serving lint:\n%s" % render_text(findings))
+
+    def modeled_cost(self):
+        """Static per-bucket cost from the mxcost pass (analysis/cost.py):
+        ``{bucket: {"flops", "transfer_bytes", "peak_hbm_bytes",
+        "bytes_read", "bytes_written"}}``.  Modeled, not measured — live
+        on the CPU host with no device attached; serialized into the
+        HTTP ``/stats`` payload as ``modeled_cost``.  Empty for Gluon
+        blocks (no Symbol to analyze) or untraceable graphs; memoized
+        (the symbol is frozen after load)."""
+        if getattr(self, "_modeled_cost", None) is not None:
+            return self._modeled_cost
+        out = {}
+        if self._is_module:
+            from ..analysis.cost import analyze_symbol
+            base = {d.name: tuple(d.shape)
+                    for d in self._model.data_shapes}
+            for b in self.buckets:
+                shapes = {name: (b,) + s[1:] for name, s in base.items()}
+                report = analyze_symbol(self._model.symbol, shapes=shapes)
+                if report is None:
+                    continue
+                d = report.as_dict()
+                out[int(b)] = {k: d[k] for k in (
+                    "flops", "transfer_bytes", "peak_hbm_bytes",
+                    "bytes_read", "bytes_written")}
+        self._modeled_cost = out
+        return out
 
     # -- bucket arithmetic -------------------------------------------------
     @property

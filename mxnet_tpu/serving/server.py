@@ -73,6 +73,12 @@ class _Handler(BaseHTTPRequestHandler):
             stats = srv.batcher.stats.as_dict()
             stats["recompiles"] = srv.runner.recompiles_since_warmup()
             stats["buckets_configured"] = list(srv.runner.buckets)
+            # static per-bucket cost model (mxcost): modeled, not
+            # measured — lets dashboards show expected flops/HBM next
+            # to the measured p50/p99 without a profiling run
+            stats["modeled_cost"] = {
+                str(b): row
+                for b, row in sorted(srv.runner.modeled_cost().items())}
             self._reply(200, stats)
         else:
             self._reply(404, {"error": "unknown path %s" % self.path})

@@ -535,6 +535,19 @@ class Symbol:
         return lint_symbol(self, shapes=shapes, type_dict=type_dict,
                            disable=disable, check_consts=check_consts)
 
+    def cost_report(self, shapes, type_dict=None, train=False,
+                    host_names=None):
+        """Static cost/memory model of this graph's forward program
+        (mxnet_tpu.analysis.cost — mxcost): FLOPs, bytes, host↔device
+        transfer, liveness-based peak HBM.  Nothing executes or
+        compiles.  ``shapes`` must make the graph inferable (same
+        contract as ``lint``'s constant check); names in ``shapes`` are
+        treated as host-fed per call unless ``host_names`` overrides.
+        Returns a ``CostReport`` or None if the graph does not trace."""
+        from ..analysis.cost import analyze_symbol
+        return analyze_symbol(self, shapes=shapes, type_dict=type_dict,
+                              train=train, host_names=host_names)
+
     # gradient of this symbol's outputs — handled inside Executor via vjp
     def grad(self, wrt):
         raise NotImplementedError(

@@ -447,3 +447,470 @@ def test_cli_source_lint_json(tmp_path):
     payload = json.loads(proc.stdout)
     got = {f["rule"] for f in payload["findings"]}
     assert got == {"SRC001", "SRC002"}
+
+
+# ---------------------------------------------------------------------------
+# cost pass (mxcost): golden per-op models, liveness, transfer,
+# collectives, XLA cross-validation, determinism
+# ---------------------------------------------------------------------------
+import jax
+from jax import lax
+
+from mxnet_tpu.analysis import cost as mxcost
+
+
+def _xla_flops(fn, *args):
+    c = jax.jit(fn).lower(*args).compile().cost_analysis()
+    d = c[0] if isinstance(c, list) else c
+    return float(d.get("flops", 0.0)), float(d.get("transcendentals", 0.0))
+
+
+def test_cost_dot_general_golden():
+    r = mxcost.analyze_fn(lambda a, b: a @ b,
+                          jnp.zeros((64, 128)), jnp.zeros((128, 256)))
+    assert r.flops == 2 * 64 * 128 * 256
+    assert r.per_primitive["dot_general"]["count"] == 1
+    # batched matmul counts the batch dims too
+    rb = mxcost.analyze_fn(jnp.matmul, jnp.zeros((4, 8, 16)),
+                           jnp.zeros((4, 16, 32)))
+    assert rb.flops == 2 * 4 * 8 * 16 * 32
+
+
+def test_cost_conv_golden():
+    x = jnp.zeros((8, 32, 32, 16))
+    w = jnp.zeros((3, 3, 16, 32))
+
+    def conv(a, b):
+        return lax.conv_general_dilated(
+            a, b, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    r = mxcost.analyze_fn(conv, x, w)
+    assert r.flops == 2 * 8 * 32 * 32 * 32 * 9 * 16
+
+
+def test_cost_reduce_golden():
+    r = mxcost.analyze_fn(lambda x: x.sum(axis=1), jnp.zeros((64, 1000)))
+    assert r.flops == 64 * 1000 - 64
+    rmax = mxcost.analyze_fn(lambda x: x.max(), jnp.zeros((128,)))
+    assert rmax.flops == 127
+
+
+def test_cost_elementwise_and_transcendental():
+    r = mxcost.analyze_fn(lambda x: x + x, jnp.zeros((64, 1000)))
+    assert r.flops == 64000 and r.transcendentals == 0
+    re_ = mxcost.analyze_fn(jnp.exp, jnp.zeros((64, 1000)))
+    assert re_.flops == 0 and re_.transcendentals == 64000
+
+
+def test_cost_reshape_and_movement_are_free():
+    r = mxcost.analyze_fn(lambda x: x.reshape(-1).T, jnp.zeros((16, 32)))
+    assert r.flops == 0 and r.transcendentals == 0
+    # but the bytes moved are counted
+    assert r.bytes_read >= 16 * 32 * 4
+
+
+def test_cost_collective_bytes_per_axis():
+    n = 1 << 20
+    r = mxcost.analyze_fn(lambda x: lax.psum(x, "data"),
+                          jnp.zeros((n,), jnp.float32),
+                          axis_env=[("data", 8)])
+    # ring all-reduce: 2*(K-1)/K * payload
+    assert r.collective_bytes_per_axis == {
+        "data": int(2 * 7 * (n * 4) // 8)}
+    rg = mxcost.analyze_fn(lambda x: lax.all_gather(x, "data"),
+                           jnp.zeros((n,), jnp.float32),
+                           axis_env=[("data", 8)])
+    assert rg.collective_bytes_per_axis == {"data": int(7 * (n * 4) // 8)}
+    # axis of size 1 moves nothing
+    r1 = mxcost.analyze_fn(lambda x: lax.psum(x, "data"),
+                           jnp.zeros((n,)), axis_env=[("data", 1)])
+    assert r1.collective_bytes == 0
+
+
+def test_cost_transfer_classification():
+    w = jnp.zeros((256, 256))
+    x = jnp.zeros((8, 256))
+    r = mxcost.analyze_fn(lambda w, x: x @ w, w, x, host_argnums=(1,))
+    # only x is host-fed; the output (8,256) f32 is fetched
+    assert r.transfer_h2d_bytes == 8 * 256 * 4
+    assert r.transfer_d2h_bytes == 8 * 256 * 4
+    assert r.input_bytes == (256 * 256 + 8 * 256) * 4
+
+
+def test_cost_peak_hbm_liveness_and_donation():
+    # chain: big intermediate dies after use; peak = inputs + biggest
+    # simultaneous pair
+    def f(x):
+        a = x * 2.0        # 4 MiB live with x
+        b = a.sum(axis=1)  # a dies after this
+        return b
+
+    x = jnp.zeros((1024, 1024))
+    nb = 1024 * 1024 * 4
+    r = mxcost.analyze_fn(f, x)
+    # non-donated input resident + intermediate a + the (1024,) output
+    assert r.peak_hbm_bytes == nb + nb + 1024 * 4
+    # donating x does not change the peak here (x is live when a is
+    # written) but a donated input must not outlive its last use:
+    def g(x):
+        a = x * 2.0
+        b = a * 3.0        # x already dead if donated
+        return b.sum()
+
+    rd = mxcost.analyze_fn(g, x, donate_argnums=(0,))
+    rn = mxcost.analyze_fn(g, x)
+    assert rd.peak_hbm_bytes < rn.peak_hbm_bytes
+
+
+def test_cost_nested_jit_is_inlined():
+    inner = jax.jit(lambda a, b: a @ b)
+    r = mxcost.analyze_fn(lambda a, b: inner(a, b) + 1.0,
+                          jnp.zeros((32, 32)), jnp.zeros((32, 32)))
+    assert r.per_primitive["dot_general"]["flops"] == 2 * 32 * 32 * 32
+
+
+def test_cost_xla_cross_validation():
+    """Modeled flops vs XLA's own post-compile cost_analysis() on CPU,
+    within the documented XLA_FLOP_RTOL for the golden ops."""
+    x = jnp.zeros((8, 32, 32, 16))
+    w = jnp.zeros((3, 3, 16, 32))
+    cases = [
+        ("dot", lambda a, b: a @ b,
+         (jnp.zeros((64, 128)), jnp.zeros((128, 256)))),
+        ("conv", lambda a, b: lax.conv_general_dilated(
+            a, b, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), (x, w)),
+        ("reduce", lambda a: a.sum(axis=1), (jnp.zeros((64, 1000)),)),
+        ("add", lambda a: a + a, (jnp.zeros((64, 1000)),)),
+        ("exp", jnp.exp, (jnp.zeros((64, 1000)),)),
+    ]
+    for name, fn, args in cases:
+        modeled = mxcost.analyze_fn(fn, *args)
+        xla_f, xla_t = _xla_flops(fn, *args)
+        if xla_f:
+            err = abs(modeled.flops - xla_f) / xla_f
+            assert err <= mxcost.XLA_FLOP_RTOL, (name, modeled.flops,
+                                                 xla_f, err)
+        if xla_t:
+            err = abs(modeled.transcendentals - xla_t) / xla_t
+            assert err <= mxcost.XLA_FLOP_RTOL, (name, err)
+
+
+def test_cost_determinism_and_self_check():
+    from mxnet_tpu.analysis import cost_self_check
+    a = mxcost.analyze_fn(lambda x: jnp.exp(x @ x.T).sum(),
+                          jnp.zeros((32, 32))).as_dict()
+    b = mxcost.analyze_fn(lambda x: jnp.exp(x @ x.T).sum(),
+                          jnp.zeros((32, 32))).as_dict()
+    assert a == b
+    assert cost_self_check() == []
+
+
+def test_cost_report_dict_shape():
+    r = mxcost.analyze_fn(lambda a, b: a @ b, jnp.zeros((4, 8)),
+                          jnp.zeros((8, 2)))
+    d = r.as_dict()
+    for key in ("flops", "transcendentals", "bytes_read", "bytes_written",
+                "transfer_bytes", "collective_bytes_per_axis",
+                "peak_hbm_bytes", "per_primitive", "n_eqns"):
+        assert key in d
+    assert "mxcost" in r.render()
+
+
+# ---------------------------------------------------------------------------
+# DST distributed-step rules
+# ---------------------------------------------------------------------------
+from mxnet_tpu.analysis import dist_lint
+
+
+def _step_jaxpr(fn, *avals, axis=8):
+    return jax.make_jaxpr(fn, axis_env=[("data", axis)])(*avals)
+
+
+def test_dst001_missing_grad_reduction():
+    """A step that applies raw per-replica grads leaves the new weights
+    replica-varying."""
+    w = jnp.zeros((16, 4))
+    x = jnp.zeros((8, 16))
+
+    def bad_step(w, x):
+        g = jax.grad(lambda w: (x @ w).sum())(w)
+        return w - 0.1 * g          # no pmean: replicas diverge
+
+    closed = _step_jaxpr(bad_step, w, x)
+    findings = dist_lint.lint_dist_step(
+        closed, "data", varying_invars=[1], param_outvars=[0],
+        param_names=["w"], axis_size=8)
+    assert rules(findings) == {"DST001"}
+    assert findings[0].subject == "w"
+
+    def good_step(w, x):
+        g = jax.grad(lambda w: (x @ w).sum())(w)
+        return w - 0.1 * lax.pmean(g, "data")
+
+    closed = _step_jaxpr(good_step, w, x)
+    assert dist_lint.lint_dist_step(
+        closed, "data", varying_invars=[1], param_outvars=[0],
+        param_names=["w"], axis_size=8) == []
+
+
+def test_dst002_duplicate_reduction():
+    def dup_step(w, x):
+        g = jax.grad(lambda w: (x @ w).sum())(w)
+        g = lax.psum(g, "data")
+        return w - lax.psum(g, "data")   # second psum: scales by K
+
+    closed = _step_jaxpr(dup_step, jnp.zeros((16, 4)), jnp.zeros((8, 16)))
+    findings = dist_lint.lint_dist_step(
+        closed, "data", varying_invars=[1], param_outvars=[0],
+        param_names=["w"], axis_size=8)
+    assert rules(findings) == {"DST002"}
+
+
+def test_dst004_widened_collective():
+    def widened(g):
+        return lax.psum(g.astype(jnp.float32), "data")
+
+    closed = _step_jaxpr(widened, jnp.zeros((1024,), jnp.bfloat16))
+    findings = dist_lint.lint_dist_step(
+        closed, "data", varying_invars=[0], param_outvars=[],
+        axis_size=8)
+    assert rules(findings) == {"DST004"}
+    assert "bfloat16->float32" in findings[0].message
+    # reducing in the native dtype is clean
+    closed2 = _step_jaxpr(lambda g: lax.psum(g, "data"),
+                          jnp.zeros((1024,), jnp.bfloat16))
+    assert dist_lint.lint_dist_step(
+        closed2, "data", varying_invars=[0], param_outvars=[],
+        axis_size=8) == []
+
+
+def test_dst005_baked_step_constant():
+    lr = np.float32(0.1)        # python-side value baked into the trace
+
+    def step(w, x):
+        g = lax.pmean(jax.grad(lambda w: (x @ w).sum())(w), "data")
+        return w - jnp.asarray(np.full((16, 4), lr)) * g
+
+    closed = _step_jaxpr(step, jnp.zeros((16, 4)), jnp.zeros((8, 16)))
+    assert closed.consts, "fixture should bake a constant"
+    findings = dist_lint.lint_dist_step(
+        closed, "data", varying_invars=[1], param_outvars=[0],
+        param_names=["w"], axis_size=8)
+    assert rules(findings) == {"DST005"}
+
+
+def _make_trainer(**kwargs):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    return DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, **kwargs)
+
+
+def test_trainer_lint_clean():
+    tr = _make_trainer()
+    assert tr.lint(data_shape=(64, 16), label_shape=(64,)) == []
+    # and the cost report of the same step is populated
+    rep = tr.cost_report(data_shape=(64, 16), label_shape=(64,))
+    assert rep.flops > 0 and rep.collective_bytes > 0
+    assert rep.transfer_h2d_bytes == 64 * 16 * 4 + 64 * 4
+
+
+def test_trainer_lint_catches_removed_grad_psum(monkeypatch):
+    """The acceptance bug class: the gradient reduction deleted from
+    DataParallelTrainer — every trainable param raises DST001."""
+    from mxnet_tpu.parallel import DataParallelTrainer
+    monkeypatch.setattr(DataParallelTrainer, "_reduce_grads",
+                        lambda self, grads: grads)
+    tr = _make_trainer()
+    findings = tr.lint(data_shape=(64, 16), label_shape=(64,))
+    assert "DST001" in rules(findings)
+    subjects = {f.subject for f in findings if f.rule_id == "DST001"}
+    # all four MLP params (2x weight, 2x bias) desync, and the loss is
+    # no longer the global mean either
+    assert len(subjects) >= 4
+
+
+def test_dst003_param_sharded_over_data_axis():
+    from jax.sharding import PartitionSpec
+    # shard only the 8-divisible params over the data axis so setup's
+    # device_put succeeds and the *lint* is what reports the bug
+    tr = _make_trainer(param_spec_fn=lambda name, shape:
+                       PartitionSpec("data")
+                       if int(shape[0]) % 8 == 0 else PartitionSpec())
+    findings = tr.lint(data_shape=(64, 16), label_shape=(64,))
+    assert "DST003" in rules(findings)
+    msgs = " ".join(f.message for f in findings
+                    if f.rule_id == "DST003")
+    assert "data" in msgs
+
+
+def test_dst003_batch_not_divisible():
+    tr = _make_trainer()
+    findings = tr.lint(data_shape=(30, 16), label_shape=(30,),
+                       declared_axis_size=8)
+    assert any(f.rule_id == "DST003" and f.subject == "data"
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# budget gate: STATIC_BUDGETS.json + tools/update_budgets.py
+# ---------------------------------------------------------------------------
+def test_budget_gate_cli():
+    """CI gate: the checked-in budgets pass on the seed models."""
+    proc = _run_cli("--cost", "--budget",
+                    os.path.join(REPO, "STATIC_BUDGETS.json"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_budget_gate_fails_on_flop_regression(tmp_path):
+    """A budget whose flops entry is >10% below the modeled value is
+    exactly what a flop-doubling PR produces: COST001, exit 2."""
+    with open(os.path.join(REPO, "STATIC_BUDGETS.json")) as f:
+        budget = json.load(f)
+    budget["models"]["mlp_train_step"]["flops"] = int(
+        budget["models"]["mlp_train_step"]["flops"] / 1.5)
+    bad = tmp_path / "budgets.json"
+    bad.write_text(json.dumps(budget))
+    proc = _run_cli("--cost", "--budget", str(bad))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "COST001" in proc.stdout
+
+    # and a stale (too-high) budget is a COST002 warning: rc 0 plain,
+    # rc 1 under --strict
+    budget["models"]["mlp_train_step"]["flops"] = int(
+        budget["models"]["mlp_train_step"]["flops"] * 4)
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps(budget))
+    proc = _run_cli("--cost", "--budget", str(stale))
+    assert proc.returncode == 0 and "COST002" in proc.stdout
+    proc = _run_cli("--cost", "--budget", str(stale), "--strict")
+    assert proc.returncode == 1
+
+
+def test_budget_gate_unknown_model(tmp_path):
+    bad = tmp_path / "budgets.json"
+    bad.write_text(json.dumps({
+        "tolerance_pct": 10,
+        "models": {"no_such_model": {"flops": 1}}}))
+    proc = _run_cli("--cost", "--budget", str(bad))
+    assert proc.returncode == 2
+    assert "COST001" in proc.stdout and "no_such_model" in proc.stdout
+
+
+def test_update_budgets_check_mode(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    tool = os.path.join(REPO, "tools", "update_budgets.py")
+    proc = subprocess.run(
+        [sys.executable, tool, "--check"], capture_output=True,
+        text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # regenerating into a scratch path writes a loadable, gate-clean file
+    out = tmp_path / "budgets.json"
+    proc = subprocess.run(
+        [sys.executable, tool, "--path", str(out)], capture_output=True,
+        text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    written = json.loads(out.read_text())
+    assert written["models"] and written["tolerance_pct"] == 10.0
+    proc = subprocess.run(
+        [sys.executable, tool, "--check", "--path", str(out)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cost_json_schema_version():
+    proc = _run_cli("--cost", "--json", "--model", "mlp_infer")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["schema_version"] == 2
+    assert payload["version"] == 1
+    assert "mlp_infer" in payload["cost"]
+    assert payload["cost"]["mlp_infer"]["flops"] > 0
+    assert payload["dist"]["rules"][0] == "DST001"
+
+
+# ---------------------------------------------------------------------------
+# cost hooks: Symbol / Module / serving ModelRunner
+# ---------------------------------------------------------------------------
+def test_symbol_and_module_cost_report():
+    net = _mlp()
+    rep = net.cost_report(shapes={"data": (2, 16)})
+    assert rep is not None and rep.flops > 0
+    # FC1 dominates: 2*2*16*8 + FC2 2*2*8*4
+    assert rep.per_primitive["dot_general"]["flops"] == \
+        2 * 2 * 16 * 8 + 2 * 2 * 8 * 4
+    # host-fed = the names shapes were given for
+    assert rep.transfer_h2d_bytes == 2 * 16 * 4
+
+    mod = mx.module.Module(_mlp(), data_names=("data",),
+                           label_names=("lint_softmax_label",))
+    assert mod.cost_report() is None          # unbound: no shapes
+    mod.bind(data_shapes=[("data", (2, 16))],
+             label_shapes=[("lint_softmax_label", (2,))])
+    mrep = mod.cost_report()
+    assert mrep is not None and mrep.flops == rep.flops
+
+
+def test_serving_modeled_cost_and_srv003():
+    import mxnet_tpu.serving as serving
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=16, name="srv_fc1")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Activation(h, act_type="relu"),
+                           num_hidden=3, name="srv_fc2"),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    runner = serving.ModelRunner(mod, buckets=(1, 4), example_shape=(8,))
+    cost = runner.modeled_cost()
+    assert set(cost) == {1, 4}
+    for b, row in cost.items():
+        assert row["flops"] > 0 and row["peak_hbm_bytes"] > 0
+    # flops scale with the bucket's batch
+    assert cost[4]["flops"] > cost[1]["flops"]
+    # SRV003: a cap below the modeled HBM flags at load
+    with pytest.warns(UserWarning, match="SRV003"):
+        serving.ModelRunner(mod, buckets=(1, 4), example_shape=(8,),
+                            hbm_cap_bytes=16, warmup=False)
+    # a generous cap stays silent (no SRV003 in any warning)
+    import warnings as _warnings
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        serving.ModelRunner(mod, buckets=(1, 4), example_shape=(8,),
+                            hbm_cap_bytes=1 << 30, warmup=False)
+    assert not any("SRV003" in str(w.message) for w in caught)
+
+
+def test_serving_stats_expose_modeled_cost():
+    from mxnet_tpu.serving.stats import ServingStats  # noqa: F401  (sanity)
+    import mxnet_tpu.serving as serving
+    data = sym.var("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=3, name="ss_fc"),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    runner = serving.ModelRunner(mod, buckets=(1, 2), example_shape=(8,))
+    server = serving.Server(runner, port=0)
+    host, port = server.start()
+    try:
+        import http.client
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("GET", "/stats")
+        resp = json.loads(conn.getresponse().read())
+        assert set(resp["modeled_cost"]) == {"1", "2"}
+        assert resp["modeled_cost"]["2"]["flops"] > 0
+    finally:
+        server.drain(timeout=10)

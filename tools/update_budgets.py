@@ -1,0 +1,87 @@
+"""Regenerate (or verify) STATIC_BUDGETS.json from the live cost model.
+
+The checked-in budget file pins the modeled step FLOPs / transfer bytes /
+peak HBM / collective bytes of the registered budget models
+(``mxnet_tpu/analysis/budget_models.py``); CI gates PRs against it via
+``python -m mxnet_tpu.analysis --cost --budget STATIC_BUDGETS.json``
+(tests/test_analysis.py, marker ``analysis``) — all hardware-free, so a
+doubled step FLOP count fails on the 1-core CPU host with the TPU down.
+
+Workflow when a PR *intentionally* changes a modeled metric (a new
+layer, a narrower transfer dtype):
+
+    python tools/update_budgets.py          # rewrite the file
+    git add STATIC_BUDGETS.json             # ship it with the PR
+
+``--check`` recomputes without writing and exits 1 on any drift beyond
+tolerance — the CI spelling (equivalent to the --budget gate, minus the
+DST findings which the gate also runs).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATH = os.path.join(_REPO, "STATIC_BUDGETS.json")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python tools/update_budgets.py",
+        description="regenerate/verify STATIC_BUDGETS.json from the "
+                    "static cost model (no hardware needed)")
+    p.add_argument("--path", default=DEFAULT_PATH,
+                   help="budget file (default: repo STATIC_BUDGETS.json)")
+    p.add_argument("--check", action="store_true",
+                   help="verify instead of write: exit 1 when any "
+                        "modeled metric drifted beyond tolerance")
+    p.add_argument("--tolerance-pct", type=float, default=10.0,
+                   help="gate tolerance recorded in the file (default 10)")
+    args = p.parse_args(argv)
+
+    # the budget numbers are defined on the CPU backend (deterministic
+    # and available even when the accelerator is down)
+    if not os.environ.get("JAX_PLATFORMS"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    sys.path.insert(0, _REPO)
+    from mxnet_tpu.analysis.budget_models import (compute_budgets,
+                                                  check_budgets)
+    from mxnet_tpu.analysis import render_text, ERROR
+
+    if args.check:
+        if not os.path.isfile(args.path):
+            print("MISSING: %s (run tools/update_budgets.py)" % args.path)
+            return 1
+        findings, _ = check_budgets(args.path)
+        findings = [f for f in findings
+                    if f.rule_id in ("COST001", "COST002")]
+        print(render_text(findings,
+                          title="update_budgets --check %s" % args.path))
+        return 1 if findings else 0
+
+    budgets = compute_budgets()
+    payload = {
+        "comment": "modeled static budgets (mxcost) — regenerate with "
+                   "tools/update_budgets.py; gated in CI by "
+                   "python -m mxnet_tpu.analysis --cost --budget",
+        "schema_version": 2,
+        "tolerance_pct": args.tolerance_pct,
+        "models": budgets,
+    }
+    with open(args.path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print("wrote %s (%d models)" % (args.path, len(budgets)))
+    for name, row in sorted(budgets.items()):
+        print("  %-18s flops=%d peak_hbm=%d transfer=%d collective=%d"
+              % (name, row["flops"], row["peak_hbm_bytes"],
+                 row["transfer_bytes"], row["collective_bytes"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
