@@ -319,6 +319,14 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_STATIC_COST", "1") == "1":
         rec.stage("static_cost", 90, _static_cost_bench)
 
+    # -- run-ahead overlap micro-bench, host-only and BEFORE backend
+    # acquisition: train_loop_overlap_ratio (stepped vs bulk wall time on
+    # CPU jax) keeps the async dispatch engine's win measurable when the
+    # TPU is down (BENCH_r05: 4 stale keys because everything sat behind
+    # backend acquisition)
+    if os.environ.get("MXTPU_BENCH_OVERLAP", "1") == "1":
+        rec.stage("overlap", 120, _overlap_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -513,6 +521,26 @@ def _static_cost_bench():
         "modeled_peak_hbm_bytes": int(cost["peak_hbm_bytes"]),
         "modeled_collective_bytes": int(cost["collective_bytes"]),
     }
+
+
+def _overlap_bench():
+    """Stepped-vs-bulk training-loop wall time through the run-ahead
+    engine (mxnet_tpu/engine_bench.py): train_loop_overlap_ratio +
+    dispatch_depth + dispatch-stall counters.  JAX_PLATFORMS=cpu
+    subprocess — same isolation contract as the serving/pipeline/cost
+    stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.engine_bench"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("overlap bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def _serving_bench():

@@ -61,9 +61,12 @@ def main():
         loss.backward()
         opt.update(0, w, w.grad, state)
         if t >= args.burnin:
-            samples.append(float(w.asnumpy()[0]))
+            # park the (immutable) device value — updates rebind w, they
+            # never mutate old buffers — and fetch once after the loop:
+            # a per-step host fetch would stall the async dispatch queue
+            samples.append(w.copy())
 
-    samples = np.asarray(samples)
+    samples = np.asarray([float(s.asnumpy()[0]) for s in samples])
     got_mean, got_std = samples.mean(), samples.std()
     print("posterior: analytic N(%.4f, %.4f) | sgld mean %.4f std %.4f "
           "(%d samples)" % (post_mean, post_std, got_mean, got_std,

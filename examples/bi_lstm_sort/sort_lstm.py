@@ -60,7 +60,7 @@ def main():
                             {"learning_rate": 4e-3})
 
     for epoch in range(args.epochs):
-        tot = 0.0
+        tot = None  # device-resident running sum: no per-step host sync
         for s in range(0, len(Xtr), args.batch):
             xb = nd.array(Xtr[s:s + args.batch])
             yb = nd.array(ytr[s:s + args.batch])
@@ -68,9 +68,11 @@ def main():
                 loss = loss_fn(net(xb), yb).mean()
             loss.backward()
             trainer.step(1)
-            tot += float(loss.asscalar())
+            tot = loss if tot is None else tot + loss
         if epoch % 10 == 0:
-            print("epoch", epoch, "loss", tot / (len(Xtr) // args.batch))
+            # epoch boundary = flush boundary: the ONE fetch per window
+            print("epoch", epoch, "loss",
+                  float(tot.asscalar()) / (len(Xtr) // args.batch))
 
     pred = net(nd.array(Xte)).asnumpy().argmax(-1)
     acc = float((pred == yte).mean())

@@ -94,7 +94,7 @@ def main():
                             {"learning_rate": 2e-3})
 
     for epoch in range(args.epochs):
-        tot = 0.0
+        tot = None  # device-resident running sum: no per-step host sync
         for s in range(0, len(Xtr), args.batch):
             xb = nd.array(Xtr[s:s + args.batch])
             yb = nd.array(ytr[s:s + args.batch])
@@ -102,9 +102,10 @@ def main():
                 loss = margin_loss(net(xb), yb)
             loss.backward()
             trainer.step(1)
-            tot += float(loss.asscalar())
+            tot = loss if tot is None else tot + loss
         if epoch % 4 == 0:
-            print("epoch", epoch, "margin loss", tot)
+            # epoch boundary = flush boundary: fetch the sum once
+            print("epoch", epoch, "margin loss", float(tot.asscalar()))
 
     pred = net(nd.array(Xte)).asnumpy().argmax(1)
     acc = float((pred == yte).mean())

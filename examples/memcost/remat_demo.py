@@ -78,8 +78,8 @@ def train_losses(remat, steps, seed=0):
             loss = l2(net(nd.array(X)), nd.array(yt)).mean()
         loss.backward()
         trainer.step(1)
-        losses.append(float(loss.asscalar()))
-    return losses
+        losses.append(loss)  # lazy device scalar; fetched after the loop
+    return [float(l.asscalar()) for l in losses]
 
 
 def main():

@@ -77,13 +77,15 @@ def main():
                             nd.array(noise))
         loss.backward()
         trainer.step(args.batch)
-        v = float(loss.asscalar())
+        # keep the lazy device scalar: referencing it is free, only the
+        # periodic log below (a flush boundary) fetches to host
         if first is None:
-            first = v
-        last = v
+            first = loss
+        last = loss
         if step % 100 == 0:
-            print("step %d nce loss %.4f" % (step, v))
+            print("step %d nce loss %.4f" % (step, float(loss.asscalar())))
 
+    first, last = float(first.asscalar()), float(last.asscalar())
     assert last < first * 0.5, (first, last)
 
     # retrieval: nearest output-embedding of a center word should be in

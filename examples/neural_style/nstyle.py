@@ -94,7 +94,8 @@ def main():
         loss.backward()
         opt.update(0, img, img.grad, state)
         if first is None:
-            first = float(loss.asnumpy())
+            first = loss  # lazy device scalar; fetched after the loop
+    first = float(first.asnumpy())
     final = float(loss.asnumpy())
 
     print("style loss %.4f -> %.4f" % (first, final))

@@ -77,7 +77,7 @@ def main():
     first_mse = None
     for epoch in range(args.epochs):
         perm = rng.permutation(n)
-        total = 0.0
+        total = None  # device-resident running sum: no per-step sync
         for s in range(0, n - args.batch_size + 1, args.batch_size):
             sel = perm[s:s + args.batch_size]
             u = nd.array(users[sel].astype(np.float32))
@@ -88,8 +88,11 @@ def main():
                 loss = loss_fn(pred, r)
             loss.backward()
             trainer.step(args.batch_size)
-            total += float(loss.mean().asscalar())
-        mse = 2 * total / (n // args.batch_size)   # L2Loss is 1/2 MSE
+            m = loss.mean()
+            total = m if total is None else total + m
+        # epoch boundary = flush boundary: one fetch per epoch
+        mse = 2 * float(total.asscalar()) / (n // args.batch_size)
+        # L2Loss is 1/2 MSE
         if first_mse is None:
             first_mse = mse
         logging.info("epoch %d  mse %.4f", epoch, mse)

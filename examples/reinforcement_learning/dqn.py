@@ -89,7 +89,11 @@ def main():
             if rng.rand() < eps:
                 a = rng.randint(len(ACTIONS))
             else:
-                a = int(q(nd.array(s[None])).asnumpy().argmax())
+                # the env is a host object: acting is inherently a
+                # per-step host sync, there is no flush boundary to
+                # defer to
+                a = int(q(nd.array(s[None])).asnumpy()  # mxlint: disable=SRC004
+                        .argmax())
             pos, r, done = env.step(a)
             s2 = onehot(pos)
             replay.append((s, a, r, s2, done))
@@ -101,13 +105,15 @@ def main():
                 idx = rng.randint(0, len(replay), args.batch)
                 S = nd.array(np.stack([replay[i][0] for i in idx]))
                 A = np.array([replay[i][1] for i in idx])
-                R = np.array([replay[i][2] for i in idx], np.float32)
+                R = nd.array(np.array([replay[i][2] for i in idx],
+                                      np.float32))
                 S2 = nd.array(np.stack([replay[i][3] for i in idx]))
-                D = np.array([float(replay[i][4]) for i in idx],
-                             np.float32)
-                # TD target through the FROZEN network (no gradient)
-                q2 = target(S2).asnumpy().max(1)
-                y = nd.array(R + args.gamma * q2 * (1.0 - D))
+                D = nd.array(np.array([replay[i][4] for i in idx],
+                                      np.float32))
+                # TD target through the FROZEN network (no gradient) —
+                # computed on device: the learner never round-trips
+                q2 = nd.max(target(S2), axis=1)
+                y = R + args.gamma * q2 * (1.0 - D)
                 with autograd.record():
                     qs = q(S)
                     qa = nd.pick(qs, nd.array(A), axis=1)
@@ -125,7 +131,9 @@ def main():
     path = 0
     done = False
     while not done and path < 40:
-        a = int(q(nd.array(s[None])).asnumpy().argmax())
+        # acting: the host env consumes the action — inherent per-step sync
+        a = int(q(nd.array(s[None])).asnumpy()  # mxlint: disable=SRC004
+                .argmax())
         pos, r, done = env.step(a)
         s = onehot(pos)
         path += 1

@@ -80,9 +80,11 @@ def main():
         data_iter.reset()
         metric.reset()
         for batch in data_iter:
-            # predict the next character
-            label = mx.nd.array(
-                np.roll(batch.data[0].asnumpy(), -1, axis=1))
+            # predict the next character: roll the sequence left by one —
+            # on device (slice + concat), so the feed loop never blocks
+            # on a host round-trip per batch
+            x = batch.data[0]
+            label = mx.nd.concat(x[:, 1:], x[:, :1], dim=1)
             batch.label = [label]
             mod.forward(batch, is_train=True)
             mod.update_metric(metric, [label])

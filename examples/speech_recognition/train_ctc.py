@@ -84,11 +84,15 @@ def main():
         mod.forward(batch, is_train=True)
         mod.backward()
         mod.update()
-        loss = float(mod.get_outputs()[0].asnumpy().mean())
+        # lazy device scalar: only the periodic log (flush boundary)
+        # and the post-loop summary fetch to host
+        loss = mod.get_outputs()[0].mean()
         losses.append(loss)
         if i % 10 == 0:
-            logging.info("batch %d  ctc loss %.3f", i, loss)
-    logging.info("loss %.3f -> %.3f", losses[0], losses[-1])
+            logging.info("batch %d  ctc loss %.3f",
+                         i, float(loss.asscalar()))
+    logging.info("loss %.3f -> %.3f", float(losses[0].asscalar()),
+                 float(losses[-1].asscalar()))
 
 
 if __name__ == "__main__":

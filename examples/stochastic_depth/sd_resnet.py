@@ -93,7 +93,7 @@ def main():
                             {"learning_rate": 3e-3})
 
     for epoch in range(args.epochs):
-        tot = 0.0
+        tot = None  # device-resident running sum: no per-step host sync
         for s in range(0, len(Xtr), args.batch):
             xb = nd.array(Xtr[s:s + args.batch])
             yb = nd.array(ytr[s:s + args.batch])
@@ -101,9 +101,10 @@ def main():
                 loss = loss_fn(net(xb), yb).mean()
             loss.backward()
             trainer.step(1)
-            tot += float(loss.asscalar())
+            tot = loss if tot is None else tot + loss
         if epoch % 4 == 0:
-            print("epoch", epoch, "loss", tot)
+            # epoch boundary = flush boundary: fetch the sum once
+            print("epoch", epoch, "loss", float(tot.asscalar()))
 
     # inference is deterministic (no gate outside record)
     p1 = net(nd.array(Xte)).asnumpy()

@@ -78,7 +78,7 @@ def main():
                             {"learning_rate": 1e-3})
 
     def neg_elbo(Xb):
-        x = nd.array(Xb)
+        x = Xb if isinstance(Xb, nd.NDArray) else nd.array(Xb)
         x_hat, mu, logvar = net(x)
         return elbo_loss(x_hat, x, mu, logvar).mean()
 
@@ -88,7 +88,9 @@ def main():
         it.reset()
         for b in it:
             with autograd.record():
-                loss = neg_elbo(b.data[0].asnumpy())
+                # feed the iterator's batch as-is: a host round-trip
+                # per step would serialize the feed against dispatch
+                loss = neg_elbo(b.data[0])
             loss.backward()
             trainer.step(args.batch)
         if first is None:

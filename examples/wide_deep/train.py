@@ -84,26 +84,30 @@ def main():
                             {"learning_rate": 0.01})
     loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
 
-    correct = total = 0
+    correct, total = None, 0
     for step, batch in enumerate(it):
         x_csr = batch.data[0]
         y = batch.label[0]
         dense_x = x_csr.todense()                 # wide one-hot input
-        # deep path reads the per-field ids back from the CSR columns
-        ids = x_csr.indices.asnumpy().reshape(-1, args.num_fields)
-        feat_ids = nd.array(ids.astype(np.float32))
+        # deep path reads the per-field ids back from the CSR columns —
+        # reshaped/cast on device, no host round-trip in the feed loop
+        feat_ids = x_csr.indices.astype(np.float32) \
+            .reshape((-1, args.num_fields))
         with autograd.record():
             logit = net(dense_x, feat_ids)
             loss = loss_fn(logit, y.reshape((-1, 1)))
         loss.backward()
         trainer.step(args.batch_size)
-        pred = (logit.asnumpy()[:, 0] > 0).astype(np.float32)
-        correct += int((pred == y.asnumpy()).sum())
-        total += len(pred)
+        # device-resident hit counter: fetched only at the periodic log
+        # and the final accuracy (flush boundaries)
+        hits = ((logit.reshape((-1,)) > 0).astype(np.float32)
+                == y).astype(np.float32).sum()
+        correct = hits if correct is None else correct + hits
+        total += y.shape[0]
         if step % 20 == 0:
             logging.info("step %d  running acc %.3f", step,
-                         correct / max(total, 1))
-    acc = correct / total
+                         float(correct.asscalar()) / max(total, 1))
+    acc = float(correct.asscalar()) / total
     logging.info("final running accuracy: %.3f", acc)
     assert acc > 0.75, "wide&deep failed to learn"
 

@@ -37,6 +37,7 @@ __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "INFO",
     "lint_registry", "lint_graph", "lint_source", "lint_file",
     "lint_symbol", "lint_serving", "lint_rule_docs", "self_check",
+    "lint_shipped_loops",
     "load_test_map",
     "generate_coverage_md",
     "render_text", "render_json", "exit_code", "worst_severity",
@@ -55,9 +56,11 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
                       disable=disable, check_consts=check_consts)
 
 
-def self_check(disable=(), with_coverage=True, with_cost=True):
+def self_check(disable=(), with_coverage=True, with_cost=True,
+               with_examples=True):
     """Registry lint over the live registry, the rule-table docs sync
-    check, and the cost-pass determinism check — what CI runs.
+    check, the cost-pass determinism check, and the SRC004 sweep over the
+    shipped training loops — what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -67,7 +70,39 @@ def self_check(disable=(), with_coverage=True, with_cost=True):
     findings += lint_rule_docs(disable=disable)
     if with_cost:
         findings += cost_self_check(disable=disable)
+    if with_examples:
+        findings += lint_shipped_loops(disable=disable)
     return findings
+
+
+def lint_shipped_loops(disable=()):
+    """SRC004 over every ``examples/`` script and the in-repo fit loops
+    (``module/base_module.py``, ``parallel/trainer.py``): the training
+    loops this repo ships must not block the host once per dispatched
+    step — the engine's run-ahead window would collapse to 1 for anyone
+    copying them.  Only SRC004 is kept (the other source rules are
+    advisory for user scripts; examples demonstrate plenty of idioms
+    they would flag).  Skipped silently outside a repo checkout."""
+    import glob
+    import os
+
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(pkg))
+    examples = os.path.join(repo, "examples")
+    if not os.path.isdir(examples):
+        return []
+    targets = sorted(glob.glob(os.path.join(examples, "**", "*.py"),
+                               recursive=True))
+    targets += [os.path.join(pkg, os.pardir, "module", "base_module.py"),
+                os.path.join(pkg, os.pardir, "parallel", "trainer.py")]
+    findings = []
+    for path in targets:
+        try:
+            found = lint_file(os.path.normpath(path))
+        except (OSError, ValueError):
+            continue
+        findings += [f for f in found if f.rule_id == "SRC004"]
+    return filter_findings(findings, disable)
 
 
 def cost_self_check(disable=()):

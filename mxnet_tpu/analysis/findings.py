@@ -75,6 +75,13 @@ RULES = {
                         "host->device transfer; use the fused device tail "
                         "(ImageRecordIter(device_tail=True) / "
                         "mx.io.make_device_tail)"),
+    "SRC004": (WARNING, "per-step blocking host sync inside a training "
+                        "loop (float(loss)/.asscalar()/.asnumpy()/"
+                        "np.asarray per step): stalls the engine's "
+                        "run-ahead dispatch every iteration; accumulate "
+                        "on device, use metric.update_lazy, or fetch at "
+                        "flush boundaries (engine.bulk / `if step %% k "
+                        "== 0` guards)"),
     # meta (mxnet_tpu/analysis/__init__.py self_check)
     "DOC001": (WARNING, "lint rule has no row in the docs/analysis.md "
                         "rule table (keep RULES and the docs in sync)"),

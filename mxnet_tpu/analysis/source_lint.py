@@ -4,9 +4,16 @@ A Symbol graph is static, but the python driving it is not: pulling a
 scalar out of an array (``.item()``, ``.asscalar()``, ``int(x)``) blocks
 on the device and bakes the value into the next trace, and branching on a
 runtime ``.shape`` retraces the jit cache per input geometry — the exact
-recompile bugs ``jax.jit`` only reveals as slowness.  These rules are
-heuristic (python is dynamic); they point at lines worth reading, they do
-not prove bugs.
+recompile bugs ``jax.jit`` only reveals as slowness.  SRC004 is the
+dispatch-side companion: a blocking host fetch that runs once per
+dispatched training step (``float(loss)``, ``.asscalar()``,
+``np.asarray``) collapses the engine's run-ahead window to 1 — the loop
+is then input-bound no matter how fast the device is.  The rule fires
+only when the sync's *innermost* enclosing loop also dispatches steps
+(``.step()``/``forward_backward``/``backward``/``update``), so
+epoch-boundary fetches and periodic ``if step % k == 0`` flush guards
+stay clean.  These rules are heuristic (python is dynamic); they point
+at lines worth reading, they do not prove bugs.
 """
 from __future__ import annotations
 
@@ -20,6 +27,17 @@ __all__ = ["lint_source", "lint_file"]
 _SYNC_METHODS = {"item", "asscalar", "asnumpy", "tolist"}
 # builtins that, applied to array expressions, capture a python scalar
 _CAST_BUILTINS = {"int", "float", "bool"}
+# additional device->host materializers for the training-loop rule
+# (SRC004): these don't bake values into traces (SRC001's concern) but
+# they DO block the host on the device every step
+_SYNC_EXTRA = {"wait_to_read", "block_until_ready"}
+# np.asarray(<expr>) materializes the device value on the host; plain
+# nd.array/np.array *construction* from host data is h2d, not a sync,
+# so only asarray participates
+_HOST_FETCH_FUNCS = {"asarray"}
+# calls that mark a loop as a *training* loop: the sync then runs at
+# step frequency, which is exactly the anti-pattern (SRC004)
+_STEP_CALLS = {"step", "forward_backward", "backward", "update"}
 # host-side normalization entry points (SRC003): the device tail does the
 # same math fused into the first jitted step, off the host's critical path
 _NORMALIZE_CALLS = {"color_normalize", "ColorNormalizeAug"}
@@ -65,11 +83,28 @@ def _call_name(fn):
     return None
 
 
+class _LoopFrame:
+    """Per-loop bookkeeping for SRC004: syncs whose *innermost* enclosing
+    loop is this one, and whether this loop directly (same innermost
+    level) dispatches training steps.  A sync only fires when both hold —
+    i.e. it runs at the same frequency as the step dispatch; an
+    epoch-boundary fetch (innermost loop = the epoch loop, steps live in
+    the nested batch loop) stays clean."""
+
+    __slots__ = ("syncs", "has_step")
+
+    def __init__(self):
+        self.syncs = []      # (node, description)
+        self.has_step = False
+
+
 class _Visitor(ast.NodeVisitor):
     def __init__(self, filename):
         self.filename = filename
         self.findings = []
         self.suppressed = {}   # lineno -> set(rule_ids), filled by caller
+        self._loops = []       # _LoopFrame stack (innermost last)
+        self._flush_guard = 0  # depth of `if step % k == 0`-style guards
 
     def _emit(self, rule, node, msg):
         muted = self.suppressed.get(node.lineno, ())
@@ -77,9 +112,56 @@ class _Visitor(ast.NodeVisitor):
             self.findings.append(Finding(
                 rule, "%s:%d" % (self.filename, node.lineno), msg))
 
+    # -- SRC004 scaffolding ------------------------------------------------
+    def _visit_loop(self, node, kind):
+        self._check_branch(node, kind)
+        self._loops.append(_LoopFrame())
+        self.generic_visit(node)
+        self._flush_loop_frame()
+
+    def _flush_loop_frame(self):
+        frame = self._loops.pop()
+        if frame.has_step:
+            for sync_node, what in frame.syncs:
+                self._emit("SRC004", sync_node,
+                           "%s runs once per dispatched training step: it "
+                           "blocks the host on the device and collapses "
+                           "the engine's run-ahead window to 1; accumulate "
+                           "on device / metric.update_lazy and fetch at a "
+                           "flush boundary (epoch end, or an `if step %% k "
+                           "== 0` guard)" % what)
+
+    def _note_sync(self, node, what):
+        if self._loops and not self._flush_guard:
+            self._loops[-1].syncs.append((node, what))
+
+    def visit_FunctionDef(self, node):
+        # a nested def is a new runtime scope: its body does not execute
+        # per iteration of the enclosing loop
+        outer_loops, outer_guard = self._loops, self._flush_guard
+        self._loops, self._flush_guard = [], 0
+        self.generic_visit(node)
+        self._loops, self._flush_guard = outer_loops, outer_guard
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
     def visit_Call(self, node):
         fn = node.func
         name = _call_name(fn)
+        if self._loops and name in _STEP_CALLS:
+            self._loops[-1].has_step = True
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in (_SYNC_METHODS | _SYNC_EXTRA):
+            self._note_sync(node, ".%s()" % fn.attr)
+        elif isinstance(fn, ast.Name) and fn.id in _CAST_BUILTINS and \
+                node.args and _is_arrayish(node.args[0]) and \
+                not _contains_shape(node.args[0]):
+            self._note_sync(node, "%s(...) of an array" % fn.id)
+        elif isinstance(fn, ast.Attribute) and \
+                fn.attr in _HOST_FETCH_FUNCS and node.args and \
+                _is_arrayish(node.args[0]):
+            self._note_sync(node, ".%s(...) of an array" % fn.attr)
         if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
             self._emit("SRC001", node,
                        ".%s() synchronizes with the device and captures a "
@@ -134,11 +216,27 @@ class _Visitor(ast.NodeVisitor):
 
     def visit_If(self, node):
         self._check_branch(node, "if-branch")
+        # `if step % k == 0:` is the periodic-flush idiom (Speedometer,
+        # logging ticks): a sync under it is a flush-boundary fetch, the
+        # SRC004 FIX, not the anti-pattern
+        periodic = any(isinstance(sub, ast.BinOp)
+                       and isinstance(sub.op, ast.Mod)
+                       for sub in ast.walk(node.test))
+        if periodic:
+            self._flush_guard += 1
         self.generic_visit(node)
+        if periodic:
+            self._flush_guard -= 1
 
     def visit_While(self, node):
-        self._check_branch(node, "while-loop")
+        self._visit_loop(node, "while-loop")
+
+    def visit_For(self, node):
+        self._loops.append(_LoopFrame())
         self.generic_visit(node)
+        self._flush_loop_frame()
+
+    visit_AsyncFor = visit_For
 
 
 def _line_suppressions(source):

@@ -1,43 +1,103 @@
-"""Engine control surface (reference: python/mxnet/engine.py).
+"""Run-ahead dispatch engine (reference: python/mxnet/engine.py over
+``src/engine/threaded_engine.h``).
 
-The reference exposes ``bulk(size)`` — batching engine ops into segments
-(``threaded_engine.h:469`` BulkAppend/BulkFlush) — and internal start/stop.
-On TPU, XLA's async dispatch queue plays the engine's role and jit tracing
-is the bulking mechanism, so these are semantic no-ops kept for script
-parity; ``bulk`` still functions as a hint boundary (it flushes pending
-async work on exit, which is the observable behaviour of a bulk segment
-boundary in the reference).
+The reference's asynchronous dependency engine lets the host push operations
+without waiting for device completion; ``bulk(size)`` batches them into
+segments (``threaded_engine.h:469`` BulkAppend/BulkFlush) so the dispatch
+queue stays full.  On TPU, XLA's async dispatch queue plays the worker-pool
+role — every jitted call returns immediately with future-backed arrays — but
+an *unbounded* run-ahead is as wrong as a synchronous loop: the host can
+enqueue arbitrarily many steps, each pinning its input batch and output
+buffers in HBM until the device catches up.
+
+This module is therefore the bounding surface the reference's engine had
+built in:
+
+- ``set_bulk_size(n)`` — the run-ahead window: a training loop (the
+  ``DataParallelTrainer`` in-flight ring) dispatches up to ``n`` steps
+  without synchronizing, then applies backpressure by waiting on the
+  *oldest* in-flight step.  Dispatch order is untouched, so numerics are
+  bitwise-identical at any window size — only synchronization points move.
+- ``bulk(size)`` — scopes the window like the reference's bulk segments and
+  flushes all in-flight work on exit (the observable behaviour of a segment
+  boundary), returning the previous size from the context manager.
+- ``flush()`` — the explicit segment flush: drains every registered
+  in-flight ring (trainers, prefetchers), then ``jax.effects_barrier()``.
+
+Components with in-flight device work register a flush callback via
+``register_flusher`` (held weakly — a dropped trainer unregisters itself).
 """
 from __future__ import annotations
 
 import contextlib
+import threading
+import weakref
 
 import jax
 
-__all__ = ["bulk", "set_bulk_size"]
+__all__ = ["bulk", "set_bulk_size", "bulk_size", "flush",
+           "register_flusher"]
 
 _bulk_size = 15
+_lock = threading.Lock()
+# weak refs to flush callables of components holding in-flight work
+_flushers = []
 
 
 def set_bulk_size(size):
-    """Reference: MXEngineSetBulkSize; returns the previous size."""
+    """Set the run-ahead window (reference: MXEngineSetBulkSize); returns
+    the previous size.  ``1`` keeps at most one step in flight (the
+    synchronous loop); larger values let the host run ahead of the device
+    by up to ``size`` dispatched-but-unfinished steps."""
     global _bulk_size
+    size = int(size)
+    if size < 1:
+        raise ValueError("bulk size must be >= 1, got %d" % size)
     prev = _bulk_size
-    _bulk_size = int(size)
+    _bulk_size = size
     return prev
+
+
+def bulk_size():
+    """The current run-ahead window."""
+    return _bulk_size
+
+
+def register_flusher(fn):
+    """Register a flush callback (held weakly) run by ``flush()``/``bulk``
+    exit.  ``fn`` is typically a bound method draining an in-flight ring
+    (e.g. ``DataParallelTrainer.flush``)."""
+    ref = weakref.WeakMethod(fn) if hasattr(fn, "__self__") \
+        else weakref.ref(fn)
+    with _lock:
+        _flushers.append(ref)
+
+
+def flush():
+    """Wait for ALL in-flight engine work: drain every registered ring,
+    then barrier any remaining async effects.  This is the explicit bulk
+    segment flush (reference: ThreadedEngine::WaitForAll)."""
+    with _lock:
+        live = [r() for r in _flushers]
+        # compact dropped components in passing
+        _flushers[:] = [r for r, f in zip(list(_flushers), live)
+                        if f is not None]
+        live = [f for f in live if f is not None]
+    for fn in live:
+        fn()
+    jax.effects_barrier()
 
 
 @contextlib.contextmanager
 def bulk(size):
-    """Bulk execution scope (reference: engine.py bulk).  XLA already
-    pipelines dispatches; exiting the scope synchronizes like a segment
-    flush."""
+    """Bulk execution scope (reference: engine.py bulk): widen (or narrow)
+    the run-ahead window inside the block; exiting restores the previous
+    size — which the context manager also yields — and runs an explicit
+    ``flush()``, so crossing the boundary synchronizes like a bulk segment
+    flush even when the body raised."""
     prev = set_bulk_size(size)
     try:
-        yield
+        yield prev
     finally:
         set_bulk_size(prev)
-        try:
-            jax.effects_barrier()
-        except AttributeError:
-            pass
+        flush()

@@ -22,7 +22,8 @@ from ..base import MXNetError
 from ..ndarray import NDArray, array as _nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "DeviceFeedIter", "CSVIter", "MNISTIter",
+           "PrefetchingIter", "DeviceFeedIter", "PrefetchToDeviceIter",
+           "CSVIter", "MNISTIter",
            "ImageRecordIter", "ImagePipelineIter", "make_device_tail",
            "LibSVMIter", "ImageDetRecordIter"]
 
@@ -439,27 +440,41 @@ class DeviceFeedIter(DataIter):
     the per-executor copy in ``executor_group.py _load_data``).
 
     A worker thread pulls host batches from ``base``, moves them to device
-    (optionally through a jitted ``transform``) and **synchronizes the
-    transfer before handing the batch over**.  Two effects: the device
-    always holds the next batch when the trainer asks for it, and — on
-    remote-tunnel transports where a long h2d RPC and compute dispatch
-    RPCs contend pathologically when interleaved — the tunnel runs one
-    big transfer at a time while the previous step's compute proceeds on
-    device.  ``depth`` bounds device-resident prefetched batches (HBM).
+    (optionally through a jitted ``transform``, optionally onto an explicit
+    ``sharding``) and **synchronizes the transfer before handing the batch
+    over**.  Two effects: the device always holds the next batch when the
+    trainer asks for it, and — on remote-tunnel transports where a long
+    h2d RPC and compute dispatch RPCs contend pathologically when
+    interleaved — the tunnel runs one big transfer at a time while the
+    previous step's compute proceeds on device.
+
+    ``depth`` is a hard slot ring: at most ``depth`` prefetched batches
+    are device-resident at once (queued *or* mid-transfer — a slot
+    semaphore gates the worker before it touches the next batch), so
+    prefetch HBM is capped at ``depth × batch_bytes``.  Feed/stall
+    accounting lands in ``self.stats`` (``profiler.PipelineStats``).
     """
 
-    def __init__(self, base, transform=None, depth=2, data_desc=None):
+    def __init__(self, base, transform=None, depth=2, data_desc=None,
+                 sharding=None):
         super().__init__(base.batch_size)
         import jax as _jax
+
+        from ..profiler import PipelineStats
         self._jax = _jax
         self.base = base
         self.transform = transform
+        self.sharding = sharding
         # post-transform data descriptors: a device-side tail changes the
         # batch's dtype/layout, so consumers binding from provide_data must
         # see the transformed geometry, not the host one
         self._data_desc = data_desc
-        self._depth = depth
-        self._queue = _queue.Queue(maxsize=depth)
+        self._depth = max(1, int(depth))
+        self.stats = PipelineStats(num_workers=1, name="io.device_feed")
+        # observability for the HBM bound: the most slots ever live at once
+        self._live = 0
+        self._live_max = 0
+        self._live_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
         self._exhausted = False
@@ -467,7 +482,24 @@ class DeviceFeedIter(DataIter):
         # worker stuck in a long transfer past reset()'s join timeout must
         # not interleave base.next() with its replacement
         self._base_lock = threading.Lock()
+        self._make_ring()
         self._start()
+
+    def _make_ring(self):
+        # +1: the end-of-epoch sentinel must never block behind a full
+        # ring of real batches (slots gate those, not the sentinel)
+        self._queue = _queue.Queue(maxsize=self._depth + 1)
+        self._slots = threading.Semaphore(self._depth)
+
+    @property
+    def depth(self):
+        return self._depth
+
+    @property
+    def live_slots_max(self):
+        """Most prefetched batches simultaneously device-resident so far
+        (must never exceed ``depth`` — the HBM bound the tests assert)."""
+        return self._live_max
 
     @property
     def provide_data(self):
@@ -481,15 +513,20 @@ class DeviceFeedIter(DataIter):
 
     def _to_device(self, batch):
         from ..ndarray import NDArray
-        outs = []
-        for arr in batch.data:
+
+        def put(arr, transform):
             raw = arr._data if isinstance(arr, NDArray) else \
                 self._jax.numpy.asarray(arr)
-            if self.transform is not None:
-                raw = self.transform(raw)
-            outs.append(raw)
-        labels = [(a._data if isinstance(a, NDArray)
-                   else self._jax.numpy.asarray(a)) for a in (batch.label or [])]
+            if transform is not None:
+                raw = transform(raw)
+            if self.sharding is not None:
+                raw = self._jax.device_put(raw, self.sharding)
+            return raw
+
+        # the transform (a fused device tail) applies to the DATA only;
+        # labels ride along untouched
+        outs = [put(a, self.transform) for a in batch.data]
+        labels = [put(a, None) for a in (batch.label or [])]
         # fence the transfer inside the worker: the consumer must never
         # block on (or contend with) a half-shipped batch
         self._jax.block_until_ready(outs + labels)
@@ -498,21 +535,34 @@ class DeviceFeedIter(DataIter):
                          pad=batch.pad, index=batch.index)
 
     def _start(self):
-        # the worker captures ITS OWN stop event, queue and error box:
-        # after a timed-out reset() swaps in fresh ones, a zombie worker
-        # can neither pollute the new queue, nor miss its (already set)
-        # stop signal, nor write a stale exception into the new epoch
+        # the worker captures ITS OWN stop event, queue, slot ring and
+        # error box: after a timed-out reset() swaps in fresh ones, a
+        # zombie worker can neither pollute the new queue, nor miss its
+        # (already set) stop signal, nor write a stale exception into the
+        # new epoch
+        import time as _time
         self._error_box = err = [None]
-        stop, q = self._stop, self._queue
+        stop, q, slots = self._stop, self._queue, self._slots
 
         def run():
             while not stop.is_set():
+                # the slot gates BEFORE the batch is pulled/transferred:
+                # acquire fails until the consumer frees a slot, so at
+                # most `depth` batches are ever device-resident
+                if not slots.acquire(timeout=0.2):
+                    continue
                 try:
                     with self._base_lock:
                         if stop.is_set():
                             return
                         host_batch = self.base.next()
+                    with self._live_lock:
+                        self._live += 1
+                        self._live_max = max(self._live_max, self._live)
+                    t0 = _time.perf_counter()
                     b = self._to_device(host_batch)
+                    self.stats.on_batch(0, _time.perf_counter() - t0,
+                                        q.qsize() + 1)
                 except StopIteration:
                     q.put(None)
                     return
@@ -546,20 +596,30 @@ class DeviceFeedIter(DataIter):
         with self._base_lock:
             self.base.reset()
         self._stop = threading.Event()
-        self._queue = _queue.Queue(maxsize=self._depth)
+        self._make_ring()
+        with self._live_lock:
+            self._live = 0
         self._exhausted = False
         self._start()
 
     def next(self):
+        import time as _time
         if self._exhausted:
             raise StopIteration
+        t0 = _time.perf_counter()
         b = self._queue.get()
+        self.stats.on_wait(_time.perf_counter() - t0)
         if b is None:
             self._exhausted = True
             if self._error_box[0] is not None:
                 err, self._error_box[0] = self._error_box[0], None
                 raise err
             raise StopIteration
+        # batch handed over: its ring slot frees and the worker may pull
+        # (and start transferring) the next host batch
+        with self._live_lock:
+            self._live -= 1
+        self._slots.release()
         return b
 
     def iter_next(self):
@@ -772,7 +832,8 @@ class MNISTIter(DataIter):
         return self._inner.next()
 
 
-# imported at the tail: both modules consume the DataIter/DataBatch/DataDesc
+# imported at the tail: these modules consume the DataIter/DataBatch/DataDesc
 # definitions above (mxnet_tpu.io is already in sys.modules by then)
 from .device_tail import make_device_tail  # noqa: E402
 from .pipeline import ImagePipelineIter, pipeline_available  # noqa: E402,F401
+from .prefetch import PrefetchToDeviceIter  # noqa: E402

@@ -66,9 +66,14 @@ def _timed_epoch(make_iter, consume):
         consume(b)
         n_img += b.data[0].shape[0]
     dt = time.perf_counter() - t0
-    stats = it.stats.snapshot() if hasattr(it, "stats") else \
-        (it.base.stats.snapshot() if hasattr(getattr(it, "base", None),
-                                             "stats") else None)
+    # prefer the decode pipeline's stats (worker pool utilization) over
+    # the DeviceFeedIter wrapper's own feed-thread stats
+    if hasattr(getattr(it, "base", None), "stats"):
+        stats = it.base.stats.snapshot()
+    elif hasattr(it, "stats"):
+        stats = it.stats.snapshot()
+    else:
+        stats = None
     close = getattr(it, "close", None) or getattr(
         getattr(it, "base", None), "close", None)
     if close:

@@ -23,6 +23,12 @@ def check_label_shapes(labels, preds, shape=False):
 
 
 class EvalMetric:
+    # lazy window bound: update_lazy keeps at most this many pending
+    # batches device-resident before draining the oldest (their values
+    # are long since computed by then, so the drain is ~free); bounds the
+    # device memory the deferred labels/preds pin across a bulk window
+    LAZY_MAX_PENDING = 64
+
     def __init__(self, name, output_names=None, label_names=None, **kwargs):
         self.name = str(name)
         self.output_names = output_names
@@ -47,11 +53,31 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError
 
+    def update_lazy(self, labels, preds):
+        """Non-blocking ``update``: park the (device-resident, lazy)
+        labels/preds without fetching them — ``update`` calls ``asnumpy``
+        per batch, a host sync that stalls the engine's run-ahead window
+        every step.  The parked batches are drained (in order, so values
+        are identical to eager updates) the next time anyone reads the
+        metric — ``get``/``get_name_value``, i.e. a ``Speedometer`` tick
+        or the epoch log: the flush boundaries."""
+        self._lazy.append((labels, preds))
+        while len(self._lazy) > self.LAZY_MAX_PENDING:
+            labels, preds = self._lazy.pop(0)
+            self.update(labels, preds)
+
+    def _drain_lazy(self):
+        pending, self._lazy = self._lazy, []
+        for labels, preds in pending:
+            self.update(labels, preds)
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._lazy = []
 
     def get(self):
+        self._drain_lazy()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -105,10 +131,12 @@ class CompositeEvalMetric(EvalMetric):
             metric.update(labels, preds)
 
     def reset(self):
+        self._lazy = []
         for metric in getattr(self, "metrics", []):
             metric.reset()
 
     def get(self):
+        self._drain_lazy()
         names, values = [], []
         for metric in self.metrics:
             name, value = metric.get()
@@ -275,6 +303,7 @@ class RMSE(MSE):
         EvalMetric.__init__(self, name, **kwargs)
 
     def get(self):
+        self._drain_lazy()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.sqrt(self.sum_metric / self.num_inst))

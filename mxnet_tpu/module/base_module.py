@@ -41,7 +41,7 @@ class BaseModule:
     def get_outputs(self, merge_multi_context=True):
         raise NotImplementedError
 
-    def update_metric(self, eval_metric, labels):
+    def update_metric(self, eval_metric, labels, lazy=False):
         raise NotImplementedError
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -214,7 +214,10 @@ class BaseModule:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
-                self.update_metric(eval_metric, data_batch.label)
+                # lazy: the metric parks the device-resident outputs and
+                # drains at its next read — a Speedometer tick or the
+                # epoch log below — instead of an asnumpy sync per step
+                self.update_metric(eval_metric, data_batch.label, lazy=True)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:

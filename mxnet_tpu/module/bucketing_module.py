@@ -158,8 +158,8 @@ class BucketingModule(BaseModule):
     def get_input_grads(self, merge_multi_context=True):
         return self._curr_module.get_input_grads(merge_multi_context)
 
-    def update_metric(self, eval_metric, labels):
-        self._curr_module.update_metric(eval_metric, labels)
+    def update_metric(self, eval_metric, labels, lazy=False):
+        self._curr_module.update_metric(eval_metric, labels, lazy=lazy)
 
     def install_monitor(self, mon):
         for mod in self._buckets.values():

@@ -294,8 +294,14 @@ class Module(BaseModule):
             raise MXNetError("bind with inputs_need_grad=True")
         return [self._exec.grad_dict.get(n) for n in self._data_names]
 
-    def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels, self.get_outputs())
+    def update_metric(self, eval_metric, labels, lazy=False):
+        # lazy: park the device-resident outputs instead of asnumpy-ing
+        # them per batch (fit's hot loop) — the metric drains at its next
+        # read (Speedometer tick / epoch log), the flush boundary
+        if lazy and hasattr(eval_metric, "update_lazy"):
+            eval_metric.update_lazy(labels, self.get_outputs())
+        else:
+            eval_metric.update(labels, self.get_outputs())
 
     # ------------------------------------------------------------------
     def save_optimizer_states(self, fname):

@@ -114,10 +114,10 @@ class SequentialModule(BaseModule):
     def get_outputs(self, merge_multi_context=True):
         return self._modules[-1].get_outputs(merge_multi_context)
 
-    def update_metric(self, eval_metric, labels):
+    def update_metric(self, eval_metric, labels, lazy=False):
         for module, meta in zip(self._modules, self._metas):
             if meta.get(self.META_TAKE_LABELS, False):
-                module.update_metric(eval_metric, labels)
+                module.update_metric(eval_metric, labels, lazy=lazy)
 
     def install_monitor(self, mon):
         for module in self._modules:

@@ -12,6 +12,9 @@ ICI, parameters donated so updates happen in place in HBM.
 """
 from __future__ import annotations
 
+import collections
+import time
+
 import numpy as np
 
 import jax
@@ -19,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import autograd
+from .. import engine as engine_mod
 from ..ndarray import NDArray
 from . import mesh as mesh_mod
 from .functional import (functionalize_forward, functional_optimizer_update,
@@ -142,6 +146,15 @@ class DataParallelTrainer:
         self._grad_fn = None
         self._update_fn = None
         self._step_count = 0
+        # run-ahead dispatch (engine.py): every dispatched step's loss
+        # handle rides this ring; waiting on it waits on the WHOLE step
+        # (one program).  ``engine.bulk_size()`` bounds the ring — the
+        # backpressure that keeps host run-ahead (and the HBM its queued
+        # batches pin) finite.  ``engine.flush()``/``bulk()`` exit drain it.
+        self._inflight = collections.deque()
+        from .. import profiler as _prof
+        self.dispatch_stats = _prof.PipelineStats(name="engine.dispatch")
+        engine_mod.register_flusher(self.flush)
 
     # -- setup -------------------------------------------------------------
     def _setup(self, data, label):
@@ -523,17 +536,82 @@ class DataParallelTrainer:
     def mesh(self):
         return self._mesh
 
+    @property
+    def batch_sharding(self):
+        """The NamedSharding step inputs are placed with (batch sharded
+        over the data axis).  A feeder that pre-places batches with this
+        sharding (``mx.io.PrefetchToDeviceIter``) hits ``step``'s
+        fast path: the transfer is reused, not redone."""
+        return NamedSharding(self._mesh, PartitionSpec(self._data_axis))
+
+    def _put_batch(self, arr, sharding):
+        """``device_put`` with a fast path: a committed ``jax.Array``
+        already laid out per ``sharding`` (the prefetcher's work) is used
+        as-is instead of being re-put — ``device_put`` is cheap for a
+        matching layout but not free (it still walks shards and can copy
+        on layout mismatch), and skipping it keeps the prefetch transfer
+        the only one."""
+        raw = arr._data if isinstance(arr, NDArray) else arr
+        if isinstance(raw, jax.Array) and getattr(raw, "committed", False):
+            try:
+                if raw.sharding.is_equivalent_to(sharding, raw.ndim):
+                    return raw
+            except (AttributeError, TypeError):
+                if raw.sharding == sharding:
+                    return raw
+        if not isinstance(raw, jax.Array):
+            raw = np.asarray(raw)
+        return jax.device_put(raw, sharding)
+
+    def _track_inflight(self, loss_val):
+        """Run-ahead bookkeeping: ring the dispatched step's output and
+        apply backpressure — wait on the OLDEST in-flight step when the
+        ring exceeds ``engine.bulk_size()``.  Dispatch order never
+        changes, so any window size is bitwise-identical; only where the
+        host blocks moves."""
+        self._inflight.append(loss_val)
+        limit = engine_mod.bulk_size()
+        while len(self._inflight) > limit:
+            oldest = self._inflight.popleft()
+            t0 = time.perf_counter()
+            try:
+                oldest.block_until_ready()
+            except AttributeError:
+                pass
+            self.dispatch_stats.on_backpressure(time.perf_counter() - t0)
+        self.dispatch_stats.on_dispatch(len(self._inflight))
+
+    def flush(self):
+        """Drain the in-flight ring: block until every dispatched step has
+        executed.  Called by ``engine.flush()``/``bulk()`` exit and at
+        ``fit`` epoch boundaries; after it returns, params/optimizer
+        states are fully materialized (donation already retired)."""
+        t0 = time.perf_counter()
+        while self._inflight:
+            oldest = self._inflight.popleft()
+            try:
+                oldest.block_until_ready()
+            except AttributeError:
+                pass
+        waited = time.perf_counter() - t0
+        if waited > 0:
+            self.dispatch_stats.on_backpressure(waited)
+
     def step(self, data, label):
-        """Run one training step; returns the (scalar) loss NDArray."""
+        """Run one training step; returns the (scalar) loss NDArray.
+
+        Non-blocking by construction: the jitted step is dispatched into
+        XLA's async queue and the loss comes back as a lazy device value —
+        the host only blocks when the engine's run-ahead window
+        (``mx.engine.set_bulk_size``) is full, and then on the *oldest*
+        in-flight step (backpressure), not the newest."""
         from .. import _rng
         if not self._ready:
             self._setup(data, label)
 
-        x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        y = label._data if isinstance(label, NDArray) else jnp.asarray(label)
-        batch_sh = NamedSharding(self._mesh, PartitionSpec(self._data_axis))
-        x = jax.device_put(x, batch_sh)
-        y = jax.device_put(y, batch_sh)
+        batch_sh = self.batch_sharding
+        x = self._put_batch(data, batch_sh)
+        y = self._put_batch(label, batch_sh)
 
         self._step_count += 1
         self._opt.num_update = self._step_count
@@ -561,7 +639,64 @@ class DataParallelTrainer:
         self._states_raw = list(new_states)
         for name, val in zip(self._fwd.mut_names or (), muts):
             self._params_by_name[name]._data._set_data(val)
+        self._track_inflight(loss_val)
         return NDArray(loss_val)
+
+    def fit(self, train_data, num_epoch=1, eval_metric="loss",
+            batch_end_callback=None, epoch_end_callback=None,
+            prefetch_depth=2, bulk_size=None, logger=None):
+        """Overlapped training loop over a ``DataIter``: device prefetch +
+        run-ahead dispatch + lazy metrics — the three stages of the step
+        pipelined (reference: the engine keeps ``model.py:157``'s loop
+        async; here ``PrefetchToDeviceIter`` ships batch *k+1* while step
+        *k* executes and the metric accumulates device-resident).
+
+        ``train_data`` yielding host batches is wrapped in a
+        ``PrefetchToDeviceIter`` targeting ``batch_sharding`` so ``step``'s
+        fast path reuses the prefetched transfer; an iterator that is
+        already a ``DeviceFeedIter`` is consumed as-is.  ``bulk_size``
+        scopes ``engine.bulk`` around each epoch (None keeps the global
+        window).  The loss is accumulated via ``EvalMetric.update_lazy`` —
+        no per-step host fetch; callbacks that read the metric
+        (``Speedometer``) fetch at their own flush boundaries.  Returns
+        the metric."""
+        import logging
+
+        from .. import metric as _metric
+        from ..io import DeviceFeedIter, PrefetchToDeviceIter
+        from ..module.base_module import BatchEndParam, _as_list
+
+        log = logger or logging
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        it = train_data
+        if not isinstance(it, DeviceFeedIter):
+            it = PrefetchToDeviceIter(train_data, sharding=self.batch_sharding,
+                                      depth=prefetch_depth)
+        for epoch in range(num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            if epoch > 0:
+                it.reset()
+            with engine_mod.bulk(bulk_size or engine_mod.bulk_size()):
+                for nbatch, batch in enumerate(it):
+                    loss = self.step(batch.data[0], batch.label[0])
+                    eval_metric.update_lazy(batch.label, [loss])
+                    if batch_end_callback is not None:
+                        params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                               eval_metric=eval_metric,
+                                               locals=None)
+                        for cb in _as_list(batch_end_callback):
+                            cb(params)
+            # bulk exit flushed the ring: everything below sees finished
+            # steps, so the epoch log's fetch is the window's ONE sync
+            for name, val in eval_metric.get_name_value():
+                log.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            log.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            if epoch_end_callback is not None:
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, None, None, None)
+        return eval_metric
 
     def _dist_step(self, train_vals, aux_vals, x, y, rng, lr_host):
         """Split step for multi-process data parallelism: local grads ->

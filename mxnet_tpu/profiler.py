@@ -265,6 +265,11 @@ class PipelineStats:
     ``ImagePipelineIter.stats`` consumers and the bench's stall accounting.
     When the profiler is running, queue depth is also emitted as a Counter
     series so the chrome trace shows the feed pipeline next to the ops.
+
+    The same accumulator carries the run-ahead dispatch counters
+    (``on_dispatch``/``on_backpressure`` — engine.py's in-flight ring
+    depth and backpressure stall time), so a trainer's ``dispatch_stats``
+    and an iterator's feed stats render through one snapshot shape.
     """
 
     def __init__(self, num_workers=0, name="io.pipeline"):
@@ -276,7 +281,15 @@ class PipelineStats:
         self._depth_max = 0
         self._respawns = 0
         self._num_workers = num_workers
-        self._counter = Domain(name).new_counter("queue_depth")
+        domain = Domain(name)
+        self._counter = domain.new_counter("queue_depth")
+        # run-ahead dispatch accounting (engine.py / DataParallelTrainer):
+        # how deep the in-flight ring got, and how long the dispatcher was
+        # blocked waiting on its oldest step (backpressure)
+        self._dispatched = 0
+        self._inflight_max = 0
+        self._dispatch_stall_s = 0.0
+        self._inflight_counter = domain.new_counter("inflight_steps")
 
     def on_batch(self, worker, busy_s, queue_depth):
         with self._lock:
@@ -292,6 +305,20 @@ class PipelineStats:
     def on_respawn(self):
         with self._lock:
             self._respawns += 1
+
+    def on_dispatch(self, inflight):
+        """A step was dispatched with ``inflight`` steps now un-synchronized
+        (the engine's run-ahead ring depth at dispatch time)."""
+        with self._lock:
+            self._dispatched += 1
+            self._inflight_max = max(self._inflight_max, inflight)
+        self._inflight_counter.set_value(inflight)
+
+    def on_backpressure(self, stall_s):
+        """The dispatcher blocked ``stall_s`` waiting on its oldest
+        in-flight step (ring full: the device is the bottleneck)."""
+        with self._lock:
+            self._dispatch_stall_s += stall_s
 
     def snapshot(self):
         """Aggregate view: ``worker_utilization`` is decode time over
@@ -313,4 +340,7 @@ class PipelineStats:
                 "stall_pct": round(100.0 * self._stall_s / wall, 2),
                 "queue_depth_max": self._depth_max,
                 "respawns": self._respawns,
+                "dispatched_steps": self._dispatched,
+                "inflight_max": self._inflight_max,
+                "dispatch_stall_s": round(self._dispatch_stall_s, 3),
             }

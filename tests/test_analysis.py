@@ -350,6 +350,57 @@ def test_src003_clean_cases():
                        "# mxlint: disable=SRC003\n") == []
 
 
+def test_src004_per_step_sync_in_training_loop():
+    """A blocking host fetch at step frequency (same innermost loop as the
+    dispatch) collapses the engine's run-ahead window — flagged."""
+    src = ("for batch in it:\n"
+           "    loss = trainer.step(batch.data, batch.label)\n"
+           "    tot += float(loss.asscalar())\n")
+    got = rules(lint_source(src))
+    assert "SRC004" in got
+    # np.asarray of a produced value in the step loop is the same trap
+    src2 = ("for b in it:\n"
+            "    mod.forward_backward(b)\n"
+            "    mod.update()\n"
+            "    hist.append(np.asarray(mod.get_outputs()[0]))\n")
+    assert "SRC004" in rules(lint_source(src2))
+
+
+def test_src004_clean_cases():
+    # epoch-boundary fetch: the sync's innermost loop (epoch) does not
+    # itself dispatch steps — the batch loop does
+    epoch = ("for epoch in range(10):\n"
+             "    tot = None\n"
+             "    for b in it:\n"
+             "        loss = trainer.step(b.data, b.label)\n"
+             "        tot = loss if tot is None else tot + loss\n"
+             "    print(float(tot.asscalar()))\n")
+    assert "SRC004" not in rules(lint_source(epoch))
+    # periodic flush guard (`if step % k == 0`) is the documented fix
+    guarded = ("for step, b in enumerate(it):\n"
+               "    loss = trainer.step(b.data, b.label)\n"
+               "    if step % 50 == 0:\n"
+               "        print(float(loss.asscalar()))\n")
+    assert "SRC004" not in rules(lint_source(guarded))
+    # a sync in a non-training loop (no step dispatch) is not SRC004
+    evalloop = ("for b in it:\n"
+                "    preds.append(net(b).asnumpy())\n")
+    assert "SRC004" not in rules(lint_source(evalloop))
+    # inline suppression
+    sup = ("for b in it:\n"
+           "    trainer.step(b.data, b.label)\n"
+           "    v = loss.asscalar()  # mxlint: disable=SRC001,SRC004\n")
+    assert rules(lint_source(sup)) == set()
+
+
+def test_src004_shipped_loops_clean():
+    """The --self-check sweep: every examples/ script and the in-repo fit
+    loops are SRC004-clean (the loops this repo tells users to copy must
+    not per-step sync)."""
+    from mxnet_tpu.analysis import lint_shipped_loops
+    assert lint_shipped_loops() == []
+
+
 def test_doc001_rule_table_in_sync():
     """Every registered rule has a docs/analysis.md row (and the check is
     part of --self-check, so a new rule cannot land undocumented)."""

@@ -1,0 +1,393 @@
+"""Run-ahead dispatch engine: bulk windows, device prefetch, lazy metrics.
+
+The engine reorders NO math — only synchronization points — so training
+under any window/prefetch configuration must be bitwise-identical to the
+synchronous loop (the exactness contract of ISSUE 5, mirroring the
+reference engine's sequential-consistency guarantee per dependency
+chain).  The HBM side: the prefetch slot ring must never hold more than
+``depth`` batches, and backpressure must bound the trainer's in-flight
+ring at ``engine.bulk_size()``.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, gluon, metric as metric_mod
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.io import (DataBatch, NDArrayIter, DeviceFeedIter,
+                          PrefetchToDeviceIter)
+from mxnet_tpu.parallel import DataParallelTrainer
+
+
+BATCH, FEAT, NCLS = 16, 8, 4
+
+
+def _data(n=160, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, FEAT).astype(np.float32)
+    y = (np.arange(n) % NCLS).astype(np.float32)
+    return X, y
+
+
+def _trainer(lr=0.1, momentum=0.9):
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(NCLS))
+    net.initialize(mx.init.Xavier())
+    tr = DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             "sgd", {"learning_rate": lr,
+                                     "momentum": momentum})
+    return net, tr
+
+
+def _run_steps(mode, nsteps=10):
+    """10 fixed steps under a dispatch mode; returns (losses, params)."""
+    X, y = _data()
+    net, tr = _trainer()
+    xb, yb = mx.nd.array(X[:BATCH]), mx.nd.array(y[:BATCH])
+    losses = []
+    if mode == "bulk":
+        with engine.bulk(4) as prev:
+            assert isinstance(prev, int) and prev >= 1
+            for _ in range(nsteps):
+                losses.append(tr.step(xb, yb))
+    else:
+        prev = engine.set_bulk_size(mode)
+        try:
+            for _ in range(nsteps):
+                losses.append(tr.step(xb, yb))
+        finally:
+            engine.set_bulk_size(prev)
+            engine.flush()
+    params = [v.data().asnumpy()
+              for v in net.collect_params().values()]
+    return [float(l.asscalar()) for l in losses], params
+
+
+# ---------------------------------------------------------------------------
+# engine surface
+# ---------------------------------------------------------------------------
+def test_set_bulk_size_returns_prev_and_validates():
+    prev = engine.set_bulk_size(3)
+    try:
+        assert engine.bulk_size() == 3
+        assert engine.set_bulk_size(5) == 3
+        with pytest.raises(ValueError):
+            engine.set_bulk_size(0)
+    finally:
+        engine.set_bulk_size(prev)
+
+
+def test_bulk_yields_prev_and_restores_on_exception():
+    base = engine.bulk_size()
+    with engine.bulk(7) as prev:
+        assert prev == base
+        assert engine.bulk_size() == 7
+    assert engine.bulk_size() == base
+    # the exit path must restore + flush even when the body raises
+    with pytest.raises(RuntimeError):
+        with engine.bulk(3):
+            assert engine.bulk_size() == 3
+            raise RuntimeError("boom")
+    assert engine.bulk_size() == base
+
+
+def test_flush_drains_registered_ring():
+    drained = []
+
+    class Ring:
+        def flush(self):
+            drained.append(True)
+
+    r = Ring()
+    engine.register_flusher(r.flush)
+    engine.flush()
+    assert drained
+    # weakly held: a dropped component unregisters itself
+    del r
+    n = len(drained)
+    engine.flush()
+    assert len(drained) == n
+
+
+# ---------------------------------------------------------------------------
+# exactness: run-ahead must not change a single bit
+# ---------------------------------------------------------------------------
+def test_runahead_bitwise_identical_depth_1_vs_4_vs_bulk():
+    l1, p1 = _run_steps(1)
+    l4, p4 = _run_steps(4)
+    lb, pb = _run_steps("bulk")
+    assert l1 == l4 == lb
+    for a, b in zip(p1, p4):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(p1, pb):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fit_prefetch_bitwise_matches_step_loop():
+    """fit (prefetch + bulk + lazy metric) == the plain step loop."""
+    X, y = _data()
+
+    def by_fit():
+        net, tr = _trainer()
+        m = tr.fit(NDArrayIter(X, y, BATCH, last_batch_handle="discard"),
+                   num_epoch=1, bulk_size=4)
+        return (m.get()[1],
+                [v.data().asnumpy() for v in net.collect_params().values()])
+
+    def by_steps():
+        net, tr = _trainer()
+        tot, n = None, 0
+        for s in range(0, len(X), BATCH):
+            loss = tr.step(mx.nd.array(X[s:s + BATCH]),
+                           mx.nd.array(y[s:s + BATCH]))
+            tot = loss if tot is None else tot + loss
+            n += 1
+        tr.flush()
+        return (float(tot.asscalar()) / n,
+                [v.data().asnumpy() for v in net.collect_params().values()])
+
+    v_fit, p_fit = by_fit()
+    v_ref, p_ref = by_steps()
+    assert v_fit == pytest.approx(v_ref, rel=1e-6)
+    for a, b in zip(p_fit, p_ref):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# HBM bound: the prefetch slot ring
+# ---------------------------------------------------------------------------
+def test_prefetch_ring_bounds_live_batches():
+    X, y = _data(n=12 * BATCH)
+
+    produced = []
+
+    class Counting(NDArrayIter):
+        def next(self):
+            b = super().next()
+            produced.append(1)
+            return b
+
+    base = Counting(X, y, BATCH, last_batch_handle="discard")
+    depth = 2
+    pf = PrefetchToDeviceIter(base, depth=depth)
+    consumed = 0
+    overdraft = 0
+    for b in pf:
+        # give the worker every chance to run ahead; the ring must stop it
+        time.sleep(0.01)
+        consumed += 1
+        # the worker may hold one batch it pulled from base but whose slot
+        # it acquired before transferring — produced-vs-consumed can lead
+        # by at most the ring depth + that one in-hand batch
+        overdraft = max(overdraft, len(produced) - consumed)
+    assert consumed == 12
+    assert pf.live_slots_max <= depth, pf.live_slots_max
+    assert overdraft <= depth + 1, overdraft
+
+
+def test_prefetch_hbm_bound_reported():
+    X, y = _data()
+    pf = PrefetchToDeviceIter(NDArrayIter(X, y, BATCH), depth=3)
+    per_batch = BATCH * FEAT * 4 + BATCH * 4  # f32 data + f32 labels
+    assert pf.batch_bytes() == per_batch
+    assert pf.hbm_bound_bytes() == 3 * per_batch
+    list(pf)  # drain so the worker thread exits cleanly
+
+
+def test_prefetch_sharded_batches_hit_step_fast_path(monkeypatch):
+    """Batches prefetched onto the trainer's batch_sharding are used
+    as-is by step() — no second device_put of the batch."""
+    X, y = _data()
+    net, tr = _trainer()
+    # prime setup with a host batch (this one IS put by the trainer)
+    tr.step(mx.nd.array(X[:BATCH]), mx.nd.array(y[:BATCH]))
+
+    xs = jax.device_put(X[:BATCH], tr.batch_sharding)
+    ys = jax.device_put(y[:BATCH], tr.batch_sharding)
+    assert tr._put_batch(xs, tr.batch_sharding) is xs
+
+    calls = []
+    real_put = jax.device_put
+
+    def spy(x, *a, **k):
+        calls.append(x)
+        return real_put(x, *a, **k)
+
+    monkeypatch.setattr(jax, "device_put", spy)
+    tr.step(mx.nd.NDArray(xs), mx.nd.NDArray(ys))
+    assert not any(x is xs or x is ys for x in calls), \
+        "committed sharded batch was re-put"
+    tr.flush()
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+# ---------------------------------------------------------------------------
+def test_backpressure_bounds_inflight_ring():
+    X, y = _data()
+    net, tr = _trainer()
+    xb, yb = mx.nd.array(X[:BATCH]), mx.nd.array(y[:BATCH])
+    prev = engine.set_bulk_size(2)
+    try:
+        for _ in range(12):
+            tr.step(xb, yb)
+            assert len(tr._inflight) <= 2
+    finally:
+        engine.set_bulk_size(prev)
+        engine.flush()
+    assert not tr._inflight  # flush drained the ring
+    snap = tr.dispatch_stats.snapshot()
+    assert snap["dispatched_steps"] == 12
+    assert 1 <= snap["inflight_max"] <= 2
+    assert snap["dispatch_stall_s"] >= 0.0
+
+
+def test_backpressure_under_slow_step_keeps_window_full():
+    """With a step much slower than dispatch, the ring sits AT the window
+    (the device queue stays full) and never beyond it."""
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(512, activation="relu"), nn.Dense(512))
+    net.initialize(mx.init.Xavier())
+    tr = DataParallelTrainer(net, gluon.loss.L2Loss(), "sgd",
+                             {"learning_rate": 0.01})
+    rng = np.random.RandomState(0)
+    xb = mx.nd.array(rng.rand(64, 256).astype(np.float32))
+    yb = mx.nd.array(rng.rand(64, 512).astype(np.float32))
+    with engine.bulk(3):
+        for _ in range(8):
+            tr.step(xb, yb)
+            assert len(tr._inflight) <= 3
+    assert tr.dispatch_stats.snapshot()["inflight_max"] == 3
+
+
+# ---------------------------------------------------------------------------
+# lazy metrics
+# ---------------------------------------------------------------------------
+def test_lazy_metric_values_identical():
+    rng = np.random.RandomState(3)
+    labels = [mx.nd.array((rng.rand(8) * NCLS).astype(np.float32) // 1)
+              for _ in range(5)]
+    preds = [mx.nd.array(rng.rand(8, NCLS).astype(np.float32))
+             for _ in range(5)]
+    for name in ("acc", "mse", "loss"):
+        eager = metric_mod.create(name)
+        lazy = metric_mod.create(name)
+        for l, p in zip(labels, preds):
+            pl = p if name != "mse" else mx.nd.array(
+                np.asarray([[float(v)] for v in l.asnumpy()]))
+            eager.update([l], [pl])
+            lazy.update_lazy([l], [pl])
+        assert eager.get() == lazy.get()
+
+
+def test_lazy_metric_drains_at_reads_and_bounds_pending():
+    m = metric_mod.create("loss")
+    x = mx.nd.array(np.ones(4, np.float32))
+    for _ in range(3):
+        m.update_lazy([], [x])
+    assert len(m._lazy) == 3 and m.num_inst == 0  # parked, not fetched
+    name, val = m.get()
+    assert not m._lazy and val == 1.0
+    # the pending window is bounded: old entries auto-drain
+    for _ in range(m.LAZY_MAX_PENDING + 10):
+        m.update_lazy([], [x])
+    assert len(m._lazy) <= m.LAZY_MAX_PENDING
+    m.reset()
+    assert m._lazy == [] and m.get()[1] != m.get()[1]  # nan after reset
+
+
+def test_module_fit_lazy_metric_matches_eager(tmp_path):
+    """Module.fit with the lazy update path reports the same epoch metric
+    as an eager re-evaluation of the same updates."""
+    X, y = _data(n=8 * BATCH)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=NCLS)
+    sym = mx.sym.SoftmaxOutput(fc, name="softmax")
+
+    def fit_once(lazy):
+        mx.random.seed(5)
+        mod = mx.mod.Module(sym)
+        it = NDArrayIter(X, y, BATCH, last_batch_handle="discard")
+        mod.bind(it.provide_data, it.provide_label)
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        m = metric_mod.create("acc")
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(m, batch.label, lazy=lazy)
+        return m.get()
+
+    assert fit_once(True) == fit_once(False)
+
+
+# ---------------------------------------------------------------------------
+# crash consistency
+# ---------------------------------------------------------------------------
+def test_interrupt_inside_bulk_leaves_params_consistent():
+    """KeyboardInterrupt mid-window: bulk's exit flush still runs, every
+    dispatched step completes, and params equal a clean run of the same
+    number of steps — nothing is torn by donation."""
+    X, y = _data()
+    xb_np, yb_np = X[:BATCH], y[:BATCH]
+
+    def clean(nsteps):
+        net, tr = _trainer()
+        for _ in range(nsteps):
+            tr.step(mx.nd.array(xb_np), mx.nd.array(yb_np))
+        tr.flush()
+        return [v.data().asnumpy() for v in net.collect_params().values()]
+
+    net, tr = _trainer()
+    with pytest.raises(KeyboardInterrupt):
+        with engine.bulk(4):
+            for i in range(10):
+                tr.step(mx.nd.array(xb_np), mx.nd.array(yb_np))
+                if i == 5:
+                    raise KeyboardInterrupt
+    assert not tr._inflight  # the exit flush drained the ring
+    got = [v.data().asnumpy() for v in net.collect_params().values()]
+    want = clean(6)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+    # and the trainer keeps working after the interrupt
+    after = tr.step(mx.nd.array(xb_np), mx.nd.array(yb_np))
+    assert np.isfinite(float(after.asscalar()))
+
+
+# ---------------------------------------------------------------------------
+# DeviceFeedIter stats surface (acceptance: stall counters visible)
+# ---------------------------------------------------------------------------
+def test_device_feed_stats_and_dispatch_counters_shape():
+    X, y = _data()
+    it = DeviceFeedIter(NDArrayIter(X, y, BATCH), depth=2)
+    list(it)
+    snap = it.stats.snapshot()
+    for key in ("batches", "stall_s", "queue_depth_max",
+                "dispatched_steps", "inflight_max", "dispatch_stall_s"):
+        assert key in snap
+    assert snap["batches"] == len(X) // BATCH
+
+
+def test_trainer_fit_decreases_loss_with_speedometer():
+    X, y = _data(n=20 * BATCH, seed=2)
+    net, tr = _trainer(lr=0.5)
+    ticks = []
+
+    def cb(param):
+        # Speedometer-style flush boundary: reading the metric drains it
+        if param.nbatch % 5 == 0:
+            ticks.append(param.eval_metric.get()[1])
+
+    m = tr.fit(NDArrayIter(X, y, BATCH, last_batch_handle="discard"),
+               num_epoch=3, bulk_size=4, batch_end_callback=cb)
+    assert ticks and ticks[-1] < ticks[0]
