@@ -171,16 +171,29 @@ def _acquire_devices(rec, max_wait):
     """Backend acquisition that survives both failure modes seen in
     BENCH_r03/r04: a hard UNAVAILABLE raise and an indefinite hang inside
     the PJRT client init.  A subprocess probe (timeboxed, killable) is
-    retried with backoff until the chip answers; only then does the main
-    process initialise its own backend.  Returns a device list or None."""
+    retried with the shared ``resilience.backoff`` policy (exponential
+    with jitter, seeded for a replayable schedule) until the chip
+    answers; only then does the main process initialise its own backend.
+    Every failed attempt's error lands in ``backend_error_history`` so a
+    dead round's record shows HOW the backend failed over time, not just
+    the last message.  Returns a device list or None."""
     import jax
 
+    from mxnet_tpu.resilience import chaos as _chaos
+    from mxnet_tpu.resilience.backoff import BackoffPolicy
+
     t0 = time.monotonic()
-    delay = 5.0
+    policy = BackoffPolicy(base_s=5.0, factor=1.7, max_delay_s=60.0,
+                           max_retries=1000, jitter=0.2, seed=0)
     attempt = 0
+    history = rec.result.setdefault("backend_error_history", [])
+    del history[:]  # carried-forward history describes a previous round
     probe_timeout = float(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT", "150"))
     while True:
         attempt += 1
+        # chaos probe: the harness stalls/faults backend init here — the
+        # BENCH_r03..r05 hang, reproducible on demand
+        _chaos.maybe_inject("backend.init", attempt)
         try:
             out = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC], capture_output=True,
@@ -197,11 +210,14 @@ def _acquire_devices(rec, max_wait):
         rec.result["backend_error"] = err
         rec.result["backend_wait_s"] = round(waited, 1)
         rec.result["backend_attempts"] = attempt
+        history.append({"attempt": attempt, "t_s": round(waited, 1),
+                        "error": err[:120]})
+        del history[:-12]  # keep the record line bounded
         rec.emit()
+        delay = policy.delay(attempt - 1)
         if waited + delay > max_wait or rec.remaining() < 120:
             return None
         time.sleep(delay)
-        delay = min(delay * 1.7, 60.0)
     # chip answered a fresh process; now init in-process (fast path)
     try:
         devices = jax.devices()
@@ -210,6 +226,8 @@ def _acquire_devices(rec, max_wait):
         rec.emit()
         return None
     rec.result.pop("backend_error", None)
+    if not rec.result.get("backend_error_history"):
+        rec.result.pop("backend_error_history", None)
     rec.result["backend_attempts"] = attempt
     rec.result["backend_wait_s"] = round(time.monotonic() - t0, 1)
     rec.result["backend_platform"] = devices[0].platform
@@ -240,7 +258,8 @@ def main():
     if lkg:
         rnd, parsed = lkg
         bookkeeping = {"measured_round", "stage_s", "backend_attempts",
-                       "backend_wait_s", "skipped_stages", "error"}
+                       "backend_wait_s", "backend_error_history",
+                       "skipped_stages", "error"}
         carried = {k: v for k, v in parsed.items()
                    if not k.startswith("stale") and not k.endswith("_error")
                    and k not in bookkeeping}
@@ -326,6 +345,14 @@ def _run_benches(rec):
     # backend acquisition)
     if os.environ.get("MXTPU_BENCH_OVERLAP", "1") == "1":
         rec.stage("overlap", 120, _overlap_bench)
+
+    # -- fault-tolerance micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): recovery_time_s (checkpoint restore ->
+    # first post-crash step) and checkpoint_overhead_pct (< 5% gate at
+    # the default cadence) stay live when the TPU is down — resilience
+    # numbers would be worthless if a dead backend could starve them
+    if os.environ.get("MXTPU_BENCH_RESILIENCE", "1") == "1":
+        rec.stage("resilience", 90, _resilience_bench)
 
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
@@ -539,6 +566,27 @@ def _overlap_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("overlap bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _resilience_bench():
+    """recovery_time_s + checkpoint_overhead_pct through the resilience
+    harness (mxnet_tpu/resilience/bench.py): an MLP trainer is stepped
+    with and without auto-checkpointing at the default cadence, then
+    crash-resumed from the snapshot, asserting bitwise-identical params.
+    JAX_PLATFORMS=cpu subprocess — same isolation contract as the
+    serving/pipeline/cost/overlap stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.resilience.bench"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("resilience bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

@@ -76,6 +76,21 @@ def seed(seed_val):
     np.random.seed(int(seed_val))
 
 
+def get_state():
+    """Snapshot the eager provider's full state (numpy RandomState tuple +
+    fold-in counter) — what a training checkpoint records so a resumed
+    run draws the exact same key sequence (resilience/checkpoint.py)."""
+    p = _providers()[0]
+    return {"numpy_state": p._rs.get_state(), "counter": p._counter}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (the resume half)."""
+    p = _providers()[0]
+    p._rs.set_state(state["numpy_state"])
+    p._counter = int(state["counter"])
+
+
 def push_provider(p):
     _providers().append(p)
 

@@ -37,7 +37,7 @@ __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "INFO",
     "lint_registry", "lint_graph", "lint_source", "lint_file",
     "lint_symbol", "lint_serving", "lint_rule_docs", "self_check",
-    "lint_shipped_loops",
+    "lint_shipped_loops", "lint_worker_loops",
     "load_test_map",
     "generate_coverage_md",
     "render_text", "render_json", "exit_code", "worst_severity",
@@ -57,10 +57,11 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 
 
 def self_check(disable=(), with_coverage=True, with_cost=True,
-               with_examples=True):
+               with_examples=True, with_workers=True):
     """Registry lint over the live registry, the rule-table docs sync
-    check, the cost-pass determinism check, and the SRC004 sweep over the
-    shipped training loops — what CI runs.
+    check, the cost-pass determinism check, the SRC004 sweep over the
+    shipped training loops and the SRC005 sweep over the shipped worker
+    loops — what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -72,6 +73,8 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += cost_self_check(disable=disable)
     if with_examples:
         findings += lint_shipped_loops(disable=disable)
+    if with_workers:
+        findings += lint_worker_loops(disable=disable)
     return findings
 
 
@@ -102,6 +105,45 @@ def lint_shipped_loops(disable=()):
         except (OSError, ValueError):
             continue
         findings += [f for f in found if f.rule_id == "SRC004"]
+    return filter_findings(findings, disable)
+
+
+def lint_worker_loops(disable=()):
+    """SRC005 over every shipped concurrency surface: the pipeline's
+    worker processes, the PS server/client loops, the serving batcher,
+    the resilience heartbeat/watchdog threads, the run-ahead engine, the
+    data loader, the launcher and all examples.  A worker loop this repo
+    ships must never block unboundedly on a peer that can die — the exact
+    wedge class behind the BENCH_r03..r05 backend-init hangs.  Skipped
+    silently outside a repo checkout."""
+    import glob
+    import os
+
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(pkg)          # mxnet_tpu/
+    repo = os.path.dirname(root)
+    targets = sorted(
+        glob.glob(os.path.join(root, "io", "*.py"))
+        + glob.glob(os.path.join(root, "serving", "*.py"))
+        + glob.glob(os.path.join(root, "resilience", "*.py"))
+        + glob.glob(os.path.join(root, "gluon", "data", "*.py")))
+    targets += [os.path.join(root, "engine.py"),
+                os.path.join(root, "kvstore.py"),
+                os.path.join(root, "kvstore_ps.py"),
+                os.path.join(root, "kvstore_server.py"),
+                os.path.join(root, "parallel", "trainer.py")]
+    if os.path.isdir(os.path.join(repo, "tools")):
+        targets += sorted(glob.glob(os.path.join(repo, "tools", "*.py")))
+    if os.path.isdir(os.path.join(repo, "examples")):
+        targets += sorted(glob.glob(os.path.join(repo, "examples", "**",
+                                                 "*.py"), recursive=True))
+    findings = []
+    for path in targets:
+        try:
+            found = lint_file(os.path.normpath(path))
+        except (OSError, ValueError):
+            continue
+        findings += [f for f in found if f.rule_id == "SRC005"]
     return filter_findings(findings, disable)
 
 

@@ -82,6 +82,11 @@ RULES = {
                         "on device, use metric.update_lazy, or fetch at "
                         "flush boundaries (engine.bulk / `if step %% k "
                         "== 0` guards)"),
+    "SRC005": (WARNING, "unbounded blocking call (.get()/.recv()/.wait()/"
+                        ".join() with no timeout) inside a while-loop "
+                        "worker/heartbeat loop: a dead peer wedges the "
+                        "loop forever; pass a timeout and re-check "
+                        "liveness/stop conditions each wake"),
     # meta (mxnet_tpu/analysis/__init__.py self_check)
     "DOC001": (WARNING, "lint rule has no row in the docs/analysis.md "
                         "rule table (keep RULES and the docs in sync)"),

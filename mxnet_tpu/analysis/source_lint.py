@@ -38,6 +38,11 @@ _HOST_FETCH_FUNCS = {"asarray"}
 # calls that mark a loop as a *training* loop: the sync then runs at
 # step frequency, which is exactly the anti-pattern (SRC004)
 _STEP_CALLS = {"step", "forward_backward", "backward", "update"}
+# unbounded blocking receivers (SRC005): zero-arg, no timeout= — inside a
+# while-style worker/heartbeat loop these wedge forever when the peer
+# (queue writer, socket, thread) dies.  Calls with any positional arg are
+# excluded by construction (sock.recv(n), " ".join(xs), q.get(timeout))
+_BLOCKING_CALLS = {"get", "recv", "wait", "join"}
 # host-side normalization entry points (SRC003): the device tail does the
 # same math fused into the first jitted step, off the host's critical path
 _NORMALIZE_CALLS = {"color_normalize", "ColorNormalizeAug"}
@@ -89,13 +94,15 @@ class _LoopFrame:
     level) dispatches training steps.  A sync only fires when both hold —
     i.e. it runs at the same frequency as the step dispatch; an
     epoch-boundary fetch (innermost loop = the epoch loop, steps live in
-    the nested batch loop) stays clean."""
+    the nested batch loop) stays clean.  ``kind`` ('while'/'for') also
+    scopes SRC005 to while-style worker loops."""
 
-    __slots__ = ("syncs", "has_step")
+    __slots__ = ("syncs", "has_step", "kind")
 
-    def __init__(self):
+    def __init__(self, kind="for"):
         self.syncs = []      # (node, description)
         self.has_step = False
+        self.kind = kind
 
 
 class _Visitor(ast.NodeVisitor):
@@ -115,7 +122,7 @@ class _Visitor(ast.NodeVisitor):
     # -- SRC004 scaffolding ------------------------------------------------
     def _visit_loop(self, node, kind):
         self._check_branch(node, kind)
-        self._loops.append(_LoopFrame())
+        self._loops.append(_LoopFrame(kind="while"))
         self.generic_visit(node)
         self._flush_loop_frame()
 
@@ -151,6 +158,20 @@ class _Visitor(ast.NodeVisitor):
         name = _call_name(fn)
         if self._loops and name in _STEP_CALLS:
             self._loops[-1].has_step = True
+        # SRC005: zero-arg blocking receiver whose innermost enclosing
+        # loop is while-style (the worker/heartbeat-loop shape).  Any
+        # positional arg or a timeout=/block= kwarg bounds the wait.
+        if isinstance(fn, ast.Attribute) and fn.attr in _BLOCKING_CALLS \
+                and not node.args \
+                and not any(k.arg in ("timeout", "block")
+                            for k in node.keywords) \
+                and self._loops and self._loops[-1].kind == "while":
+            self._emit("SRC005", node,
+                       ".%s() with no timeout inside a while-loop: a "
+                       "dead peer (killed worker process, closed socket, "
+                       "wedged thread) blocks this loop forever; use "
+                       ".%s(timeout=...) and re-check liveness/stop "
+                       "conditions on each wake" % (fn.attr, fn.attr))
         if isinstance(fn, ast.Attribute) and \
                 fn.attr in (_SYNC_METHODS | _SYNC_EXTRA):
             self._note_sync(node, ".%s()" % fn.attr)

@@ -77,6 +77,11 @@ def flush():
     """Wait for ALL in-flight engine work: drain every registered ring,
     then barrier any remaining async effects.  This is the explicit bulk
     segment flush (reference: ThreadedEngine::WaitForAll)."""
+    from .resilience import chaos as _chaos
+    # chaos probe: a scheduled kill/stall lands exactly at the segment
+    # boundary — the "crash mid-bulk-window" case the checkpoint layer
+    # must survive (tests/test_resilience.py)
+    _chaos.maybe_inject("engine.flush")
     with _lock:
         live = [r() for r in _flushers]
         # compact dropped components in passing

@@ -12,6 +12,7 @@ with TPU compute.  ``num_workers=0`` is a synchronous in-process loop.
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 
 import numpy as np
 
@@ -137,10 +138,28 @@ class DataLoader:
         for _ in range(self._prefetch or 1):
             if not submit():
                 break
+        # bounded waits (the SRC005 worker-loop discipline): a process-pool
+        # worker lost to the OOM killer can orphan its AsyncResult, and a
+        # bare .get() would then hang this loop forever.  Poll with a
+        # timeout and give up loudly at a total deadline instead.
+        deadline_s = float(os.environ.get("MXTPU_DATALOADER_TIMEOUT", "600"))
         while async_results:
-            res = async_results.pop(0).get()
+            res = async_results.pop(0)
+            waited = 0.0
+            while True:
+                try:
+                    out = res.get(timeout=5.0)
+                    break
+                except mp.TimeoutError:
+                    waited += 5.0
+                    if waited >= deadline_s:
+                        raise RuntimeError(
+                            "DataLoader batch not produced within %.0fs — "
+                            "a pool worker likely died (OOM-killed?); "
+                            "raise MXTPU_DATALOADER_TIMEOUT if the "
+                            "dataset is genuinely that slow" % deadline_s)
             submit()
-            yield _to_nd(res) if self._batchify_fn is None else res
+            yield _to_nd(out) if self._batchify_fn is None else out
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -24,8 +24,8 @@ from ..ndarray import NDArray, array as _nd_array
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "DeviceFeedIter", "PrefetchToDeviceIter",
            "CSVIter", "MNISTIter",
-           "ImageRecordIter", "ImagePipelineIter", "make_device_tail",
-           "LibSVMIter", "ImageDetRecordIter"]
+           "ImageRecordIter", "ImagePipelineIter", "PipelineWorkerStorm",
+           "make_device_tail", "LibSVMIter", "ImageDetRecordIter"]
 
 
 def ImageRecordIter(**kwargs):
@@ -835,5 +835,6 @@ class MNISTIter(DataIter):
 # imported at the tail: these modules consume the DataIter/DataBatch/DataDesc
 # definitions above (mxnet_tpu.io is already in sys.modules by then)
 from .device_tail import make_device_tail  # noqa: E402
-from .pipeline import ImagePipelineIter, pipeline_available  # noqa: E402,F401
+from .pipeline import (ImagePipelineIter, PipelineWorkerStorm,  # noqa: E402,F401
+                       pipeline_available)
 from .prefetch import PrefetchToDeviceIter  # noqa: E402

@@ -46,12 +46,24 @@ import warnings
 import numpy as _np
 
 from ..base import MXNetError
+from ..resilience import chaos as _chaos
 from . import DataBatch, DataIter
 
-__all__ = ["ImagePipelineIter", "pipeline_available", "seed_for_batch"]
+__all__ = ["ImagePipelineIter", "PipelineWorkerStorm", "pipeline_available",
+           "seed_for_batch"]
 
-_RESPAWN_LIMIT = 3          # per-worker crash budget before giving up
+_RESPAWN_LIMIT = 3          # default per-worker per-epoch crash budget
 _POLL_S = 0.25              # consumer liveness-poll interval
+_WORKER_POLL_S = 1.0        # worker-side bounded-blocking poll interval
+
+
+class PipelineWorkerStorm(MXNetError):
+    """A worker died more than ``max_respawns`` times within one epoch.
+
+    A deterministic crasher (corrupt record that segfaults the decoder,
+    OOM at a fixed batch) would otherwise respawn-loop forever — the
+    respawn budget turns the loop into a clear, immediate error naming
+    the worker and its crash count (docs/io.md failure semantics)."""
 
 
 def pipeline_available():
@@ -141,17 +153,34 @@ def _worker_main(wid, shm_name, layout, iter_kwargs, aug_list, seed,
 
     Runs no jax: the decode core is ``ImageIter.next_numpy`` and the output
     leaves through shared memory, so the worker can never acquire a device
-    backend (critical when the parent holds a TPU)."""
+    backend (critical when the parent holds a TPU).
+
+    Every blocking wait is bounded (the SRC005 discipline): the task and
+    slot waits poll at ``_WORKER_POLL_S`` and re-check that the parent is
+    still alive — an orphaned worker (parent SIGKILLed) exits instead of
+    blocking on a queue nobody will ever feed again."""
+    parent = os.getppid()
     shm = _attach_shm(shm_name)
     try:
         from ..image import ImageIter
         it = ImageIter(aug_list=list(aug_list), shuffle=False, **iter_kwargs)
         while True:
-            task = task_q.get()
+            try:
+                task = task_q.get(timeout=_WORKER_POLL_S)
+            except _queue.Empty:
+                if os.getppid() != parent:
+                    return          # orphaned: the parent died
+                continue
             if task is None:
                 break
             epoch, batch_idx, keys = task
-            slot = free_q.get()         # backpressure: bounded slots
+            while True:             # backpressure: bounded slots
+                try:
+                    slot = free_q.get(timeout=_WORKER_POLL_S)
+                    break
+                except _queue.Empty:
+                    if os.getppid() != parent:
+                        return
             t0 = _time.perf_counter()
             try:
                 _seed_rngs(seed, epoch, batch_idx)
@@ -186,10 +215,13 @@ class ImagePipelineIter(DataIter):
         the output stream is bitwise-identical for ANY ``num_workers``;
         ``None`` leaves worker RNGs free-running (fastest shuffle of
         entropy, no reproducibility).
+    max_respawns : int — crash budget per worker *per epoch* (default 3);
+        exceeding it raises :class:`PipelineWorkerStorm` instead of
+        respawn-looping forever on a deterministic crasher.
     """
 
     def __init__(self, num_workers=None, prefetch_buffer=2, seed=None,
-                 **kwargs):
+                 max_respawns=_RESPAWN_LIMIT, **kwargs):
         from .. import profiler as _profiler
         from ..image import ImageIter
         if num_workers is None:
@@ -234,6 +266,9 @@ class ImagePipelineIter(DataIter):
         self._free_qs = []
         self._ready_qs = []
         self._respawns = 0
+        self._max_respawns = int(max_respawns)
+        # per-worker per-epoch crash counts (the storm budget's unit)
+        self._worker_respawns = [0] * max(1, self._n_workers)
         if self._n_workers > 0:
             self._start_workers()
         self._begin_epoch()
@@ -311,6 +346,10 @@ class ImagePipelineIter(DataIter):
         # makes the slot ring deadlock-free (docs/io.md)
         self._next_for_worker = list(range(max(1, self._n_workers)))
         self._exhausted = not batches
+        # a fresh epoch resets the crash budget: the storm bound is
+        # "max_respawns per worker per epoch"
+        self._worker_respawns = [0] * max(1, self._n_workers)
+        self.stats.on_epoch()
         if self._n_workers > 0:
             self._fill_dispatch()
 
@@ -325,6 +364,9 @@ class ImagePipelineIter(DataIter):
                 self._next_for_worker[wid] += self._n_workers
 
     def _dispatch(self, wid, batch_idx):
+        # chaos probe: a scheduled fault SIGKILLs a worker (action "call"
+        # through ctx) or delays dispatch at a chosen batch index
+        _chaos.maybe_inject("pipeline.dispatch", ctx=(self, wid, batch_idx))
         keys = self._batches[batch_idx]
         self._in_flight[wid].append((self._epoch, batch_idx))
         self._task_qs[wid].put((self._epoch, batch_idx, keys))
@@ -397,12 +439,17 @@ class ImagePipelineIter(DataIter):
         simply re-decoded — wasted work, never a duplicate, because the
         reorder buffer keys on batch index."""
         self._respawns += 1
+        self._worker_respawns[wid] += 1
         self.stats.on_respawn()
-        if self._respawns > _RESPAWN_LIMIT * max(1, self._n_workers):
-            raise MXNetError(
-                "pipeline worker %d died repeatedly (exitcode %s); "
-                "giving up after %d respawns"
-                % (wid, proc.exitcode, self._respawns))
+        if self._worker_respawns[wid] > self._max_respawns:
+            raise PipelineWorkerStorm(
+                "pipeline worker %d died %d times this epoch (exitcode "
+                "%s), exceeding max_respawns=%d — a deterministic "
+                "crasher (corrupt record / repeatable OOM), not a "
+                "transient fault; inspect the record at the failing "
+                "batch instead of respawn-looping"
+                % (wid, self._worker_respawns[wid], proc.exitcode,
+                   self._max_respawns))
         logging.getLogger(__name__).warning(
             "pipeline worker %d died (exitcode %s); respawning and "
             "requeueing %d batches", wid, proc.exitcode,

@@ -81,7 +81,11 @@ class KVStore:
     def _start_ps(self):
         """dist_async rides a host-side parameter server on rank 0 — async
         per-push application is what a collective cannot express
-        (reference: kvstore_dist_server.h:285)."""
+        (reference: kvstore_dist_server.h:285).  The elastic tier rides
+        along: worker heartbeats feed the server watchdog (dead-worker
+        key reassignment) and pushes carry a per-store step so
+        ``MXTPU_MAX_STALENESS`` can bound how stale a rejoining worker's
+        gradients may be (docs/resilience.md)."""
         import os
         from . import kvstore_ps
         host = os.environ.get("JAX_COORDINATOR_ADDRESS",
@@ -90,10 +94,21 @@ class KVStore:
         if not port:
             raise MXNetError(
                 "dist_async needs MXTPU_PS_PORT (tools/launch.py sets it)")
+        hb_interval = float(os.environ.get("MXTPU_HEARTBEAT_INTERVAL_S",
+                                           "2.0"))
+        hb_timeout = float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT_S",
+                                          str(hb_interval * 5)))
+        staleness = os.environ.get("MXTPU_MAX_STALENESS")
         if self._rank == 0:
             self._ps_server = kvstore_ps.PSServer(
-                port=port, num_workers=self._num_workers)
+                port=port, num_workers=self._num_workers,
+                heartbeat_timeout_s=hb_timeout if hb_interval > 0 else None,
+                max_staleness=int(staleness) if staleness else None)
         self._ps_client = kvstore_ps.PSClient(host, port, rank=self._rank)
+        self._push_step = 0
+        if hb_interval > 0:
+            self._ps_client.start_heartbeat(
+                hb_interval, step_fn=lambda: self._push_step)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -194,8 +209,22 @@ class KVStore:
             self._ps_client.request("push", k, "2bit",
                                     (packed, shape, thr))
             return
-        self._ps_client.push_array(
-            k, _np.asarray(merged.asnumpy(), _np.float32))
+        self._push_step += 1
+        arr = _np.asarray(merged.asnumpy(), _np.float32)
+        try:
+            self._ps_client.push_array(k, arr, step=self._push_step)
+        except kvstore_ps.StaleWorkerError as e:
+            # bounded-staleness rejoin: this worker lagged the fleet past
+            # the bound (it was dead/partitioned) — pull fresh state,
+            # fast-forward the step clock, and re-send at the synced
+            # clock.  Async PS semantics tolerate ONE bounded-stale
+            # update; what the gate forbids is unbounded lag mixing in
+            # silently (reference: SSP's bounded-staleness contract).
+            import jax.numpy as _jnp
+            fresh = self._ps_client.pull_array(k)
+            self._store[k]._set_data(_jnp.asarray(fresh))
+            self._push_step = e.max_step
+            self._ps_client.push_array(k, arr, step=self._push_step)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out, allow_list_values=True)

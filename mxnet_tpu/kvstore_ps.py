@@ -12,9 +12,32 @@ server on rank 0 (the dmlc ps-lite analogue), applying updates per-push
 under a key lock.  Payloads cross DCN as numpy bytes; with gradient
 compression enabled the wire carries 4-values-per-byte packed 2-bit
 codes + one threshold scalar — a real 16x narrowing vs fp32.
+
+Elasticity tier (``mxnet_tpu.resilience``, docs/resilience.md):
+
+- **heartbeats**: workers beat every ``heartbeat_interval_s``
+  (``PSClient.start_heartbeat``); the server's watchdog
+  (``resilience.heartbeat.HeartbeatMonitor``) declares a silent rank
+  dead after ``heartbeat_timeout_s``, closes its socket and reassigns
+  its keys (ps-lite's van heartbeat + ``kvstore.h:339``
+  ``get_num_dead_node``).
+- **single-writer key ownership**: the rank whose init wins owns the
+  key (the same ownership discipline as the shm ring's per-worker
+  slots); a dead owner's keys are reassigned round-robin over live
+  ranks, and a rejoining worker finds itself demoted — it pulls, it
+  does not re-init.
+- **bounded staleness**: pushes carry the worker's step; when
+  ``max_staleness`` is set, a push lagging the fleet's max step by more
+  than that bound is refused with a ``stale`` reply
+  (:class:`StaleWorkerError` client-side) — the worker must pull fresh
+  state and catch up before mixing ancient gradients in.
+- **retry/backoff**: ``PSClient.request`` reconnects and retries on a
+  broken socket using the shared ``resilience.backoff`` policy
+  (exponential with jitter), so a PS restart is a blip, not a crash.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
@@ -22,7 +45,23 @@ import threading
 
 import numpy as np
 
-__all__ = ["PSServer", "PSClient", "pack_2bit", "unpack_2bit"]
+from .resilience import backoff as _backoff
+from .resilience import chaos as _chaos
+from .resilience.heartbeat import HeartbeatMonitor, HeartbeatSender
+
+__all__ = ["PSServer", "PSClient", "StaleWorkerError", "pack_2bit",
+           "unpack_2bit"]
+
+
+class StaleWorkerError(RuntimeError):
+    """Push refused: this worker lags the fleet beyond ``max_staleness``.
+
+    ``max_step`` carries the fleet's current step so the caller can pull
+    fresh state, fast-forward its step counter and retry."""
+
+    def __init__(self, msg, max_step=0):
+        super().__init__(msg)
+        self.max_step = int(max_step)
 
 
 # ---------------------------------------------------------------------------
@@ -95,9 +134,17 @@ BIGARRAY_BOUND = int(__import__("os").environ.get(
 
 
 class PSServer:
-    """Host-side async parameter server (runs as a thread on rank 0)."""
+    """Host-side async parameter server (runs as a thread on rank 0).
 
-    def __init__(self, port=0, num_workers=1):
+    ``heartbeat_timeout_s`` arms the watchdog: a rank silent for that
+    long is declared dead, its socket closed and its keys reassigned.
+    ``max_staleness`` (steps) arms the bounded-staleness gate on pushes
+    that carry a worker step.  Both default off so plain stores behave
+    exactly as before; ``kvstore.create("dist_async")`` arms them from
+    ``MXTPU_HEARTBEAT_TIMEOUT_S`` / ``MXTPU_MAX_STALENESS``."""
+
+    def __init__(self, port=0, num_workers=1, heartbeat_timeout_s=None,
+                 max_staleness=None, watchdog_poll_s=None):
         self._store = {}
         self._locks = {}
         self._updater = None
@@ -109,6 +156,17 @@ class PSServer:
         self._live_ranks = {}
         self._dead_ranks = set()
         self._live_lock = threading.Lock()
+        # elasticity: key -> owning rank (single-writer discipline; the
+        # init winner owns), plus a reassignment log for observability
+        self._key_owner = {}
+        self._reassignments = []   # (key, old_rank, new_rank)
+        self._max_staleness = (int(max_staleness)
+                               if max_staleness is not None else None)
+        self.monitor = HeartbeatMonitor(
+            timeout_s=heartbeat_timeout_s or 10.0,
+            poll_s=watchdog_poll_s, on_dead=self._on_rank_dead)
+        if heartbeat_timeout_s is not None:
+            self.monitor.start()
         # keys claimed by an in-flight chunked init (readers wait on cv)
         self._pending_init = set()
         self._pending_cv = threading.Condition()
@@ -140,7 +198,8 @@ class PSServer:
         # snapshots.  Keeping them here (not on the server) means two
         # workers chunk-pushing the same key never interleave, and a
         # client that dies mid-transfer leaks nothing.
-        ctx = {"staging": {}, "snapshots": {}, "claimed_inits": set()}
+        ctx = {"staging": {}, "snapshots": {}, "claimed_inits": set(),
+               "rank": None}
         try:
             while True:
                 msg = _recv(conn)
@@ -148,10 +207,15 @@ class PSServer:
                     return
                 if msg[0] == "hello":
                     rank_box[0] = msg[1]
+                    ctx["rank"] = msg[1]
                     with self._live_lock:
                         self._live_ranks[msg[1]] = conn
                         self._dead_ranks.discard(msg[1])
-                    _send(conn, ("ok",))
+                    # a hello is also a beat: a rejoining dead rank is
+                    # resurrected, and the reply carries the fleet's max
+                    # step so the client can gauge its staleness
+                    self.monitor.beat(msg[1])
+                    _send(conn, ("ok", self.monitor.max_step()))
                     continue
                 reply = self._handle(msg, ctx)
                 _send(conn, reply)
@@ -184,17 +248,54 @@ class PSServer:
         with self._store_lock:
             return self._locks.setdefault(key, threading.Lock())
 
+    def _on_rank_dead(self, rank):
+        """Watchdog verdict: close the rank's socket (unwedging its serve
+        thread) and reassign its keys round-robin over live ranks — the
+        shm ring's discipline transplanted: ownership moves wholesale at
+        death, never shared while alive."""
+        with self._live_lock:
+            conn = self._live_ranks.pop(rank, None)
+            self._dead_ranks.add(rank)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        with self._live_lock:
+            live = sorted(self._live_ranks)
+        owned = sorted(k for k, r in self._key_owner.items() if r == rank)
+        for i, key in enumerate(owned):
+            new = live[i % len(live)] if live else None
+            self._key_owner[key] = new
+            self._reassignments.append((key, rank, new))
+
+    def key_owner(self, key):
+        return self._key_owner.get(key)
+
     def _handle(self, msg, ctx=None):
         ctx = ctx if ctx is not None else {
-            "staging": {}, "snapshots": {}, "claimed_inits": set()}
+            "staging": {}, "snapshots": {}, "claimed_inits": set(),
+            "rank": None}
         cmd = msg[0]
         if cmd == "init":
             _, key, arr = msg
             with self._key_lock(key):
-                # first init wins (reference: server keeps the first copy)
+                # first init wins (reference: server keeps the first copy);
+                # the winner OWNS the key (single-writer discipline)
                 if key not in self._store:
                     self._store[key] = np.array(arr, np.float32)
+                    self._key_owner.setdefault(key, ctx.get("rank"))
             return ("ok",)
+        if cmd == "heartbeat":
+            rank = msg[1]
+            step = msg[2] if len(msg) > 2 else None
+            self.monitor.beat(rank, step)
+            with self._live_lock:
+                self._dead_ranks.discard(rank)
+            return ("ok", self.monitor.max_step(),
+                    len(self.monitor.dead() | self._dead_ranks))
+        if cmd == "key_owner":
+            return ("ok", self._key_owner.get(msg[1]))
         if cmd == "init_meta":
             # chunked init: claim the key (first caller wins); the array
             # is NOT visible until the owner's last chunk installs it
@@ -231,6 +332,7 @@ class PSServer:
                 with self._pending_cv:
                     if key not in self._store:
                         self._store[key] = arr
+                        self._key_owner.setdefault(key, ctx.get("rank"))
                     self._pending_init.discard(key)
                     ctx["claimed_inits"].discard(key)
                     self._pending_cv.notify_all()
@@ -242,7 +344,19 @@ class PSServer:
             self._updater = opt_mod.get_updater(optimizer)
             return ("ok",)
         if cmd == "push":
-            _, key, kind, payload = msg
+            key, kind, payload = msg[1], msg[2], msg[3]
+            step = msg[4] if len(msg) > 4 else None
+            if step is not None:
+                rank = ctx.get("rank")
+                if rank is not None:
+                    self.monitor.note_step(rank, step)
+                # bounded staleness: a worker too far behind the fleet
+                # must catch up (pull) before its gradients mix in —
+                # the rejoin gate of the elastic tier
+                if self._max_staleness is not None:
+                    maxs = self.monitor.max_step()
+                    if maxs - int(step) > self._max_staleness:
+                        return ("stale", maxs)
             self._await_init(key)
             grad = self._decode(kind, payload)
             with self._key_lock(key):
@@ -290,7 +404,8 @@ class PSServer:
             return ("ok", arr[idx], idx)
         if cmd == "num_dead":
             with self._live_lock:
-                return ("ok", len(self._dead_ranks))
+                dead = set(self._dead_ranks)
+            return ("ok", len(dead | self.monitor.dead()))
         if cmd == "pull_meta":
             # snapshot under the key lock: chunked pulls must never see a
             # torn mix of pre- and post-update halves.  The client sends
@@ -320,7 +435,8 @@ class PSServer:
                 del ctx["snapshots"][key]
             return ("ok", out)
         if cmd == "push_chunk":
-            _, key, shape, start, stop, payload, last = msg
+            key, shape, start, stop, payload, last = msg[1:7]
+            step = msg[7] if len(msg) > 7 else None
             with self._key_lock(key):
                 if key not in self._store:
                     return ("err", "key %r not initialized" % (key,))
@@ -332,8 +448,11 @@ class PSServer:
             if not last:
                 return ("ok",)
             grad = ctx["staging"].pop(key).reshape(shape)
-            # apply like a dense push (re-enter the push path)
-            return self._handle(("push", key, "dense", grad), ctx)
+            # apply like a dense push (re-enter the push path, carrying
+            # the worker step through the staleness gate)
+            if step is None:
+                return self._handle(("push", key, "dense", grad), ctx)
+            return self._handle(("push", key, "dense", grad, step), ctx)
         if cmd == "barrier":
             with self._barrier_cv:
                 gen = self._barrier_gen
@@ -371,6 +490,7 @@ class PSServer:
 
     def stop(self):
         self._stop.set()
+        self.monitor.stop()
         try:
             self._sock.close()
         except OSError:
@@ -382,11 +502,24 @@ class PSClient:
 
     Connection retries cover the startup race: workers may dial before
     rank 0's server thread is listening (ps-lite handles this with its
-    own rendezvous; plain TCP needs the retry loop)."""
+    own rendezvous; plain TCP needs the retry loop).  A socket that
+    breaks MID-conversation (PS restart, network blip) is redialed with
+    the shared ``resilience.backoff`` policy — exponential with jitter,
+    so a fleet that lost the same server does not redial in lockstep.
+    Pushes retried across a reconnect are at-least-once (the reference's
+    async push has the same property)."""
 
     def __init__(self, host, port, timeout=120, connect_retry_s=60,
-                 rank=None):
+                 rank=None, retry_policy=None):
         import time
+        self._host, self._port, self._timeout = host, port, timeout
+        self._rank = rank
+        self._retry = retry_policy or _backoff.BackoffPolicy(
+            base_s=0.2, factor=2.0, max_delay_s=5.0,
+            max_retries=int(os.environ.get("MXTPU_PS_RETRIES", "4")),
+            jitter=0.25)
+        self.reconnects = 0
+        self._hb = None
         deadline = time.time() + connect_retry_s
         while True:
             try:
@@ -401,16 +534,34 @@ class PSClient:
         if rank is not None:
             self.request("hello", rank)
 
-    def push_array(self, key, arr):
+    def start_heartbeat(self, interval_s=2.0, step_fn=None):
+        """Start the worker-side beat loop (``resilience.heartbeat``):
+        every ``interval_s`` the client reports liveness (and its step,
+        via ``step_fn``) so the server's watchdog can tell silence from
+        progress.  Idempotent; stopped by :meth:`close`."""
+        if self._hb is None:
+            def beat():
+                step = step_fn() if step_fn is not None else None
+                self.request("heartbeat", self._rank, step)
+            self._hb = HeartbeatSender(beat, interval_s).start()
+        return self._hb
+
+    def push_array(self, key, arr, step=None):
         """Dense push, chunked above BIGARRAY_BOUND elements
-        (EncodeDefaultKey analogue — bounds per-message pickle size)."""
+        (EncodeDefaultKey analogue — bounds per-message pickle size).
+        ``step`` (the worker's training step) feeds the server's
+        bounded-staleness gate; a refused push raises
+        :class:`StaleWorkerError`."""
         if arr.size <= BIGARRAY_BOUND:
-            return self.request("push", key, "dense", arr)
+            if step is None:
+                return self.request("push", key, "dense", arr)
+            return self.request("push", key, "dense", arr, int(step))
         flat = arr.reshape(-1)
         for start in range(0, arr.size, BIGARRAY_BOUND):
             stop = min(start + BIGARRAY_BOUND, arr.size)
             self.request("push_chunk", key, tuple(arr.shape), start, stop,
-                         flat[start:stop], stop == arr.size)
+                         flat[start:stop], stop == arr.size,
+                         None if step is None else int(step))
         return ("ok",)
 
     def init_array(self, key, arr):
@@ -454,18 +605,58 @@ class PSClient:
             out[start:stop] = self.request("pull_chunk", key, start, stop)[1]
         return out.reshape(shape)
 
+    def _reconnect(self):
+        """Redial + re-hello under the held request lock (the hello must
+        precede any retried request so the server re-learns our rank)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = socket.create_connection((self._host, self._port),
+                                              timeout=self._timeout)
+        self.reconnects += 1
+        if self._rank is not None:
+            _send(self._sock, ("hello", self._rank))
+            if _recv(self._sock) is None:
+                raise ConnectionError("hello rejected on reconnect")
+
     def request(self, *msg):
+        import time
+        # chaos probe: a scheduled fault drops (raise) or delays this RPC
+        _chaos.maybe_inject("kvstore.request", ctx=msg)
         with self._lock:
-            _send(self._sock, msg)
-            reply = _recv(self._sock)
-        if reply is None:
-            raise ConnectionError("parameter server closed the connection")
+            attempt = 0
+            while True:
+                try:
+                    _send(self._sock, msg)
+                    reply = _recv(self._sock)
+                    if reply is None:
+                        raise ConnectionError(
+                            "parameter server closed the connection")
+                    break
+                except (OSError, ConnectionError):
+                    if attempt >= self._retry.max_retries:
+                        raise
+                    time.sleep(self._retry.delay(attempt))
+                    attempt += 1
+                    try:
+                        self._reconnect()
+                    except OSError:
+                        continue  # next send fails fast; retry again
+        if reply[0] == "stale":
+            raise StaleWorkerError(
+                "push refused: worker lags the fleet's step %d beyond "
+                "the staleness bound — pull fresh state and catch up"
+                % reply[1], max_step=reply[1])
         if reply[0] == "err":
             from .base import MXNetError
             raise MXNetError(reply[1])
         return reply
 
     def close(self):
+        if self._hb is not None:
+            self._hb.stop()
+            self._hb = None
         try:
             self._sock.close()
         except OSError:

@@ -1,17 +1,65 @@
 """KVStore server entry point (reference: python/mxnet/kvstore_server.py —
 the process ps-lite spawns with DMLC_ROLE=server running the optimizer).
 
-TPU-native: there is no separate server process — push() applies the
-optimizer against the stored weights in-process and multi-host reduction
-is a mesh psum (see kvstore.py).  This module keeps the reference's entry
-surface so launcher scripts that probe DMLC_ROLE keep working: a 'server'
-or 'scheduler' role simply has nothing to do and returns."""
+TPU-native: the synchronous types need no server — push() applies the
+optimizer against stored weights in-process and multi-host reduction is a
+mesh psum (kvstore.py).  Two server shapes remain:
+
+- ``dist_async``'s rank-0-embedded ``PSServer`` thread (kvstore.py
+  ``_start_ps``) — the common case;
+- a **standalone** PS process for launchers that spawn a dedicated
+  server rank: ``DMLC_ROLE=server`` + ``MXTPU_PS_PORT`` makes
+  :func:`_init_kvstore_server_module` host a ``PSServer`` with the full
+  elasticity tier armed (heartbeat watchdog, dead-worker key
+  reassignment, bounded staleness — docs/resilience.md) and block until
+  SIGTERM/SIGINT.  The legacy probe surface is preserved: a
+  server/scheduler role with only ``DMLC_PS_ROOT_URI`` set still exits
+  immediately (the collective types have nothing for it to do)."""
 from __future__ import annotations
 
 import os
+import signal
 import sys
+import threading
 
 __all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+def _elasticity_env():
+    """(heartbeat_timeout_s, max_staleness) from the MXTPU_* env knobs —
+    the same knobs kvstore.py's embedded server reads."""
+    hb_interval = float(os.environ.get("MXTPU_HEARTBEAT_INTERVAL_S", "2.0"))
+    hb_timeout = float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT_S",
+                                      str(hb_interval * 5)))
+    staleness = os.environ.get("MXTPU_MAX_STALENESS")
+    return (hb_timeout if hb_interval > 0 else None,
+            int(staleness) if staleness else None)
+
+
+def _serve_ps(port, num_workers):
+    """Host a standalone PSServer until SIGTERM/SIGINT.
+
+    The wait loop is bounded (Event.wait with a timeout — the SRC005
+    discipline), so a missed signal can never wedge the process beyond
+    one poll interval after ``stop`` is set some other way."""
+    from . import kvstore_ps
+    hb_timeout, max_staleness = _elasticity_env()
+    server = kvstore_ps.PSServer(port=port, num_workers=num_workers,
+                                 heartbeat_timeout_s=hb_timeout,
+                                 max_staleness=max_staleness)
+    print("mxnet_tpu: standalone PS serving on port %d "
+          "(workers=%d, heartbeat_timeout=%s, max_staleness=%s)"
+          % (server.port, num_workers, hb_timeout, max_staleness),
+          file=sys.stderr)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:  # non-main thread (tests)
+            break
+    while not stop.wait(0.5):
+        pass
+    server.stop()
 
 
 class KVStoreServer:
@@ -20,17 +68,27 @@ class KVStoreServer:
         self.handle = kvstore
 
     def run(self):
-        """The reference blocks in the ps-lite event loop; collectives have
-        no server loop — return immediately."""
+        """Host the standalone PS when the launcher env asks for one;
+        otherwise return immediately (collectives have no server loop)."""
+        port = int(os.environ.get("MXTPU_PS_PORT", "0"))
+        if os.environ.get("DMLC_ROLE") == "server" and port:
+            _serve_ps(port, int(os.environ.get("DMLC_NUM_WORKER", "1")))
         return
 
 
 def _init_kvstore_server_module():
     """Explicit entry for launcher scripts (NOT run at import — a stray
-    exported DMLC_ROLE must not kill every `import mxnet_tpu`).  Exits only
-    when the process is clearly a ps-lite-style server spawn: role is
-    server/scheduler AND a tracker address is configured."""
+    exported DMLC_ROLE must not kill every `import mxnet_tpu`).
+
+    - role=server + MXTPU_PS_PORT: host the standalone elastic PS until
+      signalled, then exit 0;
+    - role=server/scheduler + DMLC_PS_ROOT_URI (legacy ps-lite spawn):
+      nothing to do, exit 0."""
     role = os.environ.get("DMLC_ROLE", "worker")
+    port = int(os.environ.get("MXTPU_PS_PORT", "0"))
+    if role == "server" and port:
+        _serve_ps(port, int(os.environ.get("DMLC_NUM_WORKER", "1")))
+        sys.exit(0)
     if role in ("server", "scheduler") and os.environ.get("DMLC_PS_ROOT_URI"):
         print("mxnet_tpu: '%s' role has no work (the parameter server "
               "collapsed into mesh collectives); exiting" % role,

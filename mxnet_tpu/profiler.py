@@ -280,6 +280,7 @@ class PipelineStats:
         self._batches = 0
         self._depth_max = 0
         self._respawns = 0
+        self._respawns_epoch = 0     # reset by on_epoch (the storm budget)
         self._num_workers = num_workers
         domain = Domain(name)
         self._counter = domain.new_counter("queue_depth")
@@ -305,6 +306,13 @@ class PipelineStats:
     def on_respawn(self):
         with self._lock:
             self._respawns += 1
+            self._respawns_epoch += 1
+
+    def on_epoch(self):
+        """Epoch boundary: reset the per-epoch respawn counter (the unit
+        of ``ImagePipelineIter``'s ``max_respawns`` storm budget)."""
+        with self._lock:
+            self._respawns_epoch = 0
 
     def on_dispatch(self, inflight):
         """A step was dispatched with ``inflight`` steps now un-synchronized
@@ -340,6 +348,7 @@ class PipelineStats:
                 "stall_pct": round(100.0 * self._stall_s / wall, 2),
                 "queue_depth_max": self._depth_max,
                 "respawns": self._respawns,
+                "respawns_epoch": self._respawns_epoch,
                 "dispatched_steps": self._dispatched,
                 "inflight_max": self._inflight_max,
                 "dispatch_stall_s": round(self._dispatch_stall_s, 3),

@@ -1,0 +1,45 @@
+"""mxnet_tpu.resilience — the fault-tolerance substrate.
+
+The reference stack inherits worker/server fault tolerance from ps-lite
+(MXNet, arxiv 1512.01274 §4) and TensorFlow treats checkpoint-based
+recovery as a first-class system property (arxiv 1605.08695); this
+package is the reproduction's equivalent tier, built as four cooperating
+pieces (see ``docs/resilience.md``):
+
+- :mod:`.chaos` — deterministic fault injection: a seeded schedule of
+  faults (kill/raise/delay/call) replayed at named probe sites, so every
+  failure mode gets a reproducible tier-1 test;
+- :mod:`.checkpoint` — atomic write-rename snapshots (params + optimizer
+  state + RNG + iterator cursor) behind
+  ``DataParallelTrainer.fit(checkpoint_dir=..., resume=True)``, with
+  bitwise-identical post-crash replay;
+- :mod:`.heartbeat` — worker heartbeats + a server-side watchdog, the
+  liveness layer under ``kvstore_ps``'s elastic PS tier (dead-worker key
+  reassignment, bounded-staleness rejoin);
+- :mod:`.backoff` — the one shared exponential-backoff-with-jitter
+  retry policy (bench backend acquisition, launcher rank restarts,
+  kvstore RPC reconnects).
+
+``python -m mxnet_tpu.resilience.bench`` is the host-only proof harness:
+it reports ``recovery_time_s`` and ``checkpoint_overhead_pct`` and stays
+live when the TPU backend is down (the r05 bench pattern).
+"""
+from __future__ import annotations
+
+from . import backoff, chaos, checkpoint, heartbeat
+from .backoff import BackoffPolicy, RetriesExhausted, retry_call
+from .chaos import (ChaosError, ChaosSchedule, Fault, install,
+                    install_from_env, maybe_inject, triggered, uninstall)
+from .checkpoint import (latest_checkpoint, list_checkpoints,
+                         load_checkpoint, save_checkpoint)
+from .heartbeat import HeartbeatMonitor, HeartbeatSender
+
+__all__ = [
+    "backoff", "chaos", "checkpoint", "heartbeat",
+    "BackoffPolicy", "RetriesExhausted", "retry_call",
+    "ChaosError", "ChaosSchedule", "Fault", "install", "install_from_env",
+    "maybe_inject", "triggered", "uninstall",
+    "save_checkpoint", "load_checkpoint", "latest_checkpoint",
+    "list_checkpoints",
+    "HeartbeatMonitor", "HeartbeatSender",
+]

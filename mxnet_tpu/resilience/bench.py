@@ -1,0 +1,135 @@
+"""Host-only resilience micro-bench: ``python -m mxnet_tpu.resilience.bench``.
+
+Run by ``bench.py``'s ``resilience`` stage as a ``JAX_PLATFORMS=cpu``
+subprocess BEFORE backend acquisition (the r05 pattern), so the numbers
+stay live when the TPU backend is down.  Prints ONE JSON line:
+
+- ``resilience_checkpoint_overhead_pct`` — extra wall time of a training
+  loop that auto-checkpoints at the default cadence
+  (``DEFAULT_CHECKPOINT_EVERY``) vs the same loop without; the
+  acceptance gate is < 5%.
+- ``resilience_recovery_time_s`` — crash-to-trained: construct a fresh
+  trainer, restore the newest checkpoint, run the first post-restore
+  step (the full resume path a real crash pays).
+- ``resilience_bitwise_ok`` — the recovery is *correct*, not just fast:
+  a run crashed at the midpoint and resumed finishes with params
+  byte-identical to the uncrashed run at the same step count.
+- ``resilience_ckpt_bytes`` — snapshot size on disk.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+
+def _fresh_trainer(seed):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import DataParallelTrainer
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    return DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9})
+
+
+def _params_bytes(trainer):
+    return b"".join(
+        np.asarray(p.data()._data).tobytes()
+        for _, p in sorted(trainer._params_by_name.items()))
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel.trainer import DEFAULT_CHECKPOINT_EVERY
+
+    steps = int(os.environ.get("MXTPU_RES_BENCH_STEPS", "300"))
+    cadence = DEFAULT_CHECKPOINT_EVERY
+    batch = 32
+    rng = np.random.RandomState(0)
+    batches = [(mx.nd.array(rng.rand(batch, 20).astype(np.float32)),
+                mx.nd.array(rng.randint(0, 10, batch).astype(np.int64)))
+               for _ in range(8)]
+    ckdir = tempfile.mkdtemp(prefix="mxtpu_res_bench_")
+    try:
+        # warm the step jit outside every timed window
+        t = _fresh_trainer(0)
+        for i in range(3):
+            t.step(*batches[i % len(batches)])
+        t.flush()
+
+        # plain loop vs auto-checkpointing loop, identical step streams
+        t1 = _fresh_trainer(1)
+        t1.step(*batches[0])
+        t1.flush()
+        t0w = time.perf_counter()
+        for i in range(steps):
+            t1.step(*batches[i % len(batches)])
+        t1.flush()
+        dt_plain = time.perf_counter() - t0w
+
+        t2 = _fresh_trainer(1)
+        t2.step(*batches[0])
+        t2.flush()
+        t2.save_checkpoint(ckdir, epoch=0, nbatch=0)  # warm dir + pickling
+        t0w = time.perf_counter()
+        for i in range(steps):
+            t2.step(*batches[i % len(batches)])
+            if t2._step_count % cadence == 0:
+                t2.save_checkpoint(ckdir, epoch=0, nbatch=i)
+        t2.flush()
+        dt_ckpt = time.perf_counter() - t0w
+        # the cadence may not divide the loop; guarantee >= 1 snapshot so
+        # recovery below always has something to restore
+        last = t2.save_checkpoint(ckdir, epoch=0, nbatch=steps - 1)
+        overhead_pct = 100.0 * (dt_ckpt - dt_plain) / max(dt_plain, 1e-9)
+
+        # bitwise recovery proof: run A straight, run B crash+resume
+        n_total, n_crash = 16, 8
+        ta = _fresh_trainer(2)
+        for i in range(n_total):
+            ta.step(*batches[i % len(batches)])
+        ta.flush()
+        ref = _params_bytes(ta)
+
+        tb = _fresh_trainer(2)
+        for i in range(n_crash):
+            tb.step(*batches[i % len(batches)])
+        crash_dir = os.path.join(ckdir, "crash")
+        tb.save_checkpoint(crash_dir, epoch=0, nbatch=n_crash - 1)
+        del tb  # the "crash"
+
+        t0w = time.perf_counter()
+        tc = _fresh_trainer(3)   # wrong seed on purpose: restore must win
+        tc.restore_checkpoint(crash_dir)
+        tc.step(*batches[n_crash % len(batches)])
+        tc.flush()
+        recovery_s = time.perf_counter() - t0w
+        for i in range(n_crash + 1, n_total):
+            tc.step(*batches[i % len(batches)])
+        tc.flush()
+        bitwise_ok = _params_bytes(tc) == ref
+
+        print(json.dumps({
+            "resilience_checkpoint_overhead_pct": round(overhead_pct, 2),
+            "resilience_recovery_time_s": round(recovery_s, 3),
+            "resilience_bitwise_ok": bool(bitwise_ok),
+            "resilience_ckpt_bytes": os.path.getsize(last),
+            "resilience_ckpt_cadence": cadence,
+            "resilience_bench_steps": steps,
+        }))
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Atomic write-rename training snapshots.
+
+Reference: the stack's checkpoint story (``python/mxnet/model.py``
+``save_checkpoint``/``load_checkpoint`` epoch files) plus TensorFlow's
+treatment of checkpoint-based recovery as a first-class system property
+(arxiv 1605.08695 §4.2).  Two system guarantees the reference files do
+NOT give and this module does:
+
+- **atomicity**: a snapshot is written to ``<name>.tmp.<pid>`` and
+  ``os.replace``d into place — a crash (even SIGKILL) mid-save can only
+  leave a stray tmp file, never a torn checkpoint; the previous snapshot
+  stays loadable.  ``tests/test_resilience.py`` kills a saver mid-write
+  (chaos site ``checkpoint.save``) and asserts exactly this.
+- **self-describing completeness**: the payload carries params, optimizer
+  state, RNG state AND the iterator cursor (epoch/batch), so ``resume=``
+  replays to *bitwise-identical* post-crash convergence — not merely
+  "params restored".
+
+Format (version 1): one pickled dict — ``{"version", "step", "payload"}``
+where arrays are encoded as ``("nd", dtype_str, shape, raw_bytes)``
+tuples (``encode_array``), which round-trips bf16 and every other jax
+dtype exactly (numpy's npz cannot).  jax is imported nowhere here: the
+module stays host-only (usable by the bench's CPU subprocess and by
+tooling that inspects checkpoints without a backend).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import re
+
+import numpy as _np
+
+from . import chaos as _chaos
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
+           "list_checkpoints", "encode_array", "decode_array",
+           "CKPT_SUFFIX", "FORMAT_VERSION"]
+
+CKPT_SUFFIX = ".mxckpt"
+FORMAT_VERSION = 1
+_NAME_RE = re.compile(r"^ckpt-(\d+)" + re.escape(CKPT_SUFFIX) + r"$")
+
+
+def encode_array(x):
+    """Array -> ``("nd", dtype, shape, bytes)`` — exact for every dtype
+    numpy can name (bf16 included, via jax's ml_dtypes registration)."""
+    a = _np.asarray(x)
+    return ("nd", str(a.dtype), tuple(a.shape), a.tobytes())
+
+
+def decode_array(enc):
+    tag, dtype, shape, raw = enc
+    assert tag == "nd", enc
+    return _np.frombuffer(raw, dtype=_np.dtype(dtype)).reshape(shape)
+
+
+def _ckpt_path(directory, step):
+    return os.path.join(directory, "ckpt-%012d%s" % (int(step), CKPT_SUFFIX))
+
+
+def save_checkpoint(directory, payload, step, keep=3):
+    """Atomically write ``payload`` as the step-``step`` checkpoint.
+
+    The bytes are written to a tmp file, fsynced, then ``os.replace``d —
+    the checkpoint either exists completely or not at all.  After a
+    successful install, older checkpoints beyond ``keep`` (and stray tmp
+    files from crashed saves) are pruned.  Returns the final path."""
+    os.makedirs(directory, exist_ok=True)
+    final = _ckpt_path(directory, step)
+    tmp = final + ".tmp.%d" % os.getpid()
+    blob = pickle.dumps({"version": FORMAT_VERSION, "step": int(step),
+                         "payload": payload},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+    with open(tmp, "wb") as f:
+        # two-part write with a probe between: the chaos harness kills
+        # here to prove a torn save never shadows the previous snapshot
+        f.write(blob[:len(blob) // 2])
+        _chaos.maybe_inject("checkpoint.save")
+        f.write(blob[len(blob) // 2:])
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    _prune(directory, keep)
+    return final
+
+
+def _prune(directory, keep):
+    entries = list_checkpoints(directory)
+    for step, path in entries[:-int(keep)] if keep else []:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    for name in os.listdir(directory):
+        if ".tmp." in name and name.split(".tmp.")[0].endswith(CKPT_SUFFIX):
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
+def list_checkpoints(directory):
+    """[(step, path)] ascending by step; tmp/corrupt-named files ignored."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _NAME_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort()
+    return out
+
+
+def load_checkpoint(path):
+    """Load one checkpoint file -> ``{"version", "step", "payload"}``.
+    Raises on a torn/garbage file (callers fall back to an older one)."""
+    with open(path, "rb") as f:
+        rec = pickle.load(f)
+    if not isinstance(rec, dict) or rec.get("version") != FORMAT_VERSION:
+        raise ValueError("not a version-%d checkpoint: %r"
+                         % (FORMAT_VERSION, path))
+    return rec
+
+
+def latest_checkpoint(directory):
+    """Newest *loadable* checkpoint -> ``(path, record)`` or ``None``.
+    A torn newest file (crash between write and replace is impossible,
+    but disk corruption is not) falls back to the next-newest."""
+    for step, path in reversed(list_checkpoints(directory)):
+        try:
+            return path, load_checkpoint(path)
+        except Exception:
+            continue
+    return None

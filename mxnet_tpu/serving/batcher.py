@@ -157,6 +157,10 @@ class Batcher:
         return batch, False
 
     def _run_batch(self, batch):
+        from ..resilience import chaos as _chaos
+        # chaos probe: a scheduled delay here overloads the admission
+        # queue deterministically (the serving-overload failure mode)
+        _chaos.maybe_inject("serving.batch", ctx=batch)
         self.stats.on_dequeue(len(batch))
         n = len(batch)
         bucket = self.runner.bucket_for(n)
@@ -193,7 +197,10 @@ class Batcher:
     # -- lifecycle ---------------------------------------------------------
     def drain(self, timeout=60.0):
         """Graceful shutdown: stop admitting, finish every queued request,
-        join the worker.  Idempotent."""
+        join the worker.  Idempotent.  Raises ``TimeoutError`` when the
+        deadline passes with work still in flight — callers that must
+        stop anyway (``Server.drain``'s hard ``drain_timeout_s``) follow
+        up with :meth:`force_drain`."""
         with self._admit_lock:
             if not self._draining.is_set():
                 self._draining.set()
@@ -206,5 +213,28 @@ class Batcher:
             raise TimeoutError("batcher did not drain within %ss" % timeout)
         self._thread.join(timeout=5.0)
         return True
+
+    def force_drain(self):
+        """The hard half of the drain deadline: stop admitting, fail every
+        request still queued with :class:`Draining`, and mark the batcher
+        drained WITHOUT waiting for a wedged worker (a hung model call's
+        requests resolve if/when it returns; the daemon worker thread
+        dies with the process).  Idempotent; returns the number of
+        requests failed."""
+        with self._admit_lock:
+            self._draining.set()
+        failed = 0
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            if req is _SENTINEL:
+                continue
+            req.set_exception(Draining(
+                "server hit its drain deadline; request not served"))
+            failed += 1
+        self._drained.set()
+        return failed
 
     close = drain

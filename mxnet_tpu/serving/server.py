@@ -10,12 +10,22 @@ per-connection threads).  Contract:
 - ``429`` + ``Retry-After`` when the admission queue is full
   (backpressure, never an unbounded backlog), ``503`` while draining,
   ``400`` on malformed bodies, ``500`` on model errors.
-- ``GET /healthz`` — ``{"status": "ok"|"draining"}`` (200/503).
+- ``GET /healthz`` — readiness-gated summary:
+  ``{"status": "ok"|"warming"|"draining", "alive": true, "ready": bool}``
+  with 200 only when ready (warming buckets ⇒ ready=false, alive=true —
+  a fleet scheduler must not route to a server still compiling its
+  bucket ladder, but must not restart it either).
+- ``GET /livez`` — liveness alone: 200 while the process serves HTTP at
+  all (the restart signal); ``GET /readyz`` — readiness alone (the
+  routing signal).
 - ``GET /stats`` — the :meth:`ServingStats.as_dict` JSON: per-bucket
   p50/p99 latency, queue depth, batch-fill ratio, recompile count.
 - ``drain()`` — stop admissions, finish all in-flight requests, then
   stop the listener (graceful shutdown; wired to SIGTERM/SIGINT in
-  ``tools/serve.py``).
+  ``tools/serve.py``).  Honors a hard deadline (``drain_timeout_s``):
+  when in-flight work does not finish in time, queued requests are
+  failed with 503s and the listener stops anyway — a wedged model call
+  can no longer hold shutdown hostage.
 """
 from __future__ import annotations
 
@@ -65,10 +75,16 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv = self._srv
         if self.path == "/healthz":
-            if srv.draining:
-                self._reply(503, {"status": "draining"})
-            else:
-                self._reply(200, {"status": "ok"})
+            body = {"status": srv.status, "alive": True, "ready": srv.ready}
+            self._reply(200 if srv.ready else 503, body)
+        elif self.path == "/livez":
+            # liveness: answering at all IS the signal — never 503 here,
+            # or a fleet manager would restart a server that is merely
+            # warming/draining
+            self._reply(200, {"alive": True})
+        elif self.path == "/readyz":
+            self._reply(200 if srv.ready else 503,
+                        {"ready": srv.ready, "status": srv.status})
         elif self.path == "/stats":
             stats = srv.batcher.stats.as_dict()
             stats["recompiles"] = srv.runner.recompiles_since_warmup()
@@ -126,17 +142,20 @@ class Server:
 
     def __init__(self, runner, host="127.0.0.1", port=8080, max_batch=None,
                  batch_timeout_ms=2.0, max_queue=256,
-                 request_timeout_s=30.0, verbose=False):
+                 request_timeout_s=30.0, drain_timeout_s=60.0,
+                 verbose=False):
         self.runner = runner
         self.batcher = Batcher(runner, max_batch=max_batch,
                                batch_timeout_ms=batch_timeout_ms,
                                max_queue=max_queue)
         self.request_timeout_s = float(request_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
         self.verbose = verbose
         self._httpd = _HTTPServer((host, port), _Handler)
         self._httpd.serving = self
         self._thread = None
         self._drained = False
+        self.drain_forced = False
 
     @property
     def address(self):
@@ -146,6 +165,20 @@ class Server:
     @property
     def draining(self):
         return self.batcher.draining
+
+    @property
+    def ready(self):
+        """Readiness: warmed buckets and not draining.  A runner loaded
+        with ``warmup=False`` keeps the server alive-but-unready until
+        ``warmup()`` finishes — the liveness/readiness split."""
+        return (not self.batcher.draining
+                and bool(getattr(self.runner, "warmed_up", True)))
+
+    @property
+    def status(self):
+        if self.batcher.draining:
+            return "draining"
+        return "ok" if self.ready else "warming"
 
     def start(self):
         """Serve in a background thread; returns the bound (host, port)."""
@@ -160,10 +193,19 @@ class Server:
         """Foreground serve (the tools/serve.py path)."""
         self._httpd.serve_forever(poll_interval=0.1)
 
-    def drain(self, timeout=60.0):
-        """Graceful shutdown: new requests get 503, everything already
-        admitted completes, then the listener stops."""
-        self.batcher.drain(timeout=timeout)
+    def drain(self, timeout=None):
+        """Graceful shutdown with a hard deadline: new requests get 503
+        and everything already admitted completes — but only for
+        ``drain_timeout_s`` (or ``timeout``).  Past the deadline the
+        remaining queue is failed with 503s and the listener stops
+        anyway (``drain_forced`` records it): shutdown always finishes.
+        Returns True for a clean drain, False when forced."""
+        timeout = self.drain_timeout_s if timeout is None else float(timeout)
+        try:
+            self.batcher.drain(timeout=timeout)
+        except TimeoutError:
+            self.batcher.force_drain()
+            self.drain_forced = True
         if not self._drained:
             self._drained = True
             # shutdown() blocks until serve_forever exits; in-flight
@@ -174,6 +216,6 @@ class Server:
             if self._thread is not None:
                 self._thread.join(timeout=5.0)
             self._httpd.server_close()
-        return True
+        return not self.drain_forced
 
     stop = drain

@@ -1,0 +1,734 @@
+"""Fault-tolerant elastic training (ISSUE 6): deterministic chaos harness,
+auto-checkpoint/resume, heartbeat kvstore tier.
+
+The acceptance contracts under test:
+- chaos schedules are seeded-deterministic and replay exactly;
+- checkpoints are atomic under kill-during-save (the previous snapshot
+  survives a SIGKILL mid-write);
+- crash + resume converges *bitwise-identically* to the uncrashed run at
+  the same step count — in-process (trainer-level) and end-to-end (a
+  subprocess SIGKILLed mid-epoch by the chaos harness, then resumed);
+- a SIGKILLed pipeline worker costs nothing (exactly-once), but a
+  deterministic crasher trips ``PipelineWorkerStorm`` instead of
+  respawn-looping;
+- the PS heartbeat watchdog declares silent workers dead and reassigns
+  their keys; the bounded-staleness gate refuses lagging rejoiners
+  (deleting either mechanism fails these tests — the gate bites);
+- serving splits liveness from readiness and drain honors its deadline;
+- SRC005 flags unbounded blocking calls in while-loops and the shipped
+  worker loops are clean.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, kvstore_ps
+from mxnet_tpu.parallel import DataParallelTrainer
+from mxnet_tpu.resilience import (BackoffPolicy, ChaosSchedule, Fault,
+                                  RetriesExhausted, chaos,
+                                  checkpoint as ckpt, retry_call)
+from mxnet_tpu.resilience.heartbeat import HeartbeatMonitor
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leak():
+    yield
+    chaos.uninstall()
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # 1 local device is enough for children
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+# ---------------------------------------------------------------------------
+# backoff policy
+# ---------------------------------------------------------------------------
+def test_backoff_deterministic_bounded_and_growing():
+    a = BackoffPolicy(base_s=0.5, factor=2.0, max_delay_s=4.0,
+                      max_retries=6, jitter=0.25, seed=7)
+    b = BackoffPolicy(base_s=0.5, factor=2.0, max_delay_s=4.0,
+                      max_retries=6, jitter=0.25, seed=7)
+    da, db = a.delays(), b.delays()
+    assert da == db                       # seeded jitter replays exactly
+    for i, d in enumerate(da):
+        lo = min(0.5 * 2.0 ** i, 4.0) * 0.75
+        hi = min(0.5 * 2.0 ** i, 4.0) * 1.25
+        assert lo <= d <= hi
+    # different seed, different jitter stream
+    c = BackoffPolicy(base_s=0.5, factor=2.0, max_delay_s=4.0,
+                      max_retries=6, jitter=0.25, seed=8)
+    assert c.delays() != da
+    # no jitter: exact exponential, capped
+    p = BackoffPolicy(base_s=1.0, factor=3.0, max_delay_s=5.0,
+                      max_retries=4, jitter=0.0)
+    assert p.delays() == [1.0, 3.0, 5.0, 5.0]
+
+
+def test_retry_call_succeeds_then_exhausts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("nope")
+        return "ok"
+
+    pol = BackoffPolicy(base_s=0.001, max_retries=5, jitter=0.0)
+    assert retry_call(flaky, policy=pol) == "ok"
+    assert len(calls) == 3
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(RetriesExhausted):
+        retry_call(always, policy=BackoffPolicy(base_s=0.001, max_retries=2,
+                                                jitter=0.0))
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+def test_chaos_schedule_seeded_deterministic():
+    s1 = ChaosSchedule.seeded(11, ["a", "b"], n_faults=5, max_at=20)
+    s2 = ChaosSchedule.seeded(11, ["a", "b"], n_faults=5, max_at=20)
+    assert s1.specs() == s2.specs()
+    assert s1.specs() != ChaosSchedule.seeded(12, ["a", "b"],
+                                              n_faults=5, max_at=20).specs()
+
+
+def test_chaos_raise_delay_and_counts():
+    chaos.install([Fault("rpc", 3, "raise"),
+                   Fault("rpc", 5, "delay", 0.05)])
+    chaos.maybe_inject("rpc")
+    chaos.maybe_inject("rpc")
+    with pytest.raises(chaos.ChaosError):
+        chaos.maybe_inject("rpc")          # hit 3
+    chaos.maybe_inject("rpc")              # hit 4: clean
+    t0 = time.perf_counter()
+    chaos.maybe_inject("rpc")              # hit 5: stalled
+    assert time.perf_counter() - t0 >= 0.04
+    assert [t[:2] for t in chaos.triggered()] == [("rpc", 3), ("rpc", 5)]
+    chaos.uninstall()
+    chaos.maybe_inject("rpc")              # inactive: free no-op
+
+
+def test_chaos_env_spec_parses():
+    os.environ["MXTPU_CHAOS"] = "trainer.step:7:kill,rpc:2:delay:0.1"
+    try:
+        sched = chaos.install_from_env()
+        assert sched.specs()[0][:3] == ("trainer.step", 7, "kill")
+        assert sched.specs()[1] == ("rpc", 2, "delay", 0.1)
+    finally:
+        del os.environ["MXTPU_CHAOS"]
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint atomicity
+# ---------------------------------------------------------------------------
+def test_checkpoint_roundtrip_prune_and_latest(tmp_path):
+    d = str(tmp_path)
+    for step in (1, 2, 3, 4):
+        ckpt.save_checkpoint(
+            d, {"w": ckpt.encode_array(np.full(3, step, np.float32))},
+            step=step, keep=2)
+    steps = [s for s, _ in ckpt.list_checkpoints(d)]
+    assert steps == [3, 4]                 # pruned to keep=2
+    path, rec = ckpt.latest_checkpoint(d)
+    assert rec["step"] == 4
+    np.testing.assert_array_equal(ckpt.decode_array(rec["payload"]["w"]),
+                                  np.full(3, 4, np.float32))
+    # bf16 survives the byte round-trip exactly
+    import jax.numpy as jnp
+    x = jnp.arange(5, dtype=jnp.bfloat16) / 3
+    back = ckpt.decode_array(ckpt.encode_array(x))
+    assert str(back.dtype) == "bfloat16"
+    assert np.asarray(x).tobytes() == back.tobytes()
+
+
+def test_checkpoint_kill_during_save_keeps_previous(tmp_path):
+    """SIGKILL mid-save (chaos site checkpoint.save): the torn snapshot
+    must never appear; the previous one stays the loadable latest."""
+    d = str(tmp_path)
+    script = (
+        "import sys, numpy as np\n"
+        "from mxnet_tpu.resilience import checkpoint as ck, chaos\n"
+        "d = sys.argv[1]\n"
+        "ck.save_checkpoint(d, {'w': ck.encode_array(np.arange(4.))},"
+        " step=1)\n"
+        "print('SAVED1', flush=True)\n"
+        "chaos.install([chaos.Fault('checkpoint.save', 1, 'kill')])\n"
+        "ck.save_checkpoint(d, {'w': ck.encode_array(np.zeros(4))},"
+        " step=2)\n"
+        "print('UNREACHABLE', flush=True)\n")
+    out = subprocess.run([sys.executable, "-c", script, d], env=_cpu_env(),
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == -signal.SIGKILL, (out.returncode, out.stderr)
+    assert "SAVED1" in out.stdout and "UNREACHABLE" not in out.stdout
+    path, rec = ckpt.latest_checkpoint(d)
+    assert rec["step"] == 1                # step-2 never materialized
+    np.testing.assert_array_equal(ckpt.decode_array(rec["payload"]["w"]),
+                                  np.arange(4.0))
+    # the crashed save's tmp debris is pruned by the next good save
+    ckpt.save_checkpoint(d, {"w": ckpt.encode_array(np.ones(2))}, step=3)
+    assert not [n for n in os.listdir(d) if ".tmp." in n]
+
+
+# ---------------------------------------------------------------------------
+# trainer checkpoint/resume — bitwise identity
+# ---------------------------------------------------------------------------
+def _mlp_trainer(seed):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    return DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9})
+
+
+def _params_blob(tr):
+    return b"".join(np.asarray(p.data()._data).tobytes()
+                    for _, p in sorted(tr._params_by_name.items()))
+
+
+def _batches(n, batch=8, feat=12, seed=42):
+    rng = np.random.RandomState(seed)
+    return [(mx.nd.array(rng.rand(batch, feat).astype(np.float32)),
+             mx.nd.array(rng.randint(0, 4, batch).astype(np.int64)))
+            for _ in range(n)]
+
+
+def test_trainer_resume_bitwise_identical(tmp_path):
+    data = _batches(8)
+    ref = _mlp_trainer(5)
+    for x, y in data:
+        ref.step(x, y)
+    ref.flush()
+    blob_ref = _params_blob(ref)
+
+    crash = _mlp_trainer(5)
+    for x, y in data[:4]:
+        crash.step(x, y)
+    crash.save_checkpoint(str(tmp_path), epoch=0, nbatch=3)
+
+    cont = _mlp_trainer(99)     # wrong seed on purpose: restore must win
+    cursor = cont.restore_checkpoint(str(tmp_path))
+    assert cursor["step"] == 4 and cursor["nbatch"] == 3
+    for x, y in data[4:]:
+        cont.step(x, y)
+    cont.flush()
+    assert _params_blob(cont) == blob_ref
+    # optimizer momentum state restored too (not just params)
+    import jax
+    sref = jax.tree_util.tree_leaves(ref._states_raw)
+    scon = jax.tree_util.tree_leaves(cont._states_raw)
+    assert len(sref) == len(scon)
+    for a, b in zip(sref, scon):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_fit_auto_checkpoint_and_resume(tmp_path):
+    rng = np.random.RandomState(3)
+    X = rng.rand(48, 12).astype(np.float32)
+    Y = rng.randint(0, 4, 48).astype(np.int64)
+
+    def make_iter():
+        return mx.io.NDArrayIter(X, Y, batch_size=8)
+
+    ref = _mlp_trainer(21)
+    ref.fit(make_iter(), num_epoch=2, bulk_size=4)
+    blob_ref = _params_blob(ref)
+
+    # "crash" after epoch 0 (checkpoints were written), then resume in a
+    # fresh trainer: epoch 1 replays to the identical end state
+    part = _mlp_trainer(21)
+    part.fit(make_iter(), num_epoch=1, bulk_size=4,
+             checkpoint_dir=str(tmp_path), checkpoint_every=2)
+    assert ckpt.list_checkpoints(str(tmp_path))
+
+    cont = _mlp_trainer(77)
+    cont.fit(make_iter(), num_epoch=2, bulk_size=4,
+             checkpoint_dir=str(tmp_path), checkpoint_every=2, resume=True)
+    assert cont._step_count == 12
+    assert _params_blob(cont) == blob_ref
+
+
+_CRASH_SCRIPT = """
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.parallel import DataParallelTrainer
+from mxnet_tpu.resilience import chaos
+chaos.install_from_env()
+ckdir, outpath = sys.argv[1], sys.argv[2]
+mx.random.seed(5); np.random.seed(5)
+rng = np.random.RandomState(42)
+X = rng.rand(48, 16).astype(np.float32)
+Y = rng.randint(0, 4, 48).astype(np.int64)
+it = mx.io.NDArrayIter(X, Y, batch_size=8)
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(32, activation='relu'))
+net.add(gluon.nn.Dense(4))
+net.initialize(mx.init.Xavier())
+tr = DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+                         {'learning_rate': 0.1, 'momentum': 0.9})
+tr.fit(it, num_epoch=3, bulk_size=4, checkpoint_dir=ckdir,
+       checkpoint_every=2, resume=True)
+blob = b''.join(np.asarray(p.data()._data).tobytes()
+                for _, p in sorted(tr._params_by_name.items()))
+with open(outpath, 'wb') as f:
+    f.write(blob)
+print('DONE', tr._step_count, flush=True)
+"""
+
+
+def test_sigkill_mid_epoch_resume_end_to_end(tmp_path):
+    """The headline acceptance test: SIGKILL the training process
+    mid-epoch (chaos, step 8 of 18), resume from the auto-checkpoint in
+    a fresh process, and final params are bitwise-identical to the
+    fault-free run at the same step count."""
+    env = _cpu_env()
+    ref_out = str(tmp_path / "ref.bin")
+    out = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT, str(tmp_path / "ref_ck"),
+         ref_out], env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "DONE 18" in out.stdout
+
+    crash_env = dict(env, MXTPU_CHAOS="trainer.step:8:kill")
+    res_out = str(tmp_path / "res.bin")
+    out = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT, str(tmp_path / "ck"),
+         res_out], env=crash_env, capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == -signal.SIGKILL, (out.returncode,
+                                               out.stderr[-2000:])
+    assert ckpt.list_checkpoints(str(tmp_path / "ck"))
+    assert not os.path.exists(res_out)
+
+    out = subprocess.run(
+        [sys.executable, "-c", _CRASH_SCRIPT, str(tmp_path / "ck"),
+         res_out], env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "DONE 18" in out.stdout
+    with open(ref_out, "rb") as f:
+        ref = f.read()
+    with open(res_out, "rb") as f:
+        res = f.read()
+    assert ref == res
+
+
+# ---------------------------------------------------------------------------
+# pipeline chaos: worker kill (exactly-once) and worker storm
+# ---------------------------------------------------------------------------
+def _pipeline_deps():
+    pytest.importorskip("cv2")
+    from mxnet_tpu.io.pipeline import pipeline_available
+    if not pipeline_available():
+        pytest.skip("no multiprocessing shared memory")
+
+
+def _make_rec(tmp_path, n=32, size=32):
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "p.rec")
+    idx = str(tmp_path / "p.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+    return rec, idx
+
+
+_PIPE_KW = dict(batch_size=4, data_shape=(3, 28, 28), native_decode=False)
+
+
+def test_chaos_kills_pipeline_worker_exactly_once(tmp_path):
+    """A chaos-scheduled SIGKILL of a pipeline worker at dispatch #3:
+    the stream is still complete and in order (exactly-once), and the
+    respawn shows up in the stats."""
+    _pipeline_deps()
+    from mxnet_tpu.io.pipeline import ImagePipelineIter
+    rec, idx = _make_rec(tmp_path)
+
+    it0 = ImagePipelineIter(num_workers=0, seed=2, shuffle=False,
+                            path_imgrec=rec, path_imgidx=idx, **_PIPE_KW)
+    ref = [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it0]
+
+    chaos.install([Fault("pipeline.dispatch", 3, "call",
+                         lambda ctx: ctx[0]._procs[ctx[1]].kill())])
+    it = ImagePipelineIter(num_workers=2, seed=2, shuffle=False,
+                           path_imgrec=rec, path_imgidx=idx, **_PIPE_KW)
+    try:
+        got = [(b.data[0].asnumpy(), b.label[0].asnumpy()) for b in it]
+        assert chaos.triggered()           # the kill really happened
+        assert len(got) == len(ref)
+        for (d0, l0), (d1, l1) in zip(ref, got):
+            assert np.array_equal(d0, d1) and np.array_equal(l0, l1)
+        assert it.stats.snapshot()["respawns"] >= 1
+    finally:
+        it.close()
+        chaos.uninstall()
+
+
+def test_pipeline_worker_storm_raises(tmp_path):
+    """A deterministic crasher must trip PipelineWorkerStorm after
+    max_respawns deaths in one epoch, not respawn-loop forever."""
+    _pipeline_deps()
+    from mxnet_tpu.io.pipeline import ImagePipelineIter, PipelineWorkerStorm
+    rec, idx = _make_rec(tmp_path)
+    it = ImagePipelineIter(num_workers=1, max_respawns=1, seed=1,
+                           shuffle=False, path_imgrec=rec, path_imgidx=idx,
+                           **_PIPE_KW)
+    try:
+        with pytest.raises(PipelineWorkerStorm) as err:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                p = it._procs[0]
+                if p is not None and p.is_alive():
+                    p.kill()
+                    p.join(1.0)
+                it.next()
+        assert "max_respawns=1" in str(err.value)
+        assert it.stats.snapshot()["respawns_epoch"] >= 2
+    finally:
+        it.close()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat watchdog + elastic PS tier
+# ---------------------------------------------------------------------------
+def test_heartbeat_monitor_detects_silence_and_rejoin():
+    dead = []
+    mon = HeartbeatMonitor(timeout_s=0.2, on_dead=dead.append)
+    mon.beat(0, step=5)
+    mon.beat(1, step=9)
+    assert mon.max_step() == 9
+    t_end = time.monotonic() + 1.0
+    while time.monotonic() < t_end and not mon.dead():
+        mon.beat(0)                        # rank 0 keeps beating
+        mon.check()
+        time.sleep(0.05)
+    assert mon.dead() == {1} and dead == [1]
+    mon.beat(1)                            # rejoin clears death
+    assert mon.dead() == set()
+
+
+def test_ps_watchdog_reassigns_dead_worker_keys():
+    """Kill a worker's heartbeat: the server watchdog must declare it
+    dead, report it via num_dead, and move its keys to a live rank.
+    (Deleting the watchdog makes this hang at num_dead==0 — the gate
+    bites.)"""
+    server = kvstore_ps.PSServer(port=0, num_workers=2,
+                                 heartbeat_timeout_s=0.6,
+                                 watchdog_poll_s=0.1)
+    a = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+    b = kvstore_ps.PSClient("127.0.0.1", server.port, rank=1)
+    try:
+        a.start_heartbeat(0.1)
+        b.start_heartbeat(0.1)
+        a.init_array("wa", np.ones(4, np.float32))
+        b.init_array("wb", np.full(4, 2.0, np.float32))
+        assert server.key_owner("wa") == 0
+        assert server.key_owner("wb") == 1
+        assert a.request("key_owner", "wb")[1] == 1
+
+        # silence rank 1 (its process "died"); rank 0 keeps beating
+        b._hb.stop()
+        b._hb = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if a.request("num_dead")[1] >= 1:
+                break
+            time.sleep(0.1)
+        assert a.request("num_dead")[1] >= 1
+        assert server.key_owner("wb") == 0     # reassigned to the live rank
+        assert server._reassignments == [("wb", 1, 0)]
+        # the store itself survived: rank 0 can still pull the value
+        np.testing.assert_array_equal(a.pull_array("wb"),
+                                      np.full(4, 2.0, np.float32))
+
+        # rejoin: a fresh client for rank 1 beats again -> alive, but
+        # ownership stays where the reassignment put it (single writer)
+        b2 = kvstore_ps.PSClient("127.0.0.1", server.port, rank=1)
+        b2.request("heartbeat", 1, 0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and a.request("num_dead")[1]:
+            time.sleep(0.1)
+        assert a.request("num_dead")[1] == 0
+        assert server.key_owner("wb") == 0
+        b2.close()
+    finally:
+        a.close()
+        b.close()
+        server.stop()
+
+
+def test_ps_bounded_staleness_gate_bites():
+    """A push lagging the fleet beyond max_staleness is refused with
+    StaleWorkerError; within the bound it lands.  Without the bound the
+    same lag is silently accepted (the unguarded baseline), proving the
+    gate is what does the refusing."""
+    server = kvstore_ps.PSServer(port=0, num_workers=2, max_staleness=2)
+    a = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+    b = kvstore_ps.PSClient("127.0.0.1", server.port, rank=1)
+    try:
+        a.init_array("w", np.zeros(4, np.float32))
+        a.push_array("w", np.ones(4, np.float32), step=10)
+        with pytest.raises(kvstore_ps.StaleWorkerError) as err:
+            b.push_array("w", np.full(4, 9.0, np.float32), step=3)
+        assert err.value.max_step == 10
+        # the refused push did NOT land
+        np.testing.assert_array_equal(a.pull_array("w"),
+                                      np.ones(4, np.float32))
+        # catching up (within the bound) is accepted
+        b.push_array("w", np.full(4, 5.0, np.float32), step=9)
+        np.testing.assert_array_equal(a.pull_array("w"),
+                                      np.full(4, 5.0, np.float32))
+    finally:
+        a.close()
+        b.close()
+        server.stop()
+
+    # no bound -> the same stale push is accepted (baseline)
+    server2 = kvstore_ps.PSServer(port=0, num_workers=2)
+    c = kvstore_ps.PSClient("127.0.0.1", server2.port, rank=0)
+    try:
+        c.init_array("w", np.zeros(4, np.float32))
+        c.push_array("w", np.ones(4, np.float32), step=10)
+        c.push_array("w", np.full(4, 9.0, np.float32), step=3)
+        np.testing.assert_array_equal(c.pull_array("w"),
+                                      np.full(4, 9.0, np.float32))
+    finally:
+        c.close()
+        server2.stop()
+
+
+def test_ps_client_reconnects_with_backoff():
+    """A broken socket mid-conversation is redialed (with the shared
+    backoff policy) and the request retried — PS restarts are blips."""
+    server = kvstore_ps.PSServer(port=0, num_workers=1)
+    cli = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+    try:
+        cli.init_array("k", np.arange(4, dtype=np.float32))
+        cli._sock.close()                  # simulate a dropped connection
+        np.testing.assert_array_equal(cli.pull_array("k"),
+                                      np.arange(4, dtype=np.float32))
+        assert cli.reconnects >= 1
+    finally:
+        cli.close()
+        server.stop()
+
+
+def test_chaos_drops_and_delays_kvstore_rpc():
+    """The chaos harness can drop (raise) and delay kvstore RPCs at the
+    probe site — the 'dropped push' failure mode, reproducible."""
+    server = kvstore_ps.PSServer(port=0, num_workers=1)
+    cli = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+    try:
+        cli.init_array("k", np.zeros(2, np.float32))
+        chaos.install([Fault("kvstore.request", 2, "raise")])
+        cli.push_array("k", np.ones(2, np.float32))      # hit 1: clean
+        with pytest.raises(chaos.ChaosError):
+            cli.push_array("k", np.full(2, 7.0, np.float32))  # hit 2 drops
+        # the dropped push never reached the server
+        np.testing.assert_array_equal(cli.pull_array("k"),
+                                      np.ones(2, np.float32))
+    finally:
+        chaos.uninstall()
+        cli.close()
+        server.stop()
+
+
+@pytest.mark.slow
+def test_ps_elastic_worker_death_and_rejoin_multiprocess(tmp_path):
+    """Dist-marker elasticity case: real worker processes push with
+    heartbeats; one is SIGKILLed, the watchdog reassigns its key, and a
+    respawned worker rejoins and keeps pushing under the staleness
+    bound."""
+    server = kvstore_ps.PSServer(port=0, num_workers=2,
+                                 heartbeat_timeout_s=1.0,
+                                 watchdog_poll_s=0.2, max_staleness=1000)
+    worker_src = (
+        "import sys, time, numpy as np\n"
+        "from mxnet_tpu import kvstore_ps\n"
+        "port, rank = int(sys.argv[1]), int(sys.argv[2])\n"
+        "cli = kvstore_ps.PSClient('127.0.0.1', port, rank=rank)\n"
+        "step = 0\n"
+        "cli.start_heartbeat(0.2, step_fn=lambda: step)\n"
+        "cli.init_array('w%d' % rank, np.zeros(4, np.float32))\n"
+        "print('READY', flush=True)\n"
+        "while True:\n"
+        "    step += 1\n"
+        "    cli.push_array('w%d' % rank, np.full(4, step, np.float32),"
+        " step=step)\n"
+        "    time.sleep(0.1)\n")
+    env = _cpu_env()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", worker_src, str(server.port), str(r)],
+        env=env, stdout=subprocess.PIPE, text=True) for r in (0, 1)]
+    try:
+        for p in procs:
+            assert p.stdout.readline().strip() == "READY"
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and \
+                server.key_owner("w1") is None:
+            time.sleep(0.1)
+        assert server.key_owner("w1") == 1
+        procs[1].kill()                    # SIGKILL worker 1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and server.key_owner("w1") != 0:
+            time.sleep(0.1)
+        assert server.key_owner("w1") == 0  # reassigned to live rank 0
+        assert server.monitor.dead() == {1}
+        # rejoin: respawn rank 1; it must come back alive and push again
+        procs[1] = subprocess.Popen(
+            [sys.executable, "-c", worker_src, str(server.port), "1"],
+            env=env, stdout=subprocess.PIPE, text=True)
+        assert procs[1].stdout.readline().strip() == "READY"
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and server.monitor.dead():
+            time.sleep(0.1)
+        assert server.monitor.dead() == set()
+    finally:
+        for p in procs:
+            p.kill()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# serving: liveness vs readiness, drain deadline
+# ---------------------------------------------------------------------------
+def _runner(warmup=True):
+    from mxnet_tpu.serving import ModelRunner
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return ModelRunner(net, buckets=(1, 4), example_shape=(6,),
+                       warmup=warmup)
+
+
+def _get(port, path):
+    import http.client
+    import json
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    return resp.status, body
+
+
+def test_serving_liveness_vs_readiness():
+    from mxnet_tpu.serving import Server
+    runner = _runner(warmup=False)
+    server = Server(runner, port=0)
+    _, port = server.start()
+    try:
+        # warming: alive but NOT ready
+        status, body = _get(port, "/healthz")
+        assert status == 503
+        assert body == {"status": "warming", "alive": True, "ready": False}
+        assert _get(port, "/livez") == (200, {"alive": True})
+        assert _get(port, "/readyz")[0] == 503
+
+        runner.warmup()
+        status, body = _get(port, "/healthz")
+        assert status == 200 and body["status"] == "ok" and body["ready"]
+        assert _get(port, "/readyz") == (200, {"ready": True,
+                                               "status": "ok"})
+    finally:
+        assert server.drain()
+    # draining/stopped: batcher reports draining; livez semantics held
+    assert server.status == "draining" and not server.ready
+
+
+def test_serving_drain_honors_hard_deadline():
+    from mxnet_tpu.serving import Batcher, Draining, Server
+    import threading
+    runner = _runner()
+    release = threading.Event()
+    real = runner.forward_batch
+    runner.forward_batch = lambda x: (release.wait(30), real(x))[1]
+    server = Server(runner, port=0, batch_timeout_ms=0.0,
+                    drain_timeout_s=0.5)
+    server.start()
+    try:
+        stuck = server.batcher.submit(np.zeros(6))    # wedges the worker
+        time.sleep(0.2)                               # let it enter forward
+        queued = server.batcher.submit(np.zeros(6))   # sits in the queue
+        t0 = time.monotonic()
+        clean = server.drain()
+        assert time.monotonic() - t0 < 5.0            # did NOT wait 30s
+        assert clean is False and server.drain_forced
+        with pytest.raises(Draining):
+            queued.result(1.0)                        # failed, not leaked
+    finally:
+        release.set()
+    stuck.result(10.0)                                # in-flight completes
+
+
+# ---------------------------------------------------------------------------
+# SRC005 lint
+# ---------------------------------------------------------------------------
+@pytest.mark.analysis
+def test_src005_unbounded_blocking_call():
+    from mxnet_tpu.analysis import lint_source
+    bad = "while True:\n    msg = q.get()\n"
+    found = lint_source(bad)
+    assert [f.rule_id for f in found] == ["SRC005"]
+    # timeout, positional args, for-loops and str.join stay clean
+    ok = ("while True:\n"
+          "    a = q.get(timeout=1.0)\n"
+          "    b = sock.recv(4096)\n"
+          "for t in threads:\n"
+          "    t.join()\n"
+          "s = ' '.join(parts)\n")
+    assert lint_source(ok) == []
+    # inline suppression works
+    sup = "while True:\n    x = q.get()  # mxlint: disable=SRC005\n"
+    assert lint_source(sup) == []
+
+
+@pytest.mark.analysis
+def test_src005_sweep_of_shipped_worker_loops_is_clean():
+    from mxnet_tpu.analysis import lint_worker_loops
+    assert lint_worker_loops() == []
+
+
+# ---------------------------------------------------------------------------
+# bench stage
+# ---------------------------------------------------------------------------
+def test_resilience_bench_stage_reports_recovery_and_overhead():
+    env = _cpu_env()
+    env["MXTPU_RES_BENCH_STEPS"] = "40"    # keep the tier-1 box fast
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.resilience.bench"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    import json
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["resilience_bitwise_ok"] is True
+    assert rec["resilience_recovery_time_s"] > 0
+    assert "resilience_checkpoint_overhead_pct" in rec
+    assert rec["resilience_ckpt_bytes"] > 0

@@ -17,15 +17,34 @@ collectives).  This launcher reproduces the reference CLI:
   command line, cwd preserved, same coordinator address everywhere.
 - ``--launcher echo`` only prints the per-rank environment (real pods:
   GKE/metadata provides the same variables).
+- ``--restart-failed N`` makes the launch *elastic*: a rank that exits
+  non-zero is relaunched (same rank id, same env — the worker redials
+  the coordinator/PS and rejoins) up to N times, with delays from the
+  shared ``resilience.backoff`` policy so a correlated crash doesn't
+  thundering-herd the coordinator.
 """
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import os
 import shlex
 import socket
 import subprocess
 import sys
+import time
+
+
+def _load_backoff():
+    """The shared BackoffPolicy, loaded by file path so the launcher
+    (which must stay jax-free — it forks workers) never imports the
+    mxnet_tpu package."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "mxnet_tpu", "resilience", "backoff.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_backoff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def free_port():
@@ -154,6 +173,11 @@ def main():
                              "probed locally, or a high random port is "
                              "picked when rank 0 runs on a remote host "
                              "(where no probe is possible)")
+    parser.add_argument("--restart-failed", type=int, default=0,
+                        help="elastic restarts: relaunch a rank that "
+                             "exits non-zero up to N times (same rank "
+                             "id/env, exponential backoff with jitter); "
+                             "0 = fail fast (default)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra K=V forwarded to every worker "
                              "(reference launch.py --env)")
@@ -197,8 +221,7 @@ def main():
                              " ".join(args.command)))
         return
 
-    procs = []
-    for rank in range(args.num_workers):
+    def spawn(rank):
         renv = worker_env(coordinator, args.num_workers, rank, ps_port)
         renv.update(extra)
         if args.launcher == "ssh":
@@ -210,15 +233,40 @@ def main():
                     renv[k] = os.environ[k]
             cmd = ssh_command(hosts[rank % len(hosts)], renv,
                               args.command, os.getcwd())
-            procs.append(subprocess.Popen(cmd))
-        else:
-            env = dict(os.environ)
-            env.update(renv)
-            procs.append(subprocess.Popen(args.command, env=env))
+            return subprocess.Popen(cmd)
+        env = dict(os.environ)
+        env.update(renv)
+        return subprocess.Popen(args.command, env=env)
+
+    running = {rank: spawn(rank) for rank in range(args.num_workers)}
+    budgets = [args.restart_failed] * args.num_workers
+    attempts = [0] * args.num_workers
+    policy = _load_backoff().BackoffPolicy(
+        base_s=1.0, factor=2.0, max_delay_s=30.0,
+        max_retries=max(args.restart_failed, 1), jitter=0.25)
     rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
+    # bounded poll loop (not a bare wait): crashed ranks are noticed and
+    # — with --restart-failed — relaunched while the rest keep running,
+    # which is what lets the elastic PS tier exercise worker rejoin
+    while running:
+        time.sleep(0.2)
+        for rank, p in list(running.items()):
+            r = p.poll()
+            if r is None:
+                continue
+            del running[rank]
+            if r != 0 and budgets[rank] > 0:
+                budgets[rank] -= 1
+                delay = policy.delay(attempts[rank])
+                attempts[rank] += 1
+                print("launch: rank %d exited rc=%d; restarting in %.1fs "
+                      "(%d restarts left)" % (rank, r, delay,
+                                              budgets[rank]),
+                      file=sys.stderr)
+                time.sleep(delay)
+                running[rank] = spawn(rank)
+            else:
+                rc = rc or r
     sys.exit(rc)
 
 
