@@ -192,27 +192,33 @@ class KVStore:
 
     def _ps_push(self, k, merged):
         """Async push: ships the gradient to the PS, which applies it
-        immediately — no cross-worker rendezvous of any kind."""
+        immediately — no cross-worker rendezvous of any kind.  EVERY
+        wire form (dense, rsp, 2bit) carries the worker's step so the
+        bounded-staleness gate sees compressed/sparse pushes too."""
         import numpy as _np
         from .ndarray.sparse import RowSparseNDArray
         from . import kvstore_ps
+        self._push_step += 1
         if isinstance(merged, RowSparseNDArray):
             payload = (_np.asarray(merged.indices.asnumpy(), _np.int64),
                        _np.asarray(merged.data.asnumpy(), _np.float32),
                        tuple(merged.shape))
-            self._ps_client.request("push", k, "rsp", payload)
-            return
-        if self._compression is not None:
+            send = lambda: self._ps_client.request(
+                "push", k, "rsp", payload, self._push_step)
+        elif self._compression is not None:
+            # compress once: error feedback mutates the residuals, so a
+            # staleness retry re-sends the same packed payload
             q = self._compress(k, merged)
             thr = self._compression["threshold"]
             packed, shape = kvstore_ps.pack_2bit(q.asnumpy(), thr)
-            self._ps_client.request("push", k, "2bit",
-                                    (packed, shape, thr))
-            return
-        self._push_step += 1
-        arr = _np.asarray(merged.asnumpy(), _np.float32)
+            send = lambda: self._ps_client.request(
+                "push", k, "2bit", (packed, shape, thr), self._push_step)
+        else:
+            arr = _np.asarray(merged.asnumpy(), _np.float32)
+            send = lambda: self._ps_client.push_array(
+                k, arr, step=self._push_step)
         try:
-            self._ps_client.push_array(k, arr, step=self._push_step)
+            send()
         except kvstore_ps.StaleWorkerError as e:
             # bounded-staleness rejoin: this worker lagged the fleet past
             # the bound (it was dead/partitioned) — pull fresh state,
@@ -224,7 +230,7 @@ class KVStore:
             fresh = self._ps_client.pull_array(k)
             self._store[k]._set_data(_jnp.asarray(fresh))
             self._push_step = e.max_step
-            self._ps_client.push_array(k, arr, step=self._push_step)
+            send()
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_value(key, out, allow_list_values=True)

@@ -261,16 +261,21 @@ class PSServer:
                 conn.close()
             except OSError:
                 pass
+        # _key_owner is read and written under _live_lock everywhere
+        # (serve threads setdefault on init): an unlocked iteration here
+        # can see the dict resize mid-scan and raise inside the watchdog
         with self._live_lock:
             live = sorted(self._live_ranks)
-        owned = sorted(k for k, r in self._key_owner.items() if r == rank)
-        for i, key in enumerate(owned):
-            new = live[i % len(live)] if live else None
-            self._key_owner[key] = new
-            self._reassignments.append((key, rank, new))
+            owned = sorted(k for k, r in self._key_owner.items()
+                           if r == rank)
+            for i, key in enumerate(owned):
+                new = live[i % len(live)] if live else None
+                self._key_owner[key] = new
+                self._reassignments.append((key, rank, new))
 
     def key_owner(self, key):
-        return self._key_owner.get(key)
+        with self._live_lock:
+            return self._key_owner.get(key)
 
     def _handle(self, msg, ctx=None):
         ctx = ctx if ctx is not None else {
@@ -284,7 +289,8 @@ class PSServer:
                 # the winner OWNS the key (single-writer discipline)
                 if key not in self._store:
                     self._store[key] = np.array(arr, np.float32)
-                    self._key_owner.setdefault(key, ctx.get("rank"))
+                    with self._live_lock:
+                        self._key_owner.setdefault(key, ctx.get("rank"))
             return ("ok",)
         if cmd == "heartbeat":
             rank = msg[1]
@@ -295,7 +301,7 @@ class PSServer:
             return ("ok", self.monitor.max_step(),
                     len(self.monitor.dead() | self._dead_ranks))
         if cmd == "key_owner":
-            return ("ok", self._key_owner.get(msg[1]))
+            return ("ok", self.key_owner(msg[1]))
         if cmd == "init_meta":
             # chunked init: claim the key (first caller wins); the array
             # is NOT visible until the owner's last chunk installs it
@@ -322,6 +328,14 @@ class PSServer:
             _, key, shape, start, stop, payload, last = msg
             buf = ctx["staging"].get(("init", key))
             if buf is None:
+                if start > 0:
+                    # staging is per-connection: a mid-transfer reconnect
+                    # lands here with the prefix lost — installing would
+                    # silently zero-fill it.  Refuse; the client restarts
+                    # the whole transfer from chunk 0.
+                    return ("err", "init_chunk for %r has no staged "
+                            "prefix (connection restarted mid-transfer)"
+                            % (key,))
                 buf = ctx["staging"][("init", key)] = np.zeros(
                     int(np.prod(shape)), np.float32)
             buf[start:stop] = payload
@@ -332,7 +346,8 @@ class PSServer:
                 with self._pending_cv:
                     if key not in self._store:
                         self._store[key] = arr
-                        self._key_owner.setdefault(key, ctx.get("rank"))
+                        with self._live_lock:
+                            self._key_owner.setdefault(key, ctx.get("rank"))
                     self._pending_init.discard(key)
                     ctx["claimed_inits"].discard(key)
                     self._pending_cv.notify_all()
@@ -442,6 +457,13 @@ class PSServer:
                     return ("err", "key %r not initialized" % (key,))
             buf = ctx["staging"].get(key)
             if buf is None:
+                if start > 0:
+                    # see init_chunk: a reconnect mid-push lost the staged
+                    # prefix; applying the tail over zeros would corrupt
+                    # the gradient silently.  Refuse instead.
+                    return ("err", "push_chunk for %r has no staged "
+                            "prefix (connection restarted mid-transfer)"
+                            % (key,))
                 buf = ctx["staging"][key] = np.zeros(
                     int(np.prod(shape)), np.float32)
             buf[start:stop] = payload
@@ -507,7 +529,21 @@ class PSClient:
     the shared ``resilience.backoff`` policy — exponential with jitter,
     so a fleet that lost the same server does not redial in lockstep.
     Pushes retried across a reconnect are at-least-once (the reference's
-    async push has the same property)."""
+    async push has the same property).  Only commands in
+    ``_RETRY_SAFE`` are retried — notably NOT ``barrier``: a reply lost
+    after the server counted the arrival would be counted twice on
+    retry, advancing the barrier generation before every worker
+    actually arrived."""
+
+    # commands safe to auto-retry across a reconnect: idempotent, or
+    # at-least-once-acceptable (pushes).  Anything else raises on a
+    # broken socket so the caller decides.
+    _RETRY_SAFE = frozenset({
+        "hello", "heartbeat", "init", "init_meta", "init_chunk",
+        "wait_init", "push", "push_chunk", "pull", "pull_meta",
+        "pull_chunk", "row_sparse_pull", "key_owner", "num_dead",
+        "set_optimizer",
+    })
 
     def __init__(self, host, port, timeout=120, connect_retry_s=60,
                  rank=None, retry_policy=None):
@@ -546,22 +582,53 @@ class PSClient:
             self._hb = HeartbeatSender(beat, interval_s).start()
         return self._hb
 
+    def _chunked_transfer(self, size, send_chunk):
+        """Drive ``send_chunk(start, stop)`` across ``size`` elements.
+
+        Chunk staging is per-connection server state, so a reconnect
+        anywhere in the loop orphans the already-sent prefix — the new
+        connection stages from scratch and the server would zero-fill
+        the lost chunks.  Detect the reconnect (``self.reconnects``
+        moved, or the server refused an orphaned tail) and restart the
+        WHOLE transfer from chunk 0.  Re-sending a full transfer is
+        at-least-once — the same property a retried unchunked push
+        already has."""
+        from .base import MXNetError
+        while True:
+            epoch = self.reconnects
+            restart = False
+            for start in range(0, size, BIGARRAY_BOUND):
+                stop = min(start + BIGARRAY_BOUND, size)
+                try:
+                    send_chunk(start, stop)
+                except MXNetError:
+                    if self.reconnects == epoch:
+                        raise
+                    restart = True
+                    break
+                if self.reconnects != epoch:
+                    restart = True
+                    break
+            if not restart:
+                return
+
     def push_array(self, key, arr, step=None):
         """Dense push, chunked above BIGARRAY_BOUND elements
         (EncodeDefaultKey analogue — bounds per-message pickle size).
         ``step`` (the worker's training step) feeds the server's
         bounded-staleness gate; a refused push raises
-        :class:`StaleWorkerError`."""
+        :class:`StaleWorkerError`.  A reconnect mid-chunk-loop restarts
+        the whole transfer (see :meth:`_chunked_transfer`) so a PS blip
+        never applies a gradient with a zero-filled prefix."""
         if arr.size <= BIGARRAY_BOUND:
             if step is None:
                 return self.request("push", key, "dense", arr)
             return self.request("push", key, "dense", arr, int(step))
         flat = arr.reshape(-1)
-        for start in range(0, arr.size, BIGARRAY_BOUND):
-            stop = min(start + BIGARRAY_BOUND, arr.size)
-            self.request("push_chunk", key, tuple(arr.shape), start, stop,
-                         flat[start:stop], stop == arr.size,
-                         None if step is None else int(step))
+        self._chunked_transfer(arr.size, lambda start, stop: self.request(
+            "push_chunk", key, tuple(arr.shape), start, stop,
+            flat[start:stop], stop == arr.size,
+            None if step is None else int(step)))
         return ("ok",)
 
     def init_array(self, key, arr):
@@ -569,41 +636,75 @@ class PSClient:
 
         A loser of the init_meta race does not just walk away: the winner
         may die mid-chunks (its claim is then released server-side), so
-        losers wait for the install and re-contend if it never landed."""
+        losers wait for the install and re-contend if it never landed.
+        A reconnect mid-chunk-loop orphans our own staged prefix AND our
+        claim (both per-connection) — restart at the init_meta
+        contention; the dying connection releases the claim server-side."""
         if arr.size <= BIGARRAY_BOUND:
             return self.request("init", key, arr)
+        from .base import MXNetError
+        flat = arr.reshape(-1)
         while True:
             reply = self.request("init_meta", key, tuple(arr.shape))
             fresh, installed = reply[1], reply[2]
-            if fresh:
-                break
             if installed:
                 return ("ok",)
-            # an init is in flight elsewhere: block until it installs or
-            # the owner's death releases the claim, then re-contend
-            _, installed = self.request("wait_init", key)
-            if installed:
+            if not fresh:
+                # an init is in flight elsewhere: block until it installs
+                # or the owner's death releases the claim, then re-contend
+                _, installed = self.request("wait_init", key)
+                if installed:
+                    return ("ok",)
+                continue
+            epoch = self.reconnects
+            restart = False
+            for start in range(0, arr.size, BIGARRAY_BOUND):
+                stop = min(start + BIGARRAY_BOUND, arr.size)
+                try:
+                    self.request("init_chunk", key, tuple(arr.shape),
+                                 start, stop, flat[start:stop],
+                                 stop == arr.size)
+                except MXNetError:
+                    if self.reconnects == epoch:
+                        raise
+                    restart = True
+                    break
+                if self.reconnects != epoch:
+                    restart = True
+                    break
+            if not restart:
                 return ("ok",)
-        flat = arr.reshape(-1)
-        for start in range(0, arr.size, BIGARRAY_BOUND):
-            stop = min(start + BIGARRAY_BOUND, arr.size)
-            self.request("init_chunk", key, tuple(arr.shape), start, stop,
-                         flat[start:stop], stop == arr.size)
-        return ("ok",)
 
     def pull_array(self, key):
         """Dense pull, chunked above BIGARRAY_BOUND elements.  Small
-        arrays come back inline with the meta — one round trip."""
-        _, shape, size, arr = self.request("pull_meta", key,
-                                           BIGARRAY_BOUND)
-        if arr is not None:
-            return arr
-        import numpy as _np
-        out = _np.empty(size, _np.float32)
-        for start in range(0, size, BIGARRAY_BOUND):
-            stop = min(start + BIGARRAY_BOUND, size)
-            out[start:stop] = self.request("pull_chunk", key, start, stop)[1]
-        return out.reshape(shape)
+        arrays come back inline with the meta — one round trip.  The
+        chunk snapshot is per-connection server state, so a reconnect
+        mid-loop restarts the pull (meta included, taking a fresh
+        snapshot) instead of returning a torn or zero-filled array."""
+        from .base import MXNetError
+        while True:
+            _, shape, size, arr = self.request("pull_meta", key,
+                                               BIGARRAY_BOUND)
+            if arr is not None:
+                return arr
+            epoch = self.reconnects
+            out = np.empty(size, np.float32)
+            restart = False
+            for start in range(0, size, BIGARRAY_BOUND):
+                stop = min(start + BIGARRAY_BOUND, size)
+                try:
+                    out[start:stop] = self.request("pull_chunk", key,
+                                                   start, stop)[1]
+                except MXNetError:
+                    if self.reconnects == epoch:
+                        raise
+                    restart = True
+                    break
+                if self.reconnects != epoch:
+                    restart = True
+                    break
+            if not restart:
+                return out.reshape(shape)
 
     def _reconnect(self):
         """Redial + re-hello under the held request lock (the hello must
@@ -635,7 +736,8 @@ class PSClient:
                             "parameter server closed the connection")
                     break
                 except (OSError, ConnectionError):
-                    if attempt >= self._retry.max_retries:
+                    if msg[0] not in self._RETRY_SAFE or \
+                            attempt >= self._retry.max_retries:
                         raise
                     time.sleep(self._retry.delay(attempt))
                     attempt += 1

@@ -20,6 +20,7 @@ discipline the SRC005 lint enforces on every worker loop in this repo.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -101,7 +102,14 @@ class HeartbeatMonitor:
 
     def _watch(self):
         while not self._stop.wait(self.poll_s):
-            self.check()
+            try:
+                self.check()
+            except Exception:
+                # an on_dead callback error must not kill the watchdog:
+                # with this thread gone, dead-rank detection (and key
+                # reassignment) silently stops for the rest of the run
+                logging.getLogger(__name__).exception(
+                    "heartbeat watchdog scan failed; continuing")
 
     def stop(self):
         self._stop.set()

@@ -539,6 +539,127 @@ def test_ps_client_reconnects_with_backoff():
         server.stop()
 
 
+def test_ps_chunked_push_restarts_after_reconnect(monkeypatch):
+    """A reconnect mid-chunked-push must NOT corrupt the gradient: chunk
+    staging is per-connection, so naively retrying just the broken chunk
+    applies a gradient whose lost prefix is all zeros.  The client
+    restarts the whole transfer; the value that lands is complete."""
+    monkeypatch.setattr(kvstore_ps, "BIGARRAY_BOUND", 4)
+    server = kvstore_ps.PSServer(port=0, num_workers=1)
+    cli = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+    try:
+        value = np.arange(1, 11, dtype=np.float32)       # 3 chunks of <=4
+        cli.init_array("k", np.zeros(10, np.float32))
+        orig, calls = cli.request, {"push_chunk": 0}
+        def flaky(*msg):
+            if msg[0] == "push_chunk":
+                calls["push_chunk"] += 1
+                if calls["push_chunk"] == 2:
+                    cli._sock.close()      # connection dies before chunk 2
+            return orig(*msg)
+        cli.request = flaky
+        cli.push_array("k", value)
+        assert cli.reconnects == 1
+        assert calls["push_chunk"] > 3     # the transfer restarted
+        # the landed value has NO zero-filled prefix
+        np.testing.assert_array_equal(cli.pull_array("k"), value)
+    finally:
+        cli.close()
+        server.stop()
+
+
+def test_ps_server_refuses_orphaned_push_chunk_tail():
+    """Backstop behind the client restart: a push_chunk with start > 0
+    on a connection with no staged prefix (fresh post-reconnect
+    connection) is refused, never zero-filled."""
+    server = kvstore_ps.PSServer(port=0, num_workers=1)
+    try:
+        server._handle(("init", "k", np.zeros(8, np.float32)))
+        ctx = {"staging": {}, "snapshots": {}, "claimed_inits": set(),
+               "rank": 0}
+        reply = server._handle(
+            ("push_chunk", "k", (8,), 4, 8, np.ones(4, np.float32), True,
+             None), ctx)
+        assert reply[0] == "err" and "staged prefix" in reply[1]
+        np.testing.assert_array_equal(server._store["k"],
+                                      np.zeros(8, np.float32))
+    finally:
+        server.stop()
+
+
+def test_ps_barrier_is_not_retried_across_reconnect():
+    """barrier is not idempotent (a retry after a lost reply would be
+    counted twice, releasing the barrier early) — a broken socket makes
+    it raise instead of silently resending.  Retry-safe commands still
+    heal the connection afterwards."""
+    server = kvstore_ps.PSServer(port=0, num_workers=2)
+    cli = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+    try:
+        cli._sock.close()
+        with pytest.raises((OSError, ConnectionError)):
+            cli.request("barrier")
+        assert cli.reconnects == 0         # no transparent resend
+        assert server._barrier_count == 0  # and no double-count
+        assert cli.request("num_dead")[0] == "ok"
+        assert cli.reconnects == 1
+    finally:
+        cli.close()
+        server.stop()
+
+
+def test_watchdog_survives_on_dead_callback_error():
+    """An exception in the on_dead callback must not kill the watchdog
+    thread — detection keeps running for later deaths."""
+    deaths = []
+    def bad_cb(rank):
+        deaths.append(rank)
+        raise RuntimeError("callback boom")
+    mon = HeartbeatMonitor(timeout_s=0.2, poll_s=0.05, on_dead=bad_cb)
+    mon.start()
+    try:
+        mon.beat(0)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not deaths:
+            time.sleep(0.05)
+        assert deaths == [0]
+        mon.beat(0)                        # rejoin, then go silent again
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(deaths) < 2:
+            time.sleep(0.05)
+        assert deaths == [0, 0]            # the watchdog is still alive
+    finally:
+        mon.stop()
+
+
+def test_ps_2bit_push_carries_step_through_staleness_gate():
+    """With gradient compression on, pushes still carry the worker step:
+    a lagging compressed push trips the staleness gate, recovers (pull +
+    fast-forward) and re-sends — instead of mixing in unchecked."""
+    from mxnet_tpu import kvstore as kv_mod
+    server = kvstore_ps.PSServer(port=0, num_workers=2, max_staleness=2)
+    fleet = kvstore_ps.PSClient("127.0.0.1", server.port, rank=0)
+    lag = kvstore_ps.PSClient("127.0.0.1", server.port, rank=1)
+    try:
+        kv = kv_mod.KVStore("local")
+        kv._ps_client = lag
+        kv._push_step = 0
+        kv.set_gradient_compression({"threshold": 0.5})
+        kv.init("w", mx.nd.zeros((4,)))
+        fleet.push_array("w", np.ones(4, np.float32), step=10)
+        kv.push("w", mx.nd.array(np.full(4, 2.0, np.float32)))
+        # the gate bit (step 1 vs fleet 10 > bound 2) and recovery
+        # fast-forwarded the step clock to the fleet's
+        assert kv._push_step == 10
+        assert server.monitor.step_of(1) == 10
+        # the re-sent quantized payload landed: +threshold everywhere
+        np.testing.assert_array_equal(lag.pull_array("w"),
+                                      np.full(4, 0.5, np.float32))
+    finally:
+        fleet.close()
+        lag.close()
+        server.stop()
+
+
 def test_chaos_drops_and_delays_kvstore_rpc():
     """The chaos harness can drop (raise) and delay kvstore RPCs at the
     probe site — the 'dropped push' failure mode, reproducible."""

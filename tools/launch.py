@@ -247,9 +247,17 @@ def main():
     rc = 0
     # bounded poll loop (not a bare wait): crashed ranks are noticed and
     # — with --restart-failed — relaunched while the rest keep running,
-    # which is what lets the elastic PS tier exercise worker rejoin
-    while running:
+    # which is what lets the elastic PS tier exercise worker rejoin.
+    # Backoff is a per-rank respawn DEADLINE, not an inline sleep: a
+    # correlated multi-rank crash must not serialize restarts or stall
+    # polling of the ranks still running.
+    respawn_at = {}                    # rank -> monotonic deadline
+    while running or respawn_at:
         time.sleep(0.2)
+        now = time.monotonic()
+        for rank in [r for r, t in respawn_at.items() if now >= t]:
+            del respawn_at[rank]
+            running[rank] = spawn(rank)
         for rank, p in list(running.items()):
             r = p.poll()
             if r is None:
@@ -263,8 +271,7 @@ def main():
                       "(%d restarts left)" % (rank, r, delay,
                                               budgets[rank]),
                       file=sys.stderr)
-                time.sleep(delay)
-                running[rank] = spawn(rank)
+                respawn_at[rank] = now + delay
             else:
                 rc = rc or r
     sys.exit(rc)
