@@ -352,7 +352,7 @@ def _run_benches(rec):
     # the default cadence) stay live when the TPU is down — resilience
     # numbers would be worthless if a dead backend could starve them
     if os.environ.get("MXTPU_BENCH_RESILIENCE", "1") == "1":
-        rec.stage("resilience", 90, _resilience_bench)
+        rec.stage("resilience", 150, _resilience_bench)
 
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
@@ -575,8 +575,11 @@ def _resilience_bench():
     harness (mxnet_tpu/resilience/bench.py): an MLP trainer is stepped
     with and without auto-checkpointing at the default cadence, then
     crash-resumed from the snapshot, asserting bitwise-identical params.
-    JAX_PLATFORMS=cpu subprocess — same isolation contract as the
-    serving/pipeline/cost/overlap stages."""
+    The same stage reports the PS server durability tier:
+    server_recovery_time_s (snapshot load + WAL replay of a crashed
+    PSServer's state dir), wal_replay_rate_keys_per_s and the
+    snapshot/WAL overhead split.  JAX_PLATFORMS=cpu subprocess — same
+    isolation contract as the serving/pipeline/cost/overlap stages."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)

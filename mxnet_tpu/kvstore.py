@@ -79,15 +79,20 @@ class KVStore:
             self._start_ps()
 
     def _start_ps(self):
-        """dist_async rides a host-side parameter server on rank 0 — async
-        per-push application is what a collective cannot express
-        (reference: kvstore_dist_server.h:285).  The elastic tier rides
-        along: worker heartbeats feed the server watchdog (dead-worker
-        key reassignment) and pushes carry a per-store step so
+        """dist_async rides a host-side parameter server — async per-push
+        application is what a collective cannot express (reference:
+        kvstore_dist_server.h:285).  The server is either a dedicated
+        ``DMLC_ROLE=server`` rank (``DMLC_NUM_SERVER`` > 0 — spawned by
+        ``tools/launch.py --num-servers``, crash-recoverable through its
+        state dir) or an embedded thread on rank 0.  The elastic tier
+        rides along: worker heartbeats feed the server watchdog
+        (dead-worker key reassignment), pushes carry a per-store step so
         ``MXTPU_MAX_STALENESS`` can bound how stale a rejoining worker's
-        gradients may be (docs/resilience.md)."""
+        gradients may be, and ``MXTPU_PS_STATE_DIR`` arms snapshot+WAL
+        durability for the embedded server too (docs/resilience.md)."""
         import os
         from . import kvstore_ps
+        from .kvstore_server import _durability_env
         host = os.environ.get("JAX_COORDINATOR_ADDRESS",
                               "127.0.0.1:0").split(":")[0]
         port = int(os.environ.get("MXTPU_PS_PORT", "0"))
@@ -99,11 +104,16 @@ class KVStore:
         hb_timeout = float(os.environ.get("MXTPU_HEARTBEAT_TIMEOUT_S",
                                           str(hb_interval * 5)))
         staleness = os.environ.get("MXTPU_MAX_STALENESS")
-        if self._rank == 0:
+        num_servers = int(os.environ.get("DMLC_NUM_SERVER", "0"))
+        if self._rank == 0 and num_servers == 0:
+            # no dedicated server rank: rank 0 hosts the PS in-process
+            state_dir, snapshot_every, keep = _durability_env()
             self._ps_server = kvstore_ps.PSServer(
                 port=port, num_workers=self._num_workers,
                 heartbeat_timeout_s=hb_timeout if hb_interval > 0 else None,
-                max_staleness=int(staleness) if staleness else None)
+                max_staleness=int(staleness) if staleness else None,
+                state_dir=state_dir, snapshot_every=snapshot_every,
+                snapshot_keep=keep)
         self._ps_client = kvstore_ps.PSClient(host, port, rank=self._rank)
         self._push_step = 0
         if hb_interval > 0:

@@ -34,6 +34,25 @@ Elasticity tier (``mxnet_tpu.resilience``, docs/resilience.md):
 - **retry/backoff**: ``PSClient.request`` reconnects and retries on a
   broken socket using the shared ``resilience.backoff`` policy
   (exponential with jitter), so a PS restart is a blip, not a crash.
+
+Durability tier (PR 7 — the server was the last SPOF):
+
+- **snapshots + WAL**: with ``state_dir`` set (``MXTPU_PS_STATE_DIR``),
+  the server persists periodic atomic snapshots of its key/values +
+  updater state (every ``snapshot_every`` applied pushes,
+  ``MXTPU_PS_SNAPSHOT_EVERY``) and an append-only write-ahead log of
+  every mutation in between (``resilience.server_state``).  A respawned
+  server recovers to the exact pre-crash state by snapshot + WAL replay.
+- **exactly-once pushes**: applied pushes are keyed ``(rank,
+  push_step)`` per key; a replayed WAL record or a client re-sending the
+  push the crash left unacked is deduplicated against the recovered
+  high-water mark.  A *new* client incarnation (a respawned worker whose
+  step clock restarts) announces itself in the hello, which resets its
+  dedup stream — only retries of the same stream are dropped.
+- **generation**: every recovery-armed server start bumps a persistent
+  generation number, carried in the hello reply.  Clients detect a
+  failover (vs a TCP blip) and restart in-flight chunked transfers from
+  chunk 0 — the server's staged per-connection prefix died with it.
 """
 from __future__ import annotations
 
@@ -42,12 +61,15 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
 from .resilience import backoff as _backoff
 from .resilience import chaos as _chaos
+from .resilience import checkpoint as _ckpt
 from .resilience.heartbeat import HeartbeatMonitor, HeartbeatSender
+from .resilience.server_state import ServerStateStore
 
 __all__ = ["PSServer", "PSClient", "StaleWorkerError", "pack_2bit",
            "unpack_2bit"]
@@ -133,6 +155,41 @@ BIGARRAY_BOUND = int(__import__("os").environ.get(
 # through one pickle blob)
 
 
+def _state_refs(s):
+    """Walk an updater state tree (None / tuple / NDArray / numpy) and
+    grab the underlying buffers.  NDArray wrappers are mutated in place
+    by later updates; the jax arrays underneath are not — holding them
+    is a consistent point-in-time capture."""
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_state_refs(x) for x in s)
+    return getattr(s, "_data", s)
+
+
+def _refs_to_np(s):
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_refs_to_np(x) for x in s)
+    return np.asarray(s)
+
+
+def _encode_snapshot(raw):
+    """Captured refs -> the durable snapshot payload (runs OFF the apply
+    path): encode stored arrays, convert state buffers to numpy and
+    pickle them in ``Updater.set_states``'s wire format."""
+    payload = {k: v for k, v in raw.items()
+               if k not in ("store_refs", "state_refs")}
+    payload["store"] = {k: _ckpt.encode_array(v)
+                        for k, v in raw["store_refs"].items()}
+    refs = raw["state_refs"]
+    payload["updater_states"] = None if refs is None else pickle.dumps(
+        {k: _refs_to_np(v) for k, v in refs.items()},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    return payload
+
+
 class PSServer:
     """Host-side async parameter server (runs as a thread on rank 0).
 
@@ -141,10 +198,17 @@ class PSServer:
     ``max_staleness`` (steps) arms the bounded-staleness gate on pushes
     that carry a worker step.  Both default off so plain stores behave
     exactly as before; ``kvstore.create("dist_async")`` arms them from
-    ``MXTPU_HEARTBEAT_TIMEOUT_S`` / ``MXTPU_MAX_STALENESS``."""
+    ``MXTPU_HEARTBEAT_TIMEOUT_S`` / ``MXTPU_MAX_STALENESS``.
+
+    ``state_dir`` arms crash recovery: snapshots every ``snapshot_every``
+    applied pushes + a write-ahead log between them (see the module
+    docstring); construction RECOVERS from that directory first (before
+    the listening socket binds, so no client ever sees half-replayed
+    state) and bumps the persistent ``generation``."""
 
     def __init__(self, port=0, num_workers=1, heartbeat_timeout_s=None,
-                 max_staleness=None, watchdog_poll_s=None):
+                 max_staleness=None, watchdog_poll_s=None, state_dir=None,
+                 snapshot_every=None, snapshot_keep=3):
         self._store = {}
         self._locks = {}
         self._updater = None
@@ -155,6 +219,7 @@ class PSServer:
         # kvstore.h:339 get_num_dead_node over ps-lite heartbeats)
         self._live_ranks = {}
         self._dead_ranks = set()
+        self._conns = set()       # every accepted socket, closed at stop()
         self._live_lock = threading.Lock()
         # elasticity: key -> owning rank (single-writer discipline; the
         # init winner owns), plus a reassignment log for observability
@@ -173,8 +238,38 @@ class PSServer:
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
+        # durability: every store mutation happens under _state_lock (an
+        # RLock: a push-triggered snapshot re-enters) so a snapshot never
+        # sees a torn store; _applied is the per-(rank, key) push_step
+        # high-water mark the exactly-once dedup checks against, and
+        # _incarnations tells a retry of the same client stream (dedup)
+        # from a respawned worker whose step clock restarted (reset)
+        self._state_lock = threading.RLock()
+        self._state = None
+        self._optimizer_blob = None
+        self._applied = {}              # rank -> {key: last push_step}
+        self._incarnations = {}         # rank -> client incarnation token
+        self._wal_seq = 0
+        self._pushes_since_snap = 0
+        self._replaying = False
+        self._snap_thread = None
+        self.generation = 0
+        self.recovered_wal_records = 0
+        self.recovery_replay_s = 0.0
+        self._snapshot_every = int(snapshot_every) if snapshot_every else None
+        if state_dir:
+            self._state = ServerStateStore(state_dir, keep=snapshot_keep)
+            self.generation = self._state.bump_generation()
+            self._recover()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # REUSEPORT (inherited by accepted conns) lets a RESPAWNED server
+        # bind the same port while a predecessor's half-closed sockets
+        # linger in FIN_WAIT — surviving clients hold their end open
+        # across the failover, and their redial must not wait out
+        # tcp_fin_timeout
+        if hasattr(socket, "SO_REUSEPORT"):
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
         self._sock.bind(("0.0.0.0", port))
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
@@ -189,6 +284,8 @@ class PSServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._live_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -213,9 +310,14 @@ class PSServer:
                         self._dead_ranks.discard(msg[1])
                     # a hello is also a beat: a rejoining dead rank is
                     # resurrected, and the reply carries the fleet's max
-                    # step so the client can gauge its staleness
+                    # step (staleness gauge) plus the server generation
+                    # (failover detector — bumps on every recovered
+                    # restart, so clients restart per-connection state)
                     self.monitor.beat(msg[1])
-                    _send(conn, ("ok", self.monitor.max_step()))
+                    if len(msg) > 2 and msg[2] is not None:
+                        self._note_incarnation(msg[1], msg[2])
+                    _send(conn, ("ok", self.monitor.max_step(),
+                                 self.generation))
                     continue
                 reply = self._handle(msg, ctx)
                 _send(conn, reply)
@@ -236,6 +338,8 @@ class PSServer:
                     self._pending_init.difference_update(
                         ctx["claimed_inits"])
                     self._pending_cv.notify_all()
+            with self._live_lock:
+                self._conns.discard(conn)
             conn.close()
 
     def _await_init(self, key, timeout=60):
@@ -277,6 +381,225 @@ class PSServer:
         with self._live_lock:
             return self._key_owner.get(key)
 
+    # -- durability: recovery, WAL, snapshots ------------------------------
+    def _recover(self):
+        """Snapshot + WAL replay, run before the socket binds.  Restores
+        the store, the server-side updater (optimizer + per-key states),
+        key ownership, fleet step clocks and the exactly-once dedup map
+        to the exact pre-crash state."""
+        t0 = time.monotonic()
+        payload, records = self._state.recover()
+        if payload is not None:
+            self._store = {k: _ckpt.decode_array(v).copy()
+                           for k, v in payload["store"].items()}
+            with self._live_lock:
+                self._key_owner.update(payload.get("key_owner", {}))
+            self._applied = {r: dict(m)
+                             for r, m in payload.get("applied", {}).items()}
+            self._incarnations = dict(payload.get("incarnations", {}))
+            for rank, step in payload.get("steps", {}).items():
+                self.monitor.note_step(rank, step)
+            blob = payload.get("optimizer_blob")
+            if blob is not None:
+                self._install_optimizer(blob)
+                states = payload.get("updater_states")
+                if states is not None:
+                    self._updater.set_states(states)
+            self._wal_seq = int(payload.get("seq", 0))
+        self._replaying = True
+        try:
+            for seq, record in records:
+                self._replay_record(record)
+                self._wal_seq = max(self._wal_seq, int(seq))
+        finally:
+            self._replaying = False
+        self.recovered_wal_records = len(records)
+        self.recovery_replay_s = time.monotonic() - t0
+
+    def _replay_record(self, record):
+        """Apply one WAL record.  Idempotent: a push record at or below
+        the (rank, key) high-water mark is a no-op, an init of an
+        existing key keeps the first copy, set_optimizer overwrites —
+        replaying a record twice leaves the same state as once."""
+        kind = record[0]
+        if kind == "init":
+            _, rank, key, arr = record
+            with self._state_lock:
+                if key not in self._store:
+                    self._store[key] = np.array(arr, np.float32)
+                    with self._live_lock:
+                        self._key_owner.setdefault(key, rank)
+        elif kind == "set_optimizer":
+            with self._state_lock:
+                self._install_optimizer(record[1])
+        elif kind == "incarnation":
+            self._note_incarnation(record[1], record[2])
+        elif kind == "push":
+            _, rank, step, key, grad = record
+            if rank is not None and step is not None:
+                # the live handler advances the fleet step clock before
+                # applying; replay must too, or the recovered staleness
+                # gate would reference a stale max_step
+                self.monitor.note_step(rank, step)
+            self._apply_and_log(rank, step, key, grad)
+
+    def _install_optimizer(self, blob):
+        from . import optimizer as opt_mod
+        self._optimizer_blob = blob
+        self._updater = opt_mod.get_updater(pickle.loads(blob))
+
+    def _wal_append(self, record):
+        """Log a mutation (caller holds ``_state_lock``); no-op without a
+        state dir or during replay (the record is already on disk)."""
+        if self._state is None or self._replaying:
+            return
+        self._wal_seq += 1
+        self._state.wal_append(self._wal_seq, record)
+
+    def _note_incarnation(self, rank, incarnation):
+        """A hello carries the client's incarnation token.  A NEW token
+        means a respawned worker whose push_step clock restarted — its
+        dedup stream resets (and the change is WAL'd so the reset
+        survives a server crash too).  The SAME token (a redial of the
+        surviving client) keeps the stream: its in-flight re-push after
+        our failover dedups against the recovered high-water mark."""
+        with self._state_lock:
+            if self._incarnations.get(rank) == incarnation:
+                return
+            self._incarnations[rank] = incarnation
+            self._applied.pop(rank, None)
+            self._wal_append(("incarnation", rank, incarnation))
+
+    def _apply_and_log(self, rank, step, key, grad):
+        """The one write path every push (live, chunked-final, replayed)
+        funnels through: exactly-once dedup -> chaos probe -> apply ->
+        WAL -> maybe snapshot, all under the key + state locks."""
+        with self._key_lock(key):
+            with self._state_lock:
+                if self._store.get(key) is None:
+                    return ("err", "key %r not initialized" % (key,))
+                if self._state is not None and step is not None and \
+                        rank is not None:
+                    # exactly-once is the DURABLE tier's contract (the
+                    # kvstore client's push_step is monotonic per rank):
+                    # an at-or-below step is a WAL-replay duplicate or
+                    # the client re-sending the push a crash left
+                    # unacked.  Plain servers keep PR-6's at-least-once.
+                    last = self._applied.get(rank, {}).get(key)
+                    if last is not None and int(step) <= last:
+                        return ("ok",)
+                _chaos.maybe_inject("kvstore.server_apply",
+                                    ctx=(rank, step, key))
+                self._apply_push(key, grad)
+                if step is not None and rank is not None:
+                    self._applied.setdefault(rank, {})[key] = int(step)
+                self._wal_append((
+                    "push", rank, None if step is None else int(step), key,
+                    grad))
+                if self._state is not None and not self._replaying:
+                    self._pushes_since_snap += 1
+                    if self._snapshot_every and \
+                            self._pushes_since_snap >= self._snapshot_every:
+                        self._snapshot_async_locked()
+        return ("ok",)
+
+    def _apply_push(self, key, grad):
+        """Apply one decoded gradient to the stored weight (caller holds
+        the key lock): run the updater when set, else overwrite."""
+        stored = self._store[key]
+        if self._updater is not None:
+            # applied immediately — the async server never waits
+            # for other workers (kvstore_dist_server.h:285)
+            from .ndarray import NDArray
+            import jax.numpy as jnp
+            w = NDArray(jnp.asarray(stored))
+            g = self._as_nd(grad)
+            self._updater(key, g, w)
+            self._store[key] = np.asarray(w._data)
+        else:
+            g = grad if not isinstance(grad, tuple) else None
+            if g is None:
+                idx, vals, shape = grad[1]
+                dense = np.zeros(shape, np.float32)
+                np.add.at(dense, idx.astype(np.int64), vals)
+                g = dense
+            self._store[key] = np.asarray(g, np.float32)
+
+    def save_snapshot(self):
+        """Write one atomic snapshot now (and rotate the WAL); returns
+        the snapshot path, or None when recovery is not armed.
+        Synchronous: any in-flight background snapshot is joined first."""
+        if self._state is None:
+            return None
+        self._join_snapshot_thread()
+        with self._state_lock:
+            raw, seq = self._capture_snapshot_locked()
+            self._pushes_since_snap = 0
+        return self._state.save_snapshot(_encode_snapshot(raw), seq)
+
+    def _capture_snapshot_locked(self):
+        """Grab a consistent snapshot of the server state under
+        ``_state_lock`` as *references*, not copies: stored arrays are
+        replace-only (every apply binds a fresh array) and the updater's
+        per-key state tensors bottom out in immutable jax buffers — so a
+        dict copy + a ref walk is enough, and the expensive half
+        (numpy conversion, pickling, fsync, rename) runs OFF the apply
+        path on the captured refs.  Only the live optimizer object must
+        be pickled here: its update counters mutate in place."""
+        _chaos.maybe_inject("kvstore.snapshot")
+        with self._live_lock:
+            owner = dict(self._key_owner)
+        if self._updater is not None:
+            # the LIVE optimizer (not the set_optimizer blob): schedulers
+            # key off per-index update counts, which must survive too
+            opt_blob = pickle.dumps(self._updater.optimizer,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+            state_refs = {k: _state_refs(v)
+                          for k, v in self._updater.states.items()}
+        else:
+            opt_blob, state_refs = self._optimizer_blob, None
+        raw = {
+            "store_refs": dict(self._store),
+            "key_owner": owner,
+            "applied": {r: dict(m) for r, m in self._applied.items()},
+            "incarnations": dict(self._incarnations),
+            "steps": self.monitor.steps(),
+            "optimizer_blob": opt_blob,
+            "state_refs": state_refs,
+            "seq": self._wal_seq,
+            "generation": self.generation,
+        }
+        return raw, self._wal_seq
+
+    def _snapshot_async_locked(self):
+        """Cadence-triggered snapshot: capture now (caller holds the
+        state lock), encode + write on a daemon thread so the push that
+        tripped the cadence doesn't pay the disk.  Pushes applied while
+        the write runs land in the old WAL segment with seqs PAST the
+        snapshot's — recovery replays by seq, not by file, so the chain
+        stays exact.  A still-running previous write coalesces (skip)."""
+        if self._snap_thread is not None and self._snap_thread.is_alive():
+            return
+        raw, seq = self._capture_snapshot_locked()
+        self._pushes_since_snap = 0
+        self._snap_thread = threading.Thread(
+            target=self._write_snapshot, args=(raw, seq),
+            name="mxtpu-ps-snapshot", daemon=True)
+        self._snap_thread.start()
+
+    def _write_snapshot(self, raw, seq):
+        try:
+            self._state.save_snapshot(_encode_snapshot(raw), seq)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "PS snapshot write failed; the WAL still covers state")
+
+    def _join_snapshot_thread(self):
+        t = self._snap_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=60)
+
     def _handle(self, msg, ctx=None):
         ctx = ctx if ctx is not None else {
             "staging": {}, "snapshots": {}, "claimed_inits": set(),
@@ -287,11 +610,17 @@ class PSServer:
             with self._key_lock(key):
                 # first init wins (reference: server keeps the first copy);
                 # the winner OWNS the key (single-writer discipline)
-                if key not in self._store:
-                    self._store[key] = np.array(arr, np.float32)
-                    with self._live_lock:
-                        self._key_owner.setdefault(key, ctx.get("rank"))
+                with self._state_lock:
+                    if key not in self._store:
+                        value = np.array(arr, np.float32)
+                        self._store[key] = value
+                        with self._live_lock:
+                            self._key_owner.setdefault(key, ctx.get("rank"))
+                        self._wal_append(("init", ctx.get("rank"), key,
+                                          value))
             return ("ok",)
+        if cmd == "generation":
+            return ("ok", self.generation)
         if cmd == "heartbeat":
             rank = msg[1]
             step = msg[2] if len(msg) > 2 else None
@@ -344,19 +673,23 @@ class PSServer:
             arr = ctx["staging"].pop(("init", key)).reshape(shape)
             with self._key_lock(key):
                 with self._pending_cv:
-                    if key not in self._store:
-                        self._store[key] = arr
-                        with self._live_lock:
-                            self._key_owner.setdefault(key, ctx.get("rank"))
+                    with self._state_lock:
+                        if key not in self._store:
+                            self._store[key] = arr
+                            with self._live_lock:
+                                self._key_owner.setdefault(key,
+                                                           ctx.get("rank"))
+                            self._wal_append(("init", ctx.get("rank"), key,
+                                              arr))
                     self._pending_init.discard(key)
                     ctx["claimed_inits"].discard(key)
                     self._pending_cv.notify_all()
             return ("ok",)
         if cmd == "set_optimizer":
             _, blob = msg
-            from . import optimizer as opt_mod
-            optimizer = pickle.loads(blob)
-            self._updater = opt_mod.get_updater(optimizer)
+            with self._state_lock:
+                self._install_optimizer(blob)
+                self._wal_append(("set_optimizer", blob))
             return ("ok",)
         if cmd == "push":
             key, kind, payload = msg[1], msg[2], msg[3]
@@ -373,29 +706,10 @@ class PSServer:
                     if maxs - int(step) > self._max_staleness:
                         return ("stale", maxs)
             self._await_init(key)
+            # the grad is WAL-logged in DECODED form: replay applies the
+            # exact same bytes the live apply did, whatever the wire form
             grad = self._decode(kind, payload)
-            with self._key_lock(key):
-                stored = self._store.get(key)
-                if stored is None:
-                    return ("err", "key %r not initialized" % (key,))
-                if self._updater is not None:
-                    # applied immediately — the async server never waits
-                    # for other workers (kvstore_dist_server.h:285)
-                    from .ndarray import NDArray
-                    import jax.numpy as jnp
-                    w = NDArray(jnp.asarray(stored))
-                    g = self._as_nd(grad)
-                    self._updater(key, g, w)
-                    self._store[key] = np.asarray(w._data)
-                else:
-                    g = grad if not isinstance(grad, tuple) else None
-                    if g is None:
-                        idx, vals, shape = grad[1]
-                        dense = np.zeros(shape, np.float32)
-                        np.add.at(dense, idx.astype(np.int64), vals)
-                        g = dense
-                    self._store[key] = np.asarray(g, np.float32)
-            return ("ok",)
+            return self._apply_and_log(ctx.get("rank"), step, key, grad)
         if cmd == "pull":
             # kept as the simple (unchunked) wire surface: pull_array no
             # longer sends it, but external probes and tests may
@@ -510,13 +824,47 @@ class PSServer:
                 NDArray(jnp.asarray(idx.astype(np.int64))), tuple(shape))
         return NDArray(jnp.asarray(grad))
 
-    def stop(self):
+    def stop(self, final_snapshot=False):
+        """Stop serving.  ``final_snapshot=True`` (the graceful-shutdown
+        path: SIGTERM/SIGINT in ``kvstore_server._serve_ps``) flushes one
+        last snapshot first, so a clean exit never leans on WAL replay."""
+        if final_snapshot:
+            try:
+                self.save_snapshot()
+            except Exception:
+                pass  # a failed farewell snapshot must not block exit;
+                # the WAL still covers everything applied
         self._stop.set()
         self.monitor.stop()
+        # wake the accept thread with shutdown() and JOIN it before
+        # closing the fd: closing under a blocked accept() lets the
+        # kernel recycle the fd number — a successor server binding the
+        # same port can then have its connections STOLEN by our stale
+        # accept loop (observed: a post-failover hello answered with the
+        # dead server's generation)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
         try:
             self._sock.close()
         except OSError:
             pass
+        # drop every accepted connection too: serve threads unwedge, and
+        # a successor server can bind the port immediately (an orphaned
+        # ESTABLISHED socket would otherwise hold the address)
+        with self._live_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._state is not None:
+            self._join_snapshot_thread()
+            self._state.close()
 
 
 class PSClient:
@@ -542,12 +890,11 @@ class PSClient:
         "hello", "heartbeat", "init", "init_meta", "init_chunk",
         "wait_init", "push", "push_chunk", "pull", "pull_meta",
         "pull_chunk", "row_sparse_pull", "key_owner", "num_dead",
-        "set_optimizer",
+        "set_optimizer", "generation",
     })
 
     def __init__(self, host, port, timeout=120, connect_retry_s=60,
                  rank=None, retry_policy=None):
-        import time
         self._host, self._port, self._timeout = host, port, timeout
         self._rank = rank
         self._retry = retry_policy or _backoff.BackoffPolicy(
@@ -555,6 +902,15 @@ class PSClient:
             max_retries=int(os.environ.get("MXTPU_PS_RETRIES", "4")),
             jitter=0.25)
         self.reconnects = 0
+        # the incarnation token is minted ONCE per client object: a
+        # redial re-sends the same token (the server keeps our dedup
+        # stream), a respawned worker process mints a new one (the
+        # server resets the stream — our push_step clock restarted)
+        self._incarnation = "%d-%s" % (os.getpid(), os.urandom(4).hex())
+        # server generation as of the last hello; a bump means the
+        # server itself restarted (failover), not just our socket
+        self.server_generation = None
+        self.failovers = 0
         self._hb = None
         deadline = time.time() + connect_retry_s
         while True:
@@ -568,7 +924,8 @@ class PSClient:
                 time.sleep(0.2)
         self._lock = threading.Lock()
         if rank is not None:
-            self.request("hello", rank)
+            reply = self.request("hello", rank, self._incarnation)
+            self._note_generation(reply[2] if len(reply) > 2 else None)
 
     def start_heartbeat(self, interval_s=2.0, step_fn=None):
         """Start the worker-side beat loop (``resilience.heartbeat``):
@@ -582,31 +939,69 @@ class PSClient:
             self._hb = HeartbeatSender(beat, interval_s).start()
         return self._hb
 
+    def _note_generation(self, gen):
+        if gen is None:
+            return
+        if self.server_generation is not None and \
+                gen != self.server_generation:
+            self.failovers += 1
+        self.server_generation = gen
+
+    def probe_generation(self):
+        """Ask the server its generation (redialing if needed); bumps
+        ``failovers`` when it moved since the last hello.  Chunk loops
+        call this on a server-side error: a failover with a SURVIVING
+        connection (proxy/LB in the path) breaks no socket, so
+        ``reconnects`` alone cannot see it — only the generation can."""
+        reply = self.request("generation")
+        self._note_generation(reply[1])
+        return self.server_generation
+
+    def _transfer_epoch(self):
+        """Per-connection + per-server-life epoch: chunked transfers
+        restart wholesale when EITHER moves (both invalidate the
+        server-side staged prefix / pull snapshot)."""
+        return (self.reconnects, self.failovers)
+
+    def _chunk_error_is_restart(self, epoch):
+        """A chunk RPC failed server-side: restart or genuine error?
+        If neither the socket nor the known generation moved, probe the
+        server — a failover behind a surviving connection announces
+        itself only through the generation bump."""
+        if self._transfer_epoch() == epoch:
+            try:
+                self.probe_generation()
+            except (OSError, ConnectionError):
+                pass
+        return self._transfer_epoch() != epoch
+
     def _chunked_transfer(self, size, send_chunk):
         """Drive ``send_chunk(start, stop)`` across ``size`` elements.
 
         Chunk staging is per-connection server state, so a reconnect
         anywhere in the loop orphans the already-sent prefix — the new
         connection stages from scratch and the server would zero-fill
-        the lost chunks.  Detect the reconnect (``self.reconnects``
-        moved, or the server refused an orphaned tail) and restart the
-        WHOLE transfer from chunk 0.  Re-sending a full transfer is
-        at-least-once — the same property a retried unchunked push
-        already has."""
+        the lost chunks.  A server FAILOVER loses the prefix the same
+        way even when the connection survives (LB case).  Detect either
+        (``self.reconnects``/``self.failovers`` moved, or the server
+        refused an orphaned tail) and restart the WHOLE transfer from
+        chunk 0.  Re-sending a full transfer is at-least-once on the
+        wire; the server's ``(rank, push_step)`` dedup makes the final
+        apply exactly-once when the push carries a step."""
         from .base import MXNetError
         while True:
-            epoch = self.reconnects
+            epoch = self._transfer_epoch()
             restart = False
             for start in range(0, size, BIGARRAY_BOUND):
                 stop = min(start + BIGARRAY_BOUND, size)
                 try:
                     send_chunk(start, stop)
                 except MXNetError:
-                    if self.reconnects == epoch:
+                    if not self._chunk_error_is_restart(epoch):
                         raise
                     restart = True
                     break
-                if self.reconnects != epoch:
+                if self._transfer_epoch() != epoch:
                     restart = True
                     break
             if not restart:
@@ -656,7 +1051,7 @@ class PSClient:
                 if installed:
                     return ("ok",)
                 continue
-            epoch = self.reconnects
+            epoch = self._transfer_epoch()
             restart = False
             for start in range(0, arr.size, BIGARRAY_BOUND):
                 stop = min(start + BIGARRAY_BOUND, arr.size)
@@ -665,11 +1060,11 @@ class PSClient:
                                  start, stop, flat[start:stop],
                                  stop == arr.size)
                 except MXNetError:
-                    if self.reconnects == epoch:
+                    if not self._chunk_error_is_restart(epoch):
                         raise
                     restart = True
                     break
-                if self.reconnects != epoch:
+                if self._transfer_epoch() != epoch:
                     restart = True
                     break
             if not restart:
@@ -687,7 +1082,7 @@ class PSClient:
                                                BIGARRAY_BOUND)
             if arr is not None:
                 return arr
-            epoch = self.reconnects
+            epoch = self._transfer_epoch()
             out = np.empty(size, np.float32)
             restart = False
             for start in range(0, size, BIGARRAY_BOUND):
@@ -696,11 +1091,11 @@ class PSClient:
                     out[start:stop] = self.request("pull_chunk", key,
                                                    start, stop)[1]
                 except MXNetError:
-                    if self.reconnects == epoch:
+                    if not self._chunk_error_is_restart(epoch):
                         raise
                     restart = True
                     break
-                if self.reconnects != epoch:
+                if self._transfer_epoch() != epoch:
                     restart = True
                     break
             if not restart:
@@ -708,7 +1103,9 @@ class PSClient:
 
     def _reconnect(self):
         """Redial + re-hello under the held request lock (the hello must
-        precede any retried request so the server re-learns our rank)."""
+        precede any retried request so the server re-learns our rank).
+        The hello reply's generation tells us whether we redialed the
+        same server or a failed-over one (``failovers`` bumps)."""
         try:
             self._sock.close()
         except OSError:
@@ -717,12 +1114,13 @@ class PSClient:
                                               timeout=self._timeout)
         self.reconnects += 1
         if self._rank is not None:
-            _send(self._sock, ("hello", self._rank))
-            if _recv(self._sock) is None:
+            _send(self._sock, ("hello", self._rank, self._incarnation))
+            reply = _recv(self._sock)
+            if reply is None:
                 raise ConnectionError("hello rejected on reconnect")
+            self._note_generation(reply[2] if len(reply) > 2 else None)
 
     def request(self, *msg):
-        import time
         # chaos probe: a scheduled fault drops (raise) or delays this RPC
         _chaos.maybe_inject("kvstore.request", ctx=msg)
         with self._lock:

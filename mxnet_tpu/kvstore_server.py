@@ -36,20 +36,47 @@ def _elasticity_env():
             int(staleness) if staleness else None)
 
 
+def _durability_env():
+    """(state_dir, snapshot_every, snapshot_keep) from the MXTPU_* env
+    knobs.  With a state dir but no explicit cadence, snapshot every 100
+    applied pushes — the WAL between snapshots stays a few MB for
+    typical keys and replay is milliseconds."""
+    state_dir = os.environ.get("MXTPU_PS_STATE_DIR") or None
+    every = os.environ.get("MXTPU_PS_SNAPSHOT_EVERY")
+    keep = int(os.environ.get("MXTPU_PS_SNAPSHOT_KEEP", "3"))
+    if every:
+        every = int(every)
+    else:
+        every = 100 if state_dir else None
+    return state_dir, every, keep
+
+
 def _serve_ps(port, num_workers):
     """Host a standalone PSServer until SIGTERM/SIGINT.
 
     The wait loop is bounded (Event.wait with a timeout — the SRC005
     discipline), so a missed signal can never wedge the process beyond
-    one poll interval after ``stop`` is set some other way."""
+    one poll interval after ``stop`` is set some other way.  Shutdown is
+    graceful: the signal flushes one final snapshot before exit, so a
+    drained server never leans on WAL replay — and a SIGKILLed one
+    recovers through it (``MXTPU_CHAOS`` faults are armed here so the
+    chaos harness can schedule exactly that kill deterministically)."""
     from . import kvstore_ps
+    from .resilience import chaos as _chaos
+    _chaos.install_from_env()
     hb_timeout, max_staleness = _elasticity_env()
+    state_dir, snapshot_every, keep = _durability_env()
     server = kvstore_ps.PSServer(port=port, num_workers=num_workers,
                                  heartbeat_timeout_s=hb_timeout,
-                                 max_staleness=max_staleness)
+                                 max_staleness=max_staleness,
+                                 state_dir=state_dir,
+                                 snapshot_every=snapshot_every,
+                                 snapshot_keep=keep)
     print("mxnet_tpu: standalone PS serving on port %d "
-          "(workers=%d, heartbeat_timeout=%s, max_staleness=%s)"
-          % (server.port, num_workers, hb_timeout, max_staleness),
+          "(workers=%d, heartbeat_timeout=%s, max_staleness=%s, "
+          "state_dir=%s, generation=%d, recovered_wal=%d)"
+          % (server.port, num_workers, hb_timeout, max_staleness,
+             state_dir, server.generation, server.recovered_wal_records),
           file=sys.stderr)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -59,7 +86,7 @@ def _serve_ps(port, num_workers):
             break
     while not stop.wait(0.5):
         pass
-    server.stop()
+    server.stop(final_snapshot=True)
 
 
 class KVStoreServer:

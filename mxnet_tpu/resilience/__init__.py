@@ -16,30 +16,36 @@ pieces (see ``docs/resilience.md``):
 - :mod:`.heartbeat` — worker heartbeats + a server-side watchdog, the
   liveness layer under ``kvstore_ps``'s elastic PS tier (dead-worker key
   reassignment, bounded-staleness rejoin);
+- :mod:`.server_state` — durable PS server state: atomic snapshots (the
+  checkpoint discipline) + a write-ahead log of applied pushes, so a
+  SIGKILLed parameter server recovers to its exact pre-crash state and
+  the fleet self-heals around the failover (generation handshake);
 - :mod:`.backoff` — the one shared exponential-backoff-with-jitter
   retry policy (bench backend acquisition, launcher rank restarts,
   kvstore RPC reconnects).
 
 ``python -m mxnet_tpu.resilience.bench`` is the host-only proof harness:
-it reports ``recovery_time_s`` and ``checkpoint_overhead_pct`` and stays
-live when the TPU backend is down (the r05 bench pattern).
+it reports ``recovery_time_s``/``checkpoint_overhead_pct`` plus the PS
+tier's ``server_recovery_time_s``/``wal_replay_rate_keys_per_s`` and
+stays live when the TPU backend is down (the r05 bench pattern).
 """
 from __future__ import annotations
 
-from . import backoff, chaos, checkpoint, heartbeat
+from . import backoff, chaos, checkpoint, heartbeat, server_state
 from .backoff import BackoffPolicy, RetriesExhausted, retry_call
 from .chaos import (ChaosError, ChaosSchedule, Fault, install,
                     install_from_env, maybe_inject, triggered, uninstall)
 from .checkpoint import (latest_checkpoint, list_checkpoints,
                          load_checkpoint, save_checkpoint)
 from .heartbeat import HeartbeatMonitor, HeartbeatSender
+from .server_state import ServerStateStore
 
 __all__ = [
-    "backoff", "chaos", "checkpoint", "heartbeat",
+    "backoff", "chaos", "checkpoint", "heartbeat", "server_state",
     "BackoffPolicy", "RetriesExhausted", "retry_call",
     "ChaosError", "ChaosSchedule", "Fault", "install", "install_from_env",
     "maybe_inject", "triggered", "uninstall",
     "save_checkpoint", "load_checkpoint", "latest_checkpoint",
     "list_checkpoints",
-    "HeartbeatMonitor", "HeartbeatSender",
+    "HeartbeatMonitor", "HeartbeatSender", "ServerStateStore",
 ]

@@ -15,6 +15,15 @@ stay live when the TPU backend is down.  Prints ONE JSON line:
   a run crashed at the midpoint and resumed finishes with params
   byte-identical to the uncrashed run at the same step count.
 - ``resilience_ckpt_bytes`` — snapshot size on disk.
+- ``server_recovery_time_s`` — PS server crash-to-serving: construct a
+  fresh ``PSServer`` over the crashed server's state dir (snapshot load
+  + WAL replay, the full failover path a respawned server pays).
+- ``wal_replay_rate_keys_per_s`` — WAL push records replayed per second
+  during that recovery.
+- ``server_snapshot_overhead_pct`` — push-apply loop with snapshot+WAL
+  persistence armed vs unarmed; the acceptance gate is < 5 %.
+- ``server_recovery_bitwise_ok`` — the recovered store is byte-identical
+  to the crashed server's in-memory state.
 """
 from __future__ import annotations
 
@@ -46,6 +55,111 @@ def _params_bytes(trainer):
     return b"".join(
         np.asarray(p.data()._data).tobytes()
         for _, p in sorted(trainer._params_by_name.items()))
+
+
+def _server_stage():
+    """PS server durability numbers, sockets elided: pushes are applied
+    through ``PSServer._handle`` directly (the same apply/WAL/snapshot
+    path the wire hits) so the measurement is the persistence cost, not
+    TCP.  The 'crash' is ``stop()`` without a final snapshot — recovery
+    must replay the WAL tail."""
+    from mxnet_tpu import kvstore_ps
+
+    import pickle
+
+    from mxnet_tpu import optimizer as opt
+
+    d = tempfile.mkdtemp(prefix="mxtpu_ps_state_bench_")
+    keys = ["w%03d" % i for i in range(16)]
+    size = 2048
+    rng = np.random.RandomState(0)
+    grads = [rng.rand(size).astype(np.float32) for _ in range(8)]
+    pushes = int(os.environ.get("MXTPU_RES_BENCH_SERVER_PUSHES", "192"))
+    # deliberately NOT a divisor of `pushes`: a WAL tail must be left
+    # behind for the recovery below to actually replay
+    cadence = 128
+    opt_blob = pickle.dumps(opt.create("sgd", learning_rate=0.05,
+                                       momentum=0.9))
+
+    def make(state_dir, snapshot_every):
+        # a real server-side updater (SGD+momentum), so the overhead
+        # denominator is an honest apply cost, not a free memcpy
+        srv = kvstore_ps.PSServer(port=0, state_dir=state_dir,
+                                  snapshot_every=snapshot_every)
+        ctx = {"staging": {}, "snapshots": {}, "claimed_inits": set(),
+               "rank": 0}
+        srv._handle(("set_optimizer", opt_blob), ctx)
+        for k in keys:
+            srv._handle(("init", k, np.zeros(size, np.float32)), ctx)
+        return [srv, ctx, 0]
+
+    def window(cfg):
+        srv, ctx, step = cfg
+        t0 = time.perf_counter()
+        for i in range(pushes):
+            step += 1
+            srv._handle(("push", keys[i % len(keys)], "dense",
+                         grads[i % len(grads)], step), ctx)
+        cfg[2] = step
+        return time.perf_counter() - t0
+
+    d_wal = tempfile.mkdtemp(prefix="mxtpu_ps_state_bench_wal_")
+    try:
+        # three configs timed in INTERLEAVED min-of-3 windows (1-core CI
+        # hosts drift): plain apply, +WAL, +WAL+snapshots.  The gated
+        # number is the SNAPSHOT increment — per-push WAL cost is the
+        # price of exactly-once replay and is reported separately.
+        plain = make(None, None)
+        wal_only = make(d_wal, None)
+        armed = make(d, cadence)
+        for cfg in (plain, wal_only, armed):
+            window(cfg)                  # warm updater states + jit
+        times = {id(plain): None, id(wal_only): None, id(armed): None}
+        for _ in range(3):
+            for cfg in (plain, wal_only, armed):
+                dt = window(cfg)
+                key = id(cfg)
+                times[key] = dt if times[key] is None else min(times[key],
+                                                               dt)
+        dt_plain = times[id(plain)]
+        wal_overhead = 100.0 * (times[id(wal_only)] - dt_plain) \
+            / max(dt_plain, 1e-9)
+        snap_overhead = 100.0 * (times[id(armed)] - times[id(wal_only)]) \
+            / max(dt_plain, 1e-9)
+        plain[0].stop()
+        wal_only[0].stop()
+        # guarantee a WAL tail past the newest snapshot (the windows may
+        # have ended exactly on a cadence boundary) so the recovery
+        # below really replays, then "crash" — no final snapshot
+        srv, ctx, step = armed
+        srv._join_snapshot_thread()
+        srv._snapshot_every = None
+        for i in range(64):
+            step += 1
+            srv._handle(("push", keys[i % len(keys)], "dense",
+                         grads[i % len(grads)], step), ctx)
+        blob = b"".join(srv._store[k].tobytes() for k in keys)
+        srv.stop()
+
+        t0 = time.perf_counter()
+        recovered = kvstore_ps.PSServer(port=0, state_dir=d)
+        recovery_s = time.perf_counter() - t0
+        replayed = recovered.recovered_wal_records
+        rate = replayed / max(recovered.recovery_replay_s, 1e-9)
+        ok = b"".join(recovered._store[k].tobytes() for k in keys) == blob
+        recovered.stop()
+        return {
+            "server_recovery_time_s": round(recovery_s, 3),
+            "wal_replay_rate_keys_per_s": round(rate, 1),
+            "server_snapshot_overhead_pct": round(snap_overhead, 2),
+            "server_wal_overhead_pct": round(wal_overhead, 2),
+            "server_wal_replayed": replayed,
+            "server_recovery_bitwise_ok": bool(ok),
+            "server_bench_pushes": pushes,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+        shutil.rmtree(d_wal, ignore_errors=True)
 
 
 def main():
@@ -119,14 +233,16 @@ def main():
         tc.flush()
         bitwise_ok = _params_bytes(tc) == ref
 
-        print(json.dumps({
+        rec = {
             "resilience_checkpoint_overhead_pct": round(overhead_pct, 2),
             "resilience_recovery_time_s": round(recovery_s, 3),
             "resilience_bitwise_ok": bool(bitwise_ok),
             "resilience_ckpt_bytes": os.path.getsize(last),
             "resilience_ckpt_cadence": cadence,
             "resilience_bench_steps": steps,
-        }))
+        }
+        rec.update(_server_stage())
+        print(json.dumps(rec))
     finally:
         shutil.rmtree(ckdir, ignore_errors=True)
 

@@ -11,9 +11,12 @@ backend init (BENCH_r03..r05), an overloaded serving queue — gets a
   in production.  Shipped sites: ``trainer.step`` (count = step number),
   ``pipeline.dispatch`` (count = batch index, ctx = the iterator),
   ``kvstore.request`` (count = request number, ctx = message tuple),
-  ``serving.batch`` (count = batch number), ``engine.flush``,
-  ``backend.init`` (bench.py acquisition attempts), ``checkpoint.save``
-  (mid-write, for atomicity tests).
+  ``kvstore.server_apply`` (count = applied-push ordinal on the PS
+  server, ctx = (rank, step, key) — the SIGKILL-the-server site),
+  ``kvstore.snapshot`` (server snapshot write), ``serving.batch``
+  (count = batch number), ``engine.flush``, ``backend.init`` (bench.py
+  acquisition attempts), ``checkpoint.save`` (mid-write, for atomicity
+  tests).
 - **faults**: ``Fault(site, at, action, arg)`` — trigger the ``at``-th
   probe hit (1-based; or the probe's explicit ``count``) at ``site`` and
   perform ``action``:

@@ -68,6 +68,12 @@ class HeartbeatMonitor:
         with self._lock:
             return self._steps.get(rank, 0)
 
+    def steps(self):
+        """Copy of the per-rank step clocks (the PS snapshots this so a
+        recovered server's staleness gate keeps its reference points)."""
+        with self._lock:
+            return dict(self._steps)
+
     def dead(self):
         with self._lock:
             return set(self._dead)

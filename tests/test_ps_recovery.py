@@ -1,0 +1,494 @@
+"""PS server crash recovery (ISSUE 7): durable server state, WAL replay,
+and client failover.
+
+The acceptance contracts under test:
+- a server constructed over a crashed server's state dir recovers the
+  store, the server-side updater state, key ownership and the fleet step
+  clocks to the exact pre-crash bytes (snapshot + WAL replay);
+- WAL replay is idempotent: a ``(rank, push_step)`` record replayed
+  twice — or a client re-sending the push the crash left unacked — is a
+  no-op, while a NEW client incarnation resets its dedup stream;
+- every recovery-armed restart bumps a persistent generation, carried in
+  the hello so clients can tell failover from a TCP blip; a failover
+  behind a SURVIVING connection is still detected (generation probe) and
+  forces a whole-transfer restart of in-flight chunked pushes;
+- SIGTERM on the standalone server flushes a final snapshot (graceful
+  shutdown), and snapshot pruning honors ``keep=`` incl. tmp debris;
+- 2-bit error-feedback residuals are client-side state and survive a
+  server failover untouched;
+- the headline: a server SIGKILLed mid-training by the chaos harness
+  (site ``kvstore.server_apply``), respawned over its state dir, resumes
+  to byte-identical params at equal step count vs the uncrashed run,
+  with the worker surviving the failover (no worker restart).
+"""
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore_ps
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.resilience import ChaosSchedule, Fault, chaos
+from mxnet_tpu.resilience import checkpoint as ckpt
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leak():
+    yield
+    chaos.uninstall()
+
+
+def _cpu_env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _ctx(rank=0):
+    return {"staging": {}, "snapshots": {}, "claimed_inits": set(),
+            "rank": rank}
+
+
+def _sgd_blob(momentum=0.9):
+    return pickle.dumps(opt.create("sgd", learning_rate=0.1,
+                                   momentum=momentum))
+
+
+# ---------------------------------------------------------------------------
+# snapshot + WAL recovery, in-process
+# ---------------------------------------------------------------------------
+def test_server_recovers_snapshot_plus_wal_bitwise(tmp_path):
+    """Crash after N pushes (some snapshotted, a WAL tail behind):
+    recovery restores store bytes, updater momentum, ownership, step
+    clocks and the dedup map exactly."""
+    d = str(tmp_path)
+    srv = kvstore_ps.PSServer(port=0, state_dir=d, snapshot_every=3)
+    ctx = _ctx(rank=0)
+    srv._handle(("set_optimizer", _sgd_blob()), ctx)
+    srv._handle(("init", "w", np.zeros(4, np.float32)), ctx)
+    srv._handle(("init", "v", np.ones(2, np.float32)), ctx)
+    for step in range(1, 6):
+        srv._handle(("push", "w", "dense",
+                     np.full(4, 0.1 * step, np.float32), step), ctx)
+    srv.monitor.note_step(0, 5)
+    blob_w = srv._store["w"].tobytes()
+    blob_v = srv._store["v"].tobytes()
+    mom = np.asarray(srv._updater.states["w"]._data).copy()
+    srv.stop()                                     # crash: no final snapshot
+
+    srv2 = kvstore_ps.PSServer(port=0, state_dir=d)
+    try:
+        assert srv2.generation == srv.generation + 1
+        assert srv2.recovered_wal_records >= 1     # a tail really replayed
+        assert srv2._store["w"].tobytes() == blob_w
+        assert srv2._store["v"].tobytes() == blob_v
+        np.testing.assert_array_equal(
+            np.asarray(srv2._updater.states["w"]._data), mom)
+        assert srv2.key_owner("w") == 0
+        assert srv2.monitor.step_of(0) == 5        # staleness gate intact
+        assert srv2._applied[0]["w"] == 5          # dedup high-water mark
+        # and the recovered server keeps TRAINING identically: one more
+        # push lands on recovered momentum
+        srv2._handle(("push", "w", "dense", np.ones(4, np.float32), 6),
+                     _ctx(0))
+    finally:
+        srv2.stop()
+
+
+def test_wal_replay_idempotent_and_dedups_retries(tmp_path):
+    """Double-replay of a (rank, push_step) WAL entry is a no-op; so is
+    a live client retry of an already-applied push.  A new incarnation
+    (respawned worker, step clock reset) re-opens the stream."""
+    d = str(tmp_path)
+    srv = kvstore_ps.PSServer(port=0, state_dir=d)     # WAL only
+    ctx = _ctx(rank=0)
+    srv._handle(("set_optimizer", _sgd_blob()), ctx)
+    srv._handle(("init", "w", np.zeros(4, np.float32)), ctx)
+    g = np.ones(4, np.float32)
+    srv._handle(("push", "w", "dense", g, 1), ctx)
+    srv._handle(("push", "w", "dense", g, 2), ctx)
+    blob = srv._store["w"].tobytes()
+    srv.stop()
+
+    srv2 = kvstore_ps.PSServer(port=0, state_dir=d)
+    try:
+        assert srv2.recovered_wal_records == 4   # set_opt, init, 2 pushes
+        assert srv2._store["w"].tobytes() == blob
+        srv2._replay_record(("push", 0, 2, "w", g))        # double replay
+        assert srv2._store["w"].tobytes() == blob
+        assert srv2._handle(("push", "w", "dense", g, 2),
+                            _ctx(0)) == ("ok",)            # live retry
+        assert srv2._store["w"].tobytes() == blob
+        srv2._note_incarnation(0, "respawned-worker")      # fresh stream
+        srv2._handle(("push", "w", "dense", g, 1), _ctx(0))
+        assert srv2._store["w"].tobytes() != blob
+    finally:
+        srv2.stop()
+
+
+def test_snapshot_pruning_honors_keep(tmp_path):
+    d = str(tmp_path)
+    srv = kvstore_ps.PSServer(port=0, state_dir=d, snapshot_keep=2)
+    ctx = _ctx(rank=0)
+    srv._handle(("init", "w", np.zeros(4, np.float32)), ctx)
+    for step in range(1, 6):
+        srv._handle(("push", "w", "dense",
+                     np.full(4, float(step), np.float32), step), ctx)
+        srv.save_snapshot()
+    snaps = ckpt.list_checkpoints(d)
+    assert len(snaps) == 2                         # pruned to keep=2
+    assert not [n for n in os.listdir(d) if ".tmp." in n]
+    # WAL segments older than the oldest retained snapshot are gone too
+    from mxnet_tpu.resilience.server_state import _WAL_RE
+    wal_bases = sorted(int(_WAL_RE.match(n).group(1))
+                       for n in os.listdir(d) if _WAL_RE.match(n))
+    assert wal_bases and wal_bases[0] >= snaps[0][0]
+    srv.stop()
+    # every retained snapshot still restores
+    srv2 = kvstore_ps.PSServer(port=0, state_dir=d)
+    np.testing.assert_array_equal(srv2._store["w"],
+                                  np.full(4, 5.0, np.float32))
+    srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# generation handshake + client failover
+# ---------------------------------------------------------------------------
+def test_generation_bumps_and_client_detects_failover(tmp_path):
+    d = str(tmp_path)
+    srv = kvstore_ps.PSServer(port=0, state_dir=d)
+    assert srv.generation == 1
+    port = srv.port
+    cli = kvstore_ps.PSClient("127.0.0.1", port, rank=0)
+    try:
+        assert cli.server_generation == 1
+        cli.init_array("k", np.arange(4, dtype=np.float32))
+        srv.stop(final_snapshot=True)              # graceful: snapshot
+        assert ckpt.list_checkpoints(d)
+        srv2 = kvstore_ps.PSServer(port=port, state_dir=d)
+        try:
+            assert srv2.generation == 2
+            # next request redials transparently; the re-hello re-learns
+            # the generation and records the failover
+            np.testing.assert_array_equal(
+                cli.pull_array("k"), np.arange(4, dtype=np.float32))
+            assert cli.reconnects >= 1
+            assert cli.failovers == 1
+            assert cli.server_generation == 2
+        finally:
+            srv2.stop()
+    finally:
+        cli.close()
+
+
+def test_server_failover_mid_chunked_push_generation_restart(tmp_path,
+                                                             monkeypatch):
+    """PR-6 interplay regression: the server restarts mid-chunked-push
+    while the client's CONNECTION survives (LB case — simulated by
+    swapping the socket without touching ``reconnects``).  The orphaned
+    tail is refused, the generation probe reveals the failover, and the
+    client restarts the whole transfer instead of erroring out."""
+    monkeypatch.setattr(kvstore_ps, "BIGARRAY_BOUND", 4)
+    d = str(tmp_path)
+    srv_box = [kvstore_ps.PSServer(port=0, state_dir=d)]
+    port = srv_box[0].port
+    cli = kvstore_ps.PSClient("127.0.0.1", port, rank=0)
+    try:
+        cli.init_array("k", np.zeros(10, np.float32))
+        value = np.arange(1, 11, dtype=np.float32)   # 3 chunks of <= 4
+        orig, calls = cli.request, {"n": 0}
+
+        def flaky(*msg):
+            if msg[0] == "push_chunk":
+                calls["n"] += 1
+                if calls["n"] == 2:
+                    srv_box[0].stop()
+                    srv_box[0] = kvstore_ps.PSServer(port=port, state_dir=d)
+                    sock = socket.create_connection(("127.0.0.1", port),
+                                                    timeout=10)
+                    kvstore_ps._send(sock, ("hello", 0, cli._incarnation))
+                    assert kvstore_ps._recv(sock)[0] == "ok"
+                    old, cli._sock = cli._sock, sock
+                    old.close()
+            return orig(*msg)
+
+        cli.request = flaky
+        cli.push_array("k", value)
+        assert cli.reconnects == 0       # the socket never "broke"...
+        assert cli.failovers == 1        # ...only the generation moved
+        assert calls["n"] > 3            # the transfer restarted wholesale
+        np.testing.assert_array_equal(cli.pull_array("k"), value)
+    finally:
+        cli.close()
+        srv_box[0].stop()
+
+
+def test_compression_residuals_survive_server_failover(tmp_path):
+    """Error-feedback residuals are CLIENT-side state: a server failover
+    (recovered from its state dir) never touches them — the quantized
+    stream continues exactly where it left off (docs/resilience.md)."""
+    from mxnet_tpu import kvstore as kv_mod
+    d = str(tmp_path)
+    srv = kvstore_ps.PSServer(port=0, state_dir=d, snapshot_every=1)
+    port = srv.port
+    kv = kv_mod.KVStore("local")
+    kv._ps_client = kvstore_ps.PSClient("127.0.0.1", port, rank=0)
+    kv._push_step = 0
+    kv.set_gradient_compression({"threshold": 0.5})
+    kv.init("w", mx.nd.zeros((4,)))
+    try:
+        kv.push("w", mx.nd.array(np.array([0.3, 0.6, -0.7, 0.1],
+                                          np.float32)))
+        resid1 = np.asarray(kv._compression_residuals["w"]).copy()
+        np.testing.assert_allclose(resid1, [0.3, 0.1, -0.2, 0.1],
+                                   atol=1e-6)
+        srv.stop()                                  # crash
+        srv2 = kvstore_ps.PSServer(port=port, state_dir=d)
+        try:
+            kv.push("w", mx.nd.array(np.array([0.3, 0.0, 0.0, 0.5],
+                                              np.float32)))
+            assert kv._ps_client.reconnects >= 1
+            assert kv._ps_client.failovers == 1
+            # residuals evolved by plain error feedback, crash unseen:
+            # (g2 + resid1) quantizes to [0.5, 0, 0, 0.5]
+            np.testing.assert_allclose(
+                np.asarray(kv._compression_residuals["w"]),
+                [0.1, 0.1, -0.2, 0.1], atol=1e-6)
+            np.testing.assert_array_equal(
+                kv._ps_client.pull_array("w"),
+                np.array([0.5, 0.0, 0.0, 0.5], np.float32))
+        finally:
+            srv2.stop()
+    finally:
+        kv._ps_client.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the new server probe sites
+# ---------------------------------------------------------------------------
+def test_chaos_server_sites_deterministic_and_bite():
+    sites = ["kvstore.server_apply", "kvstore.snapshot"]
+    s1 = ChaosSchedule.seeded(17, sites, n_faults=4, max_at=20)
+    s2 = ChaosSchedule.seeded(17, sites, n_faults=4, max_at=20)
+    assert s1.specs() == s2.specs()          # byte-deterministic schedule
+
+    srv = kvstore_ps.PSServer(port=0)
+    ctx = _ctx(rank=0)
+    try:
+        srv._handle(("init", "w", np.zeros(2, np.float32)), ctx)
+        chaos.install([Fault("kvstore.server_apply", 2, "raise")])
+        srv._handle(("push", "w", "dense", np.ones(2, np.float32), 1), ctx)
+        before = srv._store["w"].tobytes()
+        with pytest.raises(chaos.ChaosError):
+            srv._handle(("push", "w", "dense", np.full(2, 9.0, np.float32),
+                         2), ctx)
+        # the dropped apply mutated nothing (probe fires BEFORE apply)
+        assert srv._store["w"].tobytes() == before
+        assert srv._applied[0]["w"] == 1
+    finally:
+        chaos.uninstall()
+        srv.stop()
+
+
+def test_chaos_snapshot_site_fails_clean(tmp_path):
+    """A fault at kvstore.snapshot aborts the capture before any byte is
+    written: the WAL alone still recovers everything."""
+    d = str(tmp_path)
+    srv = kvstore_ps.PSServer(port=0, state_dir=d)
+    ctx = _ctx(rank=0)
+    try:
+        srv._handle(("init", "w", np.zeros(2, np.float32)), ctx)
+        srv._handle(("push", "w", "dense", np.ones(2, np.float32), 1), ctx)
+        chaos.install([Fault("kvstore.snapshot", 1, "raise")])
+        with pytest.raises(chaos.ChaosError):
+            srv.save_snapshot()
+        chaos.uninstall()
+        assert not ckpt.list_checkpoints(d)      # nothing half-written
+        srv.stop()
+        srv2 = kvstore_ps.PSServer(port=0, state_dir=d)
+        np.testing.assert_array_equal(srv2._store["w"],
+                                      np.ones(2, np.float32))
+        srv2.stop()
+    finally:
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# standalone server: graceful shutdown, launcher integration
+# ---------------------------------------------------------------------------
+_SERVER_SRC = (
+    "from mxnet_tpu.kvstore_server import _init_kvstore_server_module\n"
+    "_init_kvstore_server_module()\n")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_standalone_server_sigterm_flushes_final_snapshot(tmp_path):
+    d = str(tmp_path / "state")
+    port = _free_port()
+    env = _cpu_env(DMLC_ROLE="server", MXTPU_PS_PORT=port,
+                   MXTPU_PS_STATE_DIR=d, MXTPU_PS_SNAPSHOT_EVERY=100000,
+                   MXTPU_HEARTBEAT_INTERVAL_S=0)
+    proc = subprocess.Popen([sys.executable, "-c", _SERVER_SRC], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        cli = kvstore_ps.PSClient("127.0.0.1", port, rank=0,
+                                  connect_retry_s=120)
+        cli.init_array("k", np.zeros(4, np.float32))
+        cli.push_array("k", np.full(4, 3.0, np.float32), step=1)
+        cli.close()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+        # the final snapshot holds the pushed value (cadence never hit:
+        # only the graceful-shutdown flush can have written it)
+        assert ckpt.list_checkpoints(d)
+        srv = kvstore_ps.PSServer(port=0, state_dir=d)
+        assert srv.generation == 2
+        assert srv.recovered_wal_records == 0    # snapshot covered it all
+        np.testing.assert_array_equal(srv._store["k"],
+                                      np.full(4, 3.0, np.float32))
+        srv.stop()
+    finally:
+        proc.kill()
+
+
+def test_launch_echo_spawns_recovery_armed_server_rank(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "-s", "1", "--launcher", "echo",
+         "--ps-state-dir", str(tmp_path), "python", "train.py"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = out.stdout.strip().splitlines()
+    assert len(lines) == 3                       # 1 server + 2 workers
+    assert "DMLC_ROLE=server" in lines[0]
+    assert "MXTPU_PS_STATE_DIR=%s" % tmp_path in lines[0]
+    # workers know a dedicated server exists (no embedded PS on rank 0)
+    assert all("DMLC_NUM_SERVER=1" in line for line in lines)
+    assert "DMLC_ROLE=worker" in lines[1] and "DMLC_ROLE=worker" in lines[2]
+
+
+# ---------------------------------------------------------------------------
+# the headline: SIGKILL the server mid-training, resume bitwise
+# ---------------------------------------------------------------------------
+_WORKER_SRC = (
+    "import pickle, sys\n"
+    "import numpy as np\n"
+    "from mxnet_tpu import kvstore_ps\n"
+    "from mxnet_tpu import optimizer as opt\n"
+    "port, outpath, steps = int(sys.argv[1]), sys.argv[2], int(sys.argv[3])\n"
+    "cli = kvstore_ps.PSClient('127.0.0.1', port, rank=0,"
+    " connect_retry_s=120)\n"
+    "cli.request('set_optimizer', pickle.dumps(\n"
+    "    opt.create('sgd', learning_rate=0.1, momentum=0.9)))\n"
+    "keys = ['w0', 'w1']\n"
+    "rng = np.random.RandomState(11)\n"
+    "for k in keys:\n"
+    "    cli.init_array(k, rng.rand(32).astype(np.float32))\n"
+    "step = 0\n"
+    "for s in range(steps):\n"
+    "    for k in keys:\n"
+    "        step += 1\n"
+    "        g = rng.rand(32).astype(np.float32) - 0.5\n"
+    "        cli.push_array(k, g, step=step)\n"
+    "blob = b''.join(cli.pull_array(k).tobytes() for k in keys)\n"
+    "with open(outpath, 'wb') as f:\n"
+    "    f.write(blob)\n"
+    "print('DONE', step, flush=True)\n"
+    "cli.close()\n")
+
+
+def _run_fleet(tmp_path, tag, server_chaos=None, steps=10):
+    """One training run: a standalone PS subprocess + one worker
+    subprocess.  With ``server_chaos``, the server is SIGKILLed by the
+    chaos harness mid-run and respawned over the same state dir while
+    the worker keeps running (it retries through the failover)."""
+    state = str(tmp_path / ("state_" + tag))
+    outpath = str(tmp_path / (tag + ".bin"))
+    port = _free_port()
+    senv = _cpu_env(DMLC_ROLE="server", MXTPU_PS_PORT=port,
+                    MXTPU_PS_STATE_DIR=state, MXTPU_PS_SNAPSHOT_EVERY=5,
+                    MXTPU_HEARTBEAT_INTERVAL_S=0)
+    if server_chaos:
+        senv["MXTPU_CHAOS"] = server_chaos
+    server = subprocess.Popen([sys.executable, "-c", _SERVER_SRC], env=senv,
+                              stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    wenv = _cpu_env(MXTPU_PS_RETRIES=12)
+    worker = subprocess.Popen(
+        [sys.executable, "-c", _WORKER_SRC, str(port), outpath, str(steps)],
+        env=wenv, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        if server_chaos:
+            # the chaos kill fires mid-run; respawn the server rank over
+            # the SAME state dir (what launch.py --restart-failed does) —
+            # the worker rank is never touched
+            assert server.wait(timeout=300) == -signal.SIGKILL
+            senv.pop("MXTPU_CHAOS")
+            server = subprocess.Popen(
+                [sys.executable, "-c", _SERVER_SRC], env=senv,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        wout, werr = worker.communicate(timeout=300)
+        assert worker.returncode == 0, werr[-2000:]
+        assert "DONE %d" % (2 * steps) in wout
+        with open(outpath, "rb") as f:
+            return f.read()
+    finally:
+        worker.kill()
+        server.terminate()
+        try:
+            server.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+def test_sigkill_server_mid_training_resumes_bitwise(tmp_path):
+    """The headline acceptance test: SIGKILL the PS server at applied
+    push #13 of 20 (chaos site kvstore.server_apply), respawn it over
+    its state dir, and the surviving worker's final pulled params are
+    byte-identical to the uncrashed run at the same step count.  The
+    crash lands between snapshots (cadence 5, so snapshot@10 + WAL
+    11..12 + the in-flight push 13 re-sent and deduped exactly-once)."""
+    ref = _run_fleet(tmp_path, "ref")
+    res = _run_fleet(tmp_path, "crash",
+                     server_chaos="kvstore.server_apply:13:kill")
+    assert ref == res
+
+
+# ---------------------------------------------------------------------------
+# bench stage keys
+# ---------------------------------------------------------------------------
+def test_bench_reports_server_recovery_metrics():
+    env = _cpu_env(MXTPU_RES_BENCH_STEPS=30, MXTPU_RES_BENCH_SERVER_PUSHES=48)
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.resilience.bench"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    import json
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["server_recovery_time_s"] > 0
+    assert rec["wal_replay_rate_keys_per_s"] > 0
+    assert rec["server_wal_replayed"] > 0
+    assert rec["server_recovery_bitwise_ok"] is True
+    assert "server_snapshot_overhead_pct" in rec
+    assert "server_wal_overhead_pct" in rec

@@ -843,6 +843,7 @@ def test_src005_sweep_of_shipped_worker_loops_is_clean():
 def test_resilience_bench_stage_reports_recovery_and_overhead():
     env = _cpu_env()
     env["MXTPU_RES_BENCH_STEPS"] = "40"    # keep the tier-1 box fast
+    env["MXTPU_RES_BENCH_SERVER_PUSHES"] = "48"
     out = subprocess.run(
         [sys.executable, "-m", "mxnet_tpu.resilience.bench"],
         capture_output=True, text=True, timeout=300, env=env, cwd=_ROOT)
@@ -853,3 +854,8 @@ def test_resilience_bench_stage_reports_recovery_and_overhead():
     assert rec["resilience_recovery_time_s"] > 0
     assert "resilience_checkpoint_overhead_pct" in rec
     assert rec["resilience_ckpt_bytes"] > 0
+    # PS-tier durability metrics (ISSUE 7) ride the same stage
+    assert rec["server_recovery_time_s"] > 0
+    assert rec["wal_replay_rate_keys_per_s"] > 0
+    assert rec["server_recovery_bitwise_ok"] is True
+    assert "server_snapshot_overhead_pct" in rec

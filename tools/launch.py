@@ -22,6 +22,13 @@ collectives).  This launcher reproduces the reference CLI:
   the coordinator/PS and rejoins) up to N times, with delays from the
   shared ``resilience.backoff`` policy so a correlated crash doesn't
   thundering-herd the coordinator.
+- ``-s/--num-servers 1`` spawns a dedicated ``DMLC_ROLE=server`` rank
+  hosting the elastic PS (the reference CLI's ``-s``), with snapshot+WAL
+  recovery armed through ``--ps-state-dir`` (``MXTPU_PS_STATE_DIR``) —
+  so ``--restart-failed`` respawns of a SIGKILLed *server* recover the
+  exact pre-crash weights/updater state instead of wiping the fleet.
+  Once every worker exits, the server rank is drained with SIGTERM
+  (which flushes a final snapshot) rather than left running.
 """
 from __future__ import annotations
 
@@ -124,7 +131,7 @@ def coordinator_address(hosts):
     return "%s:%d" % (hosts[0], random.randint(20000, 59999))
 
 
-def worker_env(coordinator, n, rank, ps_port):
+def worker_env(coordinator, n, rank, ps_port, num_servers=0):
     """The per-rank env handshake (shared by every launcher)."""
     return {
         # jax.distributed.initialize() reads these
@@ -135,9 +142,29 @@ def worker_env(coordinator, n, rank, ps_port):
         "DMLC_ROLE": "worker",
         "DMLC_NUM_WORKER": str(n),
         "DMLC_WORKER_ID": str(rank),
-        # rank-0-hosted async parameter server (kvstore dist_async)
+        # DMLC_NUM_SERVER > 0 tells workers a dedicated PS rank exists,
+        # so rank 0 must NOT also bind the port with an embedded server
+        "DMLC_NUM_SERVER": str(num_servers),
+        # async parameter server address (kvstore dist_async)
         "MXTPU_PS_PORT": str(ps_port),
     }
+
+
+def server_env(n, ps_port, state_dir):
+    """The dedicated PS rank's env: the same command is spawned with
+    DMLC_ROLE=server (the reference tracker's convention) and the
+    program's `_init_kvstore_server_module()` hosts the elastic PS.
+    The state dir arms snapshot+WAL crash recovery, which is what makes
+    `--restart-failed` respawns of this rank a *recovery*, not a wipe."""
+    env = {
+        "DMLC_ROLE": "server",
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_NUM_SERVER": "1",
+        "MXTPU_PS_PORT": str(ps_port),
+    }
+    if state_dir:
+        env["MXTPU_PS_STATE_DIR"] = state_dir
+    return env
 
 
 def ssh_command(host, env, command, cwd):
@@ -159,6 +186,19 @@ def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed training job")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        choices=[0, 1],
+                        help="spawn a dedicated DMLC_ROLE=server rank "
+                             "hosting the elastic PS (one host server; "
+                             "the reference CLI's -s).  0 = rank 0 "
+                             "embeds the PS (default)")
+    parser.add_argument("--ps-state-dir", default=None,
+                        help="server snapshot+WAL directory "
+                             "(MXTPU_PS_STATE_DIR); with --num-servers "
+                             "and --restart-failed a respawned server "
+                             "RECOVERS from it.  Default: a fresh "
+                             "mxtpu_ps_state tmpdir when a server rank "
+                             "is spawned")
     parser.add_argument("--launcher", default="local",
                         choices=["local", "ssh", "echo"])
     parser.add_argument("-H", "--hostfile", default=None,
@@ -211,36 +251,55 @@ def main():
         if "=" not in kv:
             parser.error("--env expects K=V, got %r" % kv)
     extra = dict(kv.split("=", 1) for kv in args.env)
+    if args.num_servers and not args.ps_state_dir:
+        # recovery must be armed by default: a respawned server with no
+        # state dir would come back EMPTY and wedge every worker
+        import tempfile
+        args.ps_state_dir = tempfile.mkdtemp(prefix="mxtpu_ps_state_")
+        print("launch: server state dir %s (pass --ps-state-dir to pin)"
+              % args.ps_state_dir, file=sys.stderr)
+
+    def rank_env(rank):
+        """rank is an int worker id or the string 'server'."""
+        if rank == "server":
+            renv = server_env(args.num_workers, ps_port, args.ps_state_dir)
+        else:
+            renv = worker_env(coordinator, args.num_workers, rank, ps_port,
+                              args.num_servers)
+        renv.update(extra)
+        return renv
+
+    all_ranks = (["server"] if args.num_servers else []) \
+        + list(range(args.num_workers))
 
     if args.launcher == "echo":
-        for rank in range(args.num_workers):
-            env = worker_env(coordinator, args.num_workers, rank, ps_port)
-            env.update(extra)
+        for rank in all_ranks:
+            env = rank_env(rank)
             print("%s %s" % (" ".join("%s=%s" % kv
                                       for kv in sorted(env.items())),
                              " ".join(args.command)))
         return
 
     def spawn(rank):
-        renv = worker_env(coordinator, args.num_workers, rank, ps_port)
-        renv.update(extra)
+        renv = rank_env(rank)
         if args.launcher == "ssh":
             # remote shells inherit nothing: forward the runtime-relevant
             # locals alongside the handshake (the dmlc tracker forwards
-            # its env lists the same way)
+            # its env lists the same way).  The server rank runs on the
+            # PS host — hosts[0], where the port was probed.
             for k in ("JAX_PLATFORMS", "XLA_FLAGS", "PYTHONPATH"):
                 if k in os.environ and k not in renv:
                     renv[k] = os.environ[k]
-            cmd = ssh_command(hosts[rank % len(hosts)], renv,
-                              args.command, os.getcwd())
+            host = hosts[0] if rank == "server" else hosts[rank % len(hosts)]
+            cmd = ssh_command(host, renv, args.command, os.getcwd())
             return subprocess.Popen(cmd)
         env = dict(os.environ)
         env.update(renv)
         return subprocess.Popen(args.command, env=env)
 
-    running = {rank: spawn(rank) for rank in range(args.num_workers)}
-    budgets = [args.restart_failed] * args.num_workers
-    attempts = [0] * args.num_workers
+    running = {rank: spawn(rank) for rank in all_ranks}
+    budgets = {rank: args.restart_failed for rank in all_ranks}
+    attempts = {rank: 0 for rank in all_ranks}
     policy = _load_backoff().BackoffPolicy(
         base_s=1.0, factor=2.0, max_delay_s=30.0,
         max_retries=max(args.restart_failed, 1), jitter=0.25)
@@ -252,12 +311,21 @@ def main():
     # correlated multi-rank crash must not serialize restarts or stall
     # polling of the ranks still running.
     respawn_at = {}                    # rank -> monotonic deadline
+    server_draining = False
     while running or respawn_at:
         time.sleep(0.2)
         now = time.monotonic()
         for rank in [r for r, t in respawn_at.items() if now >= t]:
             del respawn_at[rank]
             running[rank] = spawn(rank)
+        # all workers done -> drain the server rank (SIGTERM flushes its
+        # final snapshot); a post-drain exit is a shutdown, not a crash
+        workers_left = any(r != "server"
+                           for r in list(running) + list(respawn_at))
+        if not workers_left and "server" in running and not server_draining:
+            server_draining = True
+            budgets["server"] = 0
+            running["server"].terminate()
         for rank, p in list(running.items()):
             r = p.poll()
             if r is None:
@@ -267,7 +335,7 @@ def main():
                 budgets[rank] -= 1
                 delay = policy.delay(attempts[rank])
                 attempts[rank] += 1
-                print("launch: rank %d exited rc=%d; restarting in %.1fs "
+                print("launch: rank %s exited rc=%d; restarting in %.1fs "
                       "(%d restarts left)" % (rank, r, delay,
                                               budgets[rank]),
                       file=sys.stderr)
