@@ -316,11 +316,13 @@ def _run_benches(rec):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     # -- serving micro-bench FIRST: host-runnable (Runner->Batcher reqs/s
-    # + p50/p99 latency on a JAX_PLATFORMS=cpu subprocess), so the key
-    # refreshes even when the TPU backend never comes up (the r5 failure
-    # mode: every key starved behind backend acquisition)
+    # + p50/p99 latency, plus the fleet keys: per-tier p50/p99 under
+    # mixed-model SLO-tiered load, shed_rate, swap_blip_ms — all on a
+    # JAX_PLATFORMS=cpu subprocess), so the keys refresh even when the
+    # TPU backend never comes up (the r5 failure mode: every key starved
+    # behind backend acquisition)
     if os.environ.get("MXTPU_BENCH_SERVING", "1") == "1":
-        rec.stage("serving", 90, _serving_bench)
+        rec.stage("serving", 150, _serving_bench)
 
     # -- input-pipeline micro-bench, ALSO host-only and BEFORE backend
     # acquisition: pipeline_fed_imgs_per_sec is a host property (decode +
@@ -596,7 +598,9 @@ def _resilience_bench():
 
 def _serving_bench():
     """serving_reqs_per_sec + request-latency percentiles through the full
-    ModelRunner->Batcher path (mxnet_tpu/serving/bench.py).  Runs as a
+    ModelRunner->Batcher path, and the fleet keys (per-tier p50/p99 under
+    mixed-model SLO-tiered load with a degraded-mode fallback,
+    shed_rate, swap_blip_ms) — mxnet_tpu/serving/bench.py.  Runs as a
     JAX_PLATFORMS=cpu subprocess: host-capable by construction, and a
     hung TPU backend in THIS process can never starve it."""
     env = dict(os.environ)

@@ -25,7 +25,8 @@ from .findings import (Finding, RULES, ERROR, WARNING, INFO,
 from .registry_lint import lint_registry, unique_ops
 from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
 from .source_lint import lint_source, lint_file
-from .serving_lint import lint_serving
+from .serving_lint import (lint_serving, lint_fleet_hbm,
+                           lint_deadline_propagation)
 from .coverage import load_test_map, generate_coverage_md
 from .report import (render_text, render_json, exit_code, worst_severity,
                      SCHEMA_VERSION)
@@ -36,7 +37,9 @@ from .dist_lint import lint_dist_step, lint_trainer, dist_summary
 __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "INFO",
     "lint_registry", "lint_graph", "lint_source", "lint_file",
-    "lint_symbol", "lint_serving", "lint_rule_docs", "self_check",
+    "lint_symbol", "lint_serving", "lint_fleet_hbm",
+    "lint_deadline_propagation", "lint_serving_sources",
+    "lint_rule_docs", "self_check",
     "lint_shipped_loops", "lint_worker_loops",
     "load_test_map",
     "generate_coverage_md",
@@ -57,11 +60,12 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 
 
 def self_check(disable=(), with_coverage=True, with_cost=True,
-               with_examples=True, with_workers=True):
+               with_examples=True, with_workers=True, with_serving=True):
     """Registry lint over the live registry, the rule-table docs sync
     check, the cost-pass determinism check, the SRC004 sweep over the
-    shipped training loops and the SRC005 sweep over the shipped worker
-    loops — what CI runs.
+    shipped training loops, the SRC005 sweep over the shipped worker
+    loops and the SRV004 deadline-propagation sweep over the shipped
+    serving request paths — what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -75,7 +79,38 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += lint_shipped_loops(disable=disable)
     if with_workers:
         findings += lint_worker_loops(disable=disable)
+    if with_serving:
+        findings += lint_serving_sources(disable=disable)
     return findings
+
+
+def lint_serving_sources(disable=()):
+    """SRV004 (deadline-propagation half) over every shipped serving
+    request path: the serving package itself, the serve CLI and the
+    serving examples.  A shipped path that binds ``deadline_ms`` but
+    drops it before the Batcher breaks admission control for anyone
+    copying it.  (The packing half of SRV004 runs at every
+    ``ModelFleet.register`` — it needs live modeled costs, not source.)
+    Skipped silently outside a repo checkout."""
+    import glob
+    import os
+
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(pkg)          # mxnet_tpu/
+    repo = os.path.dirname(root)
+    targets = sorted(glob.glob(os.path.join(root, "serving", "*.py")))
+    if os.path.isfile(os.path.join(repo, "tools", "serve.py")):
+        targets.append(os.path.join(repo, "tools", "serve.py"))
+    if os.path.isdir(os.path.join(repo, "examples", "serving")):
+        targets += sorted(glob.glob(os.path.join(
+            repo, "examples", "serving", "*.py")))
+    findings = []
+    for path in targets:
+        try:
+            findings += lint_deadline_propagation(os.path.normpath(path))
+        except OSError:
+            continue
+    return filter_findings(findings, disable)
 
 
 def lint_shipped_loops(disable=()):

@@ -99,6 +99,12 @@ RULES = {
     "SRV003": (WARNING, "a serving bucket's modeled peak HBM exceeds the "
                         "configured cap (static cost model; the bucket "
                         "would OOM or page at load)"),
+    "SRV004": (ERROR, "fleet admission control broken: the summed modeled "
+                      "peak HBM of a fleet registration exceeds the cap "
+                      "(over-committed packing OOMs under load), or a "
+                      "request path binds deadline_ms but calls "
+                      "submit()/infer() without propagating it (the "
+                      "request can never be shed and rots in the queue)"),
     # distributed-step pass (mxnet_tpu/analysis/dist_lint.py)
     "DST001": (ERROR, "a trainable parameter's gradient is never "
                       "psum/pmean-reduced over the data axis: replicas "

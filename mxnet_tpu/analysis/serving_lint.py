@@ -1,4 +1,5 @@
-"""Serving lint: can this Symbol be served recompile-free from buckets?
+"""Serving lint: can this Symbol be served recompile-free from buckets,
+and does the fleet's admission control actually hold?
 
 ``mxnet_tpu.serving.ModelRunner`` pads every request batch up to a fixed
 bucket ladder so steady-state traffic hits a finite, pre-compiled program
@@ -17,12 +18,29 @@ Two classes break it —
 
 The probe is pure shape inference (no tracing), so it is safe to run at
 model-load time inside the server.
+
+The fleet rules (SRV004, error) keep multi-model admission control a
+*static* problem:
+
+- **packing** (:func:`lint_fleet_hbm`): the summed modeled peak HBM of a
+  fleet registration set against the cap — ``ModelFleet.register``
+  refuses an over-cap registration with these findings rendered into the
+  error, so over-commit is caught at load, not at the first OOM;
+- **deadline propagation** (:func:`lint_deadline_propagation`): a pure
+  AST scan for request paths that bind ``deadline_ms`` but call a
+  ``submit()``/``infer()`` sink without passing it on — such a request
+  can never be shed and rots in the queue, exactly the queue-collapse
+  mode the SLO tiers exist to prevent.  ``--self-check`` sweeps it over
+  every shipped serving source (``mxnet_tpu/serving/``,
+  ``tools/serve.py``, ``examples/serving/``).
 """
 from __future__ import annotations
 
+import ast
+
 from .findings import Finding, filter_findings
 
-__all__ = ["lint_serving"]
+__all__ = ["lint_serving", "lint_fleet_hbm", "lint_deadline_propagation"]
 
 # mirrors graph_lint._RESHAPE_OPS; serving cares about the batch axis
 _RESHAPE_OPS = frozenset({"Reshape", "reshape"})
@@ -121,6 +139,90 @@ def _lint_bucket_hbm(symbol, data_shapes, buckets, cap_bytes):
                 "bucket ladder or raise the cap"
                 % (report.peak_hbm_bytes / (1 << 20),
                    cap_bytes / (1 << 20))))
+    return out
+
+
+def lint_fleet_hbm(models, cap_bytes):
+    """SRV004 (packing half): ``models`` maps model name -> modeled peak
+    HBM bytes (None = unmodelable, excluded from the sum with a note);
+    the sum of the known figures must fit ``cap_bytes``.  Called by
+    ``ModelFleet.register`` on every registration — admission control as
+    a static problem, refused with the modeled numbers in hand."""
+    if not cap_bytes:
+        return []
+    known = {n: int(b) for n, b in models.items() if b}
+    total = sum(known.values())
+    if total <= int(cap_bytes):
+        return []
+    detail = ", ".join("%s=%.1f MiB" % (n, b / (1 << 20))
+                       for n, b in sorted(known.items()))
+    unmodeled = sorted(n for n, b in models.items() if not b)
+    if unmodeled:
+        detail += "; unmodeled (not counted): %s" % ", ".join(unmodeled)
+    return [Finding(
+        "SRV004", "fleet",
+        "summed modeled peak HBM %.1f MiB exceeds the %.1f MiB cap "
+        "(%s) — the fleet would OOM under concurrent load; drop a "
+        "model, shrink its bucket ladder, or raise the cap"
+        % (total / (1 << 20), int(cap_bytes) / (1 << 20), detail))]
+
+
+_SUBMIT_SINKS = frozenset({"submit", "infer"})
+
+
+def _bound_names(fn):
+    names = {a.arg for a in fn.args.args}
+    names.update(a.arg for a in fn.args.kwonlyargs)
+    names.update(a.arg for a in getattr(fn.args, "posonlyargs", ()))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.NamedExpr)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+def lint_deadline_propagation(path=None, source=None):
+    """SRV004 (propagation half): flag functions that bind a
+    ``deadline_ms`` name (parameter or assignment) yet call a
+    ``.submit(...)`` / ``.infer(...)`` sink without a ``deadline_ms``
+    keyword (a ``**kwargs`` splat counts as propagating).  Pure AST —
+    no imports of the target."""
+    if source is None:
+        with open(path, "r") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path or "<string>")
+    except SyntaxError as e:
+        return [Finding("SRV004", path or "<string>",
+                        "source does not parse: %s" % e)]
+    subject = path or "<string>"
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if "deadline_ms" not in _bound_names(fn):
+            continue
+        for call in ast.walk(fn):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _SUBMIT_SINKS):
+                continue
+            kwargs = {k.arg for k in call.keywords}
+            if "deadline_ms" in kwargs or None in kwargs:
+                continue
+            out.append(Finding(
+                "SRV004", "%s:%d" % (subject, call.lineno),
+                "%s() binds deadline_ms but calls .%s() without "
+                "propagating it — the request carries no deadline, so "
+                "admission control can never shed it and it rots in "
+                "the queue under overload"
+                % (fn.name, call.func.attr)))
     return out
 
 

@@ -14,9 +14,12 @@ backend init (BENCH_r03..r05), an overloaded serving queue — gets a
   ``kvstore.server_apply`` (count = applied-push ordinal on the PS
   server, ctx = (rank, step, key) — the SIGKILL-the-server site),
   ``kvstore.snapshot`` (server snapshot write), ``serving.batch``
-  (count = batch number), ``engine.flush``, ``backend.init`` (bench.py
-  acquisition attempts), ``checkpoint.save`` (mid-write, for atomicity
-  tests).
+  (count = batch number; a ``delay`` here is the runner-stall /
+  queue-overload injection), ``serving.route`` (count = routed-request
+  ordinal on the model fleet, ctx = (model, tier)), ``serving.swap``
+  (fleet hot swap, ctx = model name), ``engine.flush``, ``backend.init``
+  (bench.py acquisition attempts), ``checkpoint.save`` (mid-write, for
+  atomicity tests).
 - **faults**: ``Fault(site, at, action, arg)`` — trigger the ``at``-th
   probe hit (1-based; or the probe's explicit ``count``) at ``site`` and
   perform ``action``:

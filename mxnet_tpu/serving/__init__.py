@@ -1,10 +1,9 @@
-"""mxnet_tpu.serving — dynamic-batching inference with bucketed,
-recompile-free execution.
+"""mxnet_tpu.serving — multi-model, SLO-tiered, overload-proof inference.
 
 The training side of this framework reached parity rounds ago; this
 package is the deployment half the reference papers treat as first-class
 (TensorFlow ships serving beside training, and MXNet motivates its
-symbolic executor with packaged inference).  Three layers:
+symbolic executor with packaged inference).  Four layers:
 
 - :class:`~mxnet_tpu.serving.runner.ModelRunner` — a bound Module or
   hybridized Gluon block behind a fixed ladder of padded batch buckets
@@ -12,11 +11,21 @@ symbolic executor with packaged inference).  Three layers:
   jit-cache key set exposed so steady-state traffic provably never
   recompiles;
 - :class:`~mxnet_tpu.serving.batcher.Batcher` — a thread that coalesces
-  concurrent requests up to ``max_batch``/``batch_timeout_ms``, pads to
-  the nearest bucket, splits results per request, and rejects (never
-  stalls) when its bounded queue fills;
+  concurrent requests deadline-aware up to ``max_batch``/
+  ``batch_timeout_ms``, pads to the nearest bucket, splits results per
+  request, and — before its bounded queue can collapse — sheds
+  deterministically, lowest SLO tier first, every request whose modeled
+  queue wait exceeds its ``deadline_ms``;
+- :class:`~mxnet_tpu.serving.fleet.ModelFleet` — N named runners behind
+  one routing surface: HBM-aware packing at registration (modeled cost
+  vs the SRV004 cap), per-model circuit breakers
+  (:class:`~mxnet_tpu.serving.fleet.CircuitBreaker`), degraded-mode
+  rerouting to a registered cheaper variant (the int8 path), and hot
+  model swap under drain with zero failed in-flight requests;
 - :class:`~mxnet_tpu.serving.server.Server` — a stdlib-HTTP front end
-  with ``/predict``, ``/healthz`` and ``/stats`` plus graceful drain.
+  with ``/predict`` (model/tier/deadline routing), per-model
+  ``/readyz`` vs process ``/livez``, ``/healthz``, ``/stats``, bounded
+  request bodies (413) and graceful drain.
 
 See ``docs/serving.md``, ``tools/serve.py`` (CLI) and
 ``examples/serving/`` (end-to-end demo).
@@ -24,9 +33,14 @@ See ``docs/serving.md``, ``tools/serve.py`` (CLI) and
 from __future__ import annotations
 
 from .runner import ModelRunner, DEFAULT_BUCKETS
-from .batcher import Batcher, ServerBusy, Draining
+from .batcher import (Batcher, ServerBusy, Draining, RequestShed,
+                      TIERS, DEFAULT_TIER, tier_rank, tier_name)
+from .fleet import ModelFleet, CircuitBreaker, BreakerOpen, UnknownModel
 from .server import Server
 from .stats import ServingStats, percentile
 
 __all__ = ["ModelRunner", "DEFAULT_BUCKETS", "Batcher", "ServerBusy",
-           "Draining", "Server", "ServingStats", "percentile"]
+           "Draining", "RequestShed", "TIERS", "DEFAULT_TIER",
+           "tier_rank", "tier_name", "ModelFleet", "CircuitBreaker",
+           "BreakerOpen", "UnknownModel", "Server", "ServingStats",
+           "percentile"]

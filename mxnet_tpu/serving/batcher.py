@@ -1,24 +1,47 @@
-"""Batcher: coalesce concurrent single requests into bucketed batches.
+"""Batcher: coalesce concurrent single requests into bucketed batches,
+with SLO tiers, deadline-aware coalescing, and deterministic load shed.
 
 The dynamic-batching core of the serving layer (the reference analogue is
 the server-side request coalescing TF-Serving ships; MXNet's
 BucketingModule solved the same compile-explosion problem for training).
-A bounded queue feeds one worker thread: the worker takes the first
-waiting request, keeps collecting until ``max_batch`` requests are in
-hand or ``batch_timeout_ms`` has elapsed, stacks them, and hands the
-batch to the :class:`~mxnet_tpu.serving.runner.ModelRunner`, which pads
-to the nearest bucket.  Results are split back per-request.
+Requests carry ``(tier, deadline_ms)``; a priority structure feeds one
+worker thread, which takes up to ``max_batch`` requests ordered by
+``(tier, deadline, arrival)`` — so under contention the gold tier is
+coalesced first and, within a tier, near-deadline requests are preferred
+into the next bucket — stacks them, and hands the batch to the
+:class:`~mxnet_tpu.serving.runner.ModelRunner`, which pads to the nearest
+bucket.  Results are split back per-request.
 
-Backpressure: the queue is bounded (``max_queue``); a submit against a
-full queue raises :class:`ServerBusy` immediately — callers (the HTTP
-front end maps this to 429) retry, the server never builds an unbounded
-backlog.  ``drain()`` stops admission, completes everything already
-queued, and joins the worker — the graceful-shutdown half of the
-contract.
+Overload answers, in order of preference (the anti-queue-collapse
+contract, ROADMAP item 3):
+
+- **shed before rot**: when the *modeled* queue wait (queued position /
+  ``max_batch`` x the measured-or-hinted per-batch service time) already
+  exceeds a request's ``deadline_ms``, the request is refused at
+  admission with :class:`RequestShed` carrying a ``retry_after_s`` hint —
+  immediately and deterministically, instead of timing out in the queue.
+  The worker re-runs the same arithmetic before each batch and sheds
+  queued requests that have become hopeless (``shed_at="sweep"``).
+  Because lower tiers sort behind higher ones, their modeled wait grows
+  first and shedding is confined to the lowest tier until it is empty.
+- **evict, lowest tier first**: a submit against a full queue evicts the
+  worst-ranked queued request when the newcomer strictly outranks it
+  (deterministic: lowest tier, then latest deadline, then newest);
+  otherwise the newcomer gets :class:`ServerBusy` (HTTP 429).
+- ``drain()`` stops admission, completes everything already queued, and
+  joins the worker — the graceful-shutdown half of the contract.
+
+``swap_runner()`` replaces the model *under drain of the in-flight batch
+only*: it waits for the batch currently executing to finish (the runner
+lock), installs the new runner, and every queued request is served by the
+replacement — zero in-flight failures, the hot-swap half of the fleet
+contract.  All deadline/latency arithmetic uses ``time.monotonic()``
+(wall-clock ``time.time()`` would tear under NTP steps).
 """
 from __future__ import annotations
 
-import queue as _queue
+import bisect
+import math
 import threading
 import time
 
@@ -27,28 +50,89 @@ import numpy as _np
 from ..base import MXNetError
 from .stats import ServingStats
 
-__all__ = ["Batcher", "ServerBusy", "Draining"]
+__all__ = ["Batcher", "ServerBusy", "Draining", "RequestShed",
+           "TIERS", "DEFAULT_TIER", "tier_rank", "tier_name"]
+
+# SLO tiers, best first.  Integer ranks are accepted anywhere a name is
+# (0 = gold).  The *names* are what stats and HTTP payloads speak.
+TIERS = {"gold": 0, "silver": 1, "bronze": 2}
+_TIER_NAMES = {v: k for k, v in TIERS.items()}
+DEFAULT_TIER = "gold"
+
+
+def tier_rank(tier):
+    """Canonical integer rank for a tier name or int (0 is best)."""
+    if isinstance(tier, bool):
+        raise MXNetError("bad tier %r" % (tier,))
+    if isinstance(tier, int):
+        if tier < 0:
+            raise MXNetError("tier rank must be >= 0, got %d" % tier)
+        return tier
+    try:
+        return TIERS[str(tier).lower()]
+    except KeyError:
+        raise MXNetError("unknown tier %r (want one of %s or an int rank)"
+                         % (tier, sorted(TIERS))) from None
+
+
+def tier_name(rank):
+    """Display name for a rank (falls back to ``tier<rank>``)."""
+    return _TIER_NAMES.get(int(rank), "tier%d" % int(rank))
 
 
 class ServerBusy(MXNetError):
-    """Queue full — reject now rather than stall (HTTP 429)."""
+    """Queue full and the request outranks nothing — reject now rather
+    than stall (HTTP 429)."""
 
 
 class Draining(MXNetError):
     """Server is draining — no new admissions (HTTP 503)."""
 
 
+class RequestShed(MXNetError):
+    """Request shed by admission control: the modeled queue wait exceeds
+    its deadline, or it was evicted by a higher-tier arrival (HTTP 503
+    with ``Retry-After`` = ``retry_after_s``)."""
+
+    def __init__(self, message, tier="gold", retry_after_s=1.0,
+                 shed_at="admit"):
+        super().__init__(message)
+        self.tier = tier
+        self.retry_after_s = float(retry_after_s)
+        self.shed_at = shed_at  # "admit" | "evict" | "sweep"
+
+
 class _Pending:
-    """One in-flight request: a tiny future (stdlib-only)."""
+    """One in-flight request: a tiny future (stdlib-only) plus its SLO
+    coordinates.  Orders by (tier rank, absolute deadline, arrival)."""
 
-    __slots__ = ("example", "_event", "_result", "_exc", "t_submit")
+    __slots__ = ("example", "_event", "_result", "_exc", "t_submit",
+                 "tier_rank", "deadline_ms", "t_deadline", "seq")
 
-    def __init__(self, example):
+    def __init__(self, example, tier_rank=0, deadline_ms=None, seq=0):
         self.example = example
         self._event = threading.Event()
         self._result = None
         self._exc = None
         self.t_submit = time.monotonic()
+        self.tier_rank = tier_rank
+        self.deadline_ms = deadline_ms
+        self.t_deadline = (self.t_submit + deadline_ms / 1000.0
+                           if deadline_ms is not None else None)
+        self.seq = seq
+
+    @property
+    def tier(self):
+        return tier_name(self.tier_rank)
+
+    def _key(self):
+        return (self.tier_rank,
+                self.t_deadline if self.t_deadline is not None
+                else float("inf"),
+                self.seq)
+
+    def __lt__(self, other):
+        return self._key() < other._key()
 
     def set_result(self, value):
         self._result = value
@@ -69,130 +153,332 @@ class _Pending:
         return self._result
 
 
-_SENTINEL = object()
-
-
 class Batcher:
+    """Deadline-aware dynamic batcher over one :class:`ModelRunner`.
+
+    New-in-fleet parameters (all optional, defaults reproduce the PR-2
+    single-tier behavior):
+
+    service_time_hint_ms : pins the modeled per-batch service time used
+        by admission control.  Unset, an EWMA of measured batch times is
+        used (admission is optimistic until the first measurement).  A
+        pinned hint plus a single submitting thread makes every shed
+        decision deterministic — what the chaos tests replay.
+    on_batch_success / on_batch_error : callbacks fired after each batch
+        (the fleet wires its per-model circuit breaker here).
+    model : display name carried into stats/errors (fleet routing key).
+    """
+
     def __init__(self, runner, max_batch=None, batch_timeout_ms=2.0,
-                 max_queue=256, stats=None):
+                 max_queue=256, stats=None, service_time_hint_ms=None,
+                 on_batch_success=None, on_batch_error=None, model=None):
         self.runner = runner
-        self.max_batch = int(max_batch or runner.max_batch)
-        if self.max_batch > runner.max_batch:
-            # a coalesced batch larger than the top bucket would be split
-            # by the runner anyway; cap so one batch == one device call
-            self.max_batch = runner.max_batch
+        self._max_batch_req = int(max_batch) if max_batch else None
+        self.max_batch = min(self._max_batch_req or runner.max_batch,
+                             runner.max_batch)
         self.batch_timeout_s = float(batch_timeout_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        self.model = model
         self.stats = stats if stats is not None else \
             ServingStats(runner.buckets)
-        self._q = _queue.Queue(maxsize=int(max_queue))
-        # serializes admission against drain(): the sentinel must queue
-        # strictly after every admitted request or a submit racing drain
-        # could land behind the sentinel and never be served
-        self._admit_lock = threading.Lock()
+        self.service_time_hint_ms = service_time_hint_ms
+        self.on_batch_success = on_batch_success
+        self.on_batch_error = on_batch_error
+        self._est_ewma_ms = None
+        # _cond guards _heap/_seq and serializes admission against drain
+        self._cond = threading.Condition()
+        self._heap = []        # sorted by _Pending._key()
+        self._seq = 0
+        # held while a batch executes on the runner: swap_runner acquires
+        # it, so a swap waits exactly for the in-flight batch (hot swap
+        # under drain with zero in-flight failures)
+        self._runner_lock = threading.Lock()
+        self._batch_started = None  # monotonic() while a batch executes
         self._draining = threading.Event()
         self._drained = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="mxtpu-batcher", daemon=True)
         self._thread.start()
 
+    # -- admission-control arithmetic --------------------------------------
+    @property
+    def est_batch_ms(self):
+        """Modeled per-batch service time: the pinned hint when set, else
+        the EWMA of measured batches (None before any signal)."""
+        if self.service_time_hint_ms is not None:
+            return float(self.service_time_hint_ms)
+        return self._est_ewma_ms
+
+    def _modeled_wait_ms(self, position):
+        """Modeled time until a request at 0-based queue ``position`` is
+        *served*: full batches ahead of it, plus its own batch, plus the
+        batch currently executing (if any), each costing ``est_batch_ms``.
+        0.0 when there is no service-time signal yet (admit
+        optimistically)."""
+        est = self.est_batch_ms
+        if est is None:
+            return 0.0
+        in_flight = 1 if self._batch_started is not None else 0
+        return (position // self.max_batch + 1 + in_flight) * est
+
+    def modeled_wait_ms(self):
+        """Modeled wait a request submitted *now* at the lowest priority
+        would see (the /stats + Retry-After surface)."""
+        with self._cond:
+            return self._modeled_wait_ms(len(self._heap))
+
+    def stalled(self, threshold_s):
+        """True when the in-flight batch has been executing longer than
+        ``threshold_s`` — the readiness-probe signal for a wedged runner
+        (the process stays live; routing should stop)."""
+        started = self._batch_started
+        return started is not None and \
+            time.monotonic() - started > float(threshold_s)
+
     # -- client side -------------------------------------------------------
     @property
     def queue_depth(self):
-        return self._q.qsize()
+        return len(self._heap)
 
     @property
     def draining(self):
         return self._draining.is_set()
 
-    def submit(self, example):
+    def _retry_after_s(self, wait_ms):
+        return max(1.0, math.ceil(wait_ms / 1000.0))
+
+    def submit(self, example, tier=DEFAULT_TIER, deadline_ms=None,
+               model=None):
         """Enqueue one example; returns a future-like with ``.result()``.
-        Raises :class:`ServerBusy` when the queue is full and
-        :class:`Draining` after ``drain()`` — never blocks the caller."""
-        req = _Pending(_np.asarray(example))
-        with self._admit_lock:
+
+        ``tier`` orders the request against concurrent load (gold >
+        silver > bronze); ``deadline_ms`` arms admission control: when
+        the modeled queue wait already exceeds it the request is shed
+        *now* (:class:`RequestShed`) instead of timing out queued.
+        Raises :class:`ServerBusy` when the queue is full and the request
+        outranks nothing, :class:`Draining` after ``drain()`` — never
+        blocks the caller."""
+        rank = tier_rank(tier)
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise MXNetError("deadline_ms must be positive, got %r"
+                             % (deadline_ms,))
+        victim = None
+        with self._cond:
             if self._draining.is_set():
                 raise Draining("server is draining; request rejected")
-            try:
-                self._q.put_nowait(req)
-            except _queue.Full:
-                self.stats.on_reject()
-                raise ServerBusy(
-                    "request queue full (%d deep); retry later"
-                    % self._q.maxsize) from None
+            req = _Pending(_np.asarray(example), rank, deadline_ms,
+                           self._seq)
+            self._seq += 1
+            position = bisect.bisect_left(self._heap, req)
+            if deadline_ms is not None:
+                wait_ms = self._modeled_wait_ms(position)
+                if wait_ms > deadline_ms:
+                    self.stats.on_shed(req.tier)
+                    raise RequestShed(
+                        "modeled queue wait %.0fms exceeds deadline %.0fms"
+                        " (tier=%s, depth=%d); shed at admission"
+                        % (wait_ms, deadline_ms, req.tier, len(self._heap)),
+                        tier=req.tier,
+                        retry_after_s=self._retry_after_s(wait_ms),
+                        shed_at="admit")
+            if len(self._heap) >= self.max_queue:
+                # full queue: evict the worst-ranked queued request iff
+                # the newcomer strictly outranks it (lowest tier, then
+                # latest deadline, then newest — deterministic)
+                if self._heap and req < self._heap[-1]:
+                    victim = self._heap.pop()
+                    self.stats.on_dequeue(1)
+                    self.stats.on_shed(victim.tier)
+                else:
+                    self.stats.on_reject()
+                    raise ServerBusy(
+                        "request queue full (%d deep); retry later"
+                        % self.max_queue) from None
+            bisect.insort(self._heap, req)
+            self._cond.notify_all()
+        if victim is not None:
+            victim.set_exception(RequestShed(
+                "evicted by a higher-tier arrival under a full queue "
+                "(tier=%s)" % victim.tier, tier=victim.tier,
+                retry_after_s=self._retry_after_s(self.modeled_wait_ms()),
+                shed_at="evict"))
         self.stats.on_submit()
         return req
 
-    def infer(self, example, timeout=30.0):
+    def infer(self, example, timeout=30.0, tier=DEFAULT_TIER,
+              deadline_ms=None):
         """Blocking convenience: submit + wait for the result row."""
-        return self.submit(example).result(timeout)
+        return self.submit(example, tier=tier,
+                           deadline_ms=deadline_ms).result(timeout)
 
     # -- worker side -------------------------------------------------------
-    def _collect(self, first):
-        """First request in hand: keep collecting until max_batch or the
-        coalescing window closes.  Returns (batch, saw_sentinel)."""
-        batch = [first]
-        deadline = time.monotonic() + self.batch_timeout_s
-        while len(batch) < self.max_batch:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                # during drain, whatever is queued should leave in as few
-                # device calls as possible — keep filling without waiting
+    def _sweep_hopeless_locked(self):
+        """Shed queued requests whose deadline can no longer be met given
+        their current position and the modeled service time (they would
+        rot, occupy queue slots, and waste a device call).  Returns the
+        shed list; caller resolves them outside the lock.  Positions run
+        in priority order, so lower tiers — parked at the back — see the
+        largest modeled wait and are shed first by construction."""
+        if not self._heap:
+            return []
+        now = time.monotonic()
+        shed, keep = [], []
+        for pos, req in enumerate(self._heap):
+            if req.t_deadline is not None and \
+                    now + self._modeled_wait_ms(pos) / 1000.0 \
+                    > req.t_deadline:
+                shed.append(req)
+            else:
+                keep.append(req)
+        if shed:
+            self._heap = keep
+            self.stats.on_dequeue(len(shed))
+            for req in shed:
+                self.stats.on_shed(req.tier, swept=True)
+        return shed
+
+    def _take_batch(self):
+        """Block until work is available, honor the coalescing window,
+        shed hopeless requests, and return up to ``max_batch`` requests
+        in (tier, deadline, arrival) order.  Returns None when drained
+        and empty (worker exit)."""
+        with self._cond:
+            while not self._heap:
                 if self._draining.is_set():
-                    try:
-                        nxt = self._q.get_nowait()
-                    except _queue.Empty:
+                    return None
+                self._cond.wait(timeout=0.1)
+            # coalescing window: wait for fill, but close early when the
+            # batch is full, drain began, or the most urgent deadline
+            # would be burned by further waiting (near-deadline requests
+            # go into the NEXT bucket, not one more window later)
+            window_end = time.monotonic() + self.batch_timeout_s
+            while (len(self._heap) < self.max_batch
+                   and not self._draining.is_set()):
+                now = time.monotonic()
+                remaining = window_end - now
+                if remaining <= 0:
+                    break
+                head_deadline = self._heap[0].t_deadline
+                if head_deadline is not None:
+                    est_s = (self.est_batch_ms or 0.0) / 1000.0
+                    slack = head_deadline - est_s - now
+                    if slack <= 0:
                         break
-                    if nxt is _SENTINEL:
-                        return batch, True
-                    batch.append(nxt)
-                    continue
-                break
-            try:
-                nxt = self._q.get(timeout=remaining)
-            except _queue.Empty:
-                break
-            if nxt is _SENTINEL:
-                return batch, True
-            batch.append(nxt)
-        return batch, False
+                    remaining = min(remaining, slack)
+                self._cond.wait(remaining)
+            shed = self._sweep_hopeless_locked()
+            batch = self._heap[:self.max_batch]
+            del self._heap[:len(batch)]
+            if batch:
+                self.stats.on_dequeue(len(batch))
+        for req in shed:
+            req.set_exception(RequestShed(
+                "deadline %.0fms unreachable from queue (modeled wait "
+                "exceeds remaining budget, tier=%s); shed by sweep"
+                % (req.deadline_ms, req.tier), tier=req.tier,
+                retry_after_s=self._retry_after_s(self.modeled_wait_ms()),
+                shed_at="sweep"))
+        return batch
 
     def _run_batch(self, batch):
         from ..resilience import chaos as _chaos
-        # chaos probe: a scheduled delay here overloads the admission
-        # queue deterministically (the serving-overload failure mode)
-        _chaos.maybe_inject("serving.batch", ctx=batch)
-        self.stats.on_dequeue(len(batch))
-        n = len(batch)
-        bucket = self.runner.bucket_for(n)
+        self._batch_started = time.monotonic()
         try:
-            x = _np.stack([r.example for r in batch])
-            out = self.runner.forward_batch(x)
-        except Exception as e:  # propagate per-request, keep serving
+            # chaos probe: a scheduled delay here stalls the runner (the
+            # serving-overload failure mode); a raise fails the batch and
+            # feeds the fleet's circuit breaker
+            _chaos.maybe_inject("serving.batch", ctx=batch)
+            n = len(batch)
+            bucket = self.runner.bucket_for(n)
+            try:
+                x = _np.stack([r.example for r in batch])
+                with self._runner_lock:
+                    runner = self.runner
+                    out = runner.forward_batch(x)
+            except Exception as e:  # propagate per-request, keep serving
+                for r in batch:
+                    r.set_exception(e)
+                self.stats.on_batch(bucket, n, [], error=True,
+                                    tiers=[r.tier for r in batch])
+                if self.on_batch_error is not None:
+                    try:
+                        self.on_batch_error(e)
+                    except Exception:
+                        pass
+                return
+            now = time.monotonic()
+            self._observe_batch_ms((now - self._batch_started) * 1000.0)
+            lat = []
+            for i, r in enumerate(batch):
+                r.set_result(out[i])
+                lat.append((now - r.t_submit) * 1000.0)
+            self.stats.on_batch(bucket, n, lat,
+                                tiers=[r.tier for r in batch])
+            self.stats.set_recompiles(runner.recompiles_since_warmup())
+            if self.on_batch_success is not None:
+                try:
+                    self.on_batch_success()
+                except Exception:
+                    pass
+        except Exception as e:
+            # a failure outside the runner call (e.g. an injected chaos
+            # raise) must not kill the worker: fail the batch, keep going
             for r in batch:
-                r.set_exception(e)
-            self.stats.on_batch(bucket, n, [], error=True)
-            return
-        now = time.monotonic()
-        lat = []
-        for i, r in enumerate(batch):
-            r.set_result(out[i])
-            lat.append((now - r.t_submit) * 1000.0)
-        self.stats.on_batch(bucket, n, lat)
-        self.stats.set_recompiles(self.runner.recompiles_since_warmup())
+                if not r.done():
+                    r.set_exception(e)
+            self.stats.on_batch(0, len(batch), [], error=True,
+                                tiers=[r.tier for r in batch])
+            if self.on_batch_error is not None:
+                try:
+                    self.on_batch_error(e)
+                except Exception:
+                    pass
+        finally:
+            self._batch_started = None
+
+    def _observe_batch_ms(self, measured_ms):
+        if self._est_ewma_ms is None:
+            self._est_ewma_ms = measured_ms
+        else:
+            self._est_ewma_ms = 0.7 * self._est_ewma_ms + 0.3 * measured_ms
 
     def _loop(self):
         while True:
-            try:
-                req = self._q.get(timeout=0.1)
-            except _queue.Empty:
-                continue
-            if req is _SENTINEL:
+            batch = self._take_batch()
+            if batch is None:
                 break
-            batch, saw_sentinel = self._collect(req)
-            self._run_batch(batch)
-            if saw_sentinel:
-                break
+            if batch:
+                self._run_batch(batch)
         self._drained.set()
+
+    # -- hot swap ----------------------------------------------------------
+    def swap_runner(self, runner, timeout=30.0):
+        """Replace the model under drain of the in-flight batch: waits
+        for the batch currently executing (the runner lock), installs
+        ``runner``, and every queued + future request is served by the
+        replacement — zero in-flight failures.  The new runner must share
+        the old one's ``example_shape`` (queued pixels must stay valid).
+        Returns the previous runner; raises ``TimeoutError`` when the
+        in-flight batch does not finish in ``timeout``."""
+        if tuple(runner.example_shape) != tuple(self.runner.example_shape):
+            raise MXNetError(
+                "swap refused: example_shape %r != %r — queued requests "
+                "would be fed to an incompatible model"
+                % (tuple(runner.example_shape),
+                   tuple(self.runner.example_shape)))
+        if not self._runner_lock.acquire(timeout=float(timeout)):
+            raise TimeoutError(
+                "in-flight batch did not complete within %ss; swap aborted"
+                % timeout)
+        try:
+            old, self.runner = self.runner, runner
+            with self._cond:
+                self.max_batch = min(self._max_batch_req or runner.max_batch,
+                                     runner.max_batch)
+            self.stats.on_swap()
+        finally:
+            self._runner_lock.release()
+        return old
 
     # -- lifecycle ---------------------------------------------------------
     def drain(self, timeout=60.0):
@@ -201,14 +487,9 @@ class Batcher:
         deadline passes with work still in flight — callers that must
         stop anyway (``Server.drain``'s hard ``drain_timeout_s``) follow
         up with :meth:`force_drain`."""
-        with self._admit_lock:
-            if not self._draining.is_set():
-                self._draining.set()
-                # the sentinel queues BEHIND all admitted requests (FIFO),
-                # so the worker serves everything in flight before exiting.
-                # Blocking put: on a full queue this waits for the worker
-                # to make room, which it always does.
-                self._q.put(_SENTINEL)
+        with self._cond:
+            self._draining.set()
+            self._cond.notify_all()
         if not self._drained.wait(timeout):
             raise TimeoutError("batcher did not drain within %ss" % timeout)
         self._thread.join(timeout=5.0)
@@ -221,16 +502,13 @@ class Batcher:
         requests resolve if/when it returns; the daemon worker thread
         dies with the process).  Idempotent; returns the number of
         requests failed."""
-        with self._admit_lock:
+        with self._cond:
             self._draining.set()
+            stuck, self._heap = self._heap, []
+            self._cond.notify_all()
         failed = 0
-        while True:
-            try:
-                req = self._q.get_nowait()
-            except _queue.Empty:
-                break
-            if req is _SENTINEL:
-                continue
+        for req in stuck:
+            self.stats.on_dequeue(1)
             req.set_exception(Draining(
                 "server hit its drain deadline; request not served"))
             failed += 1

@@ -1,12 +1,15 @@
 """Host-runnable serving micro-benchmark.
 
 Measures ``serving_reqs_per_sec`` plus end-to-end p50/p99 request latency
-through the full Runner→Batcher path on whatever backend is available —
-it is deliberately TPU-independent so ``bench.py`` can refresh the
-serving keys even when the chip never comes up (the r5 failure mode:
-every key starved behind backend acquisition).  ``bench.py`` runs this
-module as a ``JAX_PLATFORMS=cpu`` subprocess; it can also be run
-directly:
+through the full Runner→Batcher path, and the fleet keys — mixed-model
+SLO-tiered load through a :class:`ModelFleet` with a degraded-mode
+fallback and a mid-run hot swap: per-tier ``serving_tier_<t>_p50/p99_ms``,
+``serving_shed_rate``, ``serving_degraded_total``,
+``serving_swap_blip_ms`` — on whatever backend is available.  It is
+deliberately TPU-independent so ``bench.py`` can refresh the serving keys
+even when the chip never comes up (the r5 failure mode: every key starved
+behind backend acquisition).  ``bench.py`` runs this module as a
+``JAX_PLATFORMS=cpu`` subprocess; it can also be run directly:
 
     JAX_PLATFORMS=cpu python -m mxnet_tpu.serving.bench
 """
@@ -23,14 +26,14 @@ import numpy as _np
 __all__ = ["serving_bench"]
 
 
-def _build_runner(buckets, feat):
+def _build_runner(buckets, feat, hidden=64):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from .runner import ModelRunner
 
     mx.random.seed(0)
     net = gluon.nn.HybridSequential()
-    net.add(gluon.nn.Dense(64, activation="relu"))
+    net.add(gluon.nn.Dense(hidden, activation="relu"))
     net.add(gluon.nn.Dense(10))
     net.initialize(mx.init.Xavier())
     net.hybridize()
@@ -91,11 +94,101 @@ def serving_bench(n_requests=None, concurrency=None, buckets=(1, 4, 16, 64),
     }
 
 
+def fleet_bench(n_requests=None, concurrency=None, buckets=(1, 4, 16),
+                feat=32):
+    """Mixed-model, SLO-tiered fleet load: a primary MLP plus a cheaper
+    variant registered as its degraded-mode fallback, ``concurrency``
+    client threads cycling gold/silver/bronze tiers with per-tier
+    deadlines, and a hot swap of the primary at the halfway mark.
+    Returns the fleet bench keys (per-tier p50/p99, shed_rate,
+    swap_blip_ms) — all host-measurable, no TPU required."""
+    from .batcher import RequestShed, ServerBusy
+    from .fleet import BreakerOpen, ModelFleet
+    from .stats import percentile
+
+    n_requests = n_requests or int(os.environ.get(
+        "MXTPU_SERVING_BENCH_FLEET_N", "300"))
+    concurrency = concurrency or int(os.environ.get(
+        "MXTPU_SERVING_BENCH_CONCURRENCY", "8"))
+    primary = _build_runner(buckets, feat, hidden=256)
+    cheap = _build_runner(buckets, feat, hidden=32)
+    fleet = ModelFleet(batch_timeout_ms=1.0, max_queue=64)
+    fleet.register("primary", primary, fallback="primary_cheap")
+    fleet.register("primary_cheap", cheap)
+    spare = _build_runner(buckets, feat, hidden=256)
+
+    # (tier, deadline_ms): gold never sheds, bronze is the shed donor
+    ladder = [("gold", 10000.0), ("silver", 2000.0), ("bronze", 40.0)]
+    rng = _np.random.RandomState(0)
+    examples = rng.rand(64, feat).astype(_np.float32)
+    per_thread = n_requests // concurrency
+    lock = threading.Lock()
+    lat_by_tier = {t: [] for t, _ in ladder}
+    dropped = [0]
+
+    def client(tid):
+        got = {t: [] for t, _ in ladder}
+        drop = 0
+        for i in range(per_thread):
+            tier, deadline_ms = ladder[(tid + i) % len(ladder)]
+            t0 = time.monotonic()
+            try:
+                fleet.infer(examples[(tid + i) % len(examples)],
+                            model="primary", tier=tier,
+                            deadline_ms=deadline_ms, timeout=60)
+            except (RequestShed, ServerBusy, BreakerOpen):
+                drop += 1
+                continue
+            got[tier].append((time.monotonic() - t0) * 1000.0)
+        with lock:
+            dropped[0] += drop
+            for t, ms in got.items():
+                lat_by_tier[t].extend(ms)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    # hot swap the primary mid-burst: the blip is how long the swap
+    # waited on the in-flight batch — zero failed in-flight requests
+    time.sleep(0.05)
+    fleet.swap("primary", spare)
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    fleet.drain()
+
+    served = sum(len(v) for v in lat_by_tier.values())
+    stats = fleet.stats_dict()
+    out = {
+        "serving_fleet_reqs_per_sec": round(served / wall, 2)
+        if wall else 0.0,
+        "serving_shed_rate": round(
+            dropped[0] / float(max(1, served + dropped[0])), 4),
+        "serving_degraded_total":
+            stats["models"]["primary"]["degraded_total"],
+        "serving_swap_blip_ms": stats["models"]["primary"].get(
+            "last_swap_blip_ms", 0.0),
+        "serving_fleet_recompiles":
+            primary.recompiles_since_warmup()
+            + spare.recompiles_since_warmup()
+            + cheap.recompiles_since_warmup(),
+    }
+    for tier, _ in ladder:
+        ms = lat_by_tier[tier]
+        out["serving_tier_%s_p50_ms" % tier] = round(percentile(ms, 50), 3)
+        out["serving_tier_%s_p99_ms" % tier] = round(percentile(ms, 99), 3)
+    return out
+
+
 def main():
     out = serving_bench()
+    out.update(fleet_bench())
     print(json.dumps(out), flush=True)
     # the contract bench.py's stage relies on: zero steady-state recompiles
-    return 0 if out["serving_recompiles"] == 0 else 1
+    return 0 if (out["serving_recompiles"] == 0
+                 and out["serving_fleet_recompiles"] == 0) else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,476 @@
+"""ModelFleet: N named models behind one endpoint, overload-proof.
+
+The serving tier's answer to ROADMAP item 3 ("millions of users"): one
+process hosts many :class:`~mxnet_tpu.serving.runner.ModelRunner`\\ s, each
+behind its own deadline-aware :class:`~mxnet_tpu.serving.batcher.Batcher`,
+with the failure modes of a production fleet handled explicitly —
+
+- **HBM-aware packing (static admission control)**: ``register()`` sums
+  the *modeled* peak HBM of every hosted model (the mxcost pass behind
+  ``ModelRunner.modeled_cost()``, PR-4 discipline) against the SRV003/4
+  cap; an over-cap registration is refused *at load time* with the
+  modeled numbers in the error — packing is a solved static problem, not
+  a runtime OOM.
+- **SLO-tiered routing**: ``submit(example, model=, tier=, deadline_ms=)``
+  routes by name; the per-model batcher coalesces deadline-aware and
+  sheds deterministically, lowest tier first, before the queue collapses.
+- **per-model circuit breaker**: repeated runner failures trip the
+  model's :class:`CircuitBreaker` (open durations from
+  ``resilience/backoff.py``'s :class:`BackoffPolicy`); while open, traffic
+  fails fast (or degrades, below) instead of feeding a sick model, one
+  half-open probe window at a time.
+- **graceful degradation**: a model registered with ``fallback=`` (the
+  int8 quantized variant is the intended citizen — ``tools/serve.py
+  --model name=prefix:int8``) absorbs overflow: requests the primary
+  sheds (or refuses with an open breaker) are rerouted to the cheaper
+  variant instead of being dropped.
+- **hot swap under drain**: ``swap()`` replaces a model's runner after
+  the in-flight batch completes; queued requests are served by the
+  replacement — zero failed in-flight requests, with the blip measured.
+
+Chaos probe sites (``resilience/chaos.py``): ``serving.route`` fires per
+routed request (count = request ordinal, ctx = (model, tier)) and
+``serving.swap`` per swap (ctx = model name) — the overload/degradation
+story is tested by deterministic fault injection, not by prod incidents.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from ..base import MXNetError
+from ..resilience.backoff import BackoffPolicy
+from .batcher import Batcher, DEFAULT_TIER, RequestShed, ServerBusy
+from .stats import ServingStats
+
+__all__ = ["ModelFleet", "CircuitBreaker", "BreakerOpen", "UnknownModel"]
+
+
+class BreakerOpen(MXNetError):
+    """The model's circuit breaker is open — fail fast (HTTP 503 with
+    ``Retry-After`` = ``retry_after_s``)."""
+
+    def __init__(self, message, model=None, retry_after_s=1.0):
+        super().__init__(message)
+        self.model = model
+        self.retry_after_s = float(retry_after_s)
+
+
+class UnknownModel(MXNetError):
+    """Routing key names no registered model (HTTP 404)."""
+
+
+class CircuitBreaker:
+    """Per-model circuit breaker: closed -> open -> half-open -> closed.
+
+    ``failure_threshold`` consecutive batch failures trip it open; the
+    open duration is ``policy.delay(trip_count)`` (exponential, from the
+    shared :class:`BackoffPolicy` — a repeatedly-sick model backs off
+    harder).  After the open window one probe window is allowed
+    (half-open): a success closes the breaker and resets the trip count,
+    a failure re-opens it with the next backoff delay.  Thread-safe;
+    all timing on ``time.monotonic()``.
+    """
+
+    def __init__(self, failure_threshold=3, policy=None):
+        self.failure_threshold = int(failure_threshold)
+        if self.failure_threshold < 1:
+            raise MXNetError("failure_threshold must be >= 1")
+        # jitter=0: a single server gains nothing from desynchronizing
+        # against itself, and deterministic open windows are what the
+        # chaos tests replay
+        self.policy = policy if policy is not None else BackoffPolicy(
+            base_s=0.5, factor=2.0, max_delay_s=30.0, jitter=0.0)
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0
+        self._trips = 0
+        self._open_until = 0.0
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self):
+        if self._state == "open" and \
+                time.monotonic() >= self._open_until:
+            self._state = "half_open"
+        return self._state
+
+    def allow(self):
+        """May traffic flow?  True while closed or half-open (the probe
+        window); False while the open window runs."""
+        with self._lock:
+            return self._state_locked() != "open"
+
+    def retry_after_s(self):
+        with self._lock:
+            if self._state_locked() != "open":
+                return 0.0
+            return max(0.0, self._open_until - time.monotonic())
+
+    def record_success(self):
+        with self._lock:
+            self._consecutive = 0
+            if self._state_locked() == "half_open":
+                self._state = "closed"
+                self._trips = 0
+
+    def record_failure(self):
+        with self._lock:
+            state = self._state_locked()
+            if state == "half_open":
+                self._trip_locked()
+                return
+            self._consecutive += 1
+            if state == "closed" and \
+                    self._consecutive >= self.failure_threshold:
+                self._trip_locked()
+
+    def _trip_locked(self):
+        self._state = "open"
+        self._open_until = time.monotonic() + \
+            self.policy.delay(min(self._trips, self.policy.max_retries))
+        self._trips += 1
+        self._consecutive = 0
+
+    def reset(self):
+        """Back to pristine closed (wired to hot swap: a fresh runner
+        deserves a fresh failure budget)."""
+        with self._lock:
+            self._state = "closed"
+            self._consecutive = 0
+            self._trips = 0
+            self._open_until = 0.0
+
+    def __repr__(self):
+        return "<CircuitBreaker %s trips=%d>" % (self.state, self._trips)
+
+
+class _Entry:
+    """One hosted model: runner (behind its batcher), breaker, packing
+    bytes, fallback route, declared SLOs, swap bookkeeping."""
+
+    __slots__ = ("name", "batcher", "breaker", "hbm_bytes", "fallback",
+                 "tier_slos", "last_swap_blip_ms")
+
+    def __init__(self, name, batcher, breaker, hbm_bytes, fallback,
+                 tier_slos):
+        self.name = name
+        self.batcher = batcher
+        self.breaker = breaker
+        self.hbm_bytes = hbm_bytes
+        self.fallback = fallback
+        self.tier_slos = dict(tier_slos or {})
+        self.last_swap_blip_ms = None
+
+    @property
+    def runner(self):
+        return self.batcher.runner
+
+
+class ModelFleet:
+    """N named ModelRunners behind one routing surface.
+
+    Parameters
+    ----------
+    hbm_cap_bytes : summed-modeled-HBM cap for packing (default: the
+        ``MXTPU_SERVING_HBM_CAP`` env var; 0/unset disables).  Checked
+        statically at every ``register()`` (SRV004).
+    stall_threshold_s : a model whose in-flight batch exceeds this is
+        reported unready (``/readyz``) while the process stays live.
+    batch_timeout_ms / max_queue : per-model Batcher defaults
+        (overridable per ``register``).
+    """
+
+    def __init__(self, hbm_cap_bytes=None, stall_threshold_s=30.0,
+                 batch_timeout_ms=2.0, max_queue=256):
+        import os
+        if hbm_cap_bytes is None:
+            hbm_cap_bytes = int(os.environ.get(
+                "MXTPU_SERVING_HBM_CAP", "0")) or None
+        self.hbm_cap_bytes = hbm_cap_bytes
+        self.stall_threshold_s = float(stall_threshold_s)
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.max_queue = int(max_queue)
+        self._lock = threading.Lock()
+        self._entries = {}          # name -> _Entry, registration order
+        self._default = None
+        self._route_seq = 0
+
+    # -- registration: admission control as a static problem ---------------
+    def models(self):
+        with self._lock:
+            return list(self._entries)
+
+    @property
+    def default_model(self):
+        return self._default
+
+    def entry(self, name=None):
+        with self._lock:
+            key = name if name is not None else self._default
+            try:
+                return self._entries[key]
+            except KeyError:
+                raise UnknownModel(
+                    "no model %r registered (have: %s)"
+                    % (key, sorted(self._entries) or "none")) from None
+
+    def runner(self, name=None):
+        return self.entry(name).runner
+
+    def batcher(self, name=None):
+        return self.entry(name).batcher
+
+    @staticmethod
+    def _modeled_hbm(runner, hbm_bytes=None):
+        if hbm_bytes is not None:
+            return int(hbm_bytes)
+        return runner.modeled_peak_hbm()
+
+    def register(self, name, runner, fallback=None, hbm_bytes=None,
+                 max_batch=None, batch_timeout_ms=None, max_queue=None,
+                 service_time_hint_ms=None, breaker=None, tier_slos=None):
+        """Host ``runner`` as ``name``.  Refused (``MXNetError`` carrying
+        the SRV004 finding with the modeled per-model numbers) when the
+        fleet's summed modeled peak HBM would exceed ``hbm_cap_bytes`` —
+        over-commit is caught at registration, not at the first OOM.
+
+        ``hbm_bytes`` overrides the modeled figure for runners the cost
+        pass cannot see (Gluon blocks have no Symbol; their modeled HBM
+        is None and only the modeled models count against the cap).
+        ``fallback`` names the cheaper variant (registered before or
+        after) that absorbs this model's overflow; ``tier_slos`` is the
+        declared per-tier p99 budget (ms) surfaced in stats.
+        """
+        name = str(name)
+        candidate = self._modeled_hbm(runner, hbm_bytes)
+        with self._lock:
+            if name in self._entries:
+                raise MXNetError("model %r already registered; use swap()"
+                                 % name)
+            if self.hbm_cap_bytes:
+                from ..analysis.serving_lint import lint_fleet_hbm
+                packing = {e.name: e.hbm_bytes
+                           for e in self._entries.values()}
+                packing[name] = candidate
+                findings = lint_fleet_hbm(packing, self.hbm_cap_bytes)
+                if findings:
+                    from ..analysis import render_text
+                    raise MXNetError(
+                        "fleet registration refused — modeled HBM over "
+                        "cap:\n%s" % render_text(findings))
+            breaker = breaker if breaker is not None else CircuitBreaker()
+            batcher = Batcher(
+                runner, max_batch=max_batch,
+                batch_timeout_ms=self.batch_timeout_ms
+                if batch_timeout_ms is None else batch_timeout_ms,
+                max_queue=self.max_queue if max_queue is None
+                else max_queue,
+                stats=ServingStats(runner.buckets),
+                service_time_hint_ms=service_time_hint_ms,
+                on_batch_success=breaker.record_success,
+                on_batch_error=lambda exc: breaker.record_failure(),
+                model=name)
+            entry = _Entry(name, batcher, breaker, candidate, fallback,
+                           tier_slos)
+            self._entries[name] = entry
+            if self._default is None:
+                self._default = name
+        return entry
+
+    def modeled_hbm_total(self):
+        """Summed modeled peak HBM over registered models (None-modeled
+        runners excluded) — the packing ledger /stats exposes."""
+        with self._lock:
+            return sum(e.hbm_bytes for e in self._entries.values()
+                       if e.hbm_bytes)
+
+    # -- routing -----------------------------------------------------------
+    def submit(self, example, model=None, tier=DEFAULT_TIER,
+               deadline_ms=None):
+        """Route one example: returns a future-like with ``.result()``.
+
+        Overload ladder: an open breaker or a shed/full-queue refusal on
+        the primary reroutes to its registered ``fallback`` (degraded
+        mode) when that variant is warm and closed; only when the
+        fallback also refuses does the caller see the original
+        :class:`RequestShed` / :class:`BreakerOpen` / :class:`ServerBusy`.
+        """
+        from ..resilience import chaos as _chaos
+        entry = self.entry(model)
+        with self._lock:
+            self._route_seq += 1
+            seq = self._route_seq
+        _chaos.maybe_inject("serving.route", count=seq,
+                            ctx=(entry.name, tier))
+        self._check_shape(entry, example)
+        return self._submit_entry(entry, example, tier, deadline_ms,
+                                  allow_fallback=True)
+
+    def _check_shape(self, entry, example):
+        import numpy as _np
+        shape = _np.asarray(example).shape
+        want = tuple(entry.runner.example_shape)
+        if tuple(shape) != want:
+            raise MXNetError(
+                "example shape %r does not match model %r example_shape "
+                "%r" % (tuple(shape), entry.name, want))
+
+    def _fallback_entry(self, entry):
+        if not entry.fallback:
+            return None
+        with self._lock:
+            fb = self._entries.get(entry.fallback)
+        if fb is None or not getattr(fb.runner, "warmed_up", False):
+            return None
+        if not fb.breaker.allow() or fb.batcher.draining:
+            return None
+        return fb
+
+    def _submit_entry(self, entry, example, tier, deadline_ms,
+                      allow_fallback):
+        if not entry.breaker.allow():
+            fb = self._fallback_entry(entry) if allow_fallback else None
+            if fb is not None:
+                entry.batcher.stats.on_degraded()
+                return self._submit_entry(fb, example, tier, deadline_ms,
+                                          allow_fallback=False)
+            raise BreakerOpen(
+                "model %r breaker is open (%d consecutive batch "
+                "failures tripped it); retry after %.1fs"
+                % (entry.name, entry.breaker.failure_threshold,
+                   entry.breaker.retry_after_s()),
+                model=entry.name,
+                retry_after_s=max(1.0, math.ceil(
+                    entry.breaker.retry_after_s())))
+        try:
+            return entry.batcher.submit(example, tier=tier,
+                                        deadline_ms=deadline_ms,
+                                        model=entry.name)
+        except (RequestShed, ServerBusy):
+            fb = self._fallback_entry(entry) if allow_fallback else None
+            if fb is None:
+                raise
+            entry.batcher.stats.on_degraded()
+            return self._submit_entry(fb, example, tier, deadline_ms,
+                                      allow_fallback=False)
+
+    def infer(self, example, model=None, tier=DEFAULT_TIER,
+              deadline_ms=None, timeout=30.0):
+        """Blocking convenience: route + wait for the result row."""
+        return self.submit(example, model=model, tier=tier,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    # -- hot swap ----------------------------------------------------------
+    def swap(self, name, runner, warmup=True, timeout=30.0):
+        """Replace model ``name``'s runner under drain of its in-flight
+        batch: the new runner is warmed first (nothing is routed to a
+        cold bucket ladder), the swap waits for the executing batch, and
+        queued requests are served by the replacement — zero failed
+        in-flight requests.  The breaker resets (a fresh runner deserves
+        a fresh failure budget).  Returns the previous runner; the blip
+        (ms the swap waited on the in-flight batch) lands in
+        ``stats_dict()``."""
+        from ..resilience import chaos as _chaos
+        entry = self.entry(name)
+        _chaos.maybe_inject("serving.swap", ctx=entry.name)
+        if warmup and not getattr(runner, "warmed_up", False):
+            runner.warmup()
+        t0 = time.monotonic()
+        old = entry.batcher.swap_runner(runner, timeout=timeout)
+        entry.last_swap_blip_ms = (time.monotonic() - t0) * 1000.0
+        entry.breaker.reset()
+        return old
+
+    # -- readiness ---------------------------------------------------------
+    def unready(self):
+        """{model: reason} for every model not currently routable:
+        ``warming`` (bucket ladder not compiled), ``breaker_open`` /
+        ``breaker_half_open`` (tripped on repeated failures), ``stalled``
+        (in-flight batch exceeded ``stall_threshold_s``), ``draining``.
+        Empty dict == the fleet is ready (the /readyz contract)."""
+        out = {}
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            if not getattr(e.runner, "warmed_up", False):
+                out[e.name] = "warming"
+            elif e.breaker.state != "closed":
+                out[e.name] = "breaker_%s" % e.breaker.state
+            elif e.batcher.stalled(self.stall_threshold_s):
+                out[e.name] = "stalled"
+            elif e.batcher.draining:
+                out[e.name] = "draining"
+        return out
+
+    @property
+    def ready(self):
+        return not self.unready()
+
+    @property
+    def draining(self):
+        with self._lock:
+            entries = list(self._entries.values())
+        return any(e.batcher.draining for e in entries)
+
+    # -- observability -----------------------------------------------------
+    def stats_dict(self):
+        """Per-model stats + the fleet packing/routing ledger."""
+        with self._lock:
+            entries = list(self._entries.values())
+            cap = self.hbm_cap_bytes
+        models = {}
+        for e in entries:
+            d = e.batcher.stats.as_dict()
+            d["breaker"] = e.breaker.state
+            d["fallback"] = e.fallback
+            d["tier_slos_ms"] = dict(e.tier_slos)
+            d["modeled_peak_hbm_bytes"] = e.hbm_bytes
+            d["queue_depth"] = e.batcher.queue_depth
+            d["modeled_wait_ms"] = round(e.batcher.modeled_wait_ms(), 3)
+            d["recompiles"] = e.runner.recompiles_since_warmup()
+            d["buckets_configured"] = list(e.runner.buckets)
+            if e.last_swap_blip_ms is not None:
+                d["last_swap_blip_ms"] = round(e.last_swap_blip_ms, 3)
+            models[e.name] = d
+        return {
+            "models": models,
+            "default_model": self._default,
+            "hbm_cap_bytes": cap,
+            "modeled_hbm_total_bytes": self.modeled_hbm_total(),
+            "unready": self.unready(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def drain(self, timeout=60.0):
+        """Drain every model's batcher against one shared deadline.
+        Raises ``TimeoutError`` (after attempting all) when any batcher
+        missed it — callers holding a hard deadline follow up with
+        :meth:`force_drain`."""
+        deadline = time.monotonic() + float(timeout)
+        late = []
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            try:
+                e.batcher.drain(timeout=max(0.05,
+                                            deadline - time.monotonic()))
+            except TimeoutError:
+                late.append(e.name)
+        if late:
+            raise TimeoutError("fleet did not drain within %ss "
+                               "(stuck: %s)" % (timeout, late))
+        return True
+
+    def force_drain(self):
+        with self._lock:
+            entries = list(self._entries.values())
+        return sum(e.batcher.force_drain() for e in entries)
+
+    def __repr__(self):
+        return "<ModelFleet %s default=%r>" % (self.models(),
+                                               self._default)

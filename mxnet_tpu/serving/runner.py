@@ -134,6 +134,17 @@ class ModelRunner:
         self._modeled_cost = out
         return out
 
+    def modeled_peak_hbm(self):
+        """Worst-case modeled peak HBM over the bucket ladder (bytes) —
+        the figure fleet packing sums against the SRV004 cap.  None when
+        the cost pass cannot see the model (Gluon blocks have no Symbol);
+        such runners need an explicit ``hbm_bytes`` at registration to
+        count against the cap."""
+        cost = self.modeled_cost()
+        if not cost:
+            return None
+        return max(row["peak_hbm_bytes"] for row in cost.values())
+
     # -- bucket arithmetic -------------------------------------------------
     @property
     def max_batch(self):

@@ -1,43 +1,58 @@
-"""HTTP front end: /predict with dynamic batching, /healthz, /stats.
+"""HTTP front end: /predict with multi-model routing and SLO tiers,
+/healthz, /livez, /readyz (per-model), /stats.
 
-Stdlib ``http.server`` over the :class:`~mxnet_tpu.serving.batcher.Batcher`
+Stdlib ``http.server`` over a :class:`~mxnet_tpu.serving.fleet.ModelFleet`
 (the socket framing idioms follow ``kvstore_ps.py``: bounded, blocking,
-per-connection threads).  Contract:
+per-connection threads).  A bare :class:`ModelRunner` is accepted too and
+wrapped as a one-model fleet named ``default``.  Contract:
 
-- ``POST /predict``  body ``{"data": <nested list>}`` — one example when
-  the shape matches ``example_shape``, else a batch of examples (each
-  coalesced independently).  200 → ``{"outputs": ...}``.
+- ``POST /predict``  body ``{"data": <nested list>, "model": <name>,
+  "tier": "gold"|"silver"|"bronze", "deadline_ms": <number>}`` (model/
+  tier/deadline optional — defaults: the fleet's default model, gold, no
+  deadline).  ``data`` is one example when the shape matches the routed
+  model's ``example_shape``, else a batch of examples (each coalesced
+  independently).  200 → ``{"outputs": ..., "model": name}``.
 - ``429`` + ``Retry-After`` when the admission queue is full
-  (backpressure, never an unbounded backlog), ``503`` while draining,
-  ``400`` on malformed bodies, ``500`` on model errors.
-- ``GET /healthz`` — readiness-gated summary:
-  ``{"status": "ok"|"warming"|"draining", "alive": true, "ready": bool}``
-  with 200 only when ready (warming buckets ⇒ ready=false, alive=true —
-  a fleet scheduler must not route to a server still compiling its
-  bucket ladder, but must not restart it either).
+  (backpressure), ``503`` + ``Retry-After`` when admission control sheds
+  the request (modeled queue wait past its deadline, eviction by a
+  higher tier, or an open circuit breaker) or while draining, ``404`` on
+  an unknown model, ``400`` on malformed bodies, ``413`` when the body
+  exceeds ``max_body_bytes`` (the handler never buffers an unbounded
+  POST), ``500`` on model errors.
 - ``GET /livez`` — liveness alone: 200 while the process serves HTTP at
-  all (the restart signal); ``GET /readyz`` — readiness alone (the
-  routing signal).
-- ``GET /stats`` — the :meth:`ServingStats.as_dict` JSON: per-bucket
-  p50/p99 latency, queue depth, batch-fill ratio, recompile count.
+  all (the restart signal).  ``GET /readyz`` — the routing signal, now
+  per-model: 503 with ``{"unready": {model: reason}}`` until every
+  registered model is warm, its breaker closed, and nothing is stalled
+  or draining.  ``GET /healthz`` keeps the readiness-gated summary.
+- ``GET /stats`` — the default model's ServingStats dict (back-compat
+  flat keys) plus ``models`` with every model's stats, breaker state,
+  per-tier p50/p99/shed, modeled HBM packing ledger and swap blips.
 - ``drain()`` — stop admissions, finish all in-flight requests, then
   stop the listener (graceful shutdown; wired to SIGTERM/SIGINT in
-  ``tools/serve.py``).  Honors a hard deadline (``drain_timeout_s``):
-  when in-flight work does not finish in time, queued requests are
-  failed with 503s and the listener stops anyway — a wedged model call
-  can no longer hold shutdown hostage.
+  ``tools/serve.py``).  Honors a hard deadline (``drain_timeout_s``).
+
+All latency/drain arithmetic is ``time.monotonic()``-based (audited: no
+wall-clock ``time.time()`` in the serving path — an NTP step must never
+expire a deadline or a drain early).
 """
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as _np
 
-from .batcher import Batcher, Draining, ServerBusy
+from ..base import MXNetError
+from .batcher import Draining, RequestShed, ServerBusy, tier_rank
+from .fleet import BreakerOpen, ModelFleet, UnknownModel
 
 __all__ = ["Server"]
+
+# bound on request bodies the handler will buffer; an oversized POST gets
+# 413 without reading the payload (OOM-proofing the handler thread)
+DEFAULT_MAX_BODY_BYTES = 16 << 20
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -51,7 +66,7 @@ class _HTTPServer(ThreadingHTTPServer):
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
-    server_version = "mxtpu-serving/0.1"
+    server_version = "mxtpu-serving/0.2"
 
     # the Server instance is attached to the HTTPServer as `.serving`
     @property
@@ -80,21 +95,37 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/livez":
             # liveness: answering at all IS the signal — never 503 here,
             # or a fleet manager would restart a server that is merely
-            # warming/draining
+            # warming/draining/tripped
             self._reply(200, {"alive": True})
         elif self.path == "/readyz":
-            self._reply(200 if srv.ready else 503,
-                        {"ready": srv.ready, "status": srv.status})
+            # the routing signal, per-model: a fleet scheduler must not
+            # send traffic while any registered model is cold, tripped,
+            # stalled or draining — but must not restart the process
+            unready = srv.fleet.unready()
+            if srv.draining:
+                unready = dict(unready, **{
+                    m: "draining" for m in srv.fleet.models()
+                    if m not in unready})
+            ready = not unready and not srv.draining
+            body = {"ready": ready, "status": srv.status}
+            if unready:   # per-model detail only when something is wrong
+                body["unready"] = unready
+            self._reply(200 if ready else 503, body)
         elif self.path == "/stats":
-            stats = srv.batcher.stats.as_dict()
-            stats["recompiles"] = srv.runner.recompiles_since_warmup()
-            stats["buckets_configured"] = list(srv.runner.buckets)
+            fleet_stats = srv.fleet.stats_dict()
+            # back-compat flat surface: the default model's numbers at
+            # the top level, exactly what single-model dashboards read
+            default = srv.fleet.entry()
+            stats = default.batcher.stats.as_dict()
+            stats["recompiles"] = default.runner.recompiles_since_warmup()
+            stats["buckets_configured"] = list(default.runner.buckets)
             # static per-bucket cost model (mxcost): modeled, not
             # measured — lets dashboards show expected flops/HBM next
             # to the measured p50/p99 without a profiling run
             stats["modeled_cost"] = {
                 str(b): row
-                for b, row in sorted(srv.runner.modeled_cost().items())}
+                for b, row in sorted(default.runner.modeled_cost().items())}
+            stats.update(fleet_stats)
             self._reply(200, stats)
         else:
             self._reply(404, {"error": "unknown path %s" % self.path})
@@ -106,56 +137,107 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self._srv
         try:
             n = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(n) or b"{}")
-            data = _np.asarray(payload["data"], dtype=_np.float64)
-        except (ValueError, KeyError, TypeError) as e:
-            self._reply(400, {"error": "bad request: %s" % e})
+        except (TypeError, ValueError):
+            self._reply(400, {"error": "bad Content-Length"})
             return
-        single = data.shape == srv.runner.example_shape
-        batch = data[None] if single else data
-        if batch.ndim != len(srv.runner.example_shape) + 1 or \
-                batch.shape[1:] != srv.runner.example_shape:
-            self._reply(400, {
-                "error": "shape %r does not match example_shape %r"
-                         % (data.shape, srv.runner.example_shape)})
+        if n > srv.max_body_bytes:
+            # refuse BEFORE reading: an unbounded read here is how an
+            # oversized POST OOMs the handler thread.  The unread body
+            # makes the connection unreusable — close it.
+            self.close_connection = True
+            self._reply(413, {
+                "error": "request body %d bytes exceeds the %d-byte cap"
+                         % (n, srv.max_body_bytes)},
+                headers=[("Connection", "close")])
             return
         try:
-            pending = [srv.batcher.submit(row) for row in batch]
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            data = _np.asarray(payload["data"], dtype=_np.float64)
+            model = payload.get("model")
+            tier = payload.get("tier", "gold")
+            deadline_ms = payload.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            tier_rank(tier)  # validate before routing: bad tier is a 400
+        except (ValueError, KeyError, TypeError, MXNetError) as e:
+            self._reply(400, {"error": "bad request: %s" % e})
+            return
+        try:
+            entry = srv.fleet.entry(model)
+        except UnknownModel as e:
+            self._reply(404, {"error": str(e)})
+            return
+        example_shape = tuple(entry.runner.example_shape)
+        single = data.shape == example_shape
+        batch = data[None] if single else data
+        if batch.ndim != len(example_shape) + 1 or \
+                batch.shape[1:] != example_shape:
+            self._reply(400, {
+                "error": "shape %r does not match model %r example_shape "
+                         "%r" % (data.shape, entry.name, example_shape)})
+            return
+        try:
+            pending = [srv.fleet.submit(row, model=entry.name, tier=tier,
+                                        deadline_ms=deadline_ms)
+                       for row in batch]
+            outs = [p.result(srv.request_timeout_s) for p in pending]
         except ServerBusy as e:
             self._reply(429, {"error": str(e)},
                         headers=[("Retry-After", "1")])
             return
+        except (RequestShed, BreakerOpen) as e:
+            retry = max(1, int(math.ceil(getattr(e, "retry_after_s", 1.0))))
+            self._reply(503, {"error": str(e),
+                              "tier": getattr(e, "tier", tier)},
+                        headers=[("Retry-After", str(retry))])
+            return
         except Draining as e:
             self._reply(503, {"error": str(e)})
             return
-        try:
-            outs = [p.result(srv.request_timeout_s) for p in pending]
         except Exception as e:  # model error / timeout
             self._reply(500, {"error": str(e)[:500]})
             return
         out = _np.stack(outs)
-        self._reply(200, {"outputs": (out[0] if single else out).tolist()})
+        self._reply(200, {"outputs": (out[0] if single else out).tolist(),
+                          "model": entry.name})
 
 
 class Server:
-    """Ties Runner + Batcher + HTTP listener into one serving process."""
+    """Ties Fleet (or a single Runner) + HTTP listener into one serving
+    process.  With a bare runner, ``max_batch``/``batch_timeout_ms``/
+    ``max_queue`` configure its batcher exactly as before; with a
+    pre-built :class:`ModelFleet` those knobs live on the fleet's
+    registrations and are ignored here."""
 
-    def __init__(self, runner, host="127.0.0.1", port=8080, max_batch=None,
+    def __init__(self, model, host="127.0.0.1", port=8080, max_batch=None,
                  batch_timeout_ms=2.0, max_queue=256,
                  request_timeout_s=30.0, drain_timeout_s=60.0,
-                 verbose=False):
-        self.runner = runner
-        self.batcher = Batcher(runner, max_batch=max_batch,
-                               batch_timeout_ms=batch_timeout_ms,
-                               max_queue=max_queue)
+                 max_body_bytes=DEFAULT_MAX_BODY_BYTES, verbose=False):
+        if isinstance(model, ModelFleet):
+            self.fleet = model
+        else:
+            self.fleet = ModelFleet(batch_timeout_ms=batch_timeout_ms,
+                                    max_queue=max_queue)
+            self.fleet.register("default", model, max_batch=max_batch)
         self.request_timeout_s = float(request_timeout_s)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.max_body_bytes = int(max_body_bytes)
         self.verbose = verbose
         self._httpd = _HTTPServer((host, port), _Handler)
         self._httpd.serving = self
         self._thread = None
         self._drained = False
         self.drain_forced = False
+
+    # back-compat single-model surface (PR-2 callers/tests): the default
+    # model's runner/batcher, following hot swaps
+    @property
+    def runner(self):
+        return self.fleet.entry().runner
+
+    @property
+    def batcher(self):
+        return self.fleet.entry().batcher
 
     @property
     def address(self):
@@ -164,19 +246,18 @@ class Server:
 
     @property
     def draining(self):
-        return self.batcher.draining
+        return self.fleet.draining
 
     @property
     def ready(self):
-        """Readiness: warmed buckets and not draining.  A runner loaded
-        with ``warmup=False`` keeps the server alive-but-unready until
-        ``warmup()`` finishes — the liveness/readiness split."""
-        return (not self.batcher.draining
-                and bool(getattr(self.runner, "warmed_up", True)))
+        """Readiness: every registered model warm, breaker closed, not
+        stalled, and nothing draining — the per-model liveness/readiness
+        split ``/readyz`` serves."""
+        return not self.draining and self.fleet.ready
 
     @property
     def status(self):
-        if self.batcher.draining:
+        if self.draining:
             return "draining"
         return "ok" if self.ready else "warming"
 
@@ -197,14 +278,14 @@ class Server:
         """Graceful shutdown with a hard deadline: new requests get 503
         and everything already admitted completes — but only for
         ``drain_timeout_s`` (or ``timeout``).  Past the deadline the
-        remaining queue is failed with 503s and the listener stops
+        remaining queues are failed with 503s and the listener stops
         anyway (``drain_forced`` records it): shutdown always finishes.
         Returns True for a clean drain, False when forced."""
         timeout = self.drain_timeout_s if timeout is None else float(timeout)
         try:
-            self.batcher.drain(timeout=timeout)
+            self.fleet.drain(timeout=timeout)
         except TimeoutError:
-            self.batcher.force_drain()
+            self.fleet.force_drain()
             self.drain_forced = True
         if not self._drained:
             self._drained = True

@@ -942,6 +942,74 @@ def test_serving_modeled_cost_and_srv003():
     assert not any("SRV003" in str(w.message) for w in caught)
 
 
+def test_srv004_fleet_hbm_packing():
+    from mxnet_tpu.analysis import lint_fleet_hbm
+    # under cap / no cap: clean
+    assert lint_fleet_hbm({"a": 600 << 20, "b": 300 << 20}, 1 << 30) == []
+    assert lint_fleet_hbm({"a": 600 << 20, "b": 600 << 20}, 0) == []
+    # over cap: one SRV004 error carrying the per-model modeled numbers
+    found = lint_fleet_hbm({"a": 600 << 20, "b": 500 << 20, "c": None},
+                           1 << 30)
+    assert [f.rule_id for f in found] == ["SRV004"]
+    assert found[0].severity == "error"
+    msg = found[0].message
+    assert "a=600.0 MiB" in msg and "b=500.0 MiB" in msg
+    assert "1100.0 MiB" in msg and "1024.0 MiB" in msg
+    assert "c" in msg        # unmodelable runners are named, not counted
+
+
+def test_srv004_deadline_propagation():
+    from mxnet_tpu.analysis import lint_deadline_propagation
+    bad = (
+        "def handler(payload):\n"
+        "    deadline_ms = payload.get('deadline_ms')\n"
+        "    return fleet.submit(payload['x'], tier='gold')\n")
+    found = lint_deadline_propagation(source=bad)
+    assert [f.rule_id for f in found] == ["SRV004"]
+    assert "handler" in found[0].message
+    # propagating the kwarg (or an opaque **kwargs splat) is clean, and
+    # functions that never bind deadline_ms are out of scope
+    good = bad.replace("tier='gold'", "tier='gold', deadline_ms=deadline_ms")
+    splat = bad.replace("tier='gold'", "**kw")
+    unbound = "def f(x):\n    return fleet.submit(x)\n"
+    infer_bad = bad.replace(".submit", ".infer")
+    assert lint_deadline_propagation(source=good) == []
+    assert lint_deadline_propagation(source=splat) == []
+    assert lint_deadline_propagation(source=unbound) == []
+    assert [f.rule_id for f in lint_deadline_propagation(
+        source=infer_bad)] == ["SRV004"]
+
+
+def test_srv004_shipped_serving_sources_clean():
+    """The --self-check sweep: every shipped serving request path
+    (mxnet_tpu/serving/, tools/serve.py, examples/serving/) propagates
+    deadline_ms to its submit/infer sinks."""
+    from mxnet_tpu.analysis import lint_serving_sources
+    assert lint_serving_sources() == []
+
+
+def test_srv004_fleet_registration_refused_end_to_end():
+    """ModelFleet.register is the enforcement point: the refusal error
+    carries the rendered SRV004 finding."""
+    import mxnet_tpu.serving as serving
+    data = sym.var("data")
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(data, num_hidden=3, name="sf4_fc"),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    runner = serving.ModelRunner(mod, buckets=(1, 2), example_shape=(8,))
+    hbm = runner.modeled_peak_hbm()
+    assert hbm and hbm > 0
+    fleet = serving.ModelFleet(hbm_cap_bytes=hbm)      # exactly one fits
+    fleet.register("one", runner)
+    with pytest.raises(MXNetError, match="SRV004"):
+        fleet.register("two", runner, hbm_bytes=1)
+    fleet.drain()
+
+
 def test_serving_stats_expose_modeled_cost():
     from mxnet_tpu.serving.stats import ServingStats  # noqa: F401  (sanity)
     import mxnet_tpu.serving as serving

@@ -1,22 +1,30 @@
 #!/usr/bin/env python
-"""Serve a trained model over HTTP with dynamic batching.
+"""Serve trained models over HTTP: multi-model fleet, SLO tiers, dynamic
+batching, graceful degradation.
 
 The deployment CLI the reference never shipped (its story stopped at
-``HybridBlock.export``): load a Module checkpoint, stand it behind fixed
-padded batch buckets (AOT-compiled at load so steady-state traffic never
-recompiles), coalesce concurrent requests, answer on ``/predict`` with
-``/healthz`` and ``/stats`` beside it, and drain gracefully on
+``HybridBlock.export``): load one or many Module checkpoints, stand them
+behind fixed padded batch buckets (AOT-compiled at load so steady-state
+traffic never recompiles), pack them against the modeled-HBM cap, coalesce
+concurrent requests deadline-aware, answer on ``/predict`` with per-model
+``/readyz``, ``/livez`` and ``/stats`` beside it, and drain gracefully on
 SIGTERM/SIGINT.  See docs/serving.md.
 
-    # serve a Module checkpoint (prefix-symbol.json + prefix-0003.params)
+    # single model (PR-2 form, still supported)
     python tools/serve.py --prefix model --epoch 3 --data-shape 64 \
         --buckets 1,4,16,64 --port 8080
 
-    # no checkpoint handy: a tiny demo MLP
-    python tools/serve.py --demo --port 8080
+    # a fleet: fp32 primary + int8 quantized variant as its
+    # degraded-mode target (overflow the primary sheds reroutes there)
+    python tools/serve.py --data-shape 3,224,224 \
+        --model resnet=ckpt/resnet@3 \
+        --model resnet_int8=ckpt/resnet@3:int8 \
+        --fallback resnet=resnet_int8 --hbm-cap $((8 << 30))
 
-    curl -s -X POST localhost:8080/predict -d '{"data": [[0.1, ...]]}'
-    curl -s localhost:8080/stats
+    curl -s -X POST localhost:8080/predict \
+        -d '{"data": [[0.1, ...]], "model": "resnet", "tier": "silver",
+             "deadline_ms": 50}'
+    curl -s localhost:8080/readyz; curl -s localhost:8080/stats
 """
 from __future__ import annotations
 
@@ -32,16 +40,32 @@ if _ROOT not in sys.path:
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(
-        description="dynamic-batching inference server (mxnet_tpu.serving)")
-    p.add_argument("--prefix", help="checkpoint prefix (Module.save_checkpoint)")
+        description="multi-model SLO-tiered inference fleet "
+                    "(mxnet_tpu.serving)")
+    p.add_argument("--prefix", help="checkpoint prefix (Module."
+                                    "save_checkpoint) — single-model form")
     p.add_argument("--epoch", type=int, default=0)
     p.add_argument("--demo", action="store_true",
                    help="serve a randomly initialized demo MLP instead of "
                         "a checkpoint")
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=PREFIX[@EPOCH][:int8]",
+                   help="register a fleet model from a checkpoint; the "
+                        ":int8 suffix quantizes it at load (naive "
+                        "calibration over synthetic data — the cheap "
+                        "degraded-mode variant).  Repeatable.")
+    p.add_argument("--fallback", action="append", default=[],
+                   metavar="NAME=VARIANT",
+                   help="degraded mode: overflow NAME sheds (or refuses "
+                        "with an open breaker) reroutes to VARIANT. "
+                        "Repeatable.")
+    p.add_argument("--hbm-cap", type=int, default=None,
+                   help="fleet modeled-HBM packing cap in bytes (SRV004; "
+                        "default: MXTPU_SERVING_HBM_CAP, 0 disables)")
     p.add_argument("--data-name", default="data")
     p.add_argument("--data-shape", default=None,
                    help="per-example input shape, e.g. '64' or '3,224,224' "
-                        "(required with --prefix)")
+                        "(required with --prefix/--model)")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--buckets", default="1,4,16,64",
                    help="padded batch buckets compiled at load")
@@ -54,7 +78,14 @@ def parse_args(argv=None):
                    help="how long the batcher waits to fill a batch after "
                         "the first request arrives")
     p.add_argument("--max-queue", type=int, default=256,
-                   help="admission queue depth; beyond it requests get 429")
+                   help="per-model admission queue depth; beyond it "
+                        "requests get 429 (or evict a lower tier)")
+    p.add_argument("--max-body-bytes", type=int, default=16 << 20,
+                   help="largest POST body the handler will buffer; "
+                        "beyond it requests get 413")
+    p.add_argument("--stall-threshold-s", type=float, default=30.0,
+                   help="a model whose in-flight batch exceeds this is "
+                        "reported unready on /readyz")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip AOT bucket compilation (first requests pay "
                         "the compile)")
@@ -66,27 +97,70 @@ def _shape(text):
     return tuple(int(d) for d in str(text).split(",") if d.strip())
 
 
-def build_module_runner(args):
+def parse_model_spec(spec):
+    """``NAME=PREFIX[@EPOCH][:int8]`` -> (name, prefix, epoch, int8)."""
+    name, sep, rest = str(spec).partition("=")
+    if not sep or not name or not rest:
+        raise SystemExit("bad --model spec %r "
+                         "(want NAME=PREFIX[@EPOCH][:int8])" % (spec,))
+    int8 = rest.endswith(":int8")
+    if int8:
+        rest = rest[: -len(":int8")]
+    prefix, sep, ep = rest.partition("@")
+    try:
+        epoch = int(ep) if sep else 0
+    except ValueError:
+        raise SystemExit("bad epoch in --model spec %r" % (spec,))
+    if not prefix:
+        raise SystemExit("empty checkpoint prefix in --model spec %r"
+                         % (spec,))
+    return name, prefix, epoch, int8
+
+
+def _load_module(prefix, epoch, data_name, example_shape, buckets,
+                 int8=False):
+    """Load a Module checkpoint bound for bucketed inference; with
+    ``int8``, quantize it first (weights int8, activations calibrated
+    naively over synthetic data — scales only shift accuracy, never the
+    compiled program, so the degraded-mode variant is always buildable
+    without the training data on the serving host)."""
+    import numpy as np
+
     import mxnet_tpu as mx
+
+    sym, arg, aux = mx.model.load_checkpoint(prefix, epoch)
+    max_b = max(buckets)
+    if int8:
+        calib_batch = min(max_b, 32)
+        rng = np.random.RandomState(0)
+        calib_it = mx.io.NDArrayIter(
+            rng.rand(calib_batch, *example_shape).astype(np.float32),
+            np.zeros(calib_batch, np.float32), calib_batch)
+        sym, arg, aux = mx.contrib.quantization.quantize_model(
+            sym, arg, aux, data_names=(data_name,), calib_data=calib_it,
+            num_calib_examples=calib_batch, calib_mode="naive")
+    # label slots (…_label by convention) are bound with a batch-matched
+    # dummy feed; everything else non-data is a parameter
+    label_names = [n for n in sym.list_arguments() if n.endswith("_label")]
+    mod = mx.mod.Module(sym, data_names=(data_name,),
+                        label_names=label_names)
+    mod.bind(
+        data_shapes=[(data_name, (max_b,) + tuple(example_shape))],
+        label_shapes=[(n, (max_b,)) for n in label_names] or None,
+        for_training=False)
+    mod.set_params(arg, aux)
+    return mod
+
+
+def build_module_runner(args):
     from mxnet_tpu.serving import ModelRunner
 
     if not args.data_shape:
         raise SystemExit("--data-shape is required with --prefix")
     example_shape = _shape(args.data_shape)
     buckets = _shape(args.buckets)
-    sym, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
-                                                           args.epoch)
-    # label slots (…_label by convention) are bound with a batch-matched
-    # dummy feed; everything else non-data is a parameter
-    label_names = [n for n in sym.list_arguments() if n.endswith("_label")]
-    mod = mx.mod.Module(sym, data_names=(args.data_name,),
-                        label_names=label_names)
-    max_b = max(buckets)
-    mod.bind(
-        data_shapes=[(args.data_name, (max_b,) + example_shape)],
-        label_shapes=[(n, (max_b,)) for n in label_names] or None,
-        for_training=False)
-    mod.set_params(arg_params, aux_params)
+    mod = _load_module(args.prefix, args.epoch, args.data_name,
+                       example_shape, buckets)
     return ModelRunner(mod, buckets=buckets, dtype=args.dtype,
                        warmup=not args.no_warmup)
 
@@ -107,21 +181,69 @@ def build_demo_runner(args):
                        warmup=not args.no_warmup)
 
 
+def build_fleet(args):
+    """Fleet form: every ``--model`` becomes a registered runner (int8
+    variants quantized at load), ``--fallback`` wires degraded-mode
+    routes, and registration enforces the modeled-HBM packing cap
+    (SRV004) before any traffic arrives."""
+    from mxnet_tpu.serving import ModelFleet, ModelRunner
+
+    if not args.data_shape:
+        raise SystemExit("--data-shape is required with --model")
+    example_shape = _shape(args.data_shape)
+    buckets = _shape(args.buckets)
+    fallbacks = {}
+    for spec in args.fallback:
+        name, sep, variant = str(spec).partition("=")
+        if not sep or not name or not variant:
+            raise SystemExit("bad --fallback spec %r (want NAME=VARIANT)"
+                             % (spec,))
+        fallbacks[name] = variant
+    fleet = ModelFleet(hbm_cap_bytes=args.hbm_cap,
+                       stall_threshold_s=args.stall_threshold_s,
+                       batch_timeout_ms=args.batch_timeout_ms,
+                       max_queue=args.max_queue)
+    names = []
+    for spec in args.model:
+        name, prefix, epoch, int8 = parse_model_spec(spec)
+        mod = _load_module(prefix, epoch, args.data_name, example_shape,
+                           buckets, int8=int8)
+        runner = ModelRunner(mod, buckets=buckets, dtype=args.dtype,
+                             warmup=not args.no_warmup)
+        fleet.register(name, runner, fallback=fallbacks.get(name),
+                       max_batch=args.max_batch)
+        names.append(name)
+    unknown = {v for v in fallbacks.values() if v not in names}
+    missing = {k for k in fallbacks if k not in names}
+    if unknown or missing:
+        raise SystemExit("--fallback names unregistered models: %s"
+                         % sorted(unknown | missing))
+    return fleet
+
+
 def main(argv=None):
     args = parse_args(argv)
-    if not args.demo and not args.prefix:
-        raise SystemExit("give --prefix (a checkpoint) or --demo")
+    if not args.demo and not args.prefix and not args.model:
+        raise SystemExit("give --model specs (a fleet), --prefix "
+                         "(a checkpoint) or --demo")
 
     from mxnet_tpu.serving import Server
-    runner = build_demo_runner(args) if args.demo \
-        else build_module_runner(args)
-    server = Server(runner, host=args.host, port=args.port,
+    if args.model:
+        target = build_fleet(args)
+        summary = "fleet %s" % target.models()
+    else:
+        target = build_demo_runner(args) if args.demo \
+            else build_module_runner(args)
+        summary = repr(target)
+    server = Server(target, host=args.host, port=args.port,
                     max_batch=args.max_batch,
                     batch_timeout_ms=args.batch_timeout_ms,
-                    max_queue=args.max_queue, verbose=args.verbose)
+                    max_queue=args.max_queue,
+                    max_body_bytes=args.max_body_bytes,
+                    verbose=args.verbose)
     host, port = server.address
-    print("serving %r on http://%s:%d  (buckets=%s, warmed=%s)"
-          % (runner, host, port, list(runner.buckets), runner.warmed_up),
+    print("serving %s on http://%s:%d  (buckets=%s, ready=%s)"
+          % (summary, host, port, args.buckets, server.ready),
           flush=True)
 
     def _graceful(signum, frame):
