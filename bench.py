@@ -356,6 +356,13 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_RESILIENCE", "1") == "1":
         rec.stage("resilience", 150, _resilience_bench)
 
+    # -- telemetry micro-bench, host-only and BEFORE backend acquisition
+    # (r05 pattern): the observability layer's own cost must be provable
+    # cheap — telemetry_overhead_pct (<= 1% gate), metrics_scrape_ms and
+    # flight_recorder_write_ns stay live when the TPU is down
+    if os.environ.get("MXTPU_BENCH_TELEMETRY", "1") == "1":
+        rec.stage("telemetry", 150, _telemetry_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -568,6 +575,28 @@ def _overlap_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("overlap bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _telemetry_bench():
+    """telemetry_overhead_pct (trainer step loop with the telemetry
+    layer armed vs off, interleaved min-of-N windows — the <= 1% gate),
+    metrics_scrape_ms (one Prometheus scrape over a populated registry)
+    and flight_recorder_write_ns (one mmap ring record) through
+    mxnet_tpu/telemetry/bench.py.  JAX_PLATFORMS=cpu subprocess — same
+    isolation contract as the serving/pipeline/cost/overlap/resilience
+    stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.telemetry.bench"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("telemetry bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

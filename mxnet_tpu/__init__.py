@@ -75,6 +75,7 @@ from . import rnn
 from . import gluon
 from . import parallel
 from . import profiler
+from . import telemetry
 from . import engine
 from . import rtc
 from . import contrib

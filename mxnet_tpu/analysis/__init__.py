@@ -27,6 +27,7 @@ from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
 from .source_lint import lint_source, lint_file
 from .serving_lint import (lint_serving, lint_fleet_hbm,
                            lint_deadline_propagation)
+from .telemetry_lint import lint_chaos_sites, probe_sites_used
 from .coverage import load_test_map, generate_coverage_md
 from .report import (render_text, render_json, exit_code, worst_severity,
                      SCHEMA_VERSION)
@@ -41,6 +42,7 @@ __all__ = [
     "lint_deadline_propagation", "lint_serving_sources",
     "lint_rule_docs", "self_check",
     "lint_shipped_loops", "lint_worker_loops",
+    "lint_chaos_sites", "probe_sites_used",
     "load_test_map",
     "generate_coverage_md",
     "render_text", "render_json", "exit_code", "worst_severity",
@@ -60,12 +62,14 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 
 
 def self_check(disable=(), with_coverage=True, with_cost=True,
-               with_examples=True, with_workers=True, with_serving=True):
+               with_examples=True, with_workers=True, with_serving=True,
+               with_telemetry=True):
     """Registry lint over the live registry, the rule-table docs sync
     check, the cost-pass determinism check, the SRC004 sweep over the
     shipped training loops, the SRC005 sweep over the shipped worker
-    loops and the SRV004 deadline-propagation sweep over the shipped
-    serving request paths — what CI runs.
+    loops, the SRV004 deadline-propagation sweep over the shipped
+    serving request paths and the TEL001 chaos-probe-site sweep — what
+    CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -81,6 +85,8 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += lint_worker_loops(disable=disable)
     if with_serving:
         findings += lint_serving_sources(disable=disable)
+    if with_telemetry:
+        findings += lint_chaos_sites(disable=disable)
     return findings
 
 

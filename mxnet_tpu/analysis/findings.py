@@ -90,6 +90,13 @@ RULES = {
     # meta (mxnet_tpu/analysis/__init__.py self_check)
     "DOC001": (WARNING, "lint rule has no row in the docs/analysis.md "
                         "rule table (keep RULES and the docs in sync)"),
+    # telemetry pass (mxnet_tpu/analysis/telemetry_lint.py)
+    "TEL001": (ERROR, "chaos probe site drift: a maybe_inject site is "
+                      "unregistered in chaos.SITES / registered but "
+                      "never probed / missing from the "
+                      "docs/observability.md probe table, or "
+                      "maybe_inject no longer emits the telemetry "
+                      "instant event for fired faults"),
     # serving pass (mxnet_tpu/analysis/serving_lint.py)
     "SRV001": (ERROR, "symbol is not batch-polymorphic: shapes are "
                       "data-dependent or baked, so padded-bucket serving "

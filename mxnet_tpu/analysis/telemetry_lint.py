@@ -1,0 +1,162 @@
+"""TEL rules: the chaos fault model and the telemetry trace must agree.
+
+The chaos harness (``resilience/chaos.py``) and the telemetry layer meet
+at probe sites: every ``chaos.maybe_inject("site", ...)`` call is both a
+fault-injection point and — when a fault fires — a telemetry instant
+event + flight-ring record.  Three ways that contract silently drifts,
+all caught here as TEL001 (error, wired into ``--self-check`` per the
+DOC001 discipline):
+
+- a probe site *used* somewhere in ``mxnet_tpu/`` that is not registered
+  in ``chaos.SITES`` (an undocumented fault point: schedules can target
+  it but no one knows it exists, and the docs table lies by omission);
+- a site *registered* in ``chaos.SITES`` but never probed in the code
+  (the fault model advertises a failure mode that can no longer be
+  injected — usually a refactor moved the call);
+- a registered site missing from the ``docs/observability.md`` probe
+  table, or ``chaos.maybe_inject`` no longer stamping fired faults
+  through ``telemetry.fault_event`` (the emission point every site's
+  "must emit a telemetry instant event" guarantee routes through).
+
+Pure AST over the shipped sources — no imports of the probed modules.
+"""
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+
+from .findings import Finding, filter_findings
+
+__all__ = ["lint_chaos_sites", "probe_sites_used", "SITE_DOC"]
+
+# the documentation the probe table must live in (TEL001's third leg)
+SITE_DOC = os.path.join("docs", "observability.md")
+
+
+def _pkg_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe_sites_used(root=None):
+    """Scan ``mxnet_tpu/**/*.py`` (plus the shipped drivers:
+    ``bench.py``, ``tools/*.py``) for ``maybe_inject(<literal>, ...)``
+    calls.  Returns ``(sites, dynamic)``: ``sites`` maps each literal
+    site name to its ``file:line`` use sites; ``dynamic`` lists calls
+    whose site argument is not a string literal (unverifiable — those
+    are findings too: a computed site name can never be checked against
+    the registered fault model)."""
+    root = root or _pkg_root()
+    repo = os.path.dirname(root)
+    sites, dynamic = {}, []
+    targets = sorted(glob.glob(os.path.join(root, "**", "*.py"),
+                               recursive=True))
+    # probe sites also live in the shipped drivers outside the package
+    # (bench.py's backend.init, tools/): same fault model, same sweep
+    if os.path.isfile(os.path.join(repo, "bench.py")):
+        targets.append(os.path.join(repo, "bench.py"))
+    targets += sorted(glob.glob(os.path.join(repo, "tools", "*.py")))
+    for path in targets:
+        rel = os.path.relpath(path, os.path.dirname(root))
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                getattr(fn, "id", None)
+            if name != "maybe_inject" or not node.args:
+                continue
+            where = "%s:%d" % (rel, node.lineno)
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                sites.setdefault(arg.value, []).append(where)
+            else:
+                dynamic.append(where)
+    return sites, dynamic
+
+
+def _documented_sites(repo):
+    """Site names appearing in the docs probe table (a row whose first
+    cell is the backticked site name).  None when the doc is absent
+    (installed package — the doc legs are skipped silently, the code
+    legs still run)."""
+    path = os.path.join(repo, SITE_DOC)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        text = f.read()
+    return set(re.findall(r"^\|\s*`([a-z_.]+)`", text, re.M))
+
+
+def _maybe_inject_emits_fault_event(root):
+    """chaos.maybe_inject must route fired faults through
+    ``telemetry.fault_event`` — the single emission point that makes
+    "every probe site emits a telemetry instant event" true by
+    construction.  Verified structurally (AST), so deleting the call
+    fails ``--self-check`` instead of silently blinding the trace."""
+    path = os.path.join(root, "resilience", "chaos.py")
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "maybe_inject":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    fn = sub.func
+                    name = fn.attr if isinstance(fn, ast.Attribute) else \
+                        getattr(fn, "id", None)
+                    if name == "fault_event":
+                        return True
+    return False
+
+
+def lint_chaos_sites(disable=(), root=None):
+    """The TEL001 sweep (see module docstring).  Returns Finding
+    records; empty means fault model, code and docs agree."""
+    from ..resilience.chaos import SITES
+    root = root or _pkg_root()
+    repo = os.path.dirname(root)
+    used, dynamic = probe_sites_used(root)
+    findings = []
+    for site in sorted(set(used) - set(SITES)):
+        findings.append(Finding(
+            "TEL001", site,
+            "chaos probe site %r is used at %s but not registered in "
+            "chaos.SITES — an unregistered fault point is invisible to "
+            "the fault model and the docs"
+            % (site, ", ".join(used[site]))))
+    for site in sorted(set(SITES) - set(used)):
+        findings.append(Finding(
+            "TEL001", site,
+            "chaos.SITES registers %r but no maybe_inject call probes "
+            "it anywhere in mxnet_tpu/ — the fault model advertises an "
+            "injectable failure that no longer exists" % (site,)))
+    for where in dynamic:
+        findings.append(Finding(
+            "TEL001", where,
+            "maybe_inject called with a non-literal site name — the "
+            "site cannot be checked against the registered fault model"))
+    documented = _documented_sites(repo)
+    if documented is not None:
+        for site in sorted(set(SITES) - documented):
+            findings.append(Finding(
+                "TEL001", site,
+                "chaos probe site %r has no row in the %s probe table "
+                "(keep the fault model and the docs in sync)"
+                % (site, SITE_DOC)))
+    if not _maybe_inject_emits_fault_event(root):
+        findings.append(Finding(
+            "TEL001", "chaos.maybe_inject",
+            "chaos.maybe_inject no longer stamps fired faults through "
+            "telemetry.fault_event — injected faults would leave no "
+            "instant event or flight-ring record behind"))
+    return filter_findings(findings, disable)

@@ -65,11 +65,13 @@ import time
 
 import numpy as np
 
+from . import telemetry as _tele
 from .resilience import backoff as _backoff
 from .resilience import chaos as _chaos
 from .resilience import checkpoint as _ckpt
 from .resilience.heartbeat import HeartbeatMonitor, HeartbeatSender
 from .resilience.server_state import ServerStateStore
+from .telemetry import trace as _trace
 
 __all__ = ["PSServer", "PSClient", "StaleWorkerError", "pack_2bit",
            "unpack_2bit"]
@@ -274,8 +276,27 @@ class PSServer:
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        # one pane of glass: WAL seq / replay counters, generation and
+        # heartbeat lag become mxtpu_ps_* gauges at every metrics scrape
+        # (weakly held — a stopped server drops out of the scrape)
+        self._metrics_handle = _tele.registry().register_collector(
+            self._metrics_samples, name="ps-server")
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
+
+    def _metrics_samples(self):
+        samples = [
+            ("mxtpu_ps_wal_seq", {}, self._wal_seq),
+            ("mxtpu_ps_generation", {}, self.generation),
+            ("mxtpu_ps_recovered_wal_records", {},
+             self.recovered_wal_records),
+            ("mxtpu_ps_pushes_since_snapshot", {}, self._pushes_since_snap),
+            ("mxtpu_ps_fleet_max_step", {}, self.monitor.max_step()),
+        ]
+        for rank, lag in self.monitor.lag_s().items():
+            samples.append(("mxtpu_ps_heartbeat_lag_seconds",
+                            {"rank": rank}, lag))
+        return samples
 
     # -- server loop -------------------------------------------------------
     def _accept_loop(self):
@@ -302,6 +323,19 @@ class PSServer:
                 msg = _recv(conn)
                 if msg is None:
                     return
+                # fleet trace correlation: a telemetry-armed client wraps
+                # its message as ("tctx", wire_ctx, inner).  The context
+                # is installed thread-local for the handler (so the apply
+                # path's flight records and any chaos fault carry the
+                # WORKER span that caused them) and the handling is
+                # emitted as a server-side span linked to it.
+                tctx = None
+                if msg[0] == "tctx":
+                    try:
+                        tctx = _trace.from_wire(msg[1])
+                    except (ValueError, IndexError, TypeError):
+                        tctx = None
+                    msg = msg[2]
                 if msg[0] == "hello":
                     rank_box[0] = msg[1]
                     ctx["rank"] = msg[1]
@@ -319,7 +353,20 @@ class PSServer:
                     _send(conn, ("ok", self.monitor.max_step(),
                                  self.generation))
                     continue
-                reply = self._handle(msg, ctx)
+                if tctx is not None:
+                    from . import profiler as _prof
+                    prev = _trace.set_current(tctx)
+                    t0 = _prof._now_us()
+                    try:
+                        reply = self._handle(msg, ctx)
+                    finally:
+                        _prof.record_event(
+                            "ps.%s" % msg[0], "ps", t0,
+                            _prof._now_us() - t0,
+                            args=dict(tctx.args(), cmd=str(msg[0])))
+                        _trace.set_current(prev)
+                else:
+                    reply = self._handle(msg, ctx)
                 _send(conn, reply)
         except (OSError, EOFError):
             pass
@@ -491,6 +538,14 @@ class PSServer:
                 _chaos.maybe_inject("kvstore.server_apply",
                                     ctx=(rank, step, key))
                 self._apply_push(key, grad)
+                if _tele._ENABLED and not self._replaying:
+                    # flight-record the apply (with the worker's trace
+                    # context, installed by the serve thread): this is
+                    # the "last applied (rank, push_step)" a postmortem
+                    # of a SIGKILLed server reconstructs
+                    _tele.record("ps.apply", rank=rank,
+                                 step=None if step is None else int(step),
+                                 key=str(key))
                 if step is not None and rank is not None:
                     self._applied.setdefault(rank, {})[key] = int(step)
                 self._wal_append((
@@ -621,6 +676,12 @@ class PSServer:
             return ("ok",)
         if cmd == "generation":
             return ("ok", self.generation)
+        if cmd == "clock":
+            # the server's monotonic clock, for client-side offset
+            # estimation (trace.estimate_clock_offset): the same clock
+            # profiler timestamps and flight-ring ts_ns derive from, so
+            # one offset aligns traces AND rings across ranks
+            return ("ok", time.perf_counter_ns())
         if cmd == "heartbeat":
             rank = msg[1]
             step = msg[2] if len(msg) > 2 else None
@@ -836,6 +897,7 @@ class PSServer:
                 # the WAL still covers everything applied
         self._stop.set()
         self.monitor.stop()
+        _tele.registry().unregister_collector(self._metrics_handle)
         # wake the accept thread with shutdown() and JOIN it before
         # closing the fd: closing under a blocked accept() lets the
         # kernel recycle the fd number — a successor server binding the
@@ -890,7 +952,7 @@ class PSClient:
         "hello", "heartbeat", "init", "init_meta", "init_chunk",
         "wait_init", "push", "push_chunk", "pull", "pull_meta",
         "pull_chunk", "row_sparse_pull", "key_owner", "num_dead",
-        "set_optimizer", "generation",
+        "set_optimizer", "generation", "clock",
     })
 
     def __init__(self, host, port, timeout=120, connect_retry_s=60,
@@ -923,9 +985,35 @@ class PSClient:
                     raise
                 time.sleep(0.2)
         self._lock = threading.Lock()
+        self.clock_offset_ns = None
+        self.clock_rtt_ns = None
         if rank is not None:
             reply = self.request("hello", rank, self._incarnation)
             self._note_generation(reply[2] if len(reply) > 2 else None)
+            if _tele._ENABLED:
+                try:
+                    self.sync_clock()
+                except (OSError, ConnectionError):
+                    pass  # offsetless traces still merge, just unaligned
+
+    def sync_clock(self, n=5):
+        """Estimate ``server_clock - local_clock`` from request round
+        trips (midpoint method, best-of-N by RTT — see
+        ``telemetry.trace.estimate_clock_offset``).  The offset is
+        stamped into the profiler trace metadata and the metrics
+        registry, which is how ``tools/trace_merge.py`` aligns this
+        rank's timeline with the server's."""
+        offset, rtt = _trace.estimate_clock_offset(
+            lambda: self.request("clock")[1], n=n)
+        self.clock_offset_ns, self.clock_rtt_ns = offset, rtt
+        from . import profiler as _prof
+        _prof.set_metadata(ps_clock_offset_ns=offset,
+                           ps_clock_rtt_ns=rtt, rank=self._rank)
+        _tele.registry().gauge(
+            "mxtpu_ps_clock_offset_ns",
+            "estimated server minus local monotonic clock").set(
+            offset, rank=str(self._rank))
+        return offset, rtt
 
     def start_heartbeat(self, interval_s=2.0, step_fn=None):
         """Start the worker-side beat loop (``resilience.heartbeat``):
@@ -1123,11 +1211,24 @@ class PSClient:
     def request(self, *msg):
         # chaos probe: a scheduled fault drops (raise) or delays this RPC
         _chaos.maybe_inject("kvstore.request", ctx=msg)
+        # trace correlation (one bool check when telemetry is off): the
+        # RPC becomes a client span whose context rides the wire, so the
+        # server-side apply links back to THIS call
+        if _tele._ENABLED and msg[0] != "clock":
+            with _trace.span("ps.%s" % msg[0], category="ps",
+                             rank=self._rank,
+                             incarnation=self._incarnation,
+                             cmd=str(msg[0])) as span_ctx:
+                return self._request(msg, _trace.to_wire(span_ctx))
+        return self._request(msg, None)
+
+    def _request(self, msg, wire_ctx):
         with self._lock:
             attempt = 0
             while True:
                 try:
-                    _send(self._sock, msg)
+                    _send(self._sock, msg if wire_ctx is None
+                          else ("tctx", wire_ctx, msg))
                     reply = _recv(self._sock)
                     if reply is None:
                         raise ConnectionError(

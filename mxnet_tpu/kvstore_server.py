@@ -62,8 +62,13 @@ def _serve_ps(port, num_workers):
     recovers through it (``MXTPU_CHAOS`` faults are armed here so the
     chaos harness can schedule exactly that kill deterministically)."""
     from . import kvstore_ps
+    from . import telemetry as _tele
     from .resilience import chaos as _chaos
     _chaos.install_from_env()
+    # flight recorder + trace correlation armed from the launcher's env
+    # (MXTPU_TELEMETRY_DIR): a SIGKILLed server leaves its last applied
+    # (rank, push_step) story in the mmap ring for the postmortem CLI
+    _tele.maybe_enable_from_env()
     hb_timeout, max_staleness = _elasticity_env()
     state_dir, snapshot_every, keep = _durability_env()
     server = kvstore_ps.PSServer(port=port, num_workers=num_workers,

@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import autograd
 from .. import engine as engine_mod
+from .. import telemetry as _tele
 from ..ndarray import NDArray
 from ..resilience import chaos as _chaos
 from . import mesh as mesh_mod
@@ -635,6 +636,12 @@ class DataParallelTrainer:
         # failure, stall) fires HERE — before dispatch, so a killed step
         # never half-applies (tests/test_resilience.py end-to-end crash)
         _chaos.maybe_inject("trainer.step", self._step_count, ctx=self)
+        if _tele._ENABLED:
+            # flight-ring progress cursor (one bool check when off; a
+            # fixed-size header store when on — the <=1% bench gate): a
+            # SIGKILLed worker's ring then shows how far it trained —
+            # the worker-side half of the fleet postmortem
+            _tele.cursor(self._step_count)
         self._opt.num_update = self._step_count
         lr_host = (self._opt.lr_scheduler(self._step_count)
                    if self._opt.lr_scheduler else self._opt.lr)
@@ -786,7 +793,7 @@ class DataParallelTrainer:
             batch_end_callback=None, epoch_end_callback=None,
             prefetch_depth=2, bulk_size=None, logger=None,
             checkpoint_dir=None, checkpoint_every=None, resume=False,
-            checkpoint_keep=3):
+            checkpoint_keep=3, metrics_path=None):
         """Overlapped training loop over a ``DataIter``: device prefetch +
         run-ahead dispatch + lazy metrics — the three stages of the step
         pipelined (reference: the engine keeps ``model.py:157``'s loop
@@ -811,7 +818,13 @@ class DataParallelTrainer:
         deterministic iterator the post-crash run converges
         bitwise-identically to the uncrashed one.  Snapshots are taken
         after an explicit flush, so a crash mid-``bulk()`` window never
-        checkpoints run-ahead state.  Returns the metric."""
+        checkpoints run-ahead state.
+
+        Observability (docs/observability.md): ``metrics_path`` writes a
+        versioned telemetry-metrics JSON at the end of training (also
+        written automatically under the telemetry directory when
+        ``mx.telemetry.enable(dir)`` is armed); ``tools/parse_log.py``
+        reads it back.  Returns the metric."""
         import logging
 
         from .. import metric as _metric
@@ -875,7 +888,32 @@ class DataParallelTrainer:
             if epoch_end_callback is not None:
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, None, None, None)
+        self._dump_metrics(metrics_path, log)
         return eval_metric
+
+    def _dump_metrics(self, metrics_path, log):
+        """Versioned metrics JSON at the end of ``fit``: the registry
+        scrape (pipeline/dispatch gauges registered by PipelineStats,
+        anything else armed in-process) written to ``metrics_path``, or
+        — when telemetry is armed with a directory — to
+        ``<dir>/metrics-<role><rank>-<pid>.json``.  The document
+        ``tools/parse_log.py`` reads (``telemetry.SCHEMA_VERSION``)."""
+        import os as _os
+        path = metrics_path
+        if path is None and _tele.enabled() and _tele.telemetry_dir():
+            rank = _tele.rank()
+            path = _os.path.join(
+                _tele.telemetry_dir(),
+                "metrics-worker%s-%d.json"
+                % ("" if rank is None else rank, _os.getpid()))
+        if not path:
+            return
+        try:
+            _tele.dump_metrics(path, source="trainer.fit", extra={
+                "step_count": self._step_count,
+                "dispatch_stats": self.dispatch_stats.snapshot()})
+        except OSError:
+            log.exception("metrics dump to %s failed", path)
 
     def _dist_step(self, train_vals, aux_vals, x, y, rng, lr_host):
         """Split step for multi-process data parallelism: local grads ->

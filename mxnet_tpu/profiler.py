@@ -40,6 +40,16 @@ _paused = False
 # module-level flag read by the hot invoke() path: one attribute load when off
 _PROFILING = False
 _events = []
+# the event buffer is BOUNDED: an unbounded list on a long profiled run
+# grows without limit and every append past RAM pressure stalls the hot
+# path.  Past the cap, events are counted as dropped instead of stored —
+# the count is surfaced in dumps() metadata so a truncated trace is
+# never mistaken for a complete one.
+_MAX_EVENTS = int(os.environ.get("MXTPU_PROFILER_MAX_EVENTS", "500000"))
+_dropped = 0
+# free-form per-process metadata included in dumps() output: rank, clock
+# origin, PS clock offsets — what tools/trace_merge.py aligns fleets by
+_metadata = {}
 _start_time = None
 _jax_trace_active = False
 
@@ -67,7 +77,10 @@ def set_state(state_name="stop", profile_process="worker"):
     if state_name not in ("run", "stop"):
         raise ValueError("state must be 'run' or 'stop'")
     if state_name == "run" and _state != "run":
-        _events.clear()
+        global _dropped
+        with _lock:
+            _events.clear()
+            _dropped = 0
         _start_time = time.perf_counter_ns()
         tb = _config.get("tensorboard_dir")
         if tb:
@@ -112,34 +125,70 @@ def _now_us():
     return (time.perf_counter_ns() - (_start_time or 0)) / 1000.0
 
 
+def _append_locked(event):
+    """Append under ``_lock`` honoring the buffer cap (callers hold no
+    lock; the cap check and append are one atomic section)."""
+    global _dropped
+    with _lock:
+        if len(_events) >= _MAX_EVENTS:
+            _dropped += 1
+            return
+        _events.append(event)
+
+
 def record_event(name, category, t_start_us, dur_us, args=None):
     if not is_running():
         return
-    with _lock:
-        _events.append({"name": name, "cat": category, "ph": "X",
-                        "ts": t_start_us, "dur": dur_us, "pid": os.getpid(),
-                        "tid": threading.get_ident() % 100000,
-                        "args": args or {}})
+    _append_locked({"name": name, "cat": category, "ph": "X",
+                    "ts": t_start_us, "dur": dur_us, "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100000,
+                    "args": args or {}})
 
 
 def record_instant(name, category, args=None):
     if not is_running():
         return
+    _append_locked({"name": name, "cat": category, "ph": "i",
+                    "ts": _now_us(), "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100000, "s": "p",
+                    "args": args or {}})
+
+
+def set_metadata(**kv):
+    """Attach per-process metadata to the trace (rank, clock offsets...);
+    surfaced under ``metadata`` in :func:`dumps` output, where
+    ``tools/trace_merge.py`` reads it to align per-rank timelines."""
     with _lock:
-        _events.append({"name": name, "cat": category, "ph": "i",
-                        "ts": _now_us(), "pid": os.getpid(),
-                        "tid": threading.get_ident() % 100000, "s": "p",
-                        "args": args or {}})
+        _metadata.update(kv)
+
+
+def dropped_events():
+    """Events dropped past the ``MXTPU_PROFILER_MAX_EVENTS`` cap."""
+    with _lock:
+        return _dropped
 
 
 def dumps(reset=False):
-    """Return the chrome://tracing JSON string (reference: dumps)."""
+    """Return the chrome://tracing JSON string (reference: dumps).
+
+    Serialization runs OUTSIDE the lock — only the list copy is locked —
+    so concurrent emitters never stall behind ``json.dumps`` of a large
+    trace.  The top-level ``metadata`` object carries the process's
+    clock origin (``perf_origin_ns``), pid, ``dropped_events`` (buffer
+    cap overflow — nonzero means the trace is truncated) and anything
+    installed via :func:`set_metadata`."""
+    global _dropped
     with _lock:
-        out = json.dumps({"traceEvents": list(_events),
-                          "displayTimeUnit": "ms"}, indent=1)
+        events = list(_events)
+        meta = dict(_metadata)
+        meta.update({"pid": os.getpid(), "perf_origin_ns": _start_time,
+                     "dropped_events": _dropped,
+                     "event_cap": _MAX_EVENTS})
         if reset:
             _events.clear()
-    return out
+            _dropped = 0
+    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms",
+                       "metadata": meta}, indent=1)
 
 
 def dump(finished=True, profile_process="worker"):
@@ -214,27 +263,38 @@ class Frame(_Scope):
 
 
 class Counter:
-    """Numeric counter series (reference: profiler.Counter)."""
+    """Numeric counter series (reference: profiler.Counter).
+
+    Thread-safe: ``increment``/``decrement`` are atomic read-modify-write
+    under a per-counter lock — concurrent emitters (serving handler
+    threads, pipeline workers) never lose updates."""
 
     def __init__(self, domain, name, value=None):
         self.name = "%s::%s" % (domain.name, name)
         self._value = 0
+        self._vlock = threading.Lock()
         if value is not None:
             self.set_value(value)
 
-    def set_value(self, value):
-        self._value = value
+    def _emit(self, value):
         if is_running():
-            with _lock:
-                _events.append({"name": self.name, "ph": "C", "ts": _now_us(),
-                                "pid": os.getpid(),
-                                "args": {"value": value}})
+            _append_locked({"name": self.name, "ph": "C", "ts": _now_us(),
+                            "pid": os.getpid(),
+                            "args": {"value": value}})
+
+    def set_value(self, value):
+        with self._vlock:
+            self._value = value
+        self._emit(value)
 
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with self._vlock:
+            self._value += delta
+            value = self._value
+        self._emit(value)
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        self.increment(-delta)
 
     def __iadd__(self, v):
         self.increment(v)
@@ -274,6 +334,7 @@ class PipelineStats:
 
     def __init__(self, num_workers=0, name="io.pipeline"):
         self._lock = threading.Lock()
+        self._name = name
         self._t0 = time.perf_counter()
         self._busy_s = {}            # worker id -> cumulative decode time
         self._stall_s = 0.0          # consumer time blocked on the ring
@@ -291,6 +352,17 @@ class PipelineStats:
         self._inflight_max = 0
         self._dispatch_stall_s = 0.0
         self._inflight_counter = domain.new_counter("inflight_steps")
+        # one pane of glass: this accumulator is also a telemetry metrics
+        # source — snapshot() keys become mxtpu_pipeline_* gauges labeled
+        # by pipeline name (weakly held: a dead iterator drops out)
+        from . import telemetry as _tele
+        _tele.registry().register_collector(self._metrics_samples,
+                                            name="pipeline:" + name)
+
+    def _metrics_samples(self):
+        from . import telemetry as _tele
+        return _tele.flatten_samples("mxtpu_pipeline", self.snapshot(),
+                                     labels={"name": self._name})
 
     def on_batch(self, worker, busy_s, queue_depth):
         with self._lock:

@@ -50,7 +50,32 @@ import signal
 import time
 
 __all__ = ["Fault", "ChaosSchedule", "ChaosError", "install", "uninstall",
-           "installed", "maybe_inject", "triggered", "install_from_env"]
+           "installed", "maybe_inject", "triggered", "install_from_env",
+           "SITES"]
+
+# The registered fault model: every probe site shipped in mxnet_tpu/ with
+# a one-line contract.  This dict is the source of truth the TEL001 lint
+# checks BOTH ways against the code and docs/observability.md — a probe
+# site used but not registered here, or registered but never probed, is
+# silent drift between the fault model and the trace and fails
+# ``--self-check``.  Every fault that fires at any of these sites is
+# stamped as a telemetry instant event + flight-ring record by
+# ``maybe_inject`` (see ``telemetry.fault_event``) before its action
+# runs, so even a ``kill`` leaves the evidence behind.
+SITES = {
+    "trainer.step": "count = trainer step number; fires before dispatch",
+    "pipeline.dispatch": "per dispatched batch; ctx = (iter, wid, idx)",
+    "kvstore.request": "per client RPC; ctx = the message tuple",
+    "kvstore.server_apply": "count = applied-push ordinal on the PS "
+                            "server; ctx = (rank, step, key)",
+    "kvstore.snapshot": "PS server snapshot capture",
+    "serving.batch": "count = batch number; delay = runner stall",
+    "serving.route": "count = routed-request ordinal; ctx = (model, tier)",
+    "serving.swap": "fleet hot swap; ctx = model name",
+    "engine.flush": "run-ahead ring drain",
+    "backend.init": "count = bench.py acquisition attempt",
+    "checkpoint.save": "mid-checkpoint-write (atomicity tests)",
+}
 
 
 class ChaosError(RuntimeError):
@@ -155,6 +180,15 @@ def maybe_inject(site, count=None, ctx=None):
         if not f.repeat:
             f._armed = False
         sched._triggered.append(f.spec())
+        # stamp the injection BEFORE the action runs: the flight-ring
+        # record and trace instant survive even a SIGKILL action, which
+        # is exactly when the evidence matters (lazy import: chaos stays
+        # importable before the package finishes initializing)
+        try:
+            from .. import telemetry as _tele
+            _tele.fault_event(site, f.at, f.action, ctx=ctx)
+        except Exception:
+            pass  # telemetry must never mask or reorder the fault itself
         if f.action == "delay":
             time.sleep(float(f.arg or 0.05))
         elif f.action == "kill":

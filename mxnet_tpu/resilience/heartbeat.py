@@ -74,6 +74,14 @@ class HeartbeatMonitor:
         with self._lock:
             return dict(self._steps)
 
+    def lag_s(self, now=None):
+        """Seconds since each rank's last beat — the telemetry gauge
+        (``mxtpu_ps_heartbeat_lag_seconds``) behind the watchdog's
+        verdicts: lag approaching ``timeout_s`` is the early warning."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {rank: now - last for rank, last in self._last.items()}
+
     def dead(self):
         with self._lock:
             return set(self._dead)

@@ -199,6 +199,45 @@ class ModelFleet:
         self._entries = {}          # name -> _Entry, registration order
         self._default = None
         self._route_seq = 0
+        # one pane of glass: per-model serving stats + breaker state +
+        # the packing ledger become mxtpu_serving_* gauges at every
+        # telemetry scrape (weakly held — a dropped fleet disappears)
+        from .. import telemetry as _tele
+        _tele.registry().register_collector(self._metrics_samples,
+                                            name="serving-fleet")
+
+    _BREAKER_STATE_ENUM = {"closed": 0, "open": 1, "half_open": 2}
+
+    def _metrics_samples(self):
+        samples = [
+            ("mxtpu_serving_modeled_hbm_total_bytes", {},
+             self.modeled_hbm_total()),
+            ("mxtpu_serving_hbm_cap_bytes", {}, self.hbm_cap_bytes or 0),
+        ]
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            labels = {"model": e.name}
+            st = e.batcher.stats
+            samples.append(("mxtpu_serving_breaker_state", labels,
+                            self._BREAKER_STATE_ENUM.get(e.breaker.state,
+                                                         -1)))
+            samples.append(("mxtpu_serving_queue_depth", labels,
+                            e.batcher.queue_depth))
+            for key in ("requests_total", "rejected_total", "errors_total",
+                        "shed_total", "degraded_total", "swaps_total",
+                        "batches_total", "queue_depth_peak"):
+                samples.append(("mxtpu_serving_" + key, labels,
+                                getattr(st, key)))
+            p50, p99 = st.latency_ms()
+            samples.append(("mxtpu_serving_latency_p50_ms", labels, p50))
+            samples.append(("mxtpu_serving_latency_p99_ms", labels, p99))
+            for tier in ("gold", "silver", "bronze"):
+                tp50, tp99 = st.tier_latency_ms(tier)
+                tl = dict(labels, tier=tier)
+                samples.append(("mxtpu_serving_tier_p50_ms", tl, tp50))
+                samples.append(("mxtpu_serving_tier_p99_ms", tl, tp99))
+        return samples
 
     # -- registration: admission control as a static problem ---------------
     def models(self):

@@ -27,6 +27,10 @@ wrapped as a one-model fleet named ``default``.  Contract:
 - ``GET /stats`` — the default model's ServingStats dict (back-compat
   flat keys) plus ``models`` with every model's stats, breaker state,
   per-tier p50/p99/shed, modeled HBM packing ledger and swap blips.
+- ``GET /metrics`` — the process-wide telemetry registry in Prometheus
+  text exposition format (``text/plain; version=0.0.4``): the same
+  serving numbers as gauges/summaries plus every other registered
+  source (pipeline, dispatch, PS tier) — docs/observability.md.
 - ``drain()`` — stop admissions, finish all in-flight requests, then
   stop the listener (graceful shutdown; wired to SIGTERM/SIGINT in
   ``tools/serve.py``).  Honors a hard deadline (``drain_timeout_s``).
@@ -79,8 +83,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, code, payload, headers=()):
         body = json.dumps(payload).encode()
+        self._reply_raw(code, body, "application/json", headers)
+
+    def _reply_raw(self, code, body, content_type, headers=()):
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         for k, v in headers:
             self.send_header(k, v)
@@ -127,6 +134,14 @@ class _Handler(BaseHTTPRequestHandler):
                 for b, row in sorted(default.runner.modeled_cost().items())}
             stats.update(fleet_stats)
             self._reply(200, stats)
+        elif self.path == "/metrics":
+            # the one-pane scrape surface: the process-wide telemetry
+            # registry (serving stats, breakers, pipeline/dispatch
+            # counters, PS gauges — whatever registered) in Prometheus
+            # text exposition format
+            from .. import telemetry as _tele
+            self._reply_raw(200, _tele.registry().prometheus_text()
+                            .encode(), "text/plain; version=0.0.4")
         else:
             self._reply(404, {"error": "unknown path %s" % self.path})
 

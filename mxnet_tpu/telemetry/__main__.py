@@ -1,0 +1,50 @@
+"""CLI: ``python -m mxnet_tpu.telemetry postmortem <dir>``.
+
+Reads every flight ring under ``<dir>`` (the ``MXTPU_TELEMETRY_DIR`` a
+dead fleet was armed with) and prints the last-N-events-per-rank story:
+per ring, the surviving events, the last applied ``(rank, push_step)``
+on a PS server, and every chaos fault that fired — with trace ids, so
+the story lines up against the merged chrome trace
+(``tools/trace_merge.py``).
+
+Stdlib-only on purpose: a postmortem host needs no jax.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .flight import postmortem, render_postmortem
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.telemetry",
+        description="fleet telemetry tools")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    pm = sub.add_parser("postmortem",
+                        help="reconstruct a dead fleet's last events "
+                             "from its flight rings")
+    pm.add_argument("directory", help="the fleet's MXTPU_TELEMETRY_DIR")
+    pm.add_argument("--last", type=int, default=None,
+                    help="only the newest N events per ring")
+    pm.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    args = parser.parse_args(argv)
+    if args.cmd == "postmortem":
+        report = postmortem(args.directory, last=args.last)
+        if args.json:
+            json.dump(report, sys.stdout, indent=1, default=str)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_postmortem(report))
+        if not report["rings"]:
+            print("no flight rings under %r" % args.directory,
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

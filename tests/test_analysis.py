@@ -1033,3 +1033,52 @@ def test_serving_stats_expose_modeled_cost():
         assert resp["modeled_cost"]["2"]["flops"] > 0
     finally:
         server.drain(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# TEL001: chaos probe sites vs the registered fault model (ISSUE 9)
+# ---------------------------------------------------------------------------
+def test_tel001_shipped_sites_clean():
+    """Every probe site used in the shipped sources is registered in
+    chaos.SITES, every registered site is probed somewhere, the docs
+    table covers them all, and maybe_inject still stamps fired faults
+    through telemetry.fault_event."""
+    from mxnet_tpu.analysis import lint_chaos_sites
+    assert lint_chaos_sites() == []
+
+
+def test_tel001_detects_drift(tmp_path):
+    """A probe site used-but-unregistered, a registered-but-unused
+    fault model entry, and a non-literal site name all fire TEL001."""
+    from mxnet_tpu.analysis import lint_chaos_sites
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from resilience import chaos\n"
+        "def f(name):\n"
+        "    chaos.maybe_inject('totally.unregistered')\n"
+        "    chaos.maybe_inject(name)\n")
+    findings = lint_chaos_sites(root=str(pkg))
+    subjects = {f.subject for f in findings}
+    rules = {f.rule_id for f in findings}
+    assert rules == {"TEL001"}
+    # used but unregistered
+    assert "totally.unregistered" in subjects
+    # non-literal site argument
+    assert any(s.endswith("mod.py:4") for s in subjects)
+    # every registered site is "unused" under this synthetic root
+    from mxnet_tpu.resilience.chaos import SITES
+    assert set(SITES) <= subjects
+    # the synthetic root has no chaos.py: the emission check fires too
+    assert "chaos.maybe_inject" in subjects
+
+
+def test_tel001_probe_site_scan_matches_fault_model():
+    """probe_sites_used finds every shipped maybe_inject literal —
+    including the drivers outside the package (bench.py backend.init)."""
+    from mxnet_tpu.analysis import probe_sites_used
+    from mxnet_tpu.resilience.chaos import SITES
+    used, dynamic = probe_sites_used()
+    assert not dynamic
+    assert set(used) == set(SITES)
+    assert any(w.startswith("bench.py:") for w in used["backend.init"])

@@ -42,16 +42,30 @@ import sys
 import time
 
 
-def _load_backoff():
-    """The shared BackoffPolicy, loaded by file path so the launcher
-    (which must stay jax-free — it forks workers) never imports the
-    mxnet_tpu package."""
+def _load_by_path(name, *rel):
+    """Load a module by file path so the launcher (which must stay
+    jax-free — it forks workers) never imports the mxnet_tpu package."""
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "mxnet_tpu", "resilience", "backoff.py")
-    spec = importlib.util.spec_from_file_location("_mxtpu_backoff", path)
+        os.path.abspath(__file__))), *rel)
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_backoff():
+    """The shared BackoffPolicy (resilience/backoff.py, stdlib-only)."""
+    return _load_by_path("_mxtpu_backoff", "mxnet_tpu", "resilience",
+                         "backoff.py")
+
+
+def _load_metrics():
+    """The telemetry metrics registry (telemetry/metrics.py,
+    stdlib-only) — the launcher dumps its fleet-supervision numbers in
+    the same versioned JSON schema the trainer does, so one
+    ``tools/parse_log.py`` reads both."""
+    return _load_by_path("_mxtpu_metrics", "mxnet_tpu", "telemetry",
+                         "metrics.py")
 
 
 def free_port():
@@ -221,6 +235,17 @@ def main():
     parser.add_argument("--env", action="append", default=[],
                         help="extra K=V forwarded to every worker "
                              "(reference launch.py --env)")
+    parser.add_argument("--metrics-json", default=None,
+                        help="write the launcher's fleet-supervision "
+                             "metrics (per-rank restarts/exit codes, "
+                             "wall time) as versioned telemetry JSON "
+                             "on exit — the schema tools/parse_log.py "
+                             "reads")
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="arm fleet telemetry: exported as "
+                             "MXTPU_TELEMETRY_DIR to every rank "
+                             "(flight rings + metrics dumps land "
+                             "there; see docs/observability.md)")
     parser.add_argument("--env-server", default=None,
                         help="unused; kept for reference CLI parity")
     parser.add_argument("command", nargs=argparse.REMAINDER)
@@ -251,6 +276,10 @@ def main():
         if "=" not in kv:
             parser.error("--env expects K=V, got %r" % kv)
     extra = dict(kv.split("=", 1) for kv in args.env)
+    if args.telemetry_dir:
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        extra.setdefault("MXTPU_TELEMETRY_DIR",
+                         os.path.abspath(args.telemetry_dir))
     if args.num_servers and not args.ps_state_dir:
         # recovery must be armed by default: a respawned server with no
         # state dir would come back EMPTY and wedge every worker
@@ -297,9 +326,11 @@ def main():
         env.update(renv)
         return subprocess.Popen(args.command, env=env)
 
+    t_launch = time.monotonic()
     running = {rank: spawn(rank) for rank in all_ranks}
     budgets = {rank: args.restart_failed for rank in all_ranks}
     attempts = {rank: 0 for rank in all_ranks}
+    exit_codes = {}                    # rank -> last observed exit code
     policy = _load_backoff().BackoffPolicy(
         base_s=1.0, factor=2.0, max_delay_s=30.0,
         max_retries=max(args.restart_failed, 1), jitter=0.25)
@@ -331,6 +362,7 @@ def main():
             if r is None:
                 continue
             del running[rank]
+            exit_codes[rank] = r
             if r != 0 and budgets[rank] > 0:
                 budgets[rank] -= 1
                 delay = policy.delay(attempts[rank])
@@ -342,7 +374,34 @@ def main():
                 respawn_at[rank] = now + delay
             else:
                 rc = rc or r
+    if args.metrics_json:
+        _dump_launch_metrics(args, attempts, exit_codes,
+                             time.monotonic() - t_launch, rc)
     sys.exit(rc)
+
+
+def _dump_launch_metrics(args, attempts, exit_codes, wall_s, rc):
+    """The launcher's half of the one-pane contract: per-rank restart
+    counts and exit codes plus fleet wall time, in the same versioned
+    metrics JSON schema ``DataParallelTrainer.fit`` dumps."""
+    metrics = _load_metrics()
+    reg = metrics.MetricsRegistry()
+    g = reg.gauge("mxtpu_launch_rank_restarts_total",
+                  "elastic restarts consumed per rank")
+    for rank, n in attempts.items():
+        g.set(n, rank=rank)
+    g = reg.gauge("mxtpu_launch_rank_exit_code",
+                  "last observed exit code per rank")
+    for rank, code in exit_codes.items():
+        g.set(code, rank=rank)
+    reg.gauge("mxtpu_launch_wall_seconds", "fleet wall time").set(wall_s)
+    reg.gauge("mxtpu_launch_num_workers", "").set(args.num_workers)
+    reg.gauge("mxtpu_launch_num_servers", "").set(args.num_servers)
+    reg.gauge("mxtpu_launch_exit_code", "the launcher's own rc").set(rc)
+    try:
+        reg.dump_json(args.metrics_json, source="tools/launch.py")
+    except OSError as e:
+        print("launch: metrics dump failed: %s" % e, file=sys.stderr)
 
 
 if __name__ == "__main__":

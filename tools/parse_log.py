@@ -1,13 +1,25 @@
 #!/usr/bin/env python
 """Parse training logs into a table (reference: tools/parse_log.py).
 
-Reads fit() log lines (Epoch[..] Train-accuracy / Validation-accuracy /
-Time cost / Speedometer samples/sec) and prints tsv."""
+Two input shapes:
+
+- fit() log lines (Epoch[..] Train-accuracy / Validation-accuracy /
+  Time cost / Speedometer samples/sec) -> per-epoch tsv;
+- a versioned telemetry-metrics JSON (what ``DataParallelTrainer.fit``,
+  ``tools/launch.py --metrics-json`` and ``telemetry.dump_metrics``
+  write; detected by its ``schema_version`` key) -> one
+  ``metric{labels}\tvalue`` row per sample, histograms expanded into
+  p50/p99/count/sum rows.
+"""
 from __future__ import annotations
 
 import argparse
+import json
 import re
 import sys
+
+# the newest metrics-JSON schema this parser understands
+METRICS_SCHEMA_VERSION = 1
 
 
 def parse(lines):
@@ -31,12 +43,60 @@ def parse(lines):
     return res
 
 
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % kv
+                             for kv in sorted(labels.items()))
+
+
+def parse_metrics_json(doc):
+    """Versioned telemetry metrics JSON -> [(name{labels}, value)] rows.
+    Raises ValueError on a missing/newer schema_version (the version IS
+    the compatibility contract — a silent misparse would be worse)."""
+    version = doc.get("schema_version")
+    if version is None:
+        raise ValueError("not a telemetry metrics JSON (no schema_version)")
+    if version > METRICS_SCHEMA_VERSION:
+        raise ValueError(
+            "metrics schema_version %s is newer than this parser "
+            "understands (%s) — update tools/parse_log.py"
+            % (version, METRICS_SCHEMA_VERSION))
+    rows = []
+    for name, entry in sorted(doc.get("metrics", {}).items()):
+        for sample in entry.get("samples", []):
+            labels = sample.get("labels", {})
+            if "value" in sample:
+                rows.append((name + _fmt_labels(labels), sample["value"]))
+            else:   # histogram cell: expand the summary fields
+                for key in ("p50", "p99", "count", "sum"):
+                    if key in sample:
+                        rows.append(("%s_%s%s" % (name, key,
+                                                  _fmt_labels(labels)),
+                                     sample[key]))
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("logfile", nargs="?", default="-")
     args = parser.parse_args()
-    lines = sys.stdin if args.logfile == "-" else open(args.logfile)
-    res = parse(lines)
+    if args.logfile == "-":
+        text = sys.stdin.read()
+    else:
+        with open(args.logfile) as f:
+            text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        # telemetry metrics JSON (fit/launch dump), not a training log
+        doc = json.loads(stripped)
+        rows = parse_metrics_json(doc)
+        print("# source=%s schema_version=%s"
+              % (doc.get("source", "?"), doc.get("schema_version")))
+        for name, value in rows:
+            print("%s\t%.6g" % (name, value))
+        return
+    res = parse(text.splitlines())
     if not res:
         print("no epochs found", file=sys.stderr)
         return

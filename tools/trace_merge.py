@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Merge per-rank chrome traces (+ flight rings) into one fleet timeline.
+
+Each rank's ``mx.profiler.dumps()`` output is a chrome://tracing JSON in
+that process's private ``perf_counter`` timebase.  This tool aligns them:
+
+- every trace's ``metadata`` carries ``perf_origin_ns`` (the clock value
+  at ``set_state('run')``) and — on ranks that talked to a PS with
+  telemetry armed — ``ps_clock_offset_ns``, the ``server_clock -
+  local_clock`` offset estimated from request round trips
+  (``telemetry.trace.estimate_clock_offset``, the hello/clock RTT
+  midpoint method);
+- events are shifted into the *server's* monotonic timebase:
+  ``server_ns = perf_origin_ns + ts_us*1000 + ps_clock_offset_ns``
+  (server-side inputs have offset 0 by construction);
+- flight-recorder rings (``--rings DIR``) are converted into instant
+  events on the same timeline — ``ts_ns`` in a ring is already the
+  writer's absolute ``perf_counter_ns``, so a SIGKILLed server's last
+  applied pushes and the chaos fault that killed it land in the merged
+  view next to the worker spans that caused them (matched by
+  ``trace_id`` — the worker→server correlation the wire context built);
+- pids are rewritten per input (workers by rank, servers after) with
+  ``process_name`` metadata events, so chrome/perfetto shows one named
+  row per fleet member.
+
+Usage::
+
+    python tools/trace_merge.py -o fleet.json \
+        trace-rank0.json trace-rank1.json --rings /tmp/telemetry_dir
+
+Stdlib-only (a postmortem host needs no jax); importable — tests call
+:func:`merge` directly.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_flight():
+    """telemetry/flight.py by file path (the tools/ convention for
+    staying jax-free — see launch.py's ``_load_backoff``)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "mxnet_tpu", "telemetry", "flight.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_flight", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_trace_file(path):
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    meta = doc.get("metadata", {})
+    return events, meta
+
+
+def _abs_server_ns(ts_us, meta):
+    """One rank's relative trace timestamp -> absolute ns on the server's
+    monotonic clock."""
+    origin = meta.get("perf_origin_ns") or 0
+    offset = meta.get("ps_clock_offset_ns") or 0
+    return int(origin + ts_us * 1000.0 + offset)
+
+
+def merge(trace_paths, ring_paths=(), flight_mod=None):
+    """Merge traces + rings; returns the merged chrome-trace document.
+
+    ``trace_paths`` are per-rank chrome JSONs (with telemetry metadata);
+    ``ring_paths`` are ``*.mxring`` files.  Inputs missing an offset are
+    merged unshifted (their metadata records ``aligned: false``)."""
+    flight = flight_mod or _load_flight()
+    members = []         # (label, meta, events_abs_ns)
+    for path in trace_paths:
+        events, meta = _load_trace_file(path)
+        rank = meta.get("rank")
+        role = meta.get("role", "worker")
+        label = "%s%s" % (role, "" if rank is None else rank)
+        out = []
+        for ev in events:
+            ev = dict(ev)
+            ev["_abs_ns"] = _abs_server_ns(ev.get("ts", 0.0), meta)
+            if "dur" not in ev and ev.get("ph") == "X":
+                ev["dur"] = 0.0
+            out.append(ev)
+        members.append((label, dict(meta, source=os.path.basename(path),
+                                    aligned="ps_clock_offset_ns" in meta
+                                            or role == "server"),
+                        out))
+    for path in ring_paths:
+        try:
+            meta, events = flight.read_ring(path)
+        except (OSError, ValueError) as e:
+            print("trace_merge: skipping unreadable ring %s (%s)"
+                  % (path, e), file=sys.stderr)
+            continue
+        rank = meta.get("rank")
+        role = meta.get("role", "worker")
+        label = "ring:%s%s:%d" % (role, "" if rank is None else rank,
+                                  meta.get("pid", 0))
+        out = []
+        for ev in events:
+            args = {k: v for k, v in ev.items()
+                    if k not in ("ts_ns", "wall_ns")}
+            out.append({"name": ev.get("kind", "event"), "cat": "flight",
+                        "ph": "i", "s": "p", "tid": 0,
+                        "args": args,
+                        # ring ts is the writer's ABSOLUTE perf clock;
+                        # server rings are already in the base timebase,
+                        # worker rings would need that worker's offset
+                        # (matched by rank below)
+                        "_abs_ns": int(ev.get("ts_ns", 0))})
+        members.append((label, dict(meta, source=os.path.basename(path),
+                                    ring=True,
+                                    aligned=role == "server"), out))
+    # worker rings inherit their rank's trace offset when one is known
+    offsets_by_rank = {m[1].get("rank"): m[1].get("ps_clock_offset_ns")
+                       for m in members
+                       if m[1].get("ps_clock_offset_ns") is not None}
+    for label, meta, events in members:
+        if meta.get("ring") and meta.get("role") != "server":
+            off = offsets_by_rank.get(meta.get("rank"))
+            if off is not None:
+                for ev in events:
+                    ev["_abs_ns"] += int(off)
+                meta["aligned"] = True
+    all_ns = [ev["_abs_ns"] for _, _, evs in members for ev in evs]
+    base_ns = min(all_ns) if all_ns else 0
+    merged, meta_out = [], {}
+    for pid, (label, meta, events) in enumerate(members, start=1):
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+        for ev in events:
+            ev["pid"] = pid
+            ev["ts"] = (ev.pop("_abs_ns") - base_ns) / 1000.0
+            merged.append(ev)
+        meta_out[label] = {k: v for k, v in meta.items()
+                           if k in ("source", "rank", "role", "pid",
+                                    "aligned", "ps_clock_offset_ns",
+                                    "ps_clock_rtt_ns", "dropped_events")}
+    merged.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0)))
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "metadata": {"merged_from": meta_out, "base_ns": base_ns}}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="merge per-rank chrome traces + flight rings into "
+                    "one fleet timeline")
+    parser.add_argument("traces", nargs="*",
+                        help="per-rank chrome trace JSON files "
+                             "(mx.profiler.dumps() output)")
+    parser.add_argument("--rings", default=None,
+                        help="directory of *.mxring flight recorders "
+                             "(or a single ring file) to fold in")
+    parser.add_argument("-o", "--output", default="fleet_trace.json")
+    args = parser.parse_args(argv)
+    rings = []
+    if args.rings:
+        if os.path.isdir(args.rings):
+            rings = sorted(glob.glob(os.path.join(args.rings, "*.mxring")))
+        else:
+            rings = [args.rings]
+    if not args.traces and not rings:
+        parser.error("nothing to merge: pass trace files and/or --rings")
+    doc = merge(args.traces, rings)
+    with open(args.output, "w") as f:
+        json.dump(doc, f, indent=1)
+    print("trace_merge: %d events from %d inputs -> %s"
+          % (len(doc["traceEvents"]), len(doc["metadata"]["merged_from"]),
+             args.output))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
