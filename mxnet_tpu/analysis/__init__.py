@@ -27,7 +27,10 @@ from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
 from .source_lint import lint_source, lint_file
 from .serving_lint import (lint_serving, lint_fleet_hbm,
                            lint_deadline_propagation)
-from .telemetry_lint import lint_chaos_sites, probe_sites_used
+from .telemetry_lint import (lint_chaos_sites, probe_sites_used,
+                             lint_attribution_phases,
+                             attribution_phases_used,
+                             attribution_phase_decls)
 from .coverage import load_test_map, generate_coverage_md
 from .report import (render_text, render_json, exit_code, worst_severity,
                      SCHEMA_VERSION)
@@ -42,7 +45,8 @@ __all__ = [
     "lint_deadline_propagation", "lint_serving_sources",
     "lint_rule_docs", "self_check",
     "lint_shipped_loops", "lint_worker_loops",
-    "lint_chaos_sites", "probe_sites_used",
+    "lint_chaos_sites", "probe_sites_used", "lint_attribution_phases",
+    "attribution_phases_used", "attribution_phase_decls",
     "load_test_map",
     "generate_coverage_md",
     "render_text", "render_json", "exit_code", "worst_severity",
@@ -68,8 +72,8 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
     check, the cost-pass determinism check, the SRC004 sweep over the
     shipped training loops, the SRC005 sweep over the shipped worker
     loops, the SRV004 deadline-propagation sweep over the shipped
-    serving request paths and the TEL001 chaos-probe-site sweep — what
-    CI runs.
+    serving request paths, and the telemetry sweeps — TEL001
+    chaos-probe sites and TEL002 attribution phases — what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -87,6 +91,7 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += lint_serving_sources(disable=disable)
     if with_telemetry:
         findings += lint_chaos_sites(disable=disable)
+        findings += lint_attribution_phases(disable=disable)
     return findings
 
 
@@ -121,9 +126,9 @@ def lint_serving_sources(disable=()):
 
 def lint_shipped_loops(disable=()):
     """SRC004 over every ``examples/`` script and the in-repo fit loops
-    (``module/base_module.py``, ``parallel/trainer.py``): the training
-    loops this repo ships must not block the host once per dispatched
-    step — the engine's run-ahead window would collapse to 1 for anyone
+    (``module/base_module.py``, ``parallel/trainer.py``,
+    ``monitor.py``): the training loops this repo ships must not block
+    the host once per dispatched step — the engine's run-ahead window would collapse to 1 for anyone
     copying them.  Only SRC004 is kept (the other source rules are
     advisory for user scripts; examples demonstrate plenty of idioms
     they would flag).  Skipped silently outside a repo checkout."""
@@ -138,7 +143,10 @@ def lint_shipped_loops(disable=()):
     targets = sorted(glob.glob(os.path.join(examples, "**", "*.py"),
                                recursive=True))
     targets += [os.path.join(pkg, os.pardir, "module", "base_module.py"),
-                os.path.join(pkg, os.pardir, "parallel", "trainer.py")]
+                os.path.join(pkg, os.pardir, "parallel", "trainer.py"),
+                # the legacy Monitor used to block per batch; its lazy
+                # toc-boundary drain keeps it in the sweep, not a hole
+                os.path.join(pkg, os.pardir, "monitor.py")]
     findings = []
     for path in targets:
         try:

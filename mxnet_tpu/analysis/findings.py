@@ -97,6 +97,11 @@ RULES = {
                       "docs/observability.md probe table, or "
                       "maybe_inject no longer emits the telemetry "
                       "instant event for fired faults"),
+    "TEL002": (ERROR, "attribution phase drift: an add_phase name is "
+                      "not declared in attribution.PHASES / a declared "
+                      "phase is never measured / the doctor's HINTS map "
+                      "or the docs/observability.md phase table "
+                      "disagrees with PHASES in either direction"),
     # serving pass (mxnet_tpu/analysis/serving_lint.py)
     "SRV001": (ERROR, "symbol is not batch-polymorphic: shapes are "
                       "data-dependent or baked, so padded-bucket serving "

@@ -117,8 +117,10 @@ class KVStore:
         self._ps_client = kvstore_ps.PSClient(host, port, rank=self._rank)
         self._push_step = 0
         if hb_interval > 0:
+            from . import telemetry as _tele
             self._ps_client.start_heartbeat(
-                hb_interval, step_fn=lambda: self._push_step)
+                hb_interval, step_fn=lambda: self._push_step,
+                phase_fn=_tele.dominant_phase_or_none)
 
     # -- identity ----------------------------------------------------------
     @property

@@ -234,6 +234,10 @@ class PSServer:
             poll_s=watchdog_poll_s, on_dead=self._on_rank_dead)
         if heartbeat_timeout_s is not None:
             self.monitor.start()
+        # per-rank step-time skew over the beat stream: a rank whose p50
+        # exceeds the fleet median by MXTPU_STRAGGLER_FACTOR gets a
+        # perf.straggler flight event naming its dominant phase
+        self.straggler = _tele.StragglerDetector()
         # keys claimed by an in-flight chunked init (readers wait on cv)
         self._pending_init = set()
         self._pending_cv = threading.Condition()
@@ -296,6 +300,10 @@ class PSServer:
         for rank, lag in self.monitor.lag_s().items():
             samples.append(("mxtpu_ps_heartbeat_lag_seconds",
                             {"rank": rank}, lag))
+        snap = self.straggler.snapshot()
+        for rank, p50 in snap["rank_step_p50_s"].items():
+            samples.append(("mxtpu_perf_rank_step_p50_seconds",
+                            {"rank": rank}, p50))
         return samples
 
     # -- server loop -------------------------------------------------------
@@ -686,6 +694,14 @@ class PSServer:
             rank = msg[1]
             step = msg[2] if len(msg) > 2 else None
             self.monitor.beat(rank, step)
+            # straggler detection rides the same beat stream the
+            # monitor's step clocks come from: the optional tail fields
+            # carry the worker's dominant phase and its send time on the
+            # SERVER clock (client perf_counter + PR-9 clock offset)
+            self.straggler.observe(
+                rank, step,
+                t_ns=msg[4] if len(msg) > 4 else None,
+                phase=msg[3] if len(msg) > 3 else None)
             with self._live_lock:
                 self._dead_ranks.discard(rank)
             return ("ok", self.monitor.max_step(),
@@ -1015,15 +1031,24 @@ class PSClient:
             offset, rank=str(self._rank))
         return offset, rtt
 
-    def start_heartbeat(self, interval_s=2.0, step_fn=None):
+    def start_heartbeat(self, interval_s=2.0, step_fn=None, phase_fn=None):
         """Start the worker-side beat loop (``resilience.heartbeat``):
         every ``interval_s`` the client reports liveness (and its step,
         via ``step_fn``) so the server's watchdog can tell silence from
-        progress.  Idempotent; stopped by :meth:`close`."""
+        progress.  ``phase_fn`` (e.g.
+        ``telemetry.dominant_phase_or_none``) additionally names the
+        worker's dominant attribution phase, and a ``sync_clock``'d
+        client stamps each beat with its send time shifted onto the
+        *server's* monotonic clock — what lets the server-side straggler
+        detector measure per-rank step time free of arrival jitter.
+        Idempotent; stopped by :meth:`close`."""
         if self._hb is None:
             def beat():
                 step = step_fn() if step_fn is not None else None
-                self.request("heartbeat", self._rank, step)
+                phase = phase_fn() if phase_fn is not None else None
+                ts = (time.perf_counter_ns() + self.clock_offset_ns
+                      if self.clock_offset_ns is not None else None)
+                self.request("heartbeat", self._rank, step, phase, ts)
             self._hb = HeartbeatSender(beat, interval_s).start()
         return self._hb
 

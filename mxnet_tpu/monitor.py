@@ -5,15 +5,30 @@ MXExecutorSetMonitorCallback (c_api.h:1720).  TPU-native: a monitored
 module evaluates the symbol's *internals* group on demand (one extra jitted
 program that returns every intermediate) — no executor hook needed, and
 XLA dead-code-eliminates it when not installed.
+
+Lazy by construction (the SRC004 discipline): ``observe`` and the eager
+tap *park* device-resident outputs; the ``stat_func`` (and its implied
+device→host sync) runs only at :meth:`toc`/:meth:`toc_print` — the same
+interval boundary the reference prints at — so a monitored training loop
+never blocks the engine's run-ahead window once per batch.  A bounded
+pending queue (``MXTPU_MONITOR_MAX_PENDING``) force-drains the oldest
+entries if ``toc`` never comes.  Queue depth and drain cost register
+into the telemetry metrics registry (``mxtpu_monitor_*``).
 """
 from __future__ import annotations
 
 import logging
+import os
 import re
+import time
 
 import numpy as _np
 
 from .ndarray import NDArray
+
+# parked per-op outputs beyond this force-drain eagerly (a tic() without
+# toc() must not pin unbounded device memory)
+_MAX_PENDING = int(os.environ.get("MXTPU_MONITOR_MAX_PENDING", "1024"))
 
 
 class Monitor:
@@ -25,11 +40,48 @@ class Monitor:
         self.stat_func = stat_func
         self.interval = interval
         self.activated = False
-        self.queue = []
+        self.queue = []          # computed (step, name, stat) triples
+        self._pending = []       # parked (step, name, device value)
         self.step = 0
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        # drain accounting, scraped through the registry (weakly held —
+        # a dropped monitor leaves the scrape)
+        self._observed = 0
+        self._drains = 0
+        self._drain_s = 0.0
+        from . import telemetry as _tele
+        _tele.registry().register_collector(self._metrics_samples,
+                                            name="monitor")
+
+    def _metrics_samples(self):
+        return {
+            "mxtpu_monitor_pending": len(self._pending),
+            "mxtpu_monitor_observed_total": self._observed,
+            "mxtpu_monitor_drains_total": self._drains,
+            "mxtpu_monitor_drain_seconds_total": round(self._drain_s, 6),
+        }
+
+    def _park(self, step, name, value):
+        """Queue a device value WITHOUT fetching it; the stat (and its
+        host sync) waits for the toc boundary."""
+        self._pending.append((step, name, value))
+        self._observed += 1
+        if len(self._pending) > _MAX_PENDING:
+            # bound device memory: force-drain the oldest half eagerly
+            overflow, self._pending = (
+                self._pending[:_MAX_PENDING // 2],
+                self._pending[_MAX_PENDING // 2:])
+            self._drain(overflow)
+
+    def _drain(self, entries):
+        t0 = time.perf_counter()
+        for step, name, value in entries:
+            self.queue.append((step, name,
+                               self.stat_func(_np.asarray(value))))
+        self._drains += 1
+        self._drain_s += time.perf_counter() - t0
 
     def install(self, module):
         """Attach to a module (reference installs a C callback on the
@@ -39,6 +91,7 @@ class Monitor:
     def tic(self):
         if self.step % self.interval == 0:
             self.queue = []
+            self._pending = []
             self.activated = True
         self.step += 1
 
@@ -57,13 +110,16 @@ class Monitor:
         outs, _ = fn(arg_vals, aux_vals, _rng.next_key())
         for name, value in zip(names, outs):
             if self.re_prog.match(name):
-                self.queue.append((self.step, name,
-                                   self.stat_func(_np.asarray(value))))
+                # parked lazily: outs are future-backed device arrays;
+                # the stat computes at toc, not here
+                self._park(self.step, name, value)
 
     def toc(self):
         if not self.activated:
             return []
         self.activated = False
+        pending, self._pending = self._pending, []
+        self._drain(pending)
         res = [(n, k, str(v)) for n, k, v in self.queue]
         if self.sort:
             res = sorted(res, key=lambda x: x[1])
@@ -79,7 +135,8 @@ class Monitor:
     def install_eager(self):
         """Tap every imperative op execution (the eager-mode analogue of
         MXExecutorSetMonitorCallback, c_api.h:1720): each nd.* invoke
-        reports its named outputs while activated."""
+        parks its named outputs while activated (stats computed at
+        toc)."""
         from .ndarray import ndarray as _ndmod
 
         def tap(op_name, outs):
@@ -88,8 +145,7 @@ class Monitor:
             for i, o in enumerate(outs):
                 name = "%s_output%s" % (op_name, i if len(outs) > 1 else "")
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(_np.asarray(o._data))))
+                    self._park(self.step, name, o._data)
 
         self._eager_tap = tap
         _ndmod._MONITOR_TAPS.append(tap)

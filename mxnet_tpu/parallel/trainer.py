@@ -596,7 +596,13 @@ class DataParallelTrainer:
                 oldest.block_until_ready()
             except AttributeError:
                 pass
-            self.dispatch_stats.on_backpressure(time.perf_counter() - t0)
+            waited = time.perf_counter() - t0
+            self.dispatch_stats.on_backpressure(waited)
+            # sub-20us "waits" are block_until_ready call overhead on an
+            # already-finished step, not device backpressure — skipping
+            # them keeps the armed per-step cost inside the bench budget
+            if waited > 2e-5 and _tele._ENABLED:
+                _tele.attribution().add_phase("runahead_stall", waited)
         self.dispatch_stats.on_dispatch(len(self._inflight))
 
     def flush(self):
@@ -614,6 +620,8 @@ class DataParallelTrainer:
         waited = time.perf_counter() - t0
         if waited > 0:
             self.dispatch_stats.on_backpressure(waited)
+            if _tele._ENABLED:
+                _tele.attribution().add_phase("runahead_stall", waited)
 
     def step(self, data, label):
         """Run one training step; returns the (scalar) loss NDArray.
@@ -627,21 +635,32 @@ class DataParallelTrainer:
         if not self._ready:
             self._setup(data, label)
 
+        # per-step attribution (docs/observability.md "Performance
+        # doctor"): the on_step mark closes the previous step's window —
+        # everything phase-timed since the last mark (backpressure,
+        # metric drains, checkpoints, the fit loop's input wait)
+        # reconciles against that window's wall clock — and stores the
+        # flight-ring progress cursor (the SIGKILLed-worker "how far did
+        # it train" field).  One bool check when telemetry is off (the
+        # <=1% bench gate).
+        tele_on = _tele._ENABLED
+        attr = _tele.attribution() if tele_on else None
+        if tele_on:
+            attr.on_step(self._step_count + 1)
+
         batch_sh = self.batch_sharding
+        t0 = time.perf_counter() if tele_on else 0.0
         x = self._put_batch(data, batch_sh)
         y = self._put_batch(label, batch_sh)
+        if tele_on:
+            t1 = time.perf_counter()
+            attr.add_phase("h2d_transfer", t1 - t0)
 
         self._step_count += 1
         # chaos probe: a scheduled fault (SIGKILL at step k, injected
         # failure, stall) fires HERE — before dispatch, so a killed step
         # never half-applies (tests/test_resilience.py end-to-end crash)
         _chaos.maybe_inject("trainer.step", self._step_count, ctx=self)
-        if _tele._ENABLED:
-            # flight-ring progress cursor (one bool check when off; a
-            # fixed-size header store when on — the <=1% bench gate): a
-            # SIGKILLed worker's ring then shows how far it trained —
-            # the worker-side half of the fleet postmortem
-            _tele.cursor(self._step_count)
         self._opt.num_update = self._step_count
         lr_host = (self._opt.lr_scheduler(self._step_count)
                    if self._opt.lr_scheduler else self._opt.lr)
@@ -661,6 +680,11 @@ class DataParallelTrainer:
             loss_val, new_vals, new_states, muts = self._step_fn(
                 train_vals, tuple(self._states_raw), aux_vals, x, y, rng,
                 jnp.float32(lr_host), jnp.int32(self._step_count))
+            if tele_on:
+                # "dispatch" spans from the batch being device-ready to
+                # the step program dispatched — step bookkeeping (arg
+                # tuples, lr) is host dispatch work and bills here
+                attr.add_phase("dispatch", time.perf_counter() - t1)
 
         for name, val in zip(self._train_names, new_vals):
             self._params_by_name[name]._data._set_data(val)
@@ -685,6 +709,10 @@ class DataParallelTrainer:
             raise RuntimeError("trainer has not stepped yet: nothing to "
                                "checkpoint")
         self.flush()
+        # attribution: the flush above bills its wait to runahead_stall;
+        # only the encode + atomic write below is checkpoint time (the
+        # phases stay disjoint, so per-window sums reconcile)
+        t_ckpt = time.perf_counter() if _tele._ENABLED else 0.0
         params = {name: _ckpt.encode_array(p.data()._data)
                   for name, p in self._params_by_name.items()}
         states = []
@@ -701,8 +729,12 @@ class DataParallelTrainer:
             "setup_desc": self._setup_desc,
             "groups": [list(g) for g in self._groups],
         }
-        return _ckpt.save_checkpoint(directory, payload, self._step_count,
+        path = _ckpt.save_checkpoint(directory, payload, self._step_count,
                                      keep=keep)
+        if _tele._ENABLED:
+            _tele.attribution().add_phase(
+                "checkpoint", time.perf_counter() - t_ckpt)
+        return path
 
     def restore_checkpoint(self, path_or_dir):
         """Restore a :meth:`save_checkpoint` snapshot (a file, or a
@@ -858,12 +890,29 @@ class DataParallelTrainer:
             if epoch > start_epoch:
                 it.reset()
             with engine_mod.bulk(bulk_size or engine_mod.bulk_size()):
-                for nbatch, batch in enumerate(it):
+                batches = iter(it)
+                nbatch = -1
+                while True:
+                    # input wait: time the loop blocks on the feed — the
+                    # doctor's input_wait phase (a slow pipeline shows up
+                    # HERE, not inside step()).  One bool check when
+                    # telemetry is off.
+                    tele_on = _tele._ENABLED
+                    t_in = time.perf_counter() if tele_on else 0.0
+                    try:
+                        batch = next(batches)
+                    except StopIteration:
+                        break
+                    if tele_on:
+                        _tele.attribution().add_phase(
+                            "input_wait", time.perf_counter() - t_in)
+                    nbatch += 1
                     if epoch == start_epoch and nbatch < skip_batches:
                         # replayed batch: consumed (keeps any iterator
                         # RNG in phase) but already trained pre-crash
                         continue
                     loss = self.step(batch.data[0], batch.label[0])
+                    t_m = time.perf_counter() if tele_on else 0.0
                     eval_metric.update_lazy(batch.label, [loss])
                     if batch_end_callback is not None:
                         params = BatchEndParam(epoch=epoch, nbatch=nbatch,
@@ -871,6 +920,11 @@ class DataParallelTrainer:
                                                locals=None)
                         for cb in _as_list(batch_end_callback):
                             cb(params)
+                    if tele_on:
+                        # metric updates + callback fetches (Speedometer
+                        # drains the lazy metric at its own boundaries)
+                        _tele.attribution().add_phase(
+                            "metric_drain", time.perf_counter() - t_m)
                     if checkpoint_dir and checkpoint_every and \
                             self._step_count % checkpoint_every == 0:
                         self.save_checkpoint(checkpoint_dir, epoch=epoch,
@@ -909,9 +963,15 @@ class DataParallelTrainer:
         if not path:
             return
         try:
+            attr = _tele.attribution()
+            # close the open attribution window first: the run's tail
+            # steps (and the partial flight window) must reach both the
+            # dump and the ring before the process exits
+            attr.flush_window()
             _tele.dump_metrics(path, source="trainer.fit", extra={
                 "step_count": self._step_count,
-                "dispatch_stats": self.dispatch_stats.snapshot()})
+                "dispatch_stats": self.dispatch_stats.snapshot(),
+                "attribution": attr.snapshot()})
         except OSError:
             log.exception("metrics dump to %s failed", path)
 
@@ -926,15 +986,26 @@ class DataParallelTrainer:
         if self._grad_fn is None:
             self._grad_fn = self._build_grad_step()
             self._update_fn = self._build_update_step()
+        tele_on = _tele._ENABLED
+        attr = _tele.attribution() if tele_on else None
+        t0 = time.perf_counter() if tele_on else 0.0
         flat, muts = self._grad_fn(train_vals, aux_vals, x, y, rng)
+        if tele_on:
+            t1 = time.perf_counter()
+            attr.add_phase("dispatch", t1 - t0)
         self._kv.push(self._flat_key, NDArray(flat))
         self._kv.pull(self._flat_key, out=self._flat_out)
+        if tele_on:
+            t2 = time.perf_counter()
+            attr.add_phase("collective_or_ps", t2 - t1)
         # global-batch mean loss comes back out of the update jit, so
         # every rank's callbacks see the number the single-process run
         # would (a local loss would diverge across ranks)
         loss_val, new_vals, new_states = self._update_fn(
             train_vals, tuple(self._states_raw), self._flat_out._data,
             jnp.float32(lr_host), jnp.int32(self._step_count))
+        if tele_on:
+            attr.add_phase("dispatch", time.perf_counter() - t2)
         return loss_val, new_vals, new_states, muts
 
     def set_learning_rate(self, lr):

@@ -343,6 +343,7 @@ class PipelineStats:
         self._respawns = 0
         self._respawns_epoch = 0     # reset by on_epoch (the storm budget)
         self._num_workers = num_workers
+        self._qd_tick = 0            # 1/8 sampling for queue-growth feed
         domain = Domain(name)
         self._counter = domain.new_counter("queue_depth")
         # run-ahead dispatch accounting (engine.py / DataParallelTrainer):
@@ -370,6 +371,17 @@ class PipelineStats:
             self._batches += 1
             self._depth_max = max(self._depth_max, queue_depth)
         self._counter.set_value(queue_depth)
+        # queue-growth anomaly baseline (perf.queue_growth): a reorder
+        # queue rising above its EWMA baseline is the dying-slow
+        # signature the doctor flags before the run dies.  Sampled 1/8
+        # (growth is a trend, not a per-batch event) to keep the armed
+        # per-step cost inside the <=1% bench budget.
+        self._qd_tick += 1
+        if not (self._qd_tick & 7):
+            from . import telemetry as _tele
+            if _tele._ENABLED:
+                _tele.attribution().note_queue_depth(self._name,
+                                                     queue_depth)
 
     def on_wait(self, stall_s):
         with self._lock:
@@ -393,6 +405,12 @@ class PipelineStats:
             self._dispatched += 1
             self._inflight_max = max(self._inflight_max, inflight)
         self._inflight_counter.set_value(inflight)
+        self._qd_tick += 1
+        if not (self._qd_tick & 7):
+            from . import telemetry as _tele
+            if _tele._ENABLED:
+                _tele.attribution().note_queue_depth(
+                    self._name + ".inflight", inflight)
 
     def on_backpressure(self, stall_s):
         """The dispatcher blocked ``stall_s`` waiting on its oldest

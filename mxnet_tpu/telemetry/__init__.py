@@ -19,6 +19,13 @@ Three pillars (docs/observability.md):
   ``python -m mxnet_tpu.telemetry postmortem <dir>`` reconstructs the
   last-N-events-per-rank story of a dead fleet.
 
+On top of the pillars sits the **performance doctor**
+(:mod:`.attribution`): per-step wall-clock decomposition into named
+phases, EWMA step-time/queue-growth anomaly flags, a server-side
+straggler detector over heartbeat step clocks, and the
+``python -m mxnet_tpu.telemetry doctor <dir>`` CLI that names each
+rank's bottleneck phase with the knob that moves it.
+
 Off by default.  The hot-path contract matches the profiler's: every
 instrumented site guards on the module-global ``_ENABLED`` bool — one
 attribute load + bool check when telemetry is off (the bench.py
@@ -42,13 +49,21 @@ from .flight import (FlightRecorder, postmortem, read_ring,
                      render_postmortem)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       SCHEMA_VERSION, flatten_samples, registry)
+from . import attribution as attribution_mod
+from .attribution import (PHASES, HINTS, StepAttribution,
+                          StragglerDetector, attribution,
+                          reset_attribution, dominant_phase_or_none,
+                          doctor_report, render_doctor)
 
 __all__ = ["enable", "disable", "enabled", "maybe_enable_from_env",
            "record", "cursor", "recorder", "telemetry_dir", "dump_metrics",
            "registry", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "SCHEMA_VERSION", "flatten_samples",
            "FlightRecorder", "read_ring", "postmortem",
-           "render_postmortem", "trace", "fault_event"]
+           "render_postmortem", "trace", "fault_event",
+           "PHASES", "HINTS", "StepAttribution", "StragglerDetector",
+           "attribution", "reset_attribution", "dominant_phase_or_none",
+           "doctor_report", "render_doctor"]
 
 # the one-bool-check hot-path flag (profiler._PROFILING discipline):
 # instrumented sites read this module global and bail before touching
@@ -104,6 +119,9 @@ def enable(directory=None, rank=None, role=None, slots=None,
     else:
         _RECORDER = None
     _ENABLED = True
+    # the attribution layer's on_step fuses the progress-cursor store;
+    # hand it the armed ring so the trainer hot path stays one call
+    attribution_mod.set_ring(_RECORDER)
     if old is not None:
         old.close()
     return _RECORDER
@@ -115,6 +133,7 @@ def disable():
     global _ENABLED, _RECORDER
     _ENABLED = False
     rec, _RECORDER = _RECORDER, None
+    attribution_mod.set_ring(None)
     if rec is not None:
         rec.close()
 

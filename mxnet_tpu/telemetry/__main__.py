@@ -1,11 +1,17 @@
-"""CLI: ``python -m mxnet_tpu.telemetry postmortem <dir>``.
+"""CLI: ``python -m mxnet_tpu.telemetry {postmortem,doctor} <dir>``.
 
-Reads every flight ring under ``<dir>`` (the ``MXTPU_TELEMETRY_DIR`` a
-dead fleet was armed with) and prints the last-N-events-per-rank story:
-per ring, the surviving events, the last applied ``(rank, push_step)``
-on a PS server, and every chaos fault that fired — with trace ids, so
-the story lines up against the merged chrome trace
-(``tools/trace_merge.py``).
+``postmortem`` reads every flight ring under ``<dir>`` (the
+``MXTPU_TELEMETRY_DIR`` a dead fleet was armed with) and prints the
+last-N-events-per-rank story: per ring, the surviving events, the last
+applied ``(rank, push_step)`` on a PS server, and every chaos fault that
+fired — with trace ids, so the story lines up against the merged chrome
+trace (``tools/trace_merge.py``).
+
+``doctor`` reads the same directory's metrics dumps + rings and prints
+the *performance* story: per rank, the per-step phase decomposition and
+the bottleneck phase with the knob that moves it; fleet-wide, the
+straggler verdict and any anomaly/queue-growth events the run flagged
+(docs/observability.md "Performance doctor").
 
 Stdlib-only on purpose: a postmortem host needs no jax.
 """
@@ -15,6 +21,7 @@ import argparse
 import json
 import sys
 
+from .attribution import doctor_report, render_doctor
 from .flight import postmortem, render_postmortem
 
 
@@ -31,7 +38,29 @@ def main(argv=None):
                     help="only the newest N events per ring")
     pm.add_argument("--json", action="store_true",
                     help="machine-readable report")
+    doc = sub.add_parser("doctor",
+                         help="name each rank's bottleneck phase and the "
+                              "fleet straggler verdict from merged "
+                              "metrics/rings")
+    doc.add_argument("directory", help="the fleet's MXTPU_TELEMETRY_DIR")
+    doc.add_argument("--factor", type=float, default=None,
+                     help="straggler threshold: rank p50 vs fleet median "
+                          "(default MXTPU_STRAGGLER_FACTOR or 2.0)")
+    doc.add_argument("--json", action="store_true",
+                     help="machine-readable report")
     args = parser.parse_args(argv)
+    if args.cmd == "doctor":
+        report = doctor_report(args.directory, factor=args.factor)
+        if args.json:
+            json.dump(report, sys.stdout, indent=1, default=str)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_doctor(report))
+        if not report["ranks"]:
+            print("no attribution data under %r" % args.directory,
+                  file=sys.stderr)
+            return 1
+        return 0
     if args.cmd == "postmortem":
         report = postmortem(args.directory, last=args.last)
         if args.json:

@@ -1082,3 +1082,89 @@ def test_tel001_probe_site_scan_matches_fault_model():
     assert not dynamic
     assert set(used) == set(SITES)
     assert any(w.startswith("bench.py:") for w in used["backend.init"])
+
+
+# ---------------------------------------------------------------------------
+# TEL002: attribution phase names vs docs table vs doctor hint map (ISSUE 10)
+# ---------------------------------------------------------------------------
+def test_tel002_shipped_phases_clean():
+    """Every add_phase literal in the shipped sources is declared in
+    attribution.PHASES, every declared phase is measured somewhere, the
+    HINTS map and the docs/observability.md phase table cover exactly
+    that set — both ways."""
+    from mxnet_tpu.analysis import lint_attribution_phases
+    assert lint_attribution_phases() == []
+
+
+def test_tel002_phase_scan_matches_declaration():
+    """attribution_phases_used finds every shipped add_phase literal;
+    the declared PHASES/HINTS parse out of attribution.py by AST."""
+    from mxnet_tpu.analysis import (attribution_phase_decls,
+                                    attribution_phases_used)
+    from mxnet_tpu.telemetry.attribution import HINTS, PHASES
+    phases, hints = attribution_phase_decls()
+    assert phases == list(PHASES)
+    assert set(hints) == set(HINTS)
+    used, dynamic = attribution_phases_used()
+    assert not dynamic
+    assert set(used) == set(PHASES)
+    # the trainer is the instrumentation spine: every phase has at least
+    # one call site in parallel/trainer.py
+    for phase in PHASES:
+        assert any("trainer.py" in w for w in used[phase]), (phase, used)
+
+
+def test_tel002_detects_drift(tmp_path):
+    """An undeclared phase measured in code, a declared-but-unmeasured
+    phase, a HINTS/PHASES mismatch, a docs-table mismatch and a
+    non-literal phase name all fire TEL002 (error)."""
+    from mxnet_tpu.analysis import lint_attribution_phases
+    from mxnet_tpu.analysis.findings import RULES, ERROR
+    assert RULES["TEL002"][0] == ERROR
+    pkg = tmp_path / "pkg"
+    (pkg / "telemetry").mkdir(parents=True)
+    (pkg / "telemetry" / "attribution.py").write_text(
+        "PHASES = ('never_measured', 'documented_less')\n"
+        "HINTS = {'never_measured': 'hint', 'ghost_phase': 'stale'}\n")
+    (pkg / "mod.py").write_text(
+        "def f(attr, name):\n"
+        "    attr.add_phase('undeclared_phase', 0.1)\n"
+        "    attr.add_phase(name, 0.2)\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "| phase | measured where | doctor hint names |\n"
+        "|---|---|---|\n"
+        "| `never_measured` | somewhere | knob |\n"
+        "| `only_in_docs` | nowhere | knob |\n")
+    findings = lint_attribution_phases(root=str(pkg))
+    assert {f.rule_id for f in findings} == {"TEL002"}
+    subjects = {f.subject for f in findings}
+    assert "undeclared_phase" in subjects       # measured, not declared
+    assert "documented_less" in subjects        # declared, never measured
+    assert "ghost_phase" in subjects            # stale HINTS key
+    assert "only_in_docs" in subjects           # docs row with no phase
+    assert any(s.endswith("mod.py:3") for s in subjects)  # non-literal
+    # a PHASES tuple that is no longer a literal is itself a finding
+    (pkg / "telemetry" / "attribution.py").write_text(
+        "PHASES = tuple(x for x in ['a'])\n")
+    findings = lint_attribution_phases(root=str(pkg))
+    assert any(f.subject == "PHASES" for f in findings)
+
+
+def test_tel002_in_self_check(tmp_path):
+    """TEL002 drift fails `--self-check` end to end: tamper with the
+    phase table in a copied docs file and sweep against it."""
+    from mxnet_tpu.analysis import lint_attribution_phases
+    import mxnet_tpu.analysis.telemetry_lint as tl
+    import os
+    doc = tmp_path / "observability.md"
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(tl.__file__))), os.pardir, "docs",
+            "observability.md")) as f:
+        text = f.read()
+    doc.write_text(text.replace("| `input_wait` |", "| `renamed_wait` |"))
+    findings = lint_attribution_phases(doc_path=str(doc))
+    subjects = {f.subject for f in findings}
+    assert "input_wait" in subjects      # phase lost its docs row
+    assert "renamed_wait" in subjects    # docs row without a phase

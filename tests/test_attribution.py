@@ -1,0 +1,464 @@
+"""Performance doctor (tier-1, ISSUE 10): per-step time attribution,
+fleet straggler detection and the doctor CLI.
+
+Contract points:
+(a) StepAttribution windows: phase sums reconcile with measured step
+    wall time (documented tolerance: overshoot ~0, unattributed >= 0),
+    dominant-phase selection, per-window perf.phases flight records;
+(b) a real trainer fit run attributes dispatch/input_wait/checkpoint
+    time, embeds the snapshot in the metrics JSON and survives into the
+    doctor report;
+(c) the EWMA baseline flags a step-time regression (perf.anomaly) and
+    queue growth (perf.queue_growth) into the ring — deterministically,
+    via an injected clock;
+(d) StragglerDetector: per-rank step-time p50 vs fleet median over
+    heartbeat-style observations, perf.straggler events with the
+    reported dominant phase, cooldown re-emission;
+(e) the doctor reads a SIGKILLed rank's story from perf.phases ring
+    windows alone (no metrics dump);
+(f) the headline: a seeded 2-worker run with chaos `delay` faults at
+    pipeline.dispatch on rank 1 — the doctor names input_wait as rank
+    1's dominant phase, the server-side straggler detector flags rank 1
+    with that phase in its perf.straggler event, and the same run with
+    no fault reports balanced ranks.
+"""
+import glob
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry
+from mxnet_tpu.io.pipeline import pipeline_available
+from mxnet_tpu.parallel import DataParallelTrainer
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.telemetry import flight
+from mxnet_tpu.telemetry.attribution import (HINTS, PHASES,
+                                             StepAttribution,
+                                             StragglerDetector)
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    yield
+    telemetry.disable()
+    telemetry.reset_attribution()
+    chaos.uninstall()
+
+
+def _cpu_env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXTPU_CHAOS", None)
+    env.pop("MXTPU_TELEMETRY_DIR", None)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+# ---------------------------------------------------------------------------
+# (a) windows, reconciliation, dominant phase, ring records
+# ---------------------------------------------------------------------------
+def test_phase_window_reconciliation_and_ring(tmp_path):
+    telemetry.enable(str(tmp_path), rank=0, role="worker")
+    clock = [100.0]
+    attr = StepAttribution(ring_every=4, now=lambda: clock[0])
+    for step in range(1, 13):
+        attr.on_step(step)
+        attr.add_phase("dispatch", 0.002)
+        attr.add_phase("input_wait", 0.006)
+        clock[0] += 0.010          # window wall: 10ms
+    attr.flush_window()
+    snap = attr.snapshot()
+    assert snap["steps"] == 12
+    # reconciliation: wall == sum(phases) + unattributed, overshoot == 0
+    psum = sum(snap["phases_s"].values())
+    assert snap["overshoot_s"] == 0.0
+    assert abs(snap["wall_s"] - (psum + snap["unattributed_s"])) < 1e-9
+    assert abs(snap["wall_s"] - 0.120) < 1e-9
+    assert abs(snap["unattributed_s"] - 0.024) < 1e-9
+    assert snap["dominant_phase"] == "input_wait"
+    assert abs(snap["step_p50_s"] - 0.010) < 1e-9
+    # unknown phases are rejected, not silently dropped
+    with pytest.raises(ValueError):
+        attr.add_phase("not_a_phase", 0.1)
+    # perf.phases flight windows: 3 (every 4 steps) + no partial left
+    ring = glob.glob(str(tmp_path / "*.mxring"))[0]
+    _, events = flight.read_ring(ring)
+    wins = [e for e in events if e["kind"] == "perf.phases"]
+    assert len(wins) == 3
+    assert wins[0]["steps"] == 4
+    assert wins[0]["phase"] == "input_wait"
+    assert wins[0]["phases"]["input_wait"] == pytest.approx(0.024)
+    # every phase has a doctor hint and a PHASES entry (the TEL002
+    # contract, asserted live too)
+    assert set(HINTS) == set(PHASES)
+
+
+def test_trainer_fit_attributes_phases_and_dumps(tmp_path):
+    tele = tmp_path / "tele"
+    os.makedirs(tele)
+    telemetry.enable(str(tele), rank=0, role="worker")
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    tr = DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             "sgd", {"learning_rate": 0.05})
+    rng = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(rng.rand(96, 12).astype(np.float32),
+                           rng.randint(0, 10, 96).astype(np.int64), 16)
+    tr.fit(it, num_epoch=2, checkpoint_dir=str(tmp_path / "ck"),
+           checkpoint_every=5)
+    snap = telemetry.attribution().snapshot()
+    assert snap["steps"] == 12
+    phases = snap["phases_s"]
+    assert phases["dispatch"] > 0
+    assert phases["checkpoint"] > 0
+    assert phases["input_wait"] >= 0
+    # reconciliation against real timers: overshoot stays ~0
+    assert snap["overshoot_s"] <= 0.02 * snap["wall_s"] + 0.005
+    assert sum(phases.values()) <= snap["wall_s"] + snap["overshoot_s"] \
+        + 1e-6
+    # the metrics dump embeds the snapshot; the doctor reads it back
+    mfile = glob.glob(str(tele / "metrics-worker0-*.json"))
+    assert len(mfile) == 1
+    doc = json.load(open(mfile[0]))
+    assert doc["attribution"]["steps"] == snap["steps"]
+    assert "mxtpu_step_phase_seconds_total" in doc["metrics"]
+    assert "mxtpu_step_phase_seconds" in doc["metrics"]  # windowed hist
+    report = telemetry.doctor_report(str(tele))
+    rec = report["ranks"]["worker0"]
+    assert rec["steps"] == snap["steps"]
+    assert rec["dominant_phase"] in PHASES
+    assert rec["hint"] == HINTS[rec["dominant_phase"]]
+
+
+def test_disabled_telemetry_attributes_nothing():
+    telemetry.disable()
+    telemetry.reset_attribution()
+    net = gluon.nn.Dense(4)
+    net.initialize(mx.init.Xavier())
+    tr = DataParallelTrainer(net, gluon.loss.L2Loss(), "sgd",
+                             {"learning_rate": 0.1})
+    x = mx.nd.array(np.random.rand(8, 3).astype(np.float32))
+    y = mx.nd.array(np.random.rand(8, 4).astype(np.float32))
+    for _ in range(3):
+        tr.step(x, y)
+    tr.flush()
+    assert telemetry.attribution().snapshot()["steps"] == 0
+
+
+# ---------------------------------------------------------------------------
+# (c) EWMA anomaly + queue growth — injected clock, deterministic
+# ---------------------------------------------------------------------------
+def test_ewma_flags_step_time_regression(tmp_path):
+    telemetry.enable(str(tmp_path), rank=0, role="worker")
+    clock = [0.0]
+    attr = StepAttribution(ring_every=1000, anomaly_factor=3.0,
+                           warmup=10, now=lambda: clock[0])
+    step = 0
+    for _ in range(30):            # steady 10ms baseline
+        step += 1
+        attr.on_step(step)
+        clock[0] += 0.010
+    step += 1
+    attr.on_step(step)             # closes a normal window
+    clock[0] += 0.200              # the regression: one 200ms step
+    step += 1
+    attr.on_step(step)             # closes the slow window -> flagged
+    snap = attr.snapshot()
+    assert snap["anomalies"] == 1
+    ring = glob.glob(str(tmp_path / "*.mxring"))[0]
+    _, events = flight.read_ring(ring)
+    (anom,) = [e for e in events if e["kind"] == "perf.anomaly"]
+    assert anom["wall_s"] == pytest.approx(0.200)
+    assert anom["ewma_s"] < 0.02
+
+
+def test_queue_growth_flagged(tmp_path):
+    telemetry.enable(str(tmp_path), rank=0, role="worker")
+    attr = StepAttribution(ring_every=1000)
+    for _ in range(300):
+        attr.note_queue_depth("io.pipeline", 2)
+    for _ in range(60):            # the queue starts rotting
+        attr.note_queue_depth("io.pipeline", 12)
+    assert attr.snapshot()["queue_growth_events"] >= 1
+    ring = glob.glob(str(tmp_path / "*.mxring"))[0]
+    _, events = flight.read_ring(ring)
+    growth = [e for e in events if e["kind"] == "perf.queue_growth"]
+    assert growth and growth[0]["queue"] == "io.pipeline"
+
+
+# ---------------------------------------------------------------------------
+# (d) straggler detector unit
+# ---------------------------------------------------------------------------
+def test_straggler_detector_flags_slow_rank(tmp_path):
+    telemetry.enable(str(tmp_path), rank=None, role="server")
+    clock = [0]
+
+    def now_ns():
+        return clock[0]
+
+    det = StragglerDetector(factor=2.0, min_samples=5, cooldown_s=100.0,
+                            now_ns=now_ns)
+    emitted = []
+    # rank 0 steps every 10ms, rank 1 every 50ms; beats every 100ms
+    for beat in range(1, 12):
+        clock[0] = beat * 100_000_000
+        emitted += det.observe(0, beat * 10, phase="dispatch")
+        emitted += det.observe(1, beat * 2, phase="input_wait")
+    assert emitted, "straggler never flagged"
+    ev = emitted[0]
+    assert ev["rank"] == 1
+    assert ev["phase"] == "input_wait"
+    assert ev["lag"] >= 2.0
+    # cooldown: the persistent skew emitted exactly once
+    assert len(det.events) == 1
+    snap = det.snapshot()
+    assert snap["stragglers"] == ["1"]
+    assert snap["rank_step_p50_s"]["1"] == pytest.approx(0.05)
+    # the event reached the flight ring
+    ring = glob.glob(str(tmp_path / "*.mxring"))[0]
+    _, events = flight.read_ring(ring)
+    assert any(e["kind"] == "perf.straggler" and e["rank"] == 1
+               for e in events)
+
+
+def test_straggler_detector_balanced_ranks_quiet():
+    det = StragglerDetector(factor=2.0, min_samples=5)
+    t0 = time.perf_counter_ns()
+    for beat in range(1, 12):
+        t = t0 + beat * 100_000_000
+        det.observe(0, beat * 10, t_ns=t)
+        det.observe(1, beat * 10, t_ns=t)
+    assert det.events == []
+    assert det.snapshot()["stragglers"] == []
+
+
+# ---------------------------------------------------------------------------
+# (e) doctor from rings alone (the SIGKILLed-rank path)
+# ---------------------------------------------------------------------------
+def test_doctor_reads_ring_windows_without_metrics_dump(tmp_path):
+    telemetry.enable(str(tmp_path), rank=3, role="worker")
+    clock = [0.0]
+    attr = StepAttribution(ring_every=5, now=lambda: clock[0])
+    for step in range(1, 11):
+        attr.on_step(step)
+        attr.add_phase("collective_or_ps", 0.008)
+        clock[0] += 0.010
+    attr.flush_window()
+    telemetry.disable()   # close the ring like a dead process would not —
+    # read_ring works either way; no metrics dump was ever written
+    report = telemetry.doctor_report(str(tmp_path))
+    rec = report["ranks"]["worker3"]
+    assert rec["from_ring"]
+    assert rec["steps"] == 10
+    assert rec["dominant_phase"] == "collective_or_ps"
+    assert "max_staleness" in rec["hint"]
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.telemetry", "doctor",
+         str(tmp_path)], capture_output=True, text=True, timeout=120,
+        env=_cpu_env(), cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "worker3" in out.stdout
+    assert "collective_or_ps" in out.stdout
+    assert "max_staleness" in out.stdout
+    # --json round-trips
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.telemetry", "doctor",
+         str(tmp_path), "--json"], capture_output=True, text=True,
+        timeout=120, env=_cpu_env(), cwd=_ROOT)
+    assert out.returncode == 0
+    assert json.loads(out.stdout)["ranks"]["worker3"]["steps"] == 10
+
+
+def test_doctor_empty_dir_exits_nonzero(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.telemetry", "doctor",
+         str(tmp_path)], capture_output=True, text=True, timeout=120,
+        env=_cpu_env(), cwd=_ROOT)
+    assert out.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# (f) the headline: 2-worker run, chaos delay at pipeline.dispatch on rank 1
+# ---------------------------------------------------------------------------
+_SERVER_SRC = (
+    "from mxnet_tpu.kvstore_server import _init_kvstore_server_module\n"
+    "_init_kvstore_server_module()\n")
+
+_WORKER_SRC = """\
+import os, sys
+import numpy as np
+port, outdir, rank, epochs, rec, idx = (
+    int(sys.argv[1]), sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    sys.argv[5], sys.argv[6])
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, kvstore_ps, telemetry
+from mxnet_tpu.io.pipeline import ImagePipelineIter
+from mxnet_tpu.parallel import DataParallelTrainer
+from mxnet_tpu.resilience import chaos
+telemetry.maybe_enable_from_env()
+chaos.install_from_env()
+mx.random.seed(5)
+np.random.seed(5)
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(16, activation='relu'))
+net.add(gluon.nn.Dense(24))
+net.initialize(mx.init.Xavier())
+trainer = DataParallelTrainer(
+    net, gluon.loss.SoftmaxCrossEntropyLoss(), 'sgd',
+    {'learning_rate': 0.05})
+cli = kvstore_ps.PSClient('127.0.0.1', port, rank=rank,
+                          connect_retry_s=120)
+cli.start_heartbeat(0.03, step_fn=lambda: trainer._step_count,
+                    phase_fn=telemetry.dominant_phase_or_none)
+it = ImagePipelineIter(num_workers=1, seed=7, shuffle=False,
+                       path_imgrec=rec, path_imgidx=idx, batch_size=4,
+                       data_shape=(3, 28, 28), native_decode=False)
+try:
+    trainer.fit(it, num_epoch=epochs)
+finally:
+    it.close()
+import time as _t
+_t.sleep(0.3)   # a few post-run beats so the server sees final clocks
+cli.close()
+print('DONE', trainer._step_count, flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _make_rec(tmp_path, n=24, size=32):
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "p.rec")
+    idx = str(tmp_path / "p.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+    return rec, idx
+
+
+def _run_fleet(tmp_path, tag, epochs, rank1_chaos):
+    tele = str(tmp_path / ("tele_" + tag))
+    os.makedirs(tele)
+    rec, idx = _make_rec(tmp_path)
+    port = _free_port()
+    senv = _cpu_env(DMLC_ROLE="server", MXTPU_PS_PORT=port,
+                    MXTPU_HEARTBEAT_TIMEOUT_S=120,
+                    MXTPU_STRAGGLER_MIN_SAMPLES=4,
+                    MXTPU_TELEMETRY_DIR=tele)
+    server = subprocess.Popen([sys.executable, "-c", _SERVER_SRC],
+                              env=senv, stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    workers = []
+    try:
+        for rank in (0, 1):
+            env = _cpu_env(MXTPU_TELEMETRY_DIR=tele, DMLC_WORKER_ID=rank)
+            if rank == 1 and rank1_chaos:
+                env["MXTPU_CHAOS"] = rank1_chaos
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SRC, str(port), tele,
+                 str(rank), str(epochs), rec, idx],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        for rank, w in enumerate(workers):
+            wout, werr = w.communicate(timeout=420)
+            assert w.returncode == 0, "rank %d: %s" % (rank, werr[-3000:])
+            assert "DONE" in wout
+    finally:
+        for w in workers:
+            w.kill()
+        server.send_signal(signal.SIGTERM)
+        try:
+            server.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            server.kill()
+    return tele
+
+
+@pytest.mark.skipif(not pipeline_available(),
+                    reason="no multiprocessing shared memory")
+def test_two_worker_straggler_doctor_end_to_end(tmp_path):
+    """The ISSUE-10 acceptance test.  A seeded 2-worker run (each rank
+    training through an ImagePipelineIter + heartbeating its step clock
+    and dominant phase to a standalone PS) with chaos `delay` faults at
+    pipeline.dispatch on rank 1:
+
+    - the doctor names input_wait as rank 1's dominant phase with its
+      knob hint;
+    - rank 1 is in the doctor's straggler list AND the server-side
+      detector recorded a perf.straggler event naming rank 1 and
+      input_wait;
+    - per-rank phase sums reconcile with measured wall time within the
+      documented tolerance;
+    - the same run with no fault reports balanced ranks.
+    """
+    pytest.importorskip("cv2")
+    # one delay per dispatched batch: 6 batches/epoch x 6 epochs = 36
+    spec = ",".join("pipeline.dispatch:%d:delay:0.2" % i
+                    for i in range(1, 41))
+    tele = _run_fleet(tmp_path, "chaos", epochs=6, rank1_chaos=spec)
+
+    report = telemetry.doctor_report(tele)
+    r0, r1 = report["ranks"]["worker0"], report["ranks"]["worker1"]
+    assert r0["steps"] == r1["steps"] == 36
+    # (1) dominant phase on the slowed rank is input_wait, with its hint
+    assert r1["dominant_phase"] == "input_wait", r1
+    assert "preprocess_threads" in r1["hint"]
+    # (2a) offline straggler verdict
+    assert report["stragglers"] == ["worker1"], report["stragglers"]
+    assert not report["balanced"]
+    # (2b) the ONLINE detector flagged rank 1 into the server's ring,
+    # naming the dominant phase the rank's heartbeats reported
+    stragglers = report["events"]["straggler"]
+    assert stragglers, "server never emitted perf.straggler"
+    assert all(e["rank"] == 1 for e in stragglers)
+    assert any(e["phase"] == "input_wait" for e in stragglers), stragglers
+    assert all(e["seen_by"] == "server" for e in stragglers)
+    # (3) reconciliation on both ranks: overshoot ~0, phases fit inside
+    # the measured wall (documented tolerance: 2% + 5ms timer overhead)
+    for rec in (r0, r1):
+        psum = sum(rec["phases_s"].values())
+        assert psum <= rec["wall_s"] * 1.02 + 0.005
+        assert rec["unattributed_s"] >= 0
+    # rank 1's input wait dominates its wall; rank 0's does not
+    assert r1["phases_s"]["input_wait"] > 0.5 * r1["wall_s"]
+    assert r0["phases_s"]["input_wait"] < 0.5 * r0["wall_s"]
+    # the CLI tells the same story
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.telemetry", "doctor", tele],
+        capture_output=True, text=True, timeout=120, env=_cpu_env(),
+        cwd=_ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "STRAGGLERS" in out.stdout and "worker1" in out.stdout
+    assert "input_wait" in out.stdout
+    assert "preprocess_threads" in out.stdout
+
+    # (4) the identical run with no fault: balanced ranks
+    tele2 = _run_fleet(tmp_path, "clean", epochs=3, rank1_chaos=None)
+    report2 = telemetry.doctor_report(tele2)
+    assert report2["stragglers"] == []
+    assert report2["events"]["straggler"] == []
+    assert report2["balanced"]

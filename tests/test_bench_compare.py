@@ -1,0 +1,128 @@
+"""tools/bench_compare.py: the BENCH_r*.json lineage as a regression
+gate (tier-1, ISSUE 10 satellite).
+
+Contract points: the shipped r01..r05 lineage passes (staleness
+protocol honored — r05's carried-forward keys set no bar); a
+synthetically injected regression in a copied BENCH file exits nonzero
+and names the metric; a malformed record fails fast; the gate math
+(direction, relative vs absolute tolerance, no-prior vacuous pass) is
+pinned at the function level.
+"""
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_TOOL = os.path.join(_ROOT, "tools", "bench_compare.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("_bench_compare", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bc = _load_tool()
+_LINEAGE = sorted(
+    os.path.join(_ROOT, f) for f in os.listdir(_ROOT)
+    if f.startswith("BENCH_r") and f.endswith(".json"))
+
+
+def test_real_lineage_passes_check():
+    """The tier-1 CI wiring: the shipped bench history must gate clean
+    (a regressing or malformed BENCH file in a PR fails this test)."""
+    assert _LINEAGE, "no BENCH_r*.json lineage on disk"
+    out = subprocess.run(
+        [sys.executable, _TOOL, "--check"] + _LINEAGE,
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "bench lineage ok" in out.stdout
+
+
+def test_staleness_protocol_sets_no_bar():
+    """r05 re-emits r02's numbers as carry-forwards (stale/stale_keys);
+    they must count as neither newest-live nor best-prior."""
+    report = bc.compare(_LINEAGE)
+    gates = report["gates"]
+    # pipeline_fed was live ONLY in r02 (r05's copy is stale) -> no bar
+    assert gates["pipeline_fed_imgs_per_sec"]["verdict"] == "no-prior"
+    assert gates["pipeline_fed_imgs_per_sec"]["live_rounds"] == [2]
+    # the primary metric was live in r01 and r02, r02 improved
+    assert gates["value"]["verdict"] == "ok"
+    assert gates["value"]["live_rounds"] == [1, 2]
+    assert report["regressions"] == [] and report["malformed"] == []
+
+
+def test_injected_regression_detected(tmp_path):
+    """The acceptance criterion: copy a BENCH file, regress one gated
+    metric -> exit nonzero, metric named."""
+    for f in _LINEAGE:
+        shutil.copy(f, tmp_path)
+    rec = json.load(open(os.path.join(_ROOT, "BENCH_r02.json")))
+    rec["parsed"]["pipeline_fed_imgs_per_sec"] = 50.0   # was 126.93 live
+    rec["n"] = 6
+    with open(tmp_path / "BENCH_r06.json", "w") as f:
+        json.dump(rec, f)
+    files = sorted(str(p) for p in tmp_path.glob("BENCH_r*.json"))
+    out = subprocess.run([sys.executable, _TOOL] + files,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2, out.stdout
+    assert "REGRESSION" in out.stdout
+    assert "pipeline_fed_imgs_per_sec" in out.stdout
+    # an improvement (or within-tolerance dip) stays green
+    rec["parsed"]["pipeline_fed_imgs_per_sec"] = 120.0  # -5.5% < 10% tol
+    with open(tmp_path / "BENCH_r06.json", "w") as f:
+        json.dump(rec, f)
+    out = subprocess.run([sys.executable, _TOOL] + files,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout
+
+
+def test_malformed_record_fails_fast(tmp_path):
+    bad = tmp_path / "BENCH_r09.json"
+    bad.write_text("{torn mid-write")
+    out = subprocess.run([sys.executable, _TOOL, str(bad)],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "MALFORMED" in out.stdout
+    # structurally wrong (missing record keys) is malformed too
+    bad.write_text(json.dumps({"unexpected": 1}))
+    out = subprocess.run([sys.executable, _TOOL, str(bad)],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1, out.stdout
+    with pytest.raises(bc.MalformedRecord):
+        bc.load_record(str(bad))
+
+
+def test_gate_math_directions(tmp_path):
+    """lower_abs gates (overhead pcts near zero) use absolute slack;
+    higher gates use relative tolerance."""
+    def rec(n, parsed):
+        return {"n": n, "cmd": "bench", "rc": 0, "tail": "",
+                "parsed": parsed}
+    a = tmp_path / "BENCH_r01.json"
+    b = tmp_path / "BENCH_r02.json"
+    a.write_text(json.dumps(rec(1, {"telemetry_overhead_pct": 0.5,
+                                    "serving_reqs_per_sec": 100.0})))
+    # overhead 0.5 -> 0.9 is within +0.5 abs slack; reqs/s -15% is not
+    b.write_text(json.dumps(rec(2, {"telemetry_overhead_pct": 0.9,
+                                    "serving_reqs_per_sec": 85.0})))
+    report = bc.compare([str(a), str(b)])
+    assert report["gates"]["telemetry_overhead_pct"]["verdict"] == "ok"
+    assert report["gates"]["serving_reqs_per_sec"]["verdict"] == \
+        "regression"
+    assert report["regressions"] == ["serving_reqs_per_sec"]
+    # overhead past the absolute slack regresses
+    b.write_text(json.dumps(rec(2, {"telemetry_overhead_pct": 1.2,
+                                    "serving_reqs_per_sec": 100.0})))
+    report = bc.compare([str(a), str(b)])
+    assert report["regressions"] == ["telemetry_overhead_pct"]
+    # --tolerance-scale widens every gate
+    report = bc.compare([str(a), str(b)], tolerance_scale=2.0)
+    assert report["regressions"] == []

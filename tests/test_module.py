@@ -148,3 +148,47 @@ def test_module_monitor():
     mod.forward(next(iter(it)), is_train=False)
     stats = mon.toc()
     assert any("fc1" in name for _, name, _ in stats)
+
+
+def test_monitor_drains_lazily_at_toc(monkeypatch):
+    """ISSUE-10 satellite: the Monitor must not run its stat (and its
+    implied device->host sync) per batch — outputs are PARKED at
+    observe/tap time and the stat computes only at the toc boundary;
+    its queue/drain accounting scrapes through the telemetry registry."""
+    from mxnet_tpu import telemetry
+    calls = []
+
+    def counting_stat(x):
+        calls.append(1)
+        return float(np.abs(x).mean())
+
+    X, y = _toy_data(40)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mon = mx.Monitor(interval=1, pattern=".*", stat_func=counting_stat)
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=False)
+    # observed but NOT computed: the per-batch path never ran the stat
+    assert mon._pending and not calls
+    stats = mon.toc()
+    # the toc boundary drained everything, in observe order
+    assert calls and len(stats) == len(calls)
+    assert not mon._pending
+    # registry accounting (weakly-held collector)
+    text = telemetry.registry().prometheus_text()
+    assert "mxtpu_monitor_observed_total" in text
+    assert "mxtpu_monitor_drains_total" in text
+    # second interval: toc with nothing parked stays sane
+    mon.tic()
+    assert mon.toc() == []
+    # overflow guard: parking past MXTPU_MONITOR_MAX_PENDING force-drains
+    monkeypatch.setattr(mx.monitor, "_MAX_PENDING", 8)
+    mon.tic()
+    for i in range(10):
+        mon._park(i, "x%d" % i, np.float32(i))
+    assert len(mon._pending) <= 8
+    assert len(mon.queue) >= 2     # the oldest half computed eagerly
+    assert mon.toc()

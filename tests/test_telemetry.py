@@ -128,6 +128,78 @@ def test_histogram_reservoir_bounds_window():
     assert cell["count"] == 1000 and cell["sum"] == sum(range(1000))
 
 
+def test_histogram_percentile_accuracy_after_wrap():
+    """After the reservoir wraps, p50/p99 must track the NEWEST
+    ``reservoir`` observations accurately — not a mixture with aged-out
+    samples (ISSUE-10 satellite: the PR-9 hammer covered Counter, not
+    Histogram)."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_acc", reservoir=256)
+    # first era: uniform 0..999 — fully aged out by the second era
+    for i in range(1000):
+        h.observe(float(i))
+    # second era: exactly 256 samples of a known uniform grid 0..255
+    for i in range(256):
+        h.observe(float(i))
+    p50, p99 = h.quantiles()
+    # nearest-rank over 0..255: p50 = 128, p99 = 252 (+-1 for rounding)
+    assert abs(p50 - 127.5) <= 1.0
+    assert abs(p99 - 252.45) <= 1.0
+    (_, cell), = h.samples()
+    assert cell["count"] == 1256                       # exact lifetime
+    assert cell["sum"] == sum(range(1000)) + sum(range(256))
+    assert cell["p50"] == p50 and cell["p99"] == p99
+    # per-label-set reservoirs are independent
+    h.observe(1e6, shard="other")
+    assert h.quantiles() == (p50, p99)
+
+
+def test_histogram_concurrent_observe_four_threads():
+    """4 threads observing concurrently (the serving-handler pattern):
+    no update lost, no exception, percentiles land inside the observed
+    range — under a concurrent scrape loop too."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t_conc", reservoir=512)
+    n_per, errs = 5000, []
+
+    def worker(tid):
+        try:
+            for i in range(n_per):
+                h.observe(float(tid * n_per + i), thread=str(tid % 2))
+        except Exception as e:   # pragma: no cover - the failure mode
+            errs.append(e)
+
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            reg.prometheus_text()
+            reg.to_json()
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(4)]
+    s = threading.Thread(target=scraper)
+    s.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    stop.set()
+    s.join(timeout=60)
+    assert not errs
+    total = {}
+    for labels, cell in h.samples():
+        total[labels["thread"]] = cell
+        lo, hi = 0.0, 4.0 * n_per
+        assert lo <= cell["p50"] <= hi
+        assert lo <= cell["p99"] <= hi
+        assert cell["p50"] <= cell["p99"]
+    # exactly-once accounting across the 4 threads (2 per label set)
+    assert total["0"]["count"] == total["1"]["count"] == 2 * n_per
+    assert total["0"]["sum"] + total["1"]["sum"] == \
+        sum(range(4 * n_per))
+
+
 def test_collector_weakref_drops_dead_source():
     reg = MetricsRegistry()
 
@@ -450,6 +522,65 @@ def test_trace_merge_aligns_ranks_and_rings(tmp_path):
     assert fault["ph"] == "i" and fault["args"]["trace_id"] == "t1"
     merged_meta = doc["metadata"]["merged_from"]
     assert merged_meta["worker0"]["aligned"] is True
+    assert doc["metadata"]["skipped_count"] == 0
+
+
+def test_trace_merge_skips_torn_inputs_with_recorded_warning(tmp_path):
+    """ISSUE-10 satellite regression test: a missing or torn per-rank
+    trace/ring must be skipped with a recorded warning (surfaced in the
+    merged metadata), not abort the whole merge — exactly the files a
+    SIGKILLed rank leaves behind."""
+    good = {"traceEvents": [
+        {"name": "step", "cat": "t", "ph": "X", "ts": 10.0, "dur": 5.0,
+         "pid": 1, "tid": 1}],
+        "metadata": {"rank": 0, "perf_origin_ns": 1_000_000}}
+    gpath = str(tmp_path / "good.json")
+    json.dump(good, open(gpath, "w"))
+    torn = str(tmp_path / "torn.json")
+    with open(torn, "w") as f:
+        f.write(json.dumps(good)[:40])          # mid-write crash
+    wrong_shape = str(tmp_path / "list.json")
+    json.dump([1, 2, 3], open(wrong_shape, "w"))
+    missing = str(tmp_path / "never_written.json")
+    # one good ring + one garbage ring
+    ring = flight.FlightRecorder(str(tmp_path / "flight-worker0-1.mxring"),
+                                 meta={"role": "worker", "rank": 0})
+    ring.record("trainer.step", step=3)
+    ring.close()
+    bad_ring = str(tmp_path / "flight-worker1-2.mxring")
+    with open(bad_ring, "wb") as f:
+        f.write(b"NOTARING" + b"\x00" * 64)
+    merged_path = str(tmp_path / "fleet.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "trace_merge.py"),
+         "-o", merged_path, gpath, torn, wrong_shape, missing,
+         "--rings", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "4 unreadable input(s) skipped" in out.stdout
+    for name in ("torn.json", "list.json", "never_written.json"):
+        assert name in out.stderr
+    doc = json.load(open(merged_path))
+    # the survivors merged: the good trace's event + the good ring's
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "step" in names and "trainer.step" in names
+    # the skip count and per-file reasons are IN the merged output — a
+    # partial merge can never pass as a complete one
+    meta = doc["metadata"]
+    assert meta["skipped_count"] == 4
+    skipped_files = {s["file"] for s in meta["skipped"]}
+    assert skipped_files == {"torn.json", "list.json",
+                             "never_written.json",
+                             os.path.basename(bad_ring)}
+    assert all(s["error"] for s in meta["skipped"])
+    # importable API agrees (tests call merge() directly)
+    sys.path.insert(0, os.path.join(_ROOT, "tools"))
+    try:
+        import trace_merge
+        doc2 = trace_merge.merge([gpath, missing])
+        assert doc2["metadata"]["skipped_count"] == 1
+    finally:
+        sys.path.pop(0)
 
 
 # ---------------------------------------------------------------------------

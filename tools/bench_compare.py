@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Bench-lineage regression gate: the BENCH_r*.json history, gated.
+
+Every bench round the driver archives a ``BENCH_r<N>.json`` record
+(``{"n", "cmd", "rc", "tail", "parsed"}``); until now that lineage was
+an unread archive.  This tool makes it a gate:
+
+- **well-formedness**: every file must be a JSON object with the record
+  keys; ``parsed`` is either null (a round that died before emitting —
+  BENCH_r03/r04) or the bench's tail-line record.  A malformed file
+  exits 1.
+- **regression gate**: for each gated metric, the *newest live* value is
+  compared against the *best prior live* value with a declared
+  tolerance.  "Live" honors the bench's own staleness protocol
+  (``bench.py``): a key listed in ``stale_keys`` — or the primary
+  ``value`` under ``stale: true`` — is a carry-forward, not a
+  measurement, and neither sets the bar nor gets gated.  A regression
+  beyond tolerance exits 2 and names the metric.
+
+Gated metrics (direction, tolerance)::
+
+    value (resnet50 img/s/chip)        higher, 10% relative
+    pipeline_fed_imgs_per_sec          higher, 10% relative
+    pipeline_iter_imgs_per_sec         higher, 10% relative
+    serving_reqs_per_sec               higher, 10% relative
+    serving_fleet_reqs_per_sec         higher, 10% relative
+    train_loop_overlap_ratio           higher, 10% relative
+    int8_infer_imgs_per_sec            higher, 10% relative
+    bf16_infer_imgs_per_sec            higher, 10% relative
+    telemetry_overhead_pct             lower, +0.5 absolute slack
+    checkpoint_overhead_pct            lower, +2.0 absolute slack
+
+A metric with fewer than two live occurrences has no prior bar and
+passes vacuously (the r01–r05 lineage: ``value`` is live in r01+r02,
+the pipeline keys only in r02, everything in r05 is a carry-forward).
+
+Usage::
+
+    python tools/bench_compare.py --check BENCH_r0*.json
+    python tools/bench_compare.py --json BENCH_r0*.json NEW_RECORD.json
+
+Stdlib-only (CI and postmortem hosts need no jax); importable — tests
+call :func:`compare` directly.  Exit codes: 0 ok, 1 malformed, 2
+regression.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+# metric -> (direction, tolerance).  "higher": newest >= best * (1 - tol)
+# (relative).  "lower_abs": newest <= best + tol (absolute slack — the
+# overhead percentages live near zero, where relative tolerance is
+# meaningless).
+GATES = {
+    "value": ("higher", 0.10),
+    "pipeline_fed_imgs_per_sec": ("higher", 0.10),
+    "pipeline_iter_imgs_per_sec": ("higher", 0.10),
+    "serving_reqs_per_sec": ("higher", 0.10),
+    "serving_fleet_reqs_per_sec": ("higher", 0.10),
+    "train_loop_overlap_ratio": ("higher", 0.10),
+    "int8_infer_imgs_per_sec": ("higher", 0.10),
+    "bf16_infer_imgs_per_sec": ("higher", 0.10),
+    "telemetry_overhead_pct": ("lower_abs", 0.5),
+    "checkpoint_overhead_pct": ("lower_abs", 2.0),
+}
+
+_RECORD_KEYS = ("n", "cmd", "rc", "parsed")
+_ROUND_RE = re.compile(r"BENCH_r0*(\d+)", re.I)
+
+
+class MalformedRecord(ValueError):
+    """A lineage file that is not a bench record."""
+
+
+def load_record(path):
+    """Load + validate one BENCH_r*.json -> (round_number, record).
+    Raises :class:`MalformedRecord` on anything that is not a bench
+    record (unparseable JSON, wrong shape, non-dict non-null parsed)."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except OSError as e:
+        raise MalformedRecord("%s: unreadable (%s)" % (path, e))
+    except ValueError as e:
+        raise MalformedRecord("%s: not JSON (%s)" % (path, e))
+    if not isinstance(rec, dict):
+        raise MalformedRecord("%s: top level is %s, not an object"
+                              % (path, type(rec).__name__))
+    missing = [k for k in _RECORD_KEYS if k not in rec]
+    if missing:
+        raise MalformedRecord("%s: missing record key(s) %s"
+                              % (path, ", ".join(missing)))
+    parsed = rec["parsed"]
+    if parsed is not None and not isinstance(parsed, dict):
+        raise MalformedRecord("%s: parsed is %s, not an object/null"
+                              % (path, type(parsed).__name__))
+    m = _ROUND_RE.search(os.path.basename(path))
+    rnd = int(m.group(1)) if m else int(rec.get("n") or 0)
+    return rnd, rec
+
+
+def live_values(parsed, gates=None):
+    """The gated metrics measured LIVE in one round's record — the
+    bench's staleness protocol applied: ``stale_keys`` entries (and the
+    primary ``value`` under ``stale: true``) are carry-forwards."""
+    gates = gates or GATES
+    if not isinstance(parsed, dict):
+        return {}
+    stale_keys = set(parsed.get("stale_keys") or [])
+    out = {}
+    for key in gates:
+        if key not in parsed or key in stale_keys:
+            continue
+        if key == "value" and parsed.get("stale"):
+            continue
+        v = parsed[key]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[key] = float(v)
+    return out
+
+
+def compare(paths, gates=None, tolerance_scale=1.0):
+    """Gate the lineage.  Returns a report dict:
+
+    ``rounds``: [(round, file, live-metric dict)] ascending;
+    ``gates``: per metric — newest live value/round, best prior live
+    value/round, the allowed bar, and the verdict;
+    ``regressions``: gated metrics whose newest live value fell past
+    tolerance;
+    ``malformed``: [(file, error)] (well-formedness failures).
+    """
+    gates = gates or GATES
+    rounds, malformed = [], []
+    for path in paths:
+        try:
+            rnd, rec = load_record(path)
+        except MalformedRecord as e:
+            malformed.append((path, str(e)))
+            continue
+        rounds.append((rnd, os.path.basename(path),
+                       live_values(rec["parsed"], gates)))
+    rounds.sort(key=lambda r: r[0])
+    report = {"rounds": [(r, f, vals) for r, f, vals in rounds],
+              "gates": {}, "regressions": [], "malformed": malformed}
+    for key, (direction, tol) in sorted(gates.items()):
+        tol = tol * float(tolerance_scale)
+        history = [(rnd, fname, vals[key]) for rnd, fname, vals in rounds
+                   if key in vals]
+        if not history:
+            continue
+        newest_rnd, newest_file, newest = history[-1]
+        prior = history[:-1]
+        entry = {"newest": newest, "newest_round": newest_rnd,
+                 "direction": direction, "tolerance": tol,
+                 "live_rounds": [r for r, _, _ in history]}
+        if not prior:
+            entry["verdict"] = "no-prior"
+            report["gates"][key] = entry
+            continue
+        if direction == "higher":
+            best_rnd, _, best = max(prior, key=lambda h: h[2])
+            allowed = best * (1.0 - tol)
+            ok = newest >= allowed
+        else:  # lower_abs
+            best_rnd, _, best = min(prior, key=lambda h: h[2])
+            allowed = best + tol
+            ok = newest <= allowed
+        entry.update(best_prior=best, best_prior_round=best_rnd,
+                     allowed=round(allowed, 6),
+                     verdict="ok" if ok else "regression")
+        report["gates"][key] = entry
+        if not ok:
+            report["regressions"].append(key)
+    return report
+
+
+def render(report):
+    lines = []
+    for path, err in report["malformed"]:
+        lines.append("MALFORMED %s" % err)
+    for key, g in sorted(report["gates"].items()):
+        if g["verdict"] == "no-prior":
+            lines.append("  ----    %-32s %12.4g (r%02d) — first live "
+                         "value, no prior bar"
+                         % (key, g["newest"], g["newest_round"]))
+            continue
+        tag = "  OK  " if g["verdict"] == "ok" else "REGRESSION"
+        cmp_ch = ">=" if g["direction"] == "higher" else "<="
+        lines.append("%s  %-32s %12.4g (r%02d) %s %.4g "
+                     "(best prior %.4g @ r%02d, tol %s)"
+                     % (tag, key, g["newest"], g["newest_round"], cmp_ch,
+                        g["allowed"], g["best_prior"],
+                        g["best_prior_round"],
+                        ("%.0f%%" % (100 * g["tolerance"])
+                         if g["direction"] == "higher"
+                         else "+%.2g abs" % g["tolerance"])))
+    if report["regressions"]:
+        lines.append("REGRESSION in: %s"
+                     % ", ".join(sorted(report["regressions"])))
+    elif not report["malformed"]:
+        lines.append("bench lineage ok (%d round(s), %d gated metric(s) "
+                     "with live values)"
+                     % (len(report["rounds"]), len(report["gates"])))
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="gate bench metrics against the best prior live "
+                    "value in the BENCH_r*.json lineage")
+    parser.add_argument("files", nargs="+",
+                        help="BENCH_r*.json records, any order")
+    parser.add_argument("--check", action="store_true",
+                        help="explicit CI spelling (validation + gates "
+                             "run either way)")
+    parser.add_argument("--tolerance-scale", type=float, default=1.0,
+                        help="scale every gate's tolerance (e.g. 2.0 "
+                             "doubles the slack)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable report")
+    args = parser.parse_args(argv)
+    report = compare(args.files, tolerance_scale=args.tolerance_scale)
+    if args.as_json:
+        json.dump(report, sys.stdout, indent=1, default=str)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render(report))
+    if report["malformed"]:
+        return 1
+    if report["regressions"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

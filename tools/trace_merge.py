@@ -55,6 +55,9 @@ def _load_flight():
 def _load_trace_file(path):
     with open(path) as f:
         doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError("not a chrome trace document (top level %s)"
+                         % type(doc).__name__)
     events = doc.get("traceEvents", [])
     meta = doc.get("metadata", {})
     return events, meta
@@ -73,11 +76,28 @@ def merge(trace_paths, ring_paths=(), flight_mod=None):
 
     ``trace_paths`` are per-rank chrome JSONs (with telemetry metadata);
     ``ring_paths`` are ``*.mxring`` files.  Inputs missing an offset are
-    merged unshifted (their metadata records ``aligned: false``)."""
+    merged unshifted (their metadata records ``aligned: false``).
+
+    Fault tolerance: a missing, torn or garbage input — exactly what a
+    SIGKILLed rank leaves behind — is *skipped with a recorded warning*
+    instead of aborting the whole merge; the surviving members still
+    produce a timeline, and the merged ``metadata`` carries
+    ``skipped`` (per-file reason) + ``skipped_count`` so a partial merge
+    can never be mistaken for a complete one."""
     flight = flight_mod or _load_flight()
     members = []         # (label, meta, events_abs_ns)
+    skipped = []         # [{"file", "error"}] — surfaced in the output
     for path in trace_paths:
-        events, meta = _load_trace_file(path)
+        try:
+            events, meta = _load_trace_file(path)
+        except (OSError, ValueError) as e:
+            print("trace_merge: skipping unreadable trace %s (%s)"
+                  % (path, e), file=sys.stderr)
+            skipped.append({"file": os.path.basename(path),
+                            "error": str(e)[:200]})
+            continue
+        if not isinstance(meta, dict):
+            meta = {}
         rank = meta.get("rank")
         role = meta.get("role", "worker")
         label = "%s%s" % (role, "" if rank is None else rank)
@@ -98,6 +118,8 @@ def merge(trace_paths, ring_paths=(), flight_mod=None):
         except (OSError, ValueError) as e:
             print("trace_merge: skipping unreadable ring %s (%s)"
                   % (path, e), file=sys.stderr)
+            skipped.append({"file": os.path.basename(path),
+                            "error": str(e)[:200]})
             continue
         rank = meta.get("rank")
         role = meta.get("role", "worker")
@@ -145,7 +167,9 @@ def merge(trace_paths, ring_paths=(), flight_mod=None):
                                     "ps_clock_rtt_ns", "dropped_events")}
     merged.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0)))
     return {"traceEvents": merged, "displayTimeUnit": "ms",
-            "metadata": {"merged_from": meta_out, "base_ns": base_ns}}
+            "metadata": {"merged_from": meta_out, "base_ns": base_ns,
+                         "skipped": skipped,
+                         "skipped_count": len(skipped)}}
 
 
 def main(argv=None):
@@ -171,9 +195,11 @@ def main(argv=None):
     doc = merge(args.traces, rings)
     with open(args.output, "w") as f:
         json.dump(doc, f, indent=1)
-    print("trace_merge: %d events from %d inputs -> %s"
+    skipped = doc["metadata"]["skipped_count"]
+    print("trace_merge: %d events from %d inputs%s -> %s"
           % (len(doc["traceEvents"]), len(doc["metadata"]["merged_from"]),
-             args.output))
+             " (%d unreadable input(s) skipped)" % skipped if skipped
+             else "", args.output))
     return 0
 
 
