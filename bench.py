@@ -338,7 +338,7 @@ def _run_benches(rec):
     # (BENCH_r05: "backend unavailable after retries" left us with no
     # perf signal at all; the model is the signal of last resort)
     if os.environ.get("MXTPU_BENCH_STATIC_COST", "1") == "1":
-        rec.stage("static_cost", 90, _static_cost_bench)
+        rec.stage("static_cost", 150, _static_cost_bench)
 
     # -- run-ahead overlap micro-bench, host-only and BEFORE backend
     # acquisition: train_loop_overlap_ratio (stepped vs bulk wall time on
@@ -533,30 +533,52 @@ def _pipeline_host_bench():
 def _static_cost_bench():
     """Hardware-free modeled cost of the ResNet-50 training step via the
     mxcost CLI (JAX_PLATFORMS=cpu subprocess, same isolation contract as
-    the serving/pipeline stages).  The budget model traces at batch 32;
-    flops scale linearly in batch so flops/img is geometry-free."""
+    the serving/pipeline stages), plus the mxshard proof numbers from
+    the sharded budget models: modeled_zero1_hbm_drop_pct (the ZeRO-1
+    peak-HBM saving vs the replicated twin on the declared 8-way mesh)
+    and modeled_ring_attn_collective_bytes (the ppermute ring schedule
+    of parallel/ring_attention.py) — both deterministic, both gated by
+    tools/bench_compare.py from r06 onward.  The resnet model traces at
+    batch 32; flops scale linearly in batch so flops/img is
+    geometry-free."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
-    out = subprocess.run(
-        [sys.executable, "-m", "mxnet_tpu.analysis", "--cost", "--json",
-         "--model", "resnet50_train_step"],
-        capture_output=True, text=True, timeout=300, env=env,
-        cwd=_REPO_DIR)
-    if out.returncode != 0 or not out.stdout.strip():
-        raise RuntimeError("static cost rc=%d: %s" % (
-            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
-    payload = json.loads(out.stdout)
+
+    def run_cli(models, extra=()):
+        out = subprocess.run(
+            [sys.executable, "-m", "mxnet_tpu.analysis", "--cost",
+             "--json", "--model", models] + list(extra),
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=_REPO_DIR)
+        if out.returncode != 0 or not out.stdout.strip():
+            raise RuntimeError("static cost rc=%d: %s" % (
+                out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+        return json.loads(out.stdout)
+
+    payload = run_cli("resnet50_train_step")
     cost = payload["cost"]["resnet50_train_step"]
     batch = 32  # the budget model's pinned trace geometry
-    return {
+    result = {
         "modeled_step_flops": int(cost["flops"]),
         "modeled_flops_per_img": int(cost["flops"] // batch),
         "modeled_transfer_bytes": int(cost["transfer_bytes"]),
         "modeled_peak_hbm_bytes": int(cost["peak_hbm_bytes"]),
         "modeled_collective_bytes": int(cost["collective_bytes"]),
     }
+    sharded = run_cli("zero1_mlp_train_step,ring_attention_fwd",
+                      extra=["--shard"])
+    reports = sharded.get("shard", {}).get("reports", {})
+    zero1 = reports.get("zero1_mlp_train_step", {}).get("extras", {})
+    ring = reports.get("ring_attention_fwd", {}).get("extras", {})
+    if "modeled_zero1_hbm_drop_pct" in zero1:
+        result["modeled_zero1_hbm_drop_pct"] = float(
+            zero1["modeled_zero1_hbm_drop_pct"])
+    if "modeled_ring_attn_collective_bytes" in ring:
+        result["modeled_ring_attn_collective_bytes"] = int(
+            ring["modeled_ring_attn_collective_bytes"])
+    return result
 
 
 def _overlap_bench():

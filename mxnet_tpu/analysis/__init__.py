@@ -35,8 +35,12 @@ from .coverage import load_test_map, generate_coverage_md
 from .report import (render_text, render_json, exit_code, worst_severity,
                      SCHEMA_VERSION)
 from .cost import (CostReport, analyze_jaxpr, analyze_fn, analyze_symbol,
-                   XLA_FLOP_RTOL)
+                   XLA_FLOP_RTOL, ring_bytes_per_axis, unpriced_findings)
 from .dist_lint import lint_dist_step, lint_trainer, dist_summary
+from .shard_prop import (MeshSpec, ShardSpec, ShardReport, propagate,
+                         collective_schedule, lint_sharded_step,
+                         lint_ring_schedule, lint_global_sharding,
+                         shard_summary)
 
 __all__ = [
     "Finding", "RULES", "ERROR", "WARNING", "INFO",
@@ -53,8 +57,13 @@ __all__ = [
     "filter_findings", "suppressed_rules", "unique_ops",
     "LOSS_OPS", "LARGE_CONST_BYTES",
     "CostReport", "analyze_jaxpr", "analyze_fn", "analyze_symbol",
-    "XLA_FLOP_RTOL", "SCHEMA_VERSION",
+    "XLA_FLOP_RTOL", "SCHEMA_VERSION", "ring_bytes_per_axis",
+    "unpriced_findings",
     "lint_dist_step", "lint_trainer", "dist_summary", "cost_self_check",
+    "MeshSpec", "ShardSpec", "ShardReport", "propagate",
+    "collective_schedule", "lint_sharded_step", "lint_ring_schedule",
+    "lint_global_sharding", "shard_summary", "shard_self_check",
+    "lint_parallel_sources",
 ]
 
 
@@ -67,13 +76,17 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 
 def self_check(disable=(), with_coverage=True, with_cost=True,
                with_examples=True, with_workers=True, with_serving=True,
-               with_telemetry=True):
+               with_telemetry=True, with_shard=True):
     """Registry lint over the live registry, the rule-table docs sync
     check, the cost-pass determinism check, the SRC004 sweep over the
     shipped training loops, the SRC005 sweep over the shipped worker
     loops, the SRV004 deadline-propagation sweep over the shipped
-    serving request paths, and the telemetry sweeps — TEL001
-    chaos-probe sites and TEL002 attribution phases — what CI runs.
+    serving request paths, the telemetry sweeps — TEL001 chaos-probe
+    sites and TEL002 attribution phases — and the mxshard sweeps: the
+    golden sharded-step fixtures must lint clean and deterministically
+    (``shard_self_check``) and the shipped ring/Ulysses attention paths
+    must pass the mixed-axis DST rules (``lint_parallel_sources``) —
+    what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -92,6 +105,9 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
     if with_telemetry:
         findings += lint_chaos_sites(disable=disable)
         findings += lint_attribution_phases(disable=disable)
+    if with_shard:
+        findings += shard_self_check(disable=disable)
+        findings += lint_parallel_sources(disable=disable)
     return findings
 
 
@@ -221,6 +237,131 @@ def cost_self_check(disable=()):
             "COST003", "cost_self_check",
             "two runs of the cost pass over the same program disagree "
             "on %s — the budget gate would flap" % (diff,)))
+    return filter_findings(findings, disable)
+
+
+def shard_self_check(disable=()):
+    """mxshard sweep for ``--self-check``: the three canonical sharded
+    patterns (docs/analysis.md "Sharding propagation") must lint clean
+    under the mixed-axis DST rules, and the propagation must be
+    deterministic — the golden fixtures are miniatures (the full
+    budgeted geometries run in the budget gate / tests)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import shard_prop as sp
+    from .shard_fixtures import tp_matmul_program
+
+    findings = []
+    k = 4
+    mesh = sp.MeshSpec({"data": k})
+
+    # mini ZeRO-1: reduce-scatter / shard-update / all-gather round trip
+    def mini_zero1(w, m_sh, x):
+        loss, g = jax.value_and_grad(
+            lambda w: ((x @ w) ** 2).mean())(w)
+        g_sh = lax.psum_scatter(g.ravel(), "data", scatter_dimension=0,
+                                tiled=True) / k
+        idx = lax.axis_index("data")
+        n = w.size // k
+        w_sh = lax.dynamic_slice(w.ravel(), (idx * n,), (n,))
+        new_m = 0.9 * m_sh + g_sh
+        new_flat = lax.all_gather(w_sh - 0.1 * new_m, "data", tiled=True)
+        return lax.pmean(loss, "data"), new_flat.reshape(w.shape), new_m
+
+    w = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+    m = jax.ShapeDtypeStruct((16 * 8 // k,), jnp.float32)
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    closed = jax.make_jaxpr(mini_zero1, axis_env=[("data", k)])(w, m, x)
+    findings += sp.lint_sharded_step(
+        closed, mesh, data_axes=("data",), varying_invars=[2],
+        shard_dims={1: {0: ("data",)}}, param_outvars=[1],
+        param_names=["w"], subject="shard_self_check.zero1")
+
+    # mini tensor-parallel matmul: exactly one inferred psum over model
+    fn, args, specs = tp_matmul_program(batch=8, d_in=8, d_mid=16,
+                                        d_out=4)
+    tmesh = sp.MeshSpec({"data": 4, "model": 2})
+    tclosed = jax.make_jaxpr(fn)(*args)
+    reports = [sp.propagate(tclosed, tmesh, specs).as_dict()
+               for _ in range(2)]
+    if reports[0] != reports[1]:
+        findings.append(Finding(
+            "COST003", "shard_self_check",
+            "two runs of the shard propagation over the same program "
+            "disagree — the shard section of the budget gate would "
+            "flap"))
+    inferred = [ev for ev in reports[0]["schedule"]
+                if ev["inferred"] and "model" in ev["axes"]]
+    if not inferred:
+        findings.append(Finding(
+            "COST003", "shard_self_check",
+            "the tensor-parallel matmul fixture no longer infers its "
+            "partial-sum psum over the model axis — the propagation "
+            "lost the GSPMD contraction rule"))
+    for ev in reports[0]["reshards"]:
+        findings.append(Finding(
+            "DST010", "shard_self_check",
+            "the clean tensor-parallel fixture reports a forced "
+            "reshard (%r) — propagation regression" % (ev,)))
+
+    # mini ring: a scanned full-ring ppermute must satisfy DST009
+    def mini_ring(x):
+        perm = [(i, (i + 1) % k) for i in range(k)]
+        def hop(c, _):
+            return lax.ppermute(c, "seq", perm), ()
+        out, _ = lax.scan(hop, x, jnp.arange(k))
+        return out
+
+    rclosed = jax.make_jaxpr(mini_ring, axis_env=[("seq", k)])(
+        jax.ShapeDtypeStruct((8, 8), jnp.float32))
+    findings += sp.lint_ring_schedule(rclosed, "seq", k,
+                                      subject="shard_self_check.ring")
+    return filter_findings(findings, disable)
+
+
+def lint_parallel_sources(disable=()):
+    """The mixed-axis shard passes over the shipped sequence-parallel
+    attention paths (``parallel/ring_attention.py``): ring attention
+    forward+backward must prove its ppermute ring (DST009) and stay
+    clean under lint_sharded_step; the Ulysses all_to_all path must
+    lint clean too.  Miniature geometry — the pinned budget model
+    (``ring_attention_fwd``) covers the full one."""
+    import jax
+
+    from . import shard_prop as sp
+    from .shard_fixtures import ring_attention_program
+
+    k = 4
+    mesh = sp.MeshSpec({"sequence": k})
+    findings = []
+    for tag, with_grad in (("fwd", False), ("fwd+bwd", True)):
+        fn, args = ring_attention_program(
+            k=k, batch=1, t_global=32, heads=4, head_dim=8,
+            causal=True, with_grad=with_grad)
+        closed = jax.make_jaxpr(fn, axis_env=[("sequence", k)])(*args)
+        subject = "parallel/ring_attention.py:%s" % tag
+        findings += sp.lint_ring_schedule(closed, "sequence", k,
+                                          subject=subject)
+        findings += sp.lint_sharded_step(
+            closed, mesh, data_axes=("sequence",),
+            varying_invars=[0, 1, 2],
+            shard_dims={i: {1: ("sequence",)} for i in range(3)},
+            param_outvars=[], subject=subject)
+
+    from ..parallel.ring_attention import ulysses_attention
+    import jax.numpy as jnp
+    aval = jax.ShapeDtypeStruct((1, 8, 4, 8), jnp.float32)
+    uclosed = jax.make_jaxpr(
+        lambda q, kk, v: ulysses_attention(q, kk, v, "sequence"),
+        axis_env=[("sequence", k)])(aval, aval, aval)
+    findings += sp.lint_sharded_step(
+        uclosed, mesh, data_axes=("sequence",),
+        varying_invars=[0, 1, 2],
+        shard_dims={i: {1: ("sequence",)} for i in range(3)},
+        param_outvars=[],
+        subject="parallel/ring_attention.py:ulysses")
     return filter_findings(findings, disable)
 
 

@@ -15,7 +15,11 @@ Targets:
 
 Options:
   ``--json``              machine-readable output (schema in docs/analysis.md;
-                          ``schema_version`` 2 adds cost/dist sections)
+                          ``schema_version`` 2 added cost/dist sections,
+                          3 adds the ``--shard`` shard section)
+  ``--shard``             with --cost: mxshard sharding propagation —
+                          collective schedules (explicit + inferred),
+                          forced reshards, the ZeRO-1 memory proof
   ``--strict``            exit 1 on warnings (default for --self-check)
   ``--disable R1,R2``     mute rules globally
   ``--shapes "data=(1,3,224,224),label=(1,)"``
@@ -97,6 +101,12 @@ def main(argv=None):
     p.add_argument("--model", default="",
                    help="with --cost: comma-separated budget-model names "
                         "(see analysis/budget_models.py)")
+    p.add_argument("--shard", action="store_true",
+                   help="with --cost: run the mxshard sharding-"
+                        "propagation pass — collective schedules, "
+                        "reshards and the ZeRO-1 memory proof for the "
+                        "shard-aware budget models; adds the 'shard' "
+                        "section to --json (schema_version 3)")
     p.add_argument("--hbm-cap", type=int, default=0, dest="hbm_cap",
                    help="with --serving: flag buckets whose modeled peak "
                         "HBM exceeds this many bytes (SRV003)")
@@ -179,10 +189,11 @@ def _run_cost(args, disable):
     from . import render_json, render_text, exit_code, filter_findings
     from .budget_models import BUDGET_MODELS, build_model, check_budgets
     from .dist_lint import dist_summary
+    from .shard_prop import shard_summary
 
-    cost, findings = {}, []
+    cost, shards, findings = {}, {}, []
     if args.budget:
-        findings, reports = check_budgets(args.budget)
+        findings, reports, shards = check_budgets(args.budget)
         findings = filter_findings(findings, disable)
         cost = reports
         title = "mxcost --budget %s" % args.budget
@@ -191,21 +202,28 @@ def _run_cost(args, disable):
             or [m for m in sorted(BUDGET_MODELS)
                 if m != "resnet50_train_step"]
         for name in names:
-            report, dst = build_model(name)
+            report, dst, shard = build_model(name)
             cost[name] = report
+            if shard is not None:
+                shards[name] = shard
             findings += filter_findings(dst, disable)
         title = "mxcost %s" % ",".join(names)
     axis_sizes = {}
     for rep in cost.values():
         axis_sizes.update(rep.axis_sizes)
     if args.as_json:
-        print(render_json(findings, cost=cost,
-                          dist=dist_summary(findings,
-                                            axis_sizes=axis_sizes)))
+        print(render_json(
+            findings, cost=cost,
+            dist=dist_summary(findings, axis_sizes=axis_sizes),
+            shard=shard_summary(shards, findings)
+            if (args.shard and shards) else None))
     else:
         print(render_text(findings, title=title))
         for name, rep in sorted(cost.items()):
             print(rep.render(title="mxcost %s" % name))
+        if args.shard:
+            for name, rep in sorted(shards.items()):
+                print(rep.render(title="mxshard %s" % name))
     return exit_code(findings, strict=args.strict)
 
 

@@ -120,20 +120,154 @@ def resnet50_train_step():
     return report, findings
 
 
+def zero1_mlp_train_step():
+    """ZeRO-1 sharded weight update (arxiv 2004.13336) as a static
+    proof: the per-replica spelling reduce-scatters the flat gradient
+    over a declared 8-way data axis, updates a 1/8-sized momentum
+    shard, and all-gathers the new params.  The budget row pins its
+    peak HBM; the builder additionally proves the ZeRO-1 relation —
+    modeled peak must come in at least optimizer-state-bytes x
+    (1 - 1/8) below the replicated twin (the reduce-scatter spelling
+    saves more: the post-reduction gradient buffer is 1/8-sized too,
+    so the exact modeled drop is reported in the shard extras) — and
+    runs the mixed-axis DST lint, so a deleted all-gather fails the
+    gate with DST007 named."""
+    import jax
+
+    from . import shard_fixtures as sf
+    from . import shard_prop as sp
+    from .cost import analyze_jaxpr, unpriced_findings
+    from .findings import Finding
+
+    k = DECLARED_AXIS
+    mesh = sp.MeshSpec({"data": k})
+    step, args = sf.zero1_step_program(k)
+    closed = jax.make_jaxpr(step, axis_env=[("data", k)])(*args)
+    n_train = len(args[0])
+    # flat invars: train leaves, m_state, x, y — the batch is host-fed
+    host = [n_train + 1, n_train + 2]
+    report = analyze_jaxpr(closed, axis_sizes={"data": k},
+                           host_invars=host)
+    report.transfer_d2h_bytes = 4    # only the loss comes back
+
+    findings = sp.lint_sharded_step(
+        closed, mesh, data_axes=("data",),
+        varying_invars=host,
+        shard_dims={n_train: {0: ("data",)}},    # momentum shard
+        param_outvars=list(range(1, 1 + n_train)),
+        param_names=["w1", "b1", "w2", "b2", "w3", "b3"],
+        subject="zero1_mlp_train_step")
+    findings += unpriced_findings(report, subject="zero1_mlp_train_step")
+
+    # the memory proof against the replicated twin (same step, full
+    # optimizer state, plain pmean — what the trainer does today)
+    twin_step, twin_args = sf.zero1_step_program(
+        k, shard_state=False, all_gather=True)
+    twin_closed = jax.make_jaxpr(
+        twin_step, axis_env=[("data", k)])(*twin_args)
+    twin = analyze_jaxpr(twin_closed, axis_sizes={"data": k},
+                         host_invars=host)
+    state_bytes = sf.zero1_state_bytes(k)
+    floor = state_bytes * (k - 1) // k
+    drop = twin.peak_hbm_bytes - report.peak_hbm_bytes
+    if drop < floor:
+        findings.append(Finding(
+            "COST001", "zero1_mlp_train_step.peak_hbm_bytes",
+            "ZeRO-1 proof violated: modeled peak HBM is only %d bytes "
+            "below the replicated twin (%d vs %d) — the sharded update "
+            "must save at least optimizer-state-bytes x (1 - 1/%d) = "
+            "%d bytes (arxiv 2004.13336); the optimizer state is no "
+            "longer sharded" % (drop, report.peak_hbm_bytes,
+                                twin.peak_hbm_bytes, k, floor)))
+
+    shard = sp.collective_schedule(closed, mesh,
+                                   subject="zero1_mlp_train_step")
+    shard.extras.update({
+        "zero1_peak_hbm_bytes": int(report.peak_hbm_bytes),
+        "replicated_twin_peak_hbm_bytes": int(twin.peak_hbm_bytes),
+        "optimizer_state_bytes": int(state_bytes),
+        "zero1_floor_bytes": int(floor),
+        "modeled_hbm_drop_bytes": int(drop),
+        "modeled_zero1_hbm_drop_pct": round(
+            100.0 * drop / twin.peak_hbm_bytes, 2)
+        if twin.peak_hbm_bytes else 0.0,
+    })
+    return report, findings, shard
+
+
+def ring_attention_fwd():
+    """The shipped ring attention (forward + backward) on a declared
+    8-way ``sequence`` axis: proves the ppermute schedule — 6 rotating
+    buffers (K/V forward; K/V + dK/dV accumulators backward) x K hops x
+    chunk bytes — against the closed-form ring formula (DST009) and
+    pins the modeled collective bytes."""
+    import jax
+
+    from . import shard_fixtures as sf
+    from . import shard_prop as sp
+    from .cost import analyze_jaxpr, unpriced_findings
+    from .findings import Finding
+
+    k = 8
+    mesh = sp.MeshSpec({"sequence": k})
+    fn, args = sf.ring_attention_program(k=k)
+    closed = jax.make_jaxpr(fn, axis_env=[("sequence", k)])(*args)
+    report = analyze_jaxpr(closed, axis_sizes={"sequence": k},
+                           host_invars=[])
+    shard = sp.collective_schedule(closed, mesh,
+                                   subject="ring_attention_fwd")
+    findings = sp.lint_ring_schedule(closed, "sequence", k,
+                                     subject="ring_attention_fwd")
+    findings += sp.lint_sharded_step(
+        closed, mesh, data_axes=("sequence",),
+        varying_invars=[0, 1, 2],
+        shard_dims={i: {1: ("sequence",)} for i in range(3)},
+        param_outvars=[], subject="ring_attention_fwd")
+    findings += unpriced_findings(report, subject="ring_attention_fwd")
+
+    # closed-form cross-check: 6 rotating buffers x K hops x chunk
+    b, tl, h, d = args[0].shape
+    chunk = b * tl * h * d * 4
+    formula = 6 * k * chunk
+    if shard.collective_bytes != formula:
+        findings.append(Finding(
+            "DST009", "ring_attention_fwd",
+            "modeled ring-attention collective bytes %d do not match "
+            "the closed-form ring formula %d (= 6 buffers x %d hops x "
+            "%d-byte chunk): the schedule lost or duplicated a "
+            "rotation" % (shard.collective_bytes, formula, k, chunk)))
+    shard.extras.update({
+        "modeled_ring_attn_collective_bytes": int(shard.collective_bytes),
+        "ring_formula_bytes": int(formula),
+        "chunk_bytes": int(chunk),
+        "hops": int(k),
+    })
+    return report, findings, shard
+
+
 BUDGET_MODELS = {
     "mlp_train_step": mlp_train_step,
     "mlp_infer": mlp_infer,
     "convnet_infer": convnet_infer,
     "resnet50_train_step": resnet50_train_step,
+    "zero1_mlp_train_step": zero1_mlp_train_step,
+    "ring_attention_fwd": ring_attention_fwd,
 }
 
 
 def build_model(name):
-    """(CostReport, [Finding]) for one registered budget model."""
+    """(CostReport, [Finding], ShardReport-or-None) for one registered
+    budget model.  Only the shard-aware models (the ZeRO-1 step, ring
+    attention) produce a ShardReport; the pre-mxshard builders return
+    their original 2-tuple and are normalized here."""
     if name not in BUDGET_MODELS:
         raise KeyError("unknown budget model %r (have: %s)"
                        % (name, ", ".join(sorted(BUDGET_MODELS))))
-    return BUDGET_MODELS[name]()
+    out = BUDGET_MODELS[name]()
+    if len(out) == 2:
+        report, findings = out
+        return report, findings, None
+    return out
 
 
 def compute_budgets(models=None):
@@ -143,7 +277,7 @@ def compute_budgets(models=None):
     for name in sorted(models if models is not None
                        else [m for m in BUDGET_MODELS
                              if m != "resnet50_train_step"]):
-        report, _ = build_model(name)
+        report, _, _ = build_model(name)
         d = report.as_dict()
         out[name] = {m: int(d[m]) for m in BUDGET_METRICS}
     return out
@@ -151,8 +285,9 @@ def compute_budgets(models=None):
 
 def check_budgets(budget_path, tolerance_pct=None):
     """Gate the budget file: rebuild every budgeted model, compare each
-    pinned metric within tolerance, and fold in the models' own DST
-    findings.  Returns (findings, {model: CostReport})."""
+    pinned metric within tolerance, and fold in the models' own DST /
+    shard findings.  Returns (findings, {model: CostReport},
+    {model: ShardReport})."""
     import json
 
     from .findings import Finding
@@ -161,7 +296,7 @@ def check_budgets(budget_path, tolerance_pct=None):
         budget = json.load(f)
     tol = float(tolerance_pct if tolerance_pct is not None
                 else budget.get("tolerance_pct", 10)) / 100.0
-    findings, reports = [], {}
+    findings, reports, shards = [], {}, {}
     budgeted = budget.get("models", {})
     for name in sorted(budgeted):
         row = budgeted[name]
@@ -173,7 +308,7 @@ def check_budgets(budget_path, tolerance_pct=None):
                 "the row or restore the model" % (name,)))
             continue
         try:
-            report, dst = build_model(name)
+            report, dst, shard = build_model(name)
         except Exception as e:
             findings.append(Finding(
                 "COST001", name,
@@ -181,6 +316,8 @@ def check_budgets(budget_path, tolerance_pct=None):
                 % (name, type(e).__name__, str(e)[:200])))
             continue
         reports[name] = report
+        if shard is not None:
+            shards[name] = shard
         findings += dst
         d = report.as_dict()
         for metric in BUDGET_METRICS:
@@ -214,4 +351,4 @@ def check_budgets(budget_path, tolerance_pct=None):
             "COST002", name,
             "budget model %r has no STATIC_BUDGETS.json row — it is "
             "not gated; add it via tools/update_budgets.py" % (name,)))
-    return findings, reports
+    return findings, reports, shards

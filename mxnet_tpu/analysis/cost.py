@@ -34,7 +34,8 @@ import numpy as _np
 
 __all__ = ["CostReport", "TapeOp", "build_tape", "analyze_jaxpr",
            "analyze_fn", "analyze_symbol", "XLA_FLOP_RTOL",
-           "collective_bytes", "TRANSCENDENTALS"]
+           "collective_bytes", "ring_bytes_per_axis",
+           "unpriced_findings", "TRANSCENDENTALS"]
 
 # documented cross-validation tolerance: |modeled - xla| / xla for the
 # golden single-primitive programs of tests/test_analysis.py on the CPU
@@ -60,16 +61,29 @@ _MOVEMENT = frozenset({
     "device_put", "real", "imag", "sharding_constraint",
 })
 
-# collective primitives and their per-device wire-bytes model over an
-# axis of size K (ring algorithms; docs/analysis.md "Cost model"):
+# collective primitives and their per-device wire-bytes model over a
+# group of size K (ring algorithms; docs/analysis.md "Cost model").  A
+# grouped reduction (``psum`` over several axes at once) is priced as ONE
+# ring over the combined group (K = product of the axis sizes) — XLA
+# lowers a multi-axis reduction to a single replica group, not a
+# hierarchy — and the total is attributed per axis proportionally to
+# each axis's (size − 1) share (the marginal ring length it adds):
 #   psum (all-reduce)     2·(K-1)/K · payload
-#   all_gather            (K-1)/K · output
+#   all_gather            (K-1)/K · output   (output = K · input)
 #   reduce_scatter        (K-1)/K · input
 #   all_to_all            (K-1)/K · payload
-#   ppermute              payload
+#   ppermute              payload  (one hop; a ring is K scanned hops)
 _COLLECTIVES = frozenset({
     "psum", "pmax", "pmin", "all_gather", "reduce_scatter", "all_to_all",
     "ppermute", "pbroadcast",
+})
+
+# primitives that carry a mesh-axis name but move nothing over the wire
+# (axis arithmetic / replication-type casts) — they must NOT be flagged
+# as unpriced collectives
+_AXIS_LOCAL = frozenset({
+    "axis_index", "pvary", "psum_invariant", "pbroadcast_invariant",
+    "sharding_constraint",
 })
 
 
@@ -93,16 +107,52 @@ def _aval_bytes(aval):
     return _numel(shape) * itemsize
 
 
-def collective_bytes(prim, payload_bytes, axis_size):
-    """Per-device wire bytes for one collective over an axis of size K."""
+def collective_bytes(prim, payload_bytes, axis_size, out_bytes=None):
+    """Per-device wire bytes for one collective over a group of size K.
+
+    ``payload_bytes`` is the operand (input) size; ``out_bytes`` the
+    result size where the formula needs it (``all_gather`` moves the
+    *output* — defaults to ``K · payload`` for it, tiled semantics).
+    """
     k = max(int(axis_size), 1)
     if k == 1:
         return 0
     if prim in ("psum", "pmax", "pmin"):
         return int(2 * (k - 1) * payload_bytes // k)
-    if prim in ("all_gather", "reduce_scatter", "all_to_all", "pbroadcast"):
+    if prim == "all_gather":
+        out = payload_bytes * k if out_bytes is None else out_bytes
+        return int((k - 1) * out // k)
+    if prim in ("reduce_scatter", "all_to_all", "pbroadcast"):
         return int((k - 1) * payload_bytes // k)
     return int(payload_bytes)
+
+
+def ring_bytes_per_axis(prim, in_bytes, out_bytes, axis_sizes):
+    """{axis: wire bytes} for one collective over the (possibly grouped)
+    axes in ``axis_sizes`` — one ring over the combined group
+    K = Π sizes, attributed per axis proportionally to (size − 1), the
+    marginal ring length each axis contributes (remainder bytes go to
+    the first axis in sorted order, keeping the split deterministic and
+    the per-axis sum exactly equal to the group total)."""
+    sizes = {ax: max(int(s), 1) for ax, s in axis_sizes.items()}
+    group = 1
+    for s in sizes.values():
+        group *= s
+    total = collective_bytes(prim, in_bytes, group, out_bytes=out_bytes)
+    if total == 0 or not sizes:
+        return {ax: 0 for ax in sizes}
+    weights = {ax: s - 1 for ax, s in sizes.items()}
+    wsum = sum(weights.values())
+    if wsum == 0:
+        return {ax: 0 for ax in sizes}
+    out = {}
+    assigned = 0
+    for ax in sorted(sizes)[1:]:
+        out[ax] = total * weights[ax] // wsum
+        assigned += out[ax]
+    first = sorted(sizes)[0]
+    out[first] = total - assigned
+    return out
 
 
 def _axis_names(params):
@@ -207,7 +257,7 @@ class TapeOp:
 
 class Tape:
     """Flat program tape + var table, shared by the cost totals, the
-    liveness walk and the DST variance pass."""
+    liveness walk, the DST variance pass and the mxshard propagation."""
 
     def __init__(self):
         self.ops = []            # [TapeOp]
@@ -215,13 +265,17 @@ class Tape:
         self.invar_ids = []      # program inputs, in order
         self.outvar_ids = []     # program outputs, in order
         self.const_ids = []      # closure constants
+        self.literal_ids = set()  # inline literals (e.g. the 1 in psum(1))
+        self.unpriced = []       # [(prim, axis, reason)] — COST004 feed
         self.unbounded_loops = False
         self._next = 0
 
-    def fresh(self, aval):
+    def fresh(self, aval, literal=False):
         i = self._next
         self._next += 1
         self.avals[i] = aval
+        if literal:
+            self.literal_ids.add(i)
         return i
 
 
@@ -250,7 +304,7 @@ def build_tape(closed_jaxpr, axis_sizes=None):
 
     def read(env, atom):
         if isinstance(atom, jax.core.Literal):
-            i = tape.fresh(atom.aval)
+            i = tape.fresh(atom.aval, literal=True)
             return i
         return env[atom]
 
@@ -277,11 +331,27 @@ def build_tape(closed_jaxpr, axis_sizes=None):
             br = sum(_aval_bytes(a.aval) for a in eqn.invars)
             bw = sum(_aval_bytes(v.aval) for v in eqn.outvars)
             coll = {}
+            eqn_axes = _axis_names(eqn.params)
             if prim in _COLLECTIVES:
                 payload = sum(_aval_bytes(a.aval) for a in eqn.invars)
-                for ax in _axis_names(eqn.params):
-                    coll[ax] = collective_bytes(
-                        prim, payload, axis_sizes.get(ax, 1))
+                out_payload = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+                declared = {ax: axis_sizes[ax] for ax in eqn_axes
+                            if ax in axis_sizes}
+                for ax in eqn_axes:
+                    if ax not in axis_sizes:
+                        # an undeclared axis defaults to size 1: the
+                        # collective would silently price at ZERO bytes —
+                        # name it so COST004 can surface the hole
+                        tape.unpriced.append(
+                            (prim, ax, "axis size undeclared"))
+                coll = ring_bytes_per_axis(prim, payload, out_payload,
+                                           declared)
+            elif eqn_axes and prim not in _AXIS_LOCAL:
+                # a primitive that names mesh axes but has no wire-bytes
+                # model: whatever it moves contributes zero to the
+                # collective totals — flag instead of staying silent
+                for ax in eqn_axes:
+                    tape.unpriced.append((prim, ax, "no cost model"))
             tape.ops.append(TapeOp(
                 prim, scale, in_ids, out_ids, flops * scale, trans * scale,
                 br * scale, bw * scale,
@@ -416,7 +486,7 @@ class CostReport:
                  bytes_written, transfer_h2d_bytes, transfer_d2h_bytes,
                  collective_bytes_per_axis, peak_hbm_bytes, input_bytes,
                  output_bytes, const_bytes, n_eqns, axis_sizes,
-                 unbounded_loops=False):
+                 unbounded_loops=False, unpriced_collectives=()):
         self.per_primitive = per_primitive
         self.flops = flops
         self.transcendentals = transcendentals
@@ -432,6 +502,9 @@ class CostReport:
         self.n_eqns = n_eqns
         self.axis_sizes = axis_sizes
         self.unbounded_loops = unbounded_loops
+        # [(prim, axis, reason)]: collectives whose modeled wire bytes
+        # are silently zero (unknown primitive / undeclared axis size)
+        self.unpriced_collectives = list(unpriced_collectives)
 
     @property
     def transfer_bytes(self):
@@ -462,6 +535,9 @@ class CostReport:
             "axis_sizes": {k: int(v)
                            for k, v in sorted(self.axis_sizes.items())},
             "unbounded_loops": bool(self.unbounded_loops),
+            "unpriced_collectives": [
+                {"prim": p, "axis": a, "reason": r}
+                for p, a, r in sorted(set(self.unpriced_collectives))],
             "per_primitive": {
                 prim: {k: int(v) for k, v in sorted(row.items())}
                 for prim, row in sorted(self.per_primitive.items())},
@@ -531,7 +607,8 @@ def analyze_tape(tape, donated_ids=(), host_invar_ids=None,
         peak_hbm_bytes=_peak_hbm(tape, donated_ids),
         input_bytes=in_bytes, output_bytes=out_bytes,
         const_bytes=const_bytes, n_eqns=len(tape.ops),
-        axis_sizes=axis_sizes, unbounded_loops=tape.unbounded_loops)
+        axis_sizes=axis_sizes, unbounded_loops=tape.unbounded_loops,
+        unpriced_collectives=tape.unpriced)
 
 
 def analyze_jaxpr(closed_jaxpr, axis_sizes=None, donated_invars=(),
@@ -642,3 +719,28 @@ def analyze_symbol(symbol, shapes, type_dict=None, train=False,
                          fetched_outvars=range(
                              len(closed.jaxpr.outvars)
                              - len(aux)))
+
+
+def unpriced_findings(report_or_tape, subject="<program>", disable=()):
+    """COST004 findings for every collective the model could not price.
+
+    A ``ppermute`` traced without its axis declared (or a collective
+    primitive this module has no formula for) contributes ZERO modeled
+    wire bytes — a budget gate built on that number would pass a PR that
+    floods the interconnect.  The fallback therefore *names* the hole.
+    """
+    from .findings import Finding, filter_findings
+
+    rows = getattr(report_or_tape, "unpriced_collectives", None)
+    if rows is None:
+        rows = getattr(report_or_tape, "unpriced", [])
+    findings = []
+    for prim, axis, reason in sorted(set(tuple(r) for r in rows)):
+        findings.append(Finding(
+            "COST004", subject,
+            "collective %r over axis %r contributes zero modeled wire "
+            "bytes (%s): declare the axis size (axis_env / mesh) or "
+            "teach analysis/cost.py its ring formula — an unpriced "
+            "collective makes every collective-byte budget a lie"
+            % (prim, axis, reason)))
+    return filter_findings(findings, disable)

@@ -134,6 +134,26 @@ RULES = {
     "DST005": (WARNING, "step program closes over a baked Python "
                         "constant: iteration-dependent values captured "
                         "at trace time diverge across hosts"),
+    # mixed-axis shard rules (mxnet_tpu/analysis/shard_prop.py)
+    "DST006": (ERROR, "gradient reduced over the wrong mesh axes: a "
+                      "non-data axis it does not vary over, or an axis "
+                      "the destination parameter is sharded over "
+                      "(summing unrelated shard pieces)"),
+    "DST007": (ERROR, "reduce-scatter not paired with the covering "
+                      "all-gather before next-step use: the new "
+                      "parameter is still a per-rank shard"),
+    "DST008": (ERROR, "duplicate/overlapping sub-axis reduction: a "
+                      "collective reduces over axes already covered on "
+                      "the chain (or with nothing to reduce) — grads "
+                      "come out scaled by the axis size"),
+    "DST009": (ERROR, "ring-collective schedule broken: a scanned "
+                      "ppermute's perm is not a single full ring or "
+                      "its hop count differs from the axis size, so "
+                      "the modeled bytes do not match the ring formula"),
+    "DST010": (ERROR, "activation resharding forced inside the step "
+                      "body: operand shardings disagree, so GSPMD "
+                      "inserts a hidden all_to_all/all_gather every "
+                      "step that no budget accounts for"),
     # cost pass / budget gate (mxnet_tpu/analysis/cost.py, __main__)
     "COST001": (ERROR, "modeled cost metric exceeds its STATIC_BUDGETS "
                        "entry beyond tolerance (or a budgeted model no "
@@ -144,6 +164,11 @@ RULES = {
                          "tools/update_budgets.py"),
     "COST003": (ERROR, "cost pass is nondeterministic: two analyses of "
                        "the same program produced different reports"),
+    "COST004": (WARNING, "collective contributes zero modeled wire "
+                         "bytes: unknown collective primitive or an "
+                         "axis whose size was never declared — the "
+                         "collective-byte budget silently understates "
+                         "traffic"),
 }
 
 

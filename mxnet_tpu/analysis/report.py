@@ -1,13 +1,16 @@
 """Reporters: render Findings as text or JSON.
 
 The JSON schema is stable tooling surface (documented in
-docs/analysis.md): ``{"version": 1, "schema_version": 2, "findings":
+docs/analysis.md): ``{"version": 1, "schema_version": 3, "findings":
 [{"rule", "severity", "subject", "message"}], "counts": {severity: n}}``
 plus, when the cost/dist passes ran, a ``"cost"`` section ({target:
-CostReport.as_dict()}) and a ``"dist"`` section
-(:func:`~mxnet_tpu.analysis.dist_lint.dist_summary`).  ``version`` is
-the original findings-list schema (kept for pre-cost consumers);
-``schema_version`` is bumped when any section's shape changes.
+CostReport.as_dict()}), a ``"dist"`` section
+(:func:`~mxnet_tpu.analysis.dist_lint.dist_summary`) and — schema 3 —
+a ``"shard"`` section (:func:`~mxnet_tpu.analysis.shard_prop.
+shard_summary`: per-model collective schedules, reshards and the ZeRO
+extras).  ``version`` is the original findings-list schema (kept for
+pre-cost consumers); ``schema_version`` is bumped when any section's
+shape changes — consumers (``tools/parse_log.py``) must refuse newer.
 """
 from __future__ import annotations
 
@@ -19,8 +22,10 @@ from .findings import ERROR, WARNING, severity_rank
 __all__ = ["render_text", "render_json", "worst_severity", "exit_code",
            "SCHEMA_VERSION"]
 
-# bumped in PR 4: cost/dist sections + schema_version field itself
-SCHEMA_VERSION = 2
+# bumped in PR 4 (cost/dist sections + the field itself); 3 adds the
+# shard section (mxshard collective schedules) and the
+# unpriced_collectives row inside each cost report
+SCHEMA_VERSION = 3
 
 
 def _sorted(findings):
@@ -40,9 +45,10 @@ def render_text(findings, title="mxlint"):
     return "\n".join(lines)
 
 
-def render_json(findings, cost=None, dist=None):
+def render_json(findings, cost=None, dist=None, shard=None):
     """``cost``: {target_name: CostReport-or-dict}; ``dist``: the
-    dist_summary dict.  Both sections appear only when provided."""
+    dist_summary dict; ``shard``: the shard_summary dict.  Sections
+    appear only when provided."""
     counts = Counter(f.severity for f in findings)
     payload = {
         "version": 1,
@@ -56,6 +62,8 @@ def render_json(findings, cost=None, dist=None):
             for name, rep in sorted(cost.items())}
     if dist is not None:
         payload["dist"] = dist
+    if shard is not None:
+        payload["shard"] = shard
     return json.dumps(payload, indent=2)
 
 

@@ -1,0 +1,192 @@
+"""Canonical sharded-step programs for the mxshard passes.
+
+Three patterns cover the collective grammar of every planned parallelism
+tier (ROADMAP items 1-2), each as a hand-spelled *per-replica* program
+the analysis tier can trace hardware-free:
+
+- **ZeRO-1 update** (arxiv 2004.13336): full forward/backward per
+  replica, gradients reduce-scattered over the data axis, an optimizer
+  whose state is 1/K-sized per rank, updated params all-gathered back.
+  The memory proof: modeled peak HBM drops by optimizer-state-bytes x
+  (1 - 1/K) vs the replicated twin — gated in STATIC_BUDGETS.json.
+- **tensor-parallel matmul** (GSPMD, arxiv 1810.09868): a row-sharded
+  weight contraction whose output is a partial-sum over the ``model``
+  axis — the global-view propagation must *infer* the completing psum.
+- **ring attention** (``parallel/ring_attention.py``): K/V chunks rotate
+  over the ``sequence`` axis via scanned ``ppermute``; the schedule must
+  match the ring formula (K hops x chunk bytes) — DST009's subject.
+
+The module-level ``ZERO1_*`` flags are **mutation seams** for the
+gate-kill tests (tests/test_shard_prop.py): flipping one from a
+subprocess re-creates the classic bug (all-gather deleted -> DST007;
+optimizer state kept replicated -> the ZeRO budget row blows COST001)
+and the STATIC_BUDGETS gate must exit 2 naming the rule.  Production
+code never touches them.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["ZERO1_GEOMETRY", "zero1_step_program", "zero1_state_bytes",
+           "tp_matmul_program", "ring_attention_program",
+           "ZERO1_ALL_GATHER", "ZERO1_SHARD_STATE"]
+
+# mutation seams (see module docstring) — flipped only by tests
+ZERO1_ALL_GATHER = True      # False: the "forgot the all-gather" bug
+ZERO1_SHARD_STATE = True     # False: replicated (full) optimizer state
+
+# pinned trace geometry for the budgeted ZeRO model: a 3-layer MLP
+# whose optimizer state (momentum) is large relative to activations, so
+# the modeled 7/8 state saving is far outside the budget tolerance
+ZERO1_GEOMETRY = {
+    "batch": 64, "in_dim": 16, "hidden": (512, 128), "classes": 10,
+    "momentum": 0.9, "lr": 0.1,
+}
+
+
+def _zero1_shapes(k):
+    g = ZERO1_GEOMETRY
+    dims = [(g["in_dim"], g["hidden"][0]), (g["hidden"][0],),
+            (g["hidden"][0], g["hidden"][1]), (g["hidden"][1],),
+            (g["hidden"][1], g["classes"]), (g["classes"],)]
+    total = sum(int(_np.prod(s)) for s in dims)
+    padded = -(-total // k) * k     # flat param vector, padded to K
+    return dims, total, padded
+
+
+def zero1_state_bytes(k=None):
+    """float32 bytes of the FULL (replicated-twin) optimizer state —
+    the quantity the ZeRO-1 proof says peak HBM drops by x (1 - 1/K)."""
+    dims, total, padded = _zero1_shapes(k or 8)
+    return padded * 4
+
+
+def zero1_step_program(k, shard_state=None, all_gather=None):
+    """(step_fn, example_args) — the per-replica ZeRO-1 spelling.
+
+    ``step_fn(train_vals, m_state, x, y)`` returns ``(loss, new_vals,
+    new_m)``.  With ``shard_state`` (default: the module seam) the
+    momentum input/output is the rank's 1/K flat shard and grads are
+    reduce-scattered; otherwise it is the replicated twin (full state,
+    plain pmean) used as the HBM baseline.  ``all_gather=False`` spells
+    the broken step that skips the covering gather (DST007's subject).
+    Everything is shapes-only: callers trace with
+    ``jax.make_jaxpr(axis_env=[("data", k)])``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    shard_state = ZERO1_SHARD_STATE if shard_state is None else shard_state
+    all_gather = ZERO1_ALL_GATHER if all_gather is None else all_gather
+    g = ZERO1_GEOMETRY
+    dims, total, padded = _zero1_shapes(k)
+    shard = padded // k
+    mu, lr = g["momentum"], g["lr"]
+
+    def loss_fn(tv, x, y):
+        w1, b1, w2, b2, w3, b3 = tv
+        h = jax.nn.relu(x @ w1 + b1)
+        h = jax.nn.relu(h @ w2 + b2)
+        logits = h @ w3 + b3
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+        return (lse - picked).mean()
+
+    def unflatten(flat):
+        out, off = [], 0
+        for s in dims:
+            n = int(_np.prod(s))
+            out.append(flat[off:off + n].reshape(s))
+            off += n
+        return tuple(out)
+
+    def step(train_vals, m_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(train_vals, x, y)
+        flat_g = jnp.concatenate(
+            [gr.ravel() for gr in grads]
+            + [jnp.zeros((padded - total,), jnp.float32)])
+        flat_w = jnp.concatenate(
+            [v.ravel() for v in train_vals]
+            + [jnp.zeros((padded - total,), jnp.float32)])
+        if shard_state:
+            # ZeRO-1: each rank owns 1/K of the flat (param, state)
+            # space — reduce-scatter lands exactly the owned grad shard
+            g_sh = lax.psum_scatter(flat_g, "data", scatter_dimension=0,
+                                    tiled=True) / k
+            idx = lax.axis_index("data")
+            w_sh = lax.dynamic_slice(flat_w, (idx * shard,), (shard,))
+            new_m = mu * m_state + g_sh
+            new_w_sh = w_sh - lr * new_m
+            if all_gather:
+                new_flat = lax.all_gather(new_w_sh, "data", tiled=True)
+            else:
+                # the classic broken spelling: the rank's shard tiled
+                # out as if it were the gathered whole
+                new_flat = jnp.concatenate([new_w_sh] * k)
+        else:
+            # replicated twin: full-state baseline for the HBM proof
+            g_mean = lax.pmean(flat_g, "data")
+            new_m = mu * m_state + g_mean
+            new_flat = flat_w - lr * new_m
+        new_vals = unflatten(new_flat[:total])
+        return lax.pmean(loss, "data"), new_vals, new_m
+
+    state_n = shard if shard_state else padded
+    args = (
+        tuple(jax.ShapeDtypeStruct(s, jnp.float32) for s in dims),
+        jax.ShapeDtypeStruct((state_n,), jnp.float32),
+        jax.ShapeDtypeStruct((g["batch"], g["in_dim"]), jnp.float32),
+        jax.ShapeDtypeStruct((g["batch"],), jnp.int32),
+    )
+    return step, args
+
+
+def tp_matmul_program(batch=32, d_in=64, d_mid=128, d_out=32):
+    """(fn, args, in_specs) — the tensor-parallel matmul pattern in the
+    GLOBAL view: ``x @ W1`` with W1 column-sharded over ``model`` (free
+    dim: no collective), then ``h @ W2`` with W2 row-sharded (contracted
+    dim: the propagation must infer a partial-sum psum over ``model``)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    def fn(x, w1, w2):
+        h = jax.nn.relu(x @ w1)
+        return h @ w2
+
+    args = (jax.ShapeDtypeStruct((batch, d_in), jnp.float32),
+            jax.ShapeDtypeStruct((d_in, d_mid), jnp.float32),
+            jax.ShapeDtypeStruct((d_mid, d_out), jnp.float32))
+    in_specs = (PartitionSpec("data", None),      # batch over data
+                PartitionSpec(None, "model"),     # W1 column-sharded
+                PartitionSpec("model", None))     # W2 row-sharded
+    return fn, args, in_specs
+
+
+def ring_attention_program(k=8, batch=2, t_global=512, heads=4,
+                           head_dim=32, causal=True, with_grad=True):
+    """(fn, args) — the shipped ring attention's per-replica program at
+    a pinned geometry: local (B, T/K, H, D) chunks over a declared
+    ``sequence`` axis.  ``with_grad`` traces forward + backward (the
+    dk/dv accumulators double the ring traffic: 6 ppermutes per hop
+    total).  Trace with ``axis_env=[("sequence", k)]``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.ring_attention import ring_attention
+
+    t_local = t_global // k
+    aval = jax.ShapeDtypeStruct((batch, t_local, heads, head_dim),
+                                jnp.float32)
+
+    if with_grad:
+        def fn(q, kk, v):
+            return jax.grad(
+                lambda a, b, c: ring_attention(
+                    a, b, c, "sequence", causal=causal).sum(),
+                argnums=(0, 1, 2))(q, kk, v)
+    else:
+        def fn(q, kk, v):
+            return ring_attention(q, kk, v, "sequence", causal=causal)
+    return fn, (aval, aval, aval)

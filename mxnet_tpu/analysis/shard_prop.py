@@ -1,0 +1,939 @@
+"""mxshard: whole-program static sharding propagation over the mxcost tape.
+
+GSPMD (PAPERS.md arxiv 1810.09868) partitions a whole XLA program from a
+handful of sharding annotations: specs propagate through every op,
+resharding is inserted where operands disagree, and contracted sharded
+dims become partial-sums that an all-reduce later completes.  With the
+live TPU signal dead, this module does the same propagation *statically*
+— a mesh is a name→size declaration (``MeshSpec``), no devices — so the
+repo can prove a ZeRO/tensor-parallel/sequence-parallel step's collective
+schedule and memory story on the 1-core CI host.
+
+Two complementary views over the same inlined tape (:mod:`.cost`):
+
+- **global view** (:func:`propagate`): the program traced WITHOUT an
+  axis env; inputs carry factored sharding specs (``ShardSpec``, built
+  from ``PartitionSpec``); specs propagate GSPMD-style through every
+  eqn.  Where operands disagree a **forced reshard** is recorded (the
+  hidden ``all_to_all`` class — DST010); where a contracted sharded dim
+  creates a partial-sum an **inferred psum** is scheduled (the
+  tensor-parallel matmul reduction GSPMD would insert).  The result is a
+  modeled collective schedule (op, axes, bytes, ring cost) for a program
+  that never spelled a collective.
+
+- **per-replica view** (:func:`collective_schedule`,
+  :func:`lint_sharded_step`): the program traced WITH ``axis_env`` — the
+  ``shard_map`` / ``_build_replica_step`` spelling where collectives are
+  explicit and shapes are local shards.  The schedule prices every
+  explicit collective with the multi-axis ring formulas
+  (:func:`.cost.ring_bytes_per_axis`); a per-axis variance propagation
+  distinguishing *content* variance (batch-derived: a different value
+  per rank), *layout* variance (a different **piece** per rank: sharded
+  params, scattered optimizer shards) and pending *partial sums* proves
+  the mixed-axis step rules DST006–DST010 (docs/analysis.md).
+
+Both views walk the scan body once (costs are scaled by trip count;
+variance reaches a fixpoint for every shipped pattern in one pass) and
+treat ``psum`` of a literal (the ``lax.psum(1, axis)`` axis-size idiom)
+as axis arithmetic, not a collective.
+"""
+from __future__ import annotations
+
+from .cost import (_AXIS_LOCAL, _COLLECTIVES, _aval_bytes, _axis_names,
+                   build_tape, ring_bytes_per_axis, unpriced_findings)
+from .findings import Finding, filter_findings
+
+__all__ = ["MeshSpec", "ShardSpec", "CollectiveEvent", "ReshardEvent",
+           "ShardReport", "propagate", "collective_schedule",
+           "lint_sharded_step", "lint_ring_schedule",
+           "lint_global_sharding", "shard_summary"]
+
+# collectives that reduce (sum/min/max) across the named axes
+_REDUCING = frozenset({"psum", "pmax", "pmin"})
+
+
+class MeshSpec:
+    """A mesh as pure declaration: ordered ``{axis_name: size}``.
+
+    No devices are ever constructed — the whole point is analyzing an
+    8-way (or 2×4×… ) mesh from a 1-core host.  Accepts a dict, a list
+    of pairs, or a live ``jax.sharding.Mesh`` (sizes are read off it).
+    """
+
+    def __init__(self, axes):
+        if hasattr(axes, "axis_names") and hasattr(axes, "devices"):
+            axes = dict(zip(axes.axis_names, axes.devices.shape))
+        if isinstance(axes, dict):
+            items = list(axes.items())
+        else:
+            items = [(a, s) for a, s in axes]
+        self.axes = {str(a): int(s) for a, s in items}
+
+    def size(self, axis):
+        return self.axes.get(axis, 1)
+
+    def group_size(self, axes):
+        n = 1
+        for a in axes:
+            n *= self.size(a)
+        return n
+
+    def __contains__(self, axis):
+        return axis in self.axes
+
+    def names(self):
+        return tuple(self.axes)
+
+    def as_dict(self):
+        return {a: int(s) for a, s in self.axes.items()}
+
+    def __repr__(self):
+        return "MeshSpec(%r)" % (self.axes,)
+
+
+class ShardSpec:
+    """Factored sharding of one value: per-dim mesh axes + partial axes.
+
+    ``dims[d]`` is the tuple of mesh axes dim ``d`` is split over
+    (GSPMD's tiled assignment); ``partial`` is the set of axes over
+    which the value is a pending partial-sum (each member of the axis
+    holds an addend; a ``psum`` over it completes the value).
+    """
+    __slots__ = ("dims", "partial")
+
+    def __init__(self, dims, partial=()):
+        self.dims = tuple(tuple(d) for d in dims)
+        self.partial = frozenset(partial)
+
+    @classmethod
+    def replicated(cls, rank):
+        return cls(((),) * rank)
+
+    @classmethod
+    def from_partition_spec(cls, spec, rank):
+        """From a ``jax.sharding.PartitionSpec`` (or tuple / None)."""
+        if spec is None:
+            return cls.replicated(rank)
+        if isinstance(spec, ShardSpec):
+            return spec
+        entries = list(tuple(spec))
+        dims = []
+        for d in range(rank):
+            e = entries[d] if d < len(entries) else None
+            if e is None:
+                dims.append(())
+            elif isinstance(e, str):
+                dims.append((e,))
+            else:
+                dims.append(tuple(e))
+        return cls(dims)
+
+    def axes(self):
+        """Every mesh axis this value is tiled over."""
+        return frozenset(a for d in self.dims for a in d)
+
+    def shard_factor(self, mesh):
+        n = 1
+        for d in self.dims:
+            for a in d:
+                n *= mesh.size(a)
+        return n
+
+    def local_bytes(self, aval, mesh):
+        """Bytes of one device's tile of a global ``aval``."""
+        return _aval_bytes(aval) // max(self.shard_factor(mesh), 1)
+
+    def with_rank(self, rank):
+        if len(self.dims) == rank:
+            return self
+        dims = (self.dims + ((),) * rank)[:rank]
+        return ShardSpec(dims, self.partial)
+
+    def as_tuple(self):
+        return (self.dims, tuple(sorted(self.partial)))
+
+    def as_dict(self):
+        return {"dims": [list(d) for d in self.dims],
+                "partial": sorted(self.partial)}
+
+    def __eq__(self, other):
+        return (isinstance(other, ShardSpec) and self.dims == other.dims
+                and self.partial == other.partial)
+
+    def __hash__(self):
+        return hash(self.as_tuple())
+
+    def __repr__(self):
+        return "ShardSpec(%r%s)" % (
+            self.dims, ", partial=%r" % sorted(self.partial)
+            if self.partial else "")
+
+
+class CollectiveEvent:
+    """One modeled collective: explicit (from the tape) or inferred
+    (GSPMD would insert it)."""
+    __slots__ = ("index", "prim", "axes", "payload_bytes", "wire_bytes",
+                 "per_axis", "scale", "inferred", "note")
+
+    def __init__(self, index, prim, axes, payload_bytes, per_axis,
+                 scale=1, inferred=False, note=""):
+        self.index = int(index)
+        self.prim = prim
+        self.axes = tuple(axes)
+        self.payload_bytes = int(payload_bytes)
+        self.per_axis = {a: int(b) for a, b in per_axis.items()}
+        self.wire_bytes = int(sum(self.per_axis.values()))
+        self.scale = int(scale)
+        self.inferred = bool(inferred)
+        self.note = note
+
+    def as_dict(self):
+        return {"index": self.index, "prim": self.prim,
+                "axes": list(self.axes),
+                "payload_bytes": self.payload_bytes,
+                "wire_bytes": self.wire_bytes,
+                "per_axis": {a: b for a, b in sorted(self.per_axis.items())},
+                "scale": self.scale, "inferred": self.inferred,
+                "note": self.note}
+
+
+class ReshardEvent:
+    """A forced layout change: the operand's sharding disagreed with
+    what the consuming eqn needed — GSPMD would insert a hidden
+    collective here (DST010)."""
+    __slots__ = ("index", "prim", "kind", "axes", "wire_bytes", "note")
+
+    def __init__(self, index, prim, kind, axes, wire_bytes, note=""):
+        self.index = int(index)
+        self.prim = prim
+        self.kind = kind          # "all_to_all" | "all_gather"
+        self.axes = tuple(axes)
+        self.wire_bytes = int(wire_bytes)
+        self.note = note
+
+    def as_dict(self):
+        return {"index": self.index, "prim": self.prim, "kind": self.kind,
+                "axes": list(self.axes), "wire_bytes": self.wire_bytes,
+                "note": self.note}
+
+
+class ShardReport:
+    """Deterministic shard-propagation summary of one program: the
+    modeled collective schedule (explicit + inferred), forced reshards,
+    per-axis wire bytes and the input/output factored specs.  The
+    ``extras`` dict carries model-specific derived numbers (e.g. the
+    ZeRO-1 HBM proof) into the ``--json`` ``shard`` section."""
+
+    def __init__(self, mesh, in_specs=(), out_specs=(), schedule=(),
+                 reshards=(), unpriced=(), extras=None):
+        self.mesh = mesh
+        self.in_specs = list(in_specs)
+        self.out_specs = list(out_specs)
+        self.schedule = list(schedule)
+        self.reshards = list(reshards)
+        self.unpriced = list(unpriced)
+        self.extras = dict(extras or {})
+
+    @property
+    def collective_bytes_per_axis(self):
+        out = {}
+        for ev in self.schedule:
+            for a, b in ev.per_axis.items():
+                out[a] = out.get(a, 0) + b
+        return out
+
+    @property
+    def collective_bytes(self):
+        return sum(self.collective_bytes_per_axis.values())
+
+    @property
+    def reshard_bytes(self):
+        return sum(ev.wire_bytes for ev in self.reshards)
+
+    def as_dict(self):
+        return {
+            "mesh": self.mesh.as_dict(),
+            "in_specs": [s.as_dict() if isinstance(s, ShardSpec) else s
+                         for s in self.in_specs],
+            "out_specs": [s.as_dict() if isinstance(s, ShardSpec) else s
+                          for s in self.out_specs],
+            "schedule": [ev.as_dict() for ev in self.schedule],
+            "reshards": [ev.as_dict() for ev in self.reshards],
+            "collective_bytes": int(self.collective_bytes),
+            "collective_bytes_per_axis": {
+                a: int(b) for a, b in
+                sorted(self.collective_bytes_per_axis.items())},
+            "reshard_bytes": int(self.reshard_bytes),
+            "n_collectives": len(self.schedule),
+            "unpriced_collectives": [
+                {"prim": p, "axis": a, "reason": r}
+                for p, a, r in sorted(set(self.unpriced))],
+            "extras": dict(sorted(self.extras.items())),
+        }
+
+    def render(self, title="mxshard"):
+        d = self.as_dict()
+        lines = ["%s: mesh %s — %d collective(s), %.2f MiB wire, "
+                 "%d reshard(s)" % (
+                     title, d["mesh"], d["n_collectives"],
+                     d["collective_bytes"] / (1 << 20),
+                     len(d["reshards"]))]
+        for ev in self.schedule[:16]:
+            lines.append("  [%4d] %-16s%s over %-18s %10d B x%d%s" % (
+                ev.index, ev.prim, "*" if ev.inferred else " ",
+                "x".join(ev.axes) or "-", ev.wire_bytes, ev.scale,
+                (" (%s)" % ev.note) if ev.note else ""))
+        if len(self.schedule) > 16:
+            lines.append("  ... %d more" % (len(self.schedule) - 16))
+        for ev in self.reshards:
+            lines.append("  [%4d] RESHARD %s at %s over %s: %d B" % (
+                ev.index, ev.kind, ev.prim, "x".join(ev.axes) or "-",
+                ev.wire_bytes))
+        for p, a, r in sorted(set(self.unpriced)):
+            lines.append("  UNPRICED %s over %r (%s)" % (p, a, r))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# per-replica view: explicit collectives, variance propagation
+# ---------------------------------------------------------------------------
+class _VState:
+    """Per-value variance state in the per-replica (local-shard) view.
+
+    ``content``: axes along which the VALUE differs per rank (batch
+    shards and everything derived from them).  ``dims[d]``: axes along
+    which dim ``d`` holds a different PIECE per rank (layout sharding).
+    ``partial``: pending partial-sum axes.  ``reduced``: axes a
+    reducing collective already covered on this chain (DST008 feed).
+    ``scattered``: axes whose layout came from an in-step
+    ``reduce_scatter`` that no ``all_gather`` has covered yet (DST007).
+    """
+    __slots__ = ("content", "dims", "partial", "reduced", "scattered")
+
+    def __init__(self, rank=0, content=(), dims=None, partial=(),
+                 reduced=(), scattered=()):
+        self.content = frozenset(content)
+        self.dims = tuple(frozenset(d) for d in (
+            dims if dims is not None else ((),) * rank))
+        self.partial = frozenset(partial)
+        self.reduced = frozenset(reduced)
+        self.scattered = frozenset(scattered)
+
+    def layout(self):
+        return frozenset(a for d in self.dims for a in d)
+
+    def clone(self, **kw):
+        out = _VState()
+        for slot in _VState.__slots__:
+            setattr(out, slot, kw.get(slot, getattr(self, slot)))
+        return out
+
+
+def _union_state(states, out_rank):
+    content = frozenset().union(*(s.content for s in states)) \
+        if states else frozenset()
+    partial = frozenset().union(*(s.partial for s in states)) \
+        if states else frozenset()
+    reduced = frozenset().union(*(s.reduced for s in states)) \
+        if states else frozenset()
+    scattered = frozenset().union(*(s.scattered for s in states)) \
+        if states else frozenset()
+    # dims merge only when every same-rank operand agrees; a mismatch
+    # (or a rank change the handler didn't map) degrades to no layout —
+    # value-level sets survive, so the error rules stay sound
+    dims = None
+    for s in states:
+        if len(s.dims) != out_rank:
+            continue
+        if dims is None:
+            dims = s.dims
+        elif dims != s.dims:
+            dims = tuple(frozenset() for _ in range(out_rank))
+            break
+    if dims is None:
+        dims = tuple(frozenset() for _ in range(out_rank))
+    return _VState(content=content, dims=dims, partial=partial,
+                   reduced=reduced, scattered=scattered)
+
+
+def _rank_of(aval):
+    return len(getattr(aval, "shape", ()))
+
+
+def _dot_contracted_axes(op, states):
+    (lc, rc), _ = op.params["dimension_numbers"]
+    axes = set()
+    for side, cdims in ((0, lc), (1, rc)):
+        if side < len(states):
+            s = states[side]
+            for d in cdims:
+                if d < len(s.dims):
+                    axes |= s.dims[d]
+    return frozenset(axes)
+
+
+def _replica_collect(tape, mesh, init_states, data_axes, on_reduce=None):
+    """Walk the tape propagating ``_VState``; calls ``on_reduce(op,
+    in_state, axes)`` at every reducing collective (for the lint rules).
+    Returns ``{var_id: _VState}``."""
+    env = {}
+
+    def state_of(i):
+        if i in env:
+            return env[i]
+        return _VState(rank=_rank_of(tape.avals.get(i)))
+
+    for i, st in init_states.items():
+        env[i] = st
+
+    for t, op in enumerate(tape.ops):
+        in_states = [state_of(i) for i in op.in_ids]
+        out_rank = _rank_of(tape.avals.get(op.out_ids[0])) \
+            if op.out_ids else 0
+        merged = _union_state(in_states, out_rank)
+        axes = frozenset(a for a in op.axes)
+        all_literal = all(i in tape.literal_ids for i in op.in_ids)
+
+        if op.prim in _REDUCING and axes and not all_literal:
+            if on_reduce is not None:
+                on_reduce(t, op, merged, axes)
+            new = merged.clone(
+                content=merged.content - axes,
+                partial=merged.partial - axes,
+                reduced=merged.reduced | axes)
+        elif op.prim == "reduce_scatter" and axes:
+            if on_reduce is not None:
+                on_reduce(t, op, merged, axes)
+            d = int(op.params.get("scatter_dimension", 0))
+            dims = list(merged.dims) if len(merged.dims) == out_rank \
+                else [frozenset()] * out_rank
+            if d < len(dims):
+                dims[d] = dims[d] | axes
+            new = merged.clone(
+                content=merged.content - axes,
+                partial=merged.partial - axes,
+                reduced=merged.reduced | axes,
+                scattered=merged.scattered | axes,
+                dims=tuple(dims))
+        elif op.prim == "all_gather" and axes:
+            dims = tuple(d - axes for d in merged.dims) \
+                if len(merged.dims) == out_rank \
+                else tuple(frozenset() for _ in range(out_rank))
+            new = merged.clone(
+                content=merged.content - axes,
+                scattered=merged.scattered - axes,
+                dims=dims)
+        elif op.prim == "all_to_all" and axes:
+            split = op.params.get("split_axis")
+            concat = op.params.get("concat_axis")
+            dims = list(merged.dims) if len(merged.dims) == out_rank \
+                else [frozenset()] * out_rank
+            if split is not None and split < len(dims):
+                dims[split] = dims[split] | axes
+            if concat is not None and concat < len(dims):
+                dims[concat] = dims[concat] - axes
+            new = merged.clone(dims=tuple(dims))
+        elif op.prim == "ppermute":
+            # content rotates among ranks: still a different value per
+            # rank — every variance survives
+            new = merged
+        elif op.prim == "pbroadcast" and axes:
+            new = merged.clone(content=merged.content - axes,
+                               scattered=merged.scattered - axes,
+                               dims=tuple(d - axes for d in merged.dims))
+        elif op.prim == "axis_index":
+            new = _VState(rank=out_rank, content=axes)
+        elif op.prim == "dot_general":
+            contracted = _dot_contracted_axes(op, in_states)
+            new = merged.clone(partial=merged.partial | contracted)
+        else:
+            new = merged
+        for o in op.out_ids:
+            env[o] = new.clone() if len(op.out_ids) > 1 else new
+    return env
+
+
+def collective_schedule(closed_jaxpr, mesh, subject="<program>"):
+    """The explicit collective schedule of a per-replica program, priced
+    with the multi-axis ring formulas.  ``mesh``: a :class:`MeshSpec`
+    (or anything its constructor takes)."""
+    mesh = mesh if isinstance(mesh, MeshSpec) else MeshSpec(mesh)
+    tape = build_tape(closed_jaxpr, axis_sizes=mesh.as_dict())
+    events = []
+    for t, op in enumerate(tape.ops):
+        if op.prim not in _COLLECTIVES or not op.axes:
+            continue
+        if all(i in tape.literal_ids for i in op.in_ids):
+            continue    # lax.psum(1, axis): axis-size arithmetic
+        in_b = sum(_aval_bytes(tape.avals[i]) for i in op.in_ids)
+        out_b = sum(_aval_bytes(tape.avals[i]) for i in op.out_ids)
+        per_axis = ring_bytes_per_axis(
+            op.prim, in_b, out_b,
+            {a: mesh.size(a) for a in op.axes if a in mesh})
+        per_axis = {a: b * op.scale for a, b in per_axis.items()}
+        events.append(CollectiveEvent(
+            t, op.prim, op.axes, in_b, per_axis, scale=op.scale))
+    return ShardReport(mesh, schedule=events, unpriced=tape.unpriced)
+
+
+def lint_sharded_step(closed_jaxpr, mesh, data_axes=("data",),
+                      varying_invars=(), shard_dims=None,
+                      param_outvars=None, param_names=None,
+                      state_axes=None, disable=(), subject="<step>"):
+    """Mixed-axis DST rules over a per-replica step (DST006/007/008).
+
+    ``varying_invars``: flat invar indices whose *content* differs per
+    rank along ``data_axes`` (the batch shards).  ``shard_dims``:
+    ``{invar_index: {dim: (axis, ...)}}`` declaring layout-sharded
+    inputs (tensor-parallel params, ZeRO optimizer-state shards).
+    ``param_outvars``/``param_names``: the new-parameter outputs that
+    must come back whole and replica-identical.  ``state_axes``:
+    ``{invar_index: (axis, ...)}`` marking inputs (e.g. optimizer-state
+    shards) that legitimately stay scattered across steps.
+    """
+    mesh = mesh if isinstance(mesh, MeshSpec) else MeshSpec(mesh)
+    tape = build_tape(closed_jaxpr, axis_sizes=mesh.as_dict())
+    data_axes = frozenset(data_axes)
+    init = {}
+    for idx in varying_invars:
+        if 0 <= idx < len(tape.invar_ids):
+            i = tape.invar_ids[idx]
+            init[i] = _VState(rank=_rank_of(tape.avals[i]),
+                              content=data_axes)
+    for idx, dmap in (shard_dims or {}).items():
+        if not (0 <= idx < len(tape.invar_ids)):
+            continue
+        i = tape.invar_ids[idx]
+        rank = _rank_of(tape.avals[i])
+        dims = [frozenset() for _ in range(rank)]
+        for d, axs in dmap.items():
+            if d < rank:
+                dims[d] = frozenset(
+                    (axs,) if isinstance(axs, str) else axs)
+        st = init.get(i, _VState(rank=rank))
+        init[i] = st.clone(dims=tuple(dims))
+
+    findings = []
+
+    def on_reduce(t, op, state, axes):
+        for a in sorted(axes):
+            if a in state.partial:
+                continue            # completes a partial sum: legit
+            if a in state.layout():
+                findings.append(Finding(
+                    "DST006", subject,
+                    "%s over axis %r reduces across LAYOUT shards: the "
+                    "operand holds a different piece of the tensor on "
+                    "each member of %r (a model-sharded parameter's "
+                    "gradient, an optimizer shard) — summing the pieces "
+                    "mixes unrelated coordinates; reduce over the data "
+                    "axes only and keep per-shard math shard-local"
+                    % (op.prim, a, a)))
+                continue
+            if a in state.content:
+                continue            # the grad/batch reduction: legit
+            if a in state.reduced:
+                findings.append(Finding(
+                    "DST008", subject,
+                    "%s over axis %r overlaps a reduction already "
+                    "applied on this chain (covered axes %s): psum "
+                    "multiplies by the axis size per extra application "
+                    "— grads come out K-scaled"
+                    % (op.prim, a, sorted(state.reduced))))
+                continue
+            if (state.content & data_axes) and a not in data_axes:
+                findings.append(Finding(
+                    "DST006", subject,
+                    "%s over non-data axis %r applied to a value that "
+                    "varies over the data axes %s but not over %r: the "
+                    "gradient reduction rides the wrong mesh axis — the "
+                    "replicas never sync and the %r members get a dead "
+                    "K-scaling collective"
+                    % (op.prim, a, sorted(state.content & data_axes),
+                       a, a)))
+            else:
+                findings.append(Finding(
+                    "DST008", subject,
+                    "%s over axis %r applied to a value with no "
+                    "variance, partial sum or shard layout over it — a "
+                    "dead (or duplicate) sub-axis reduction that scales "
+                    "by the axis size" % (op.prim, a)))
+
+    env = _replica_collect(tape, mesh, init, data_axes,
+                           on_reduce=on_reduce)
+
+    out_idx = (range(len(tape.outvar_ids)) if param_outvars is None
+               else param_outvars)
+    names = list(param_names or [])
+    for j, oi in enumerate(out_idx):
+        if not (0 <= oi < len(tape.outvar_ids)):
+            continue
+        i = tape.outvar_ids[oi]
+        st = env.get(i)
+        if st is None:
+            continue
+        name = names[j] if j < len(names) else "output %d" % oi
+        if st.scattered:
+            findings.append(Finding(
+                "DST007", name,
+                "new value of %r is still reduce-scattered over %s: the "
+                "covering all_gather is missing before next-step use — "
+                "every rank would apply the next step to a tensor that "
+                "is mostly some OTHER rank's shard (the ZeRO-1 "
+                "all-gather half of the reduce-scatter/all-gather pair)"
+                % (name, sorted(st.scattered))))
+            continue    # DST007 is the specific diagnosis; skip DST001
+        if st.content & data_axes:
+            findings.append(Finding(
+                "DST001", name,
+                "new value of %r still varies over mesh axes %s: its "
+                "gradient is never reduced over the data axes, so "
+                "replicas silently diverge after one step"
+                % (name, sorted(st.content & data_axes))))
+    return filter_findings(findings, disable)
+
+
+def lint_ring_schedule(closed_jaxpr, axis, axis_size, disable=(),
+                       subject="<ring>"):
+    """DST009: every scanned ``ppermute`` over ``axis`` must be a full
+    single-cycle ring whose hop count equals the axis size — that is
+    exactly when the modeled bytes (hops × chunk) match the ring formula
+    (K × chunk) and every chunk visits every rank once."""
+    k = int(axis_size)
+    tape = build_tape(closed_jaxpr, axis_sizes={axis: k})
+    findings = []
+    for op in tape.ops:
+        if op.prim != "ppermute" or axis not in op.axes:
+            continue
+        perm = tuple(tuple(p) for p in op.params.get("perm", ()))
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        chunk = sum(_aval_bytes(tape.avals[i]) for i in op.in_ids)
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            findings.append(Finding(
+                "DST009", subject,
+                "ppermute over %r repeats a source or destination in "
+                "its perm %r: chunks are dropped or double-sent — not a "
+                "ring" % (axis, perm)))
+            continue
+        if op.scale <= 1:
+            continue    # a single halo exchange, not a scanned ring
+        mapping = dict(perm)
+        covered = set(srcs) == set(range(k)) == set(dsts)
+        single_cycle = False
+        if covered:
+            seen, cur = set(), 0
+            while cur not in seen:
+                seen.add(cur)
+                cur = mapping[cur]
+            single_cycle = len(seen) == k
+        if not covered or not single_cycle:
+            findings.append(Finding(
+                "DST009", subject,
+                "scanned ppermute over %r (size %d) has perm %r which "
+                "is not a single full ring over all %d members: some "
+                "chunk never reaches some rank, so the attention output "
+                "silently drops context" % (axis, k, perm, k)))
+            continue
+        if op.scale != k:
+            findings.append(Finding(
+                "DST009", subject,
+                "ring over %r scans %d hop(s) but the axis has %d "
+                "members: modeled collective bytes %d do not match the "
+                "ring formula %d (= K x %d-byte chunk) — the ring never "
+                "completes (or over-rotates) and the modeled budget "
+                "misstates the wire traffic"
+                % (axis, op.scale, k, op.scale * chunk, k * chunk,
+                   chunk)))
+    return filter_findings(findings, disable)
+
+
+# ---------------------------------------------------------------------------
+# global view: GSPMD-style spec propagation with inferred collectives
+# ---------------------------------------------------------------------------
+def _reshard_cost(mesh, aval, src, dst):
+    """(kind, axes, wire bytes) to move one value from spec ``src`` to
+    ``dst``: moved axes ride an all_to_all of the local tile, removed
+    axes an all_gather; newly-added sharding is a free local slice."""
+    src_axes, dst_axes = src.axes(), dst.axes()
+    removed = sorted(src_axes - dst_axes)
+    moved = sorted(a for a in (src_axes & dst_axes)
+                   if [a in d for d in src.dims]
+                   != [a in d for d in dst.dims])
+    local = src.local_bytes(aval, mesh)
+    cost = 0
+    for a in moved:
+        ka = mesh.size(a)
+        cost += (ka - 1) * local // max(ka, 1)
+    for a in removed:
+        ka = mesh.size(a)
+        cost += (ka - 1) * local    # all_gather: (K-1)/K x (K x local)
+        local *= ka
+    kind = "all_to_all" if moved else "all_gather"
+    return kind, tuple(moved + removed), cost
+
+
+def _reshape_dim_map(src_shape, dst_shape):
+    """{src_dim: dst_dim} for dims preserved 1:1 by a row-major reshape
+    (cumulative-product alignment); split/merged dims are unmapped."""
+    out = {}
+    si = di = 0
+    while si < len(src_shape) and di < len(dst_shape):
+        s, d = int(src_shape[si]), int(dst_shape[di])
+        if s == d:
+            out[si] = di
+            si += 1
+            di += 1
+            continue
+        # a split/merge region: accumulate the smaller side until the
+        # running products align; nothing inside the region maps 1:1
+        sp, dp = s, d
+        s2, d2 = si + 1, di + 1
+        while sp != dp:
+            if sp < dp and s2 < len(src_shape):
+                sp *= int(src_shape[s2])
+                s2 += 1
+            elif d2 < len(dst_shape):
+                dp *= int(dst_shape[d2])
+                d2 += 1
+            else:
+                return out
+        si, di = s2, d2
+    return out
+
+
+def propagate(closed_jaxpr, mesh, in_specs, donated_invars=(),
+              subject="<program>"):
+    """GSPMD-style whole-program sharding propagation (global view).
+
+    ``in_specs``: one ``PartitionSpec``/``ShardSpec``/None per flat
+    invar.  Returns a :class:`ShardReport` whose schedule holds the
+    *inferred* collectives (partial-sum psums from contracted sharded
+    dims, reduced sharded dims) and whose ``reshards`` list every forced
+    layout change (hidden-collective class, DST010).
+    """
+    mesh = mesh if isinstance(mesh, MeshSpec) else MeshSpec(mesh)
+    tape = build_tape(closed_jaxpr)
+    env = {}
+    for idx, i in enumerate(tape.invar_ids):
+        spec = in_specs[idx] if idx < len(in_specs) else None
+        env[i] = ShardSpec.from_partition_spec(
+            spec, _rank_of(tape.avals[i]))
+
+    schedule, reshards = [], []
+
+    def spec_of(i):
+        s = env.get(i)
+        if s is None:
+            s = ShardSpec.replicated(_rank_of(tape.avals.get(i)))
+            env[i] = s
+        return s
+
+    def infer_psum(t, spec, aval, note):
+        """Flush a pending partial-sum: the all-reduce GSPMD inserts."""
+        if not spec.partial:
+            return spec
+        local = spec.local_bytes(aval, mesh)
+        per_axis = ring_bytes_per_axis(
+            "psum", local, local,
+            {a: mesh.size(a) for a in sorted(spec.partial)})
+        schedule.append(CollectiveEvent(
+            t, "psum", sorted(spec.partial), local, per_axis,
+            inferred=True, note=note))
+        return ShardSpec(spec.dims)
+
+    def force(t, op, i, want):
+        """Reshard operand ``i`` to ``want``, recording the event when
+        wire traffic is forced (gaining sharding is a free local
+        slice).  The env is updated: after the reshard the value exists
+        in the new layout, so later uses do not pay again."""
+        have = spec_of(i)
+        want = ShardSpec(want.dims, have.partial)
+        if have.dims == want.dims:
+            return
+        kind, axes, cost = _reshard_cost(mesh, tape.avals[i], have, want)
+        env[i] = want
+        if not axes:
+            return
+        reshards.append(ReshardEvent(
+            t, op.prim, kind, axes, cost * op.scale,
+            note="operand sharding %r forced to %r"
+                 % (have.dims, want.dims)))
+
+    for t, op in enumerate(tape.ops):
+        in_specs_op = [spec_of(i) for i in op.in_ids]
+        out_avals = [tape.avals[i] for i in op.out_ids]
+        out_rank = _rank_of(out_avals[0]) if out_avals else 0
+
+        # any operand still carrying a partial sum gets its inferred
+        # psum flushed before use (GSPMD sinks further; pricing at first
+        # use is the deterministic upper bound) — except a reducing
+        # collective over exactly those axes, which completes it for free
+        for k_i, i in enumerate(op.in_ids):
+            s = in_specs_op[k_i]
+            if s.partial and not (
+                    op.prim in _REDUCING
+                    and s.partial <= frozenset(op.axes)):
+                env[i] = infer_psum(t, s, tape.avals[i],
+                                    "partial sum consumed by %s" % op.prim)
+                in_specs_op[k_i] = env[i]
+
+        if op.prim == "dot_general":
+            lhs, rhs = in_specs_op[0], in_specs_op[1]
+            (lc, rc), (lb, rb) = op.params["dimension_numbers"]
+            contracted = set()
+            rhs_dims = list(rhs.dims)
+            mismatch = False
+            for dl, dr in zip(lc, rc):
+                la = set(lhs.dims[dl]) if dl < len(lhs.dims) else set()
+                ra = set(rhs_dims[dr]) if dr < len(rhs_dims) else set()
+                if la == ra:
+                    contracted |= la
+                else:
+                    # both sides of a contraction must agree on the
+                    # contracted dim's layout: align rhs onto lhs
+                    rhs_dims[dr] = tuple(sorted(la))
+                    mismatch = True
+                    contracted |= la
+            if mismatch:
+                force(t, op, op.in_ids[1], ShardSpec(rhs_dims))
+                rhs = spec_of(op.in_ids[1])
+            lfree = [d for d in range(len(lhs.dims))
+                     if d not in set(lc) | set(lb)]
+            rfree = [d for d in range(len(rhs.dims))
+                     if d not in set(rc) | set(rb)]
+            dims = [lhs.dims[d] for d in lb] \
+                + [lhs.dims[d] for d in lfree] \
+                + [rhs.dims[d] for d in rfree]
+            dims = (dims + [()] * out_rank)[:out_rank]
+            new = ShardSpec(dims,
+                            lhs.partial | rhs.partial | contracted)
+        elif op.prim.startswith("reduce_") and "axes" in op.params \
+                and op.prim not in _COLLECTIVES:
+            src = in_specs_op[0] if in_specs_op else \
+                ShardSpec.replicated(0)
+            red = set(op.params["axes"])
+            partial = set(src.partial)
+            dims = []
+            for d, axs in enumerate(src.dims):
+                if d in red:
+                    partial |= set(axs)   # reducing a sharded dim:
+                else:                     # each shard holds an addend
+                    dims.append(axs)
+            new = ShardSpec((tuple(dims) + ((),) * out_rank)[:out_rank],
+                            partial)
+        elif op.prim == "transpose":
+            src = in_specs_op[0]
+            perm = op.params["permutation"]
+            new = ShardSpec(tuple(src.dims[p] if p < len(src.dims)
+                                  else () for p in perm), src.partial)
+        elif op.prim == "broadcast_in_dim":
+            src = in_specs_op[0] if in_specs_op else None
+            bdims = op.params.get("broadcast_dimensions", ())
+            dims = [()] * out_rank
+            if src is not None:
+                for sd, od in enumerate(bdims):
+                    if sd < len(src.dims) and od < out_rank:
+                        dims[od] = src.dims[sd]
+            new = ShardSpec(dims, src.partial if src else ())
+        elif op.prim == "reshape":
+            src = in_specs_op[0]
+            src_shape = getattr(tape.avals[op.in_ids[0]], "shape", ())
+            dst_shape = getattr(out_avals[0], "shape", ())
+            dmap = _reshape_dim_map(src_shape, dst_shape)
+            dims = [()] * out_rank
+            for sd, od in dmap.items():
+                if sd < len(src.dims):
+                    dims[od] = src.dims[sd]
+            lost = src.axes() - frozenset(a for d in dims for a in d)
+            if lost:
+                # a sharded dim was split/merged: GSPMD reshards
+                force(t, op, op.in_ids[0], ShardSpec.replicated(
+                    len(src.dims)))
+            new = ShardSpec(dims, src.partial)
+        elif op.prim in ("convert_element_type", "copy", "stop_gradient",
+                         "device_put", "sharding_constraint"):
+            new = in_specs_op[0] if in_specs_op else \
+                ShardSpec.replicated(out_rank)
+            if op.prim == "sharding_constraint":
+                want = op.params.get("sharding")
+                spec = getattr(want, "spec", None)
+                if spec is not None:
+                    target = ShardSpec.from_partition_spec(spec, out_rank)
+                    force(t, op, op.in_ids[0], target)
+                    new = ShardSpec(target.dims, new.partial)
+        else:
+            # default: elementwise/unhandled.  Same-rank operands with
+            # agreeing dims keep them; a dim where two sharded operands
+            # disagree forces the minority onto the first operand's
+            # layout (recorded); rank changes degrade to replicated.
+            cands = [s for s in in_specs_op if len(s.dims) == out_rank]
+            dims = [()] * out_rank
+            partial = frozenset().union(*(s.partial
+                                          for s in in_specs_op)) \
+                if in_specs_op else frozenset()
+            if cands:
+                # the most-sharded operand wins (replicated operands
+                # slice down for free); disagreeing sharded operands
+                # are forced onto it — the DST010 hidden-collective
+                base = max(cands, key=lambda s: s.shard_factor(mesh))
+                dims = list(base.dims)
+                for k_i, i in enumerate(op.in_ids):
+                    s = in_specs_op[k_i]
+                    if len(s.dims) != out_rank:
+                        continue
+                    if s.dims != base.dims and s.axes():
+                        force(t, op, i, base)
+            new = ShardSpec(dims, partial)
+
+        for o in op.out_ids:
+            env[o] = new
+
+    out_specs = []
+    for t_out, i in enumerate(tape.outvar_ids):
+        s = spec_of(i)
+        if s.partial:
+            s = infer_psum(len(tape.ops), s, tape.avals[i],
+                           "partial sum at program output")
+            env[i] = s
+        out_specs.append(s)
+    return ShardReport(mesh,
+                       in_specs=[spec_of(i) for i in tape.invar_ids],
+                       out_specs=out_specs, schedule=schedule,
+                       reshards=reshards, unpriced=tape.unpriced)
+
+
+def lint_global_sharding(closed_jaxpr, mesh, in_specs, disable=(),
+                         subject="<program>"):
+    """DST010 (+ COST004) over a global-view program: every forced
+    reshard of an intermediate is a hidden collective GSPMD would
+    silently insert inside the step body."""
+    report = propagate(closed_jaxpr, mesh, in_specs, subject=subject)
+    findings = []
+    for ev in report.reshards:
+        findings.append(Finding(
+            "DST010", subject,
+            "activation resharding forced inside the step body at eqn "
+            "%d (%s): operand layouts disagree, so GSPMD inserts a "
+            "hidden %s over %s moving %d modeled bytes every step — "
+            "annotate the producer/consumer to agree, or make the "
+            "collective explicit so it is budgeted"
+            % (ev.index, ev.prim, ev.kind, "x".join(ev.axes) or "?",
+               ev.wire_bytes)))
+    findings += unpriced_findings(report, subject=subject)
+    return filter_findings(findings, disable), report
+
+
+def shard_summary(reports, findings=()):
+    """Machine-readable ``shard`` section for the CLI ``--json``
+    output (schema_version 3): {model: ShardReport.as_dict()} plus the
+    shard-rule findings."""
+    return {
+        "rules": ["DST006", "DST007", "DST008", "DST009", "DST010",
+                  "COST004"],
+        "reports": {name: (rep.as_dict() if hasattr(rep, "as_dict")
+                           else rep)
+                    for name, rep in sorted((reports or {}).items())},
+        "findings": [f.as_dict() for f in findings
+                     if f.rule_id.startswith(("DST", "COST"))],
+    }

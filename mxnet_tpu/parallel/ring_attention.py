@@ -16,6 +16,19 @@ rebuild adds as first-class citizens:
 
 Both are pure jax functions usable inside ``shard_map`` (see
 ``ring_attention_sharded`` for the pre-wired entry point).
+
+The collective schedule here is a *proven* artifact: the analysis
+tier's mxshard passes (``docs/analysis.md`` "Sharding propagation")
+trace these functions on a declared ``sequence`` axis and verify that
+every scanned ``ppermute`` is a single full ring whose modeled bytes
+match the closed-form formula (K hops x chunk — DST009), that no dead
+or mixed-axis reduction sneaks in (DST006/DST008), and that the
+priced total (6 rotating buffers x K x chunk for forward+backward) is
+pinned in ``STATIC_BUDGETS.json`` as ``ring_attention_fwd``.  Both the
+ring and Ulysses paths currently lint clean with zero inline disables
+(``--self-check`` sweeps them via ``lint_parallel_sources``); anyone
+changing a ``perm``, hop count or accumulator rotation below will hear
+about it from CI before any hardware runs it.
 """
 from __future__ import annotations
 

@@ -506,6 +506,79 @@ class DataParallelTrainer:
         report.axis_sizes = {self._data_axis: ksize}
         return report
 
+    def shard_report(self, data_shape=None, label_shape=None,
+                     data_dtype="float32", label_dtype="int32",
+                     declared_axis_size=None):
+        """mxshard global-view report of one training step
+        (analysis/shard_prop.py): the full-batch step program with the
+        trainer's declared input shardings (params/states per
+        ``param_spec_fn``, batch over the data axis) propagated
+        GSPMD-style — the returned schedule holds the collectives the
+        compiler would INSERT (the gradient psum appears as an inferred
+        partial-sum reduction, without the per-replica spelling) plus
+        any forced activation reshards (DST010 material).  Hardware-
+        free; never executes or compiles."""
+        import numpy as _onp
+
+        from ..analysis import shard_prop as _sp
+
+        if not self._ready:
+            if data_shape is None:
+                raise ValueError(
+                    "trainer has not stepped yet: pass data_shape (and "
+                    "label_shape)")
+            x0 = NDArray(jnp.zeros(tuple(data_shape),
+                                   _onp.dtype(data_dtype)))
+            y0 = NDArray(jnp.zeros(
+                tuple(label_shape or (data_shape[0],)),
+                _onp.dtype(label_dtype)))
+            self._setup(x0, y0)
+        data_shape = tuple(data_shape)
+        label_shape = tuple(label_shape or (data_shape[0],))
+        train_vals = tuple(self._params_by_name[n].data()._data
+                           for n in self._train_names)
+        aux_vals = tuple(self._params_by_name[n].data()._data
+                         for n in self._aux_names)
+        states = tuple(self._states_raw)
+        x = jax.ShapeDtypeStruct(data_shape, _onp.dtype(data_dtype))
+        y = jax.ShapeDtypeStruct(label_shape, _onp.dtype(label_dtype))
+        key = jax.ShapeDtypeStruct((2,), _onp.uint32)
+        fwd = self._fwd
+
+        def pure_step(train_vals, states, aux_vals, x, y, key, lr, t):
+            def loss_of(tv):
+                outs, muts = fwd(tv, aux_vals, (x, y), key)
+                return outs[0], muts
+
+            (loss_val, muts), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            new_vals, new_states = self._apply_groups(
+                train_vals, states, grads, lr, t)
+            return loss_val, new_vals, new_states, muts
+
+        closed = jax.make_jaxpr(pure_step)(
+            train_vals, states, aux_vals, x, y, key,
+            jnp.float32(0.01), jnp.int32(1))
+        axis_sizes = dict(zip(self._mesh.axis_names,
+                              self._mesh.devices.shape))
+        axis_sizes[self._data_axis] = int(
+            declared_axis_size or axis_sizes.get(self._data_axis, 1))
+        mesh = _sp.MeshSpec(axis_sizes)
+        # flat in_specs follow the step's arg order: params get their
+        # PartitionSpec, optimizer states their group sharding, the
+        # batch shards over the data axis, everything else replicates
+        in_specs = [self._param_spec_fn(
+            n, self._params_by_name[n].shape) for n in self._train_names]
+        for gi, raw in enumerate(self._states_raw):
+            spec = self._group_shardings[gi].spec
+            in_specs += [spec] * len(jax.tree_util.tree_leaves(raw))
+        in_specs += [self._param_spec_fn(
+            n, self._params_by_name[n].shape) for n in self._aux_names]
+        in_specs += [PartitionSpec(self._data_axis),
+                     PartitionSpec(self._data_axis), None, None, None]
+        return _sp.propagate(closed, mesh, in_specs,
+                             subject="DataParallelTrainer")
+
     def _build_grad_step(self):
         """Dist split-step, part 1: loss + local gradients (no update) —
         the grads cross the process boundary through the kvstore between

@@ -548,6 +548,56 @@ class Symbol:
         return analyze_symbol(self, shapes=shapes, type_dict=type_dict,
                               train=train, host_names=host_names)
 
+    def shard_report(self, shapes, mesh_axes, in_specs=None,
+                     type_dict=None, train=False, data_axis="data"):
+        """mxshard global-view sharding propagation of this graph's
+        forward program (mxnet_tpu.analysis.shard_prop): given a
+        declared mesh (``mesh_axes``: {axis: size} — no devices) and
+        per-argument ``PartitionSpec``s, returns a ``ShardReport`` with
+        the inferred collective schedule (partial-sum psums from
+        contracted sharded dims) and any forced reshards.  Defaults:
+        the names in ``shapes`` (the batch inputs) shard dim 0 over
+        ``data_axis`` when the mesh has it; parameters replicate.
+        Returns None when the graph does not trace."""
+        from ..analysis import shard_prop as _sp
+
+        known = {k: tuple(v) for k, v in (shapes or {}).items()
+                 if v is not None}
+        tdict = {k: _np.dtype(v) for k, v in (type_dict or {}).items()}
+        entry_shapes, ok = _infer_entry_shapes(self._outputs, known,
+                                               tdict)
+        if not ok:
+            return None
+        args, aux = {}, {}
+        for n in self._nodes():
+            if n.op is not None:
+                continue
+            s = entry_shapes.get((id(n), 0))
+            if s is None:
+                return None
+            (aux if n._is_aux else args)[n.name] = jax.ShapeDtypeStruct(
+                tuple(s.shape), s.dtype)
+        graph_fn = make_graph_fn(self, train=train)
+        try:
+            closed = jax.make_jaxpr(graph_fn)(
+                args, aux, jax.random.PRNGKey(0))
+        except Exception:
+            return None
+        mesh = _sp.MeshSpec(mesh_axes)
+        in_specs = dict(in_specs or {})
+        from jax.sharding import PartitionSpec as _P
+        flat_specs = []
+        for name in sorted(args) + sorted(aux):
+            if name in in_specs:
+                flat_specs.append(in_specs[name])
+            elif name in known and data_axis in mesh:
+                flat_specs.append(_P(data_axis))
+            else:
+                flat_specs.append(None)
+        flat_specs.append(None)     # the PRNG key
+        return _sp.propagate(closed, mesh, flat_specs,
+                             subject=self.name or "<symbol>")
+
     # gradient of this symbol's outputs — handled inside Executor via vjp
     def grad(self, wrt):
         raise NotImplementedError(

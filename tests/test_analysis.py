@@ -568,10 +568,33 @@ def test_cost_collective_bytes_per_axis():
     # ring all-reduce: 2*(K-1)/K * payload
     assert r.collective_bytes_per_axis == {
         "data": int(2 * 7 * (n * 4) // 8)}
+    # all_gather moves the OUTPUT around the ring: (K-1)/K x (K x input)
     rg = mxcost.analyze_fn(lambda x: lax.all_gather(x, "data"),
                            jnp.zeros((n,), jnp.float32),
                            axis_env=[("data", 8)])
-    assert rg.collective_bytes_per_axis == {"data": int(7 * (n * 4) // 8)}
+    assert rg.collective_bytes_per_axis == {"data": int(7 * (n * 4))}
+    # reduce_scatter moves the input: (K-1)/K x input
+    rs = mxcost.analyze_fn(
+        lambda x: lax.psum_scatter(x, "data", scatter_dimension=0,
+                                   tiled=True),
+        jnp.zeros((n,), jnp.float32), axis_env=[("data", 8)])
+    assert rs.collective_bytes_per_axis == {"data": int(7 * (n * 4) // 8)}
+    # grouped psum: ONE ring over the combined group (K = 8 x 4),
+    # attributed per axis proportionally to (size - 1); the per-axis
+    # sum equals the group total exactly
+    gp = mxcost.analyze_fn(lambda x: lax.psum(x, ("data", "model")),
+                           jnp.zeros((n,), jnp.float32),
+                           axis_env=[("data", 8), ("model", 4)])
+    total = int(2 * 31 * (n * 4) // 32)
+    assert sum(gp.collective_bytes_per_axis.values()) == total
+    assert set(gp.collective_bytes_per_axis) == {"data", "model"}
+    assert gp.collective_bytes_per_axis["data"] == total - total * 3 // 10
+    # ppermute prices one hop of the payload
+    pp = mxcost.analyze_fn(
+        lambda x: lax.ppermute(x, "data",
+                               [(i, (i + 1) % 8) for i in range(8)]),
+        jnp.zeros((n,), jnp.float32), axis_env=[("data", 8)])
+    assert pp.collective_bytes_per_axis == {"data": n * 4}
     # axis of size 1 moves nothing
     r1 = mxcost.analyze_fn(lambda x: lax.psum(x, "data"),
                            jnp.zeros((n,)), axis_env=[("data", 1)])
@@ -881,7 +904,7 @@ def test_cost_json_schema_version():
     proc = _run_cli("--cost", "--json", "--model", "mlp_infer")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
-    assert payload["schema_version"] == 2
+    assert payload["schema_version"] == 3    # 3: the shard section
     assert payload["version"] == 1
     assert "mlp_infer" in payload["cost"]
     assert payload["cost"]["mlp_infer"]["flops"] > 0

@@ -1,0 +1,565 @@
+"""mxshard: whole-program static sharding propagation, multi-axis ring
+formulas and the hardware-free ZeRO/tensor-parallel proof gate
+(mxnet_tpu/analysis/shard_prop.py; docs/analysis.md "Sharding
+propagation").
+
+Golden fixtures cover the three canonical patterns — ZeRO-1 update
+(reduce-scatter/all-gather), tensor-parallel matmul (inferred
+partial-sum psum over ``model``), ring attention (scanned ppermute over
+``sequence``) — and every new DST rule (006-010) has a broken-fixture
+subprocess test proving exit code 2 with the rule named, plus the two
+headline mutation kills: deleting the ZeRO all-gather fails the
+STATIC_BUDGETS gate with DST007, inflating the optimizer state past
+budget fails COST001.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import mxnet_tpu as mx
+
+pytestmark = pytest.mark.analysis
+
+from mxnet_tpu.analysis import cost as mxcost
+from mxnet_tpu.analysis import shard_fixtures as sf
+from mxnet_tpu.analysis import shard_prop as sp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, "-m", "mxnet_tpu.analysis"]
+                          + list(args), capture_output=True, text=True,
+                          cwd=REPO, env=env, timeout=300)
+
+
+def _run_script(tmp_path, body):
+    """Run a broken-fixture script in a subprocess; the script exits via
+    ``exit_code(findings)`` so error-severity rules mean rc=2."""
+    script = tmp_path / "fixture.py"
+    script.write_text(textwrap.dedent("""\
+        import os, sys
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax, jax.numpy as jnp
+        from jax import lax
+        from mxnet_tpu.analysis import exit_code
+        from mxnet_tpu.analysis import shard_prop as sp
+        """) + textwrap.dedent(body) + textwrap.dedent("""
+        for f in findings:
+            print(f)
+        sys.exit(exit_code(findings))
+        """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    return subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=300)
+
+
+# ---------------------------------------------------------------------------
+# ShardSpec / MeshSpec basics
+# ---------------------------------------------------------------------------
+def test_shardspec_from_partition_spec():
+    from jax.sharding import PartitionSpec as P
+    mesh = sp.MeshSpec({"data": 8, "model": 4})
+    s = sp.ShardSpec.from_partition_spec(P("data", None, ("model",)), 3)
+    assert s.dims == (("data",), (), ("model",))
+    assert s.axes() == {"data", "model"}
+    assert s.shard_factor(mesh) == 32
+    aval = jax.ShapeDtypeStruct((64, 2, 16), jnp.float32)
+    assert s.local_bytes(aval, mesh) == 64 * 2 * 16 * 4 // 32
+    assert sp.ShardSpec.from_partition_spec(None, 2).dims == ((), ())
+    # a live Mesh is accepted as a MeshSpec source
+    from mxnet_tpu.parallel import make_mesh
+    m = sp.MeshSpec(make_mesh((4, 2), ("data", "model")))
+    assert m.as_dict() == {"data": 4, "model": 2}
+
+
+# ---------------------------------------------------------------------------
+# golden fixture 1: the ZeRO-1 update (reduce-scatter / all-gather)
+# ---------------------------------------------------------------------------
+def test_zero1_golden_schedule_and_lint():
+    k = 8
+    mesh = sp.MeshSpec({"data": k})
+    step, args = sf.zero1_step_program(k)
+    closed = jax.make_jaxpr(step, axis_env=[("data", k)])(*args)
+    report = sp.collective_schedule(closed, mesh)
+    prims = [(e.prim, e.wire_bytes) for e in report.schedule]
+    flat_bytes = sf.zero1_state_bytes(k)       # the padded flat vector
+    rs = flat_bytes * (k - 1) // k
+    # reduce_scatter (grads) + all_gather (new params) + loss pmean
+    assert prims[0] == ("reduce_scatter", rs)
+    assert prims[1] == ("all_gather", rs)
+    assert prims[2][0] == "psum"
+    # collective-byte parity with the replicated spelling: rs + ag ==
+    # one ring all-reduce of the flat vector (2*(K-1)/K * bytes)
+    assert prims[0][1] + prims[1][1] == \
+        mxcost.collective_bytes("psum", flat_bytes, k)
+
+    n_train = len(args[0])
+    findings = sp.lint_sharded_step(
+        closed, mesh, data_axes=("data",),
+        varying_invars=[n_train + 1, n_train + 2],
+        shard_dims={n_train: {0: ("data",)}},
+        param_outvars=list(range(1, 1 + n_train)),
+        param_names=["w1", "b1", "w2", "b2", "w3", "b3"])
+    assert findings == []
+
+
+def test_zero1_hbm_proof_via_budget_model():
+    """The registered budget model proves the ZeRO-1 relation: modeled
+    peak HBM at least optimizer-state x (1 - 1/8) below the replicated
+    twin (the reduce-scatter spelling saves more — the post-reduction
+    gradient buffer is 1/8-sized too)."""
+    from mxnet_tpu.analysis.budget_models import build_model
+    report, findings, shard = build_model("zero1_mlp_train_step")
+    assert findings == []
+    assert shard is not None
+    ex = shard.extras
+    assert ex["modeled_hbm_drop_bytes"] >= ex["zero1_floor_bytes"]
+    assert ex["zero1_floor_bytes"] == \
+        ex["optimizer_state_bytes"] * 7 // 8
+    assert ex["zero1_peak_hbm_bytes"] == report.peak_hbm_bytes
+    assert ex["replicated_twin_peak_hbm_bytes"] > report.peak_hbm_bytes
+    assert 0 < ex["modeled_zero1_hbm_drop_pct"] < 100
+
+
+# ---------------------------------------------------------------------------
+# golden fixture 2: tensor-parallel matmul (inferred psum over model)
+# ---------------------------------------------------------------------------
+def test_tp_matmul_inferred_psum():
+    fn, args, specs = sf.tp_matmul_program()
+    mesh = sp.MeshSpec({"data": 8, "model": 4})
+    closed = jax.make_jaxpr(fn)(*args)
+    report = sp.propagate(closed, mesh, specs)
+    assert report.reshards == []
+    inferred = [e for e in report.schedule if e.inferred]
+    assert len(inferred) == 1 and inferred[0].prim == "psum"
+    assert inferred[0].axes == ("model",)
+    # the partial output h @ W2 is (32, 32) f32 sharded over data on its
+    # batch dim: local tile 4x32, one ring all-reduce over model (K=4)
+    local = 32 * 32 * 4 // 8
+    assert inferred[0].wire_bytes == \
+        mxcost.collective_bytes("psum", local, 4)
+    # output stays batch-sharded, partial resolved
+    assert report.out_specs[0].dims[0] == ("data",)
+    assert not report.out_specs[0].partial
+
+
+def test_propagation_determinism():
+    fn, args, specs = sf.tp_matmul_program()
+    mesh = sp.MeshSpec({"data": 8, "model": 4})
+    closed = jax.make_jaxpr(fn)(*args)
+    a = sp.propagate(closed, mesh, specs).as_dict()
+    b = sp.propagate(closed, mesh, specs).as_dict()
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# golden fixture 3: ring attention (scanned ppermute over sequence)
+# ---------------------------------------------------------------------------
+def test_ring_attention_schedule_matches_ring_formula():
+    from mxnet_tpu.analysis.budget_models import build_model
+    report, findings, shard = build_model("ring_attention_fwd")
+    assert findings == []
+    ex = shard.extras
+    # 6 rotating buffers (fwd K/V + bwd K/V + dK/dV accumulators) x
+    # K hops x chunk bytes — the closed-form ring formula
+    assert ex["modeled_ring_attn_collective_bytes"] == \
+        ex["ring_formula_bytes"] == 6 * ex["hops"] * ex["chunk_bytes"]
+    assert report.collective_bytes == ex["ring_formula_bytes"]
+    # every scheduled event is a ppermute over sequence, scaled K
+    assert {e.prim for e in shard.schedule} == {"ppermute"}
+    assert all(e.scale == ex["hops"] for e in shard.schedule)
+
+
+def test_ulysses_all_to_all_priced():
+    import importlib
+    ra = importlib.import_module("mxnet_tpu.parallel.ring_attention")
+    k = 4
+    aval = jax.ShapeDtypeStruct((2, 16, 8, 16), jnp.float32)
+    closed = jax.make_jaxpr(
+        lambda q, kk, v: ra.ulysses_attention(q, kk, v, "sequence"),
+        axis_env=[("sequence", k)])(aval, aval, aval)
+    report = sp.collective_schedule(closed, sp.MeshSpec({"sequence": k}))
+    a2a = [e for e in report.schedule if e.prim == "all_to_all"]
+    assert len(a2a) == 4          # q/k/v in, output back
+    payload = 2 * 16 * 8 * 16 * 4
+    assert all(e.wire_bytes ==
+               mxcost.collective_bytes("all_to_all", payload, k)
+               for e in a2a)
+
+
+# ---------------------------------------------------------------------------
+# global view faithfulness: trainer inferred == replica explicit
+# ---------------------------------------------------------------------------
+def _mlp_trainer():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.analysis.budget_models import _cpu_mesh
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import DataParallelTrainer
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    return DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=_cpu_mesh())
+
+
+def test_trainer_shard_report_matches_replica_spelling():
+    """The GSPMD story, proven both ways: the global-view propagation
+    over the full-batch step (no explicit collectives anywhere) must
+    INFER gradient psums whose total bytes equal the per-replica
+    spelling's explicit pmean bytes exactly."""
+    tr = _mlp_trainer()
+    srep = tr.shard_report(data_shape=(64, 16), label_shape=(64,),
+                           declared_axis_size=8)
+    assert srep.reshards == []
+    assert all(e.inferred for e in srep.schedule)
+    crep = tr.cost_report(data_shape=(64, 16), label_shape=(64,),
+                          declared_axis_size=8)
+    assert srep.collective_bytes_per_axis == \
+        crep.collective_bytes_per_axis
+    assert srep.collective_bytes_per_axis["data"] > 0
+
+
+def test_symbol_shard_report_tensor_parallel():
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import symbol as sym
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=64, name="tp_fc1")
+    a = sym.Activation(h, act_type="relu", name="tp_relu")
+    out = sym.FullyConnected(a, num_hidden=16, name="tp_fc2")
+    # the Megatron pairing on (out, in) FC weights: fc1 column-parallel
+    # (out dim over model -> the activation comes out model-sharded),
+    # fc2 row-parallel (in dim over model -> the contraction meets the
+    # sharded activation and the output is a partial-sum over model
+    # that the propagation must resolve with an inferred psum)
+    specs = {"tp_fc1_weight": P("model", None),
+             "tp_fc2_weight": P(None, "model")}
+    rep = out.shard_report(shapes={"data": (8, 64)},
+                           mesh_axes={"data": 8, "model": 4},
+                           in_specs=specs)
+    assert rep is not None
+    inferred = [e for e in rep.schedule
+                if e.inferred and "model" in e.axes]
+    assert inferred, rep.as_dict()
+
+
+# ---------------------------------------------------------------------------
+# broken fixtures: one rc=2 subprocess per new DST rule, rule named
+# ---------------------------------------------------------------------------
+def test_dst006_wrong_axis_grad_reduction_rc2(tmp_path):
+    proc = _run_script(tmp_path, """
+        def bad(w, x):
+            g = jax.grad(lambda w: (x @ w).sum())(w)
+            return w - 0.1 * lax.pmean(g, "model")   # wrong axis
+        closed = jax.make_jaxpr(
+            bad, axis_env=[("data", 8), ("model", 4)])(
+            jax.ShapeDtypeStruct((16, 4), jnp.float32),
+            jax.ShapeDtypeStruct((8, 16), jnp.float32))
+        findings = sp.lint_sharded_step(
+            closed, sp.MeshSpec({"data": 8, "model": 4}),
+            varying_invars=[1], param_outvars=[0], param_names=["w"])
+    """)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST006" in proc.stdout
+
+
+def test_dst006_model_sharded_param_reduced_over_model_rc2(tmp_path):
+    proc = _run_script(tmp_path, """
+        def bad(w_sh, x):
+            g = jax.grad(lambda w: (x @ w).sum())(w_sh)
+            # params are model-sharded: reducing over data x model
+            # mixes unrelated shard coordinates
+            return w_sh - 0.1 * lax.psum(g, ("data", "model"))
+        closed = jax.make_jaxpr(
+            bad, axis_env=[("data", 8), ("model", 4)])(
+            jax.ShapeDtypeStruct((16, 4), jnp.float32),
+            jax.ShapeDtypeStruct((8, 16), jnp.float32))
+        findings = sp.lint_sharded_step(
+            closed, sp.MeshSpec({"data": 8, "model": 4}),
+            varying_invars=[1], shard_dims={0: {1: ("model",)}},
+            param_outvars=[0], param_names=["w"])
+    """)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST006" in proc.stdout
+
+
+def test_dst007_missing_all_gather_fails_budget_gate_rc2(tmp_path):
+    """Headline mutation kill #1: deleting the all-gather from the ZeRO
+    fixture fails the STATIC_BUDGETS gate with DST007 named."""
+    script = tmp_path / "mutate.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu.analysis import shard_fixtures\n"
+        "shard_fixtures.ZERO1_ALL_GATHER = False\n"
+        "from mxnet_tpu.analysis.__main__ import main\n"
+        "sys.exit(main(['--cost', '--budget', %r]))\n"
+        % os.path.join(REPO, "STATIC_BUDGETS.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=300)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST007" in proc.stdout
+    assert "all_gather" in proc.stdout
+
+
+def test_cost001_unsharded_optimizer_state_fails_budget_gate_rc2(
+        tmp_path):
+    """Headline mutation kill #2: inflating the ZeRO step's optimizer
+    state back to replicated blows the pinned peak-HBM budget (and the
+    ZeRO-1 relation check) — COST001, exit 2."""
+    script = tmp_path / "mutate.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu.analysis import shard_fixtures\n"
+        "shard_fixtures.ZERO1_SHARD_STATE = False\n"
+        "from mxnet_tpu.analysis.__main__ import main\n"
+        "sys.exit(main(['--cost', '--budget', %r]))\n"
+        % os.path.join(REPO, "STATIC_BUDGETS.json"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=300)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "COST001" in proc.stdout
+    assert "zero1_mlp_train_step" in proc.stdout
+
+
+def test_dst008_overlapping_subaxis_reduction_rc2(tmp_path):
+    proc = _run_script(tmp_path, """
+        def bad(w, x):
+            g = jax.grad(lambda w: (x @ w).sum())(w)
+            g = lax.psum(g, "data")
+            g = lax.psum(g, ("data", "model"))   # overlaps the first
+            return w - 0.1 * g
+        closed = jax.make_jaxpr(
+            bad, axis_env=[("data", 8), ("model", 4)])(
+            jax.ShapeDtypeStruct((16, 4), jnp.float32),
+            jax.ShapeDtypeStruct((8, 16), jnp.float32))
+        findings = sp.lint_sharded_step(
+            closed, sp.MeshSpec({"data": 8, "model": 4}),
+            varying_invars=[1], param_outvars=[0], param_names=["w"])
+    """)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST008" in proc.stdout
+
+
+def test_dst009_broken_ring_rc2(tmp_path):
+    proc = _run_script(tmp_path, """
+        K = 8
+        def bad(x):
+            perm = [(i, (i + 1) % K) for i in range(K)]
+            def hop(c, _):
+                return lax.ppermute(c, "sequence", perm), ()
+            out, _ = lax.scan(hop, x, jnp.arange(K - 1))  # a hop short
+            return out
+        closed = jax.make_jaxpr(bad, axis_env=[("sequence", K)])(
+            jax.ShapeDtypeStruct((8, 8), jnp.float32))
+        findings = sp.lint_ring_schedule(closed, "sequence", K)
+    """)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST009" in proc.stdout
+    assert "ring formula" in proc.stdout
+
+
+def test_dst009_partial_perm_rc2(tmp_path):
+    proc = _run_script(tmp_path, """
+        K = 8
+        def bad(x):
+            perm = [(i, (i + 1) % K) for i in range(K - 1)]  # no ring
+            def hop(c, _):
+                return lax.ppermute(c, "sequence", perm), ()
+            out, _ = lax.scan(hop, x, jnp.arange(K))
+            return out
+        closed = jax.make_jaxpr(bad, axis_env=[("sequence", K)])(
+            jax.ShapeDtypeStruct((8, 8), jnp.float32))
+        findings = sp.lint_ring_schedule(closed, "sequence", K)
+    """)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST009" in proc.stdout
+
+
+def test_dst010_hidden_reshard_rc2(tmp_path):
+    proc = _run_script(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+        closed = jax.make_jaxpr(lambda a, b: a + b)(
+            jax.ShapeDtypeStruct((16, 16), jnp.float32),
+            jax.ShapeDtypeStruct((16, 16), jnp.float32))
+        findings, report = sp.lint_global_sharding(
+            closed, sp.MeshSpec({"data": 8, "model": 4}),
+            [P("model", None), P(None, "model")])
+        assert report.reshards, "expected a forced reshard"
+    """)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST010" in proc.stdout
+    assert "all_to_all" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# COST004: unpriced collectives are named, never silent
+# ---------------------------------------------------------------------------
+def test_cost004_undeclared_axis_is_named():
+    closed = jax.make_jaxpr(
+        lambda x: lax.ppermute(x, "sequence", [(0, 1), (1, 0)]),
+        axis_env=[("sequence", 2)])(jnp.zeros((1024,)))
+    # analyzed WITHOUT the axis declared: the ppermute would price at
+    # zero — the report must name it and COST004 must fire
+    report = mxcost.analyze_jaxpr(closed)
+    assert report.collective_bytes == 0
+    rows = report.as_dict()["unpriced_collectives"]
+    assert rows == [{"prim": "ppermute", "axis": "sequence",
+                     "reason": "axis size undeclared"}]
+    findings = mxcost.unpriced_findings(report, subject="t")
+    assert rules(findings) == ["COST004"]
+    # declared: priced, nothing unpriced
+    priced = mxcost.analyze_jaxpr(closed, axis_sizes={"sequence": 2})
+    assert priced.collective_bytes == 1024 * 4
+    assert priced.as_dict()["unpriced_collectives"] == []
+
+
+def test_cost004_axis_local_primitives_not_flagged():
+    closed = jax.make_jaxpr(
+        lambda x: x + lax.axis_index("data"),
+        axis_env=[("data", 8)])(jnp.zeros((4,), jnp.int32))
+    report = mxcost.analyze_jaxpr(closed)
+    assert report.as_dict()["unpriced_collectives"] == []
+
+
+def test_psum_of_literal_is_axis_arithmetic_not_collective():
+    """lax.psum(1, axis) — the axis-size idiom all over ring attention
+    — must neither price as a collective nor trip the dead-reduction
+    rule."""
+    k = 8
+    closed = jax.make_jaxpr(
+        lambda x: x * lax.psum(1, "sequence"),
+        axis_env=[("sequence", k)])(jnp.zeros((4,), jnp.int32))
+    report = sp.collective_schedule(closed, sp.MeshSpec({"sequence": k}))
+    assert report.schedule == []
+    findings = sp.lint_sharded_step(
+        closed, sp.MeshSpec({"sequence": k}), data_axes=("sequence",),
+        varying_invars=[0], param_outvars=[])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# CLI / schema / tooling wiring
+# ---------------------------------------------------------------------------
+def test_shard_cli_json_section():
+    proc = _run_cli("--cost", "--shard", "--json", "--model",
+                    "zero1_mlp_train_step,ring_attention_fwd")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["schema_version"] == 3
+    shard = payload["shard"]
+    assert shard["rules"] == ["DST006", "DST007", "DST008", "DST009",
+                              "DST010", "COST004"]
+    z = shard["reports"]["zero1_mlp_train_step"]
+    assert z["mesh"] == {"data": 8}
+    assert [e["prim"] for e in z["schedule"]][:2] == \
+        ["reduce_scatter", "all_gather"]
+    assert z["extras"]["modeled_zero1_hbm_drop_pct"] > 0
+    r = shard["reports"]["ring_attention_fwd"]
+    assert r["extras"]["modeled_ring_attn_collective_bytes"] == \
+        r["extras"]["ring_formula_bytes"]
+    # without --shard the section is absent (pre-3 consumers unaffected)
+    proc = _run_cli("--cost", "--json", "--model", "mlp_infer")
+    assert "shard" not in json.loads(proc.stdout)
+
+
+def test_parse_log_reads_and_refuses_analysis_schema(tmp_path):
+    """tools/parse_log.py understands the v3 analysis JSON and refuses
+    newer schema_versions (the regression twin of the telemetry-JSON
+    refusal test in test_telemetry.py)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    doc = {"version": 1, "schema_version": 3, "findings": [
+        {"rule": "DST007", "severity": "error", "subject": "w1",
+         "message": "m"}],
+        "cost": {"m": {"flops": 10, "collective_bytes": 3}},
+        "shard": {"reports": {"m": {"collective_bytes": 3,
+                                    "n_collectives": 1,
+                                    "extras": {"x": 2.5}}}}}
+    rows = parse_log.parse_analysis_json(doc)
+    names = [n for n, _ in rows]
+    assert 'finding.DST007{subject="w1"}' in names
+    assert "cost.m.flops" in names and "shard.m.x" in names
+    with pytest.raises(ValueError, match="newer"):
+        parse_log.parse_analysis_json(dict(doc, schema_version=99))
+    # end to end through the CLI: a v4 document is refused (rc != 0)
+    newer = tmp_path / "newer.json"
+    newer.write_text(json.dumps(dict(doc, schema_version=4)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         str(newer)], capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "newer" in (proc.stderr + proc.stdout)
+
+
+def test_bench_compare_gates_modeled_shard_metrics(tmp_path):
+    """The two static_cost keys gate from their first two live rounds:
+    a shrinking ZeRO drop (higher-direction) and growing ring bytes
+    (lower_rel) both regress."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_compare
+    finally:
+        sys.path.pop(0)
+
+    def rec(n, **parsed):
+        p = tmp_path / ("BENCH_r%02d.json" % n)
+        p.write_text(json.dumps({"n": n, "cmd": "bench", "rc": 0,
+                                 "tail": "", "parsed": parsed}))
+        return str(p)
+
+    ok = [rec(6, modeled_zero1_hbm_drop_pct=31.3,
+              modeled_ring_attn_collective_bytes=3145728),
+          rec(7, modeled_zero1_hbm_drop_pct=31.3,
+              modeled_ring_attn_collective_bytes=3145728)]
+    report = bench_compare.compare(ok)
+    assert report["regressions"] == []
+    assert report["gates"]["modeled_ring_attn_collective_bytes"][
+        "verdict"] == "ok"
+
+    bad = ok + [rec(8, modeled_zero1_hbm_drop_pct=20.0,
+                    modeled_ring_attn_collective_bytes=4000000)]
+    report = bench_compare.compare(bad)
+    assert set(report["regressions"]) == {
+        "modeled_zero1_hbm_drop_pct",
+        "modeled_ring_attn_collective_bytes"}
+
+
+def test_shard_self_check_sweeps_clean():
+    """What --self-check runs: golden mini-fixtures + the shipped
+    ring/Ulysses paths lint clean under the new rules (currently with
+    zero inline disables)."""
+    from mxnet_tpu.analysis import lint_parallel_sources, shard_self_check
+    assert shard_self_check() == []
+    assert lint_parallel_sources() == []

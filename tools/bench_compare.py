@@ -29,6 +29,10 @@ Gated metrics (direction, tolerance)::
     bf16_infer_imgs_per_sec            higher, 10% relative
     telemetry_overhead_pct             lower, +0.5 absolute slack
     checkpoint_overhead_pct            lower, +2.0 absolute slack
+    modeled_zero1_hbm_drop_pct         higher, 2% relative (modeled:
+                                       deterministic, so near-zero slack)
+    modeled_ring_attn_collective_bytes lower, 2% relative (growing ring
+                                       traffic is the regression)
 
 A metric with fewer than two live occurrences has no prior bar and
 passes vacuously (the r01–r05 lineage: ``value`` is live in r01+r02,
@@ -54,7 +58,8 @@ import sys
 # metric -> (direction, tolerance).  "higher": newest >= best * (1 - tol)
 # (relative).  "lower_abs": newest <= best + tol (absolute slack — the
 # overhead percentages live near zero, where relative tolerance is
-# meaningless).
+# meaningless).  "lower_rel": newest <= best * (1 + tol) (relative, for
+# byte counts where down is good and zero is unreachable).
 GATES = {
     "value": ("higher", 0.10),
     "pipeline_fed_imgs_per_sec": ("higher", 0.10),
@@ -66,6 +71,12 @@ GATES = {
     "bf16_infer_imgs_per_sec": ("higher", 0.10),
     "telemetry_overhead_pct": ("lower_abs", 0.5),
     "checkpoint_overhead_pct": ("lower_abs", 2.0),
+    # modeled (hardware-free) numbers from the static_cost stage: fully
+    # deterministic, so the slack is only there for intentional
+    # regenerations a PR ships alongside (r06 onward — no prior bar in
+    # the r01-r05 lineage, so they gate vacuously until then)
+    "modeled_zero1_hbm_drop_pct": ("higher", 0.02),
+    "modeled_ring_attn_collective_bytes": ("lower_rel", 0.02),
 }
 
 _RECORD_KEYS = ("n", "cmd", "rc", "parsed")
@@ -166,6 +177,10 @@ def compare(paths, gates=None, tolerance_scale=1.0):
             best_rnd, _, best = max(prior, key=lambda h: h[2])
             allowed = best * (1.0 - tol)
             ok = newest >= allowed
+        elif direction == "lower_rel":
+            best_rnd, _, best = min(prior, key=lambda h: h[2])
+            allowed = best * (1.0 + tol)
+            ok = newest <= allowed
         else:  # lower_abs
             best_rnd, _, best = min(prior, key=lambda h: h[2])
             allowed = best + tol
@@ -197,7 +212,7 @@ def render(report):
                         g["allowed"], g["best_prior"],
                         g["best_prior_round"],
                         ("%.0f%%" % (100 * g["tolerance"])
-                         if g["direction"] == "higher"
+                         if g["direction"] in ("higher", "lower_rel")
                          else "+%.2g abs" % g["tolerance"])))
     if report["regressions"]:
         lines.append("REGRESSION in: %s"

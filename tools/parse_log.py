@@ -9,7 +9,13 @@ Two input shapes:
   ``tools/launch.py --metrics-json`` and ``telemetry.dump_metrics``
   write; detected by its ``schema_version`` key) -> one
   ``metric{labels}\tvalue`` row per sample, histograms expanded into
-  p50/p99/count/sum rows.
+  p50/p99/count/sum rows;
+- an analysis-CLI JSON (``python -m mxnet_tpu.analysis --json``;
+  detected by its ``findings`` + ``schema_version`` keys) -> one row
+  per finding plus ``cost.<model>.<metric>`` / ``shard.<model>.*``
+  rows.  A ``schema_version`` newer than this parser understands is
+  refused — the version IS the compatibility contract; a silent
+  misparse of a gate document would be worse than an error.
 """
 from __future__ import annotations
 
@@ -20,6 +26,9 @@ import sys
 
 # the newest metrics-JSON schema this parser understands
 METRICS_SCHEMA_VERSION = 1
+# the newest analysis-CLI (--json) schema this parser understands
+# (3 = the mxshard "shard" section; see docs/analysis.md)
+ANALYSIS_SCHEMA_VERSION = 3
 
 
 def parse(lines):
@@ -77,6 +86,41 @@ def parse_metrics_json(doc):
     return rows
 
 
+def parse_analysis_json(doc):
+    """Analysis-CLI ``--json`` document -> [(name, value-or-text)] rows.
+    Raises ValueError when ``schema_version`` is newer than
+    ``ANALYSIS_SCHEMA_VERSION`` (refuse, never misparse)."""
+    version = doc.get("schema_version")
+    if version is None:
+        raise ValueError("not an analysis JSON (no schema_version)")
+    if version > ANALYSIS_SCHEMA_VERSION:
+        raise ValueError(
+            "analysis schema_version %s is newer than this parser "
+            "understands (%s) — update tools/parse_log.py"
+            % (version, ANALYSIS_SCHEMA_VERSION))
+    rows = []
+    for f in doc.get("findings", []):
+        rows.append(("finding.%s{subject=\"%s\"}"
+                     % (f.get("rule"), f.get("subject")),
+                     f.get("severity", "")))
+    for model, rep in sorted(doc.get("cost", {}).items()):
+        for metric in ("flops", "transcendentals", "transfer_bytes",
+                       "peak_hbm_bytes", "collective_bytes"):
+            if metric in rep:
+                rows.append(("cost.%s.%s" % (model, metric),
+                             rep[metric]))
+    shard = doc.get("shard", {})
+    for model, rep in sorted(shard.get("reports", {}).items()):
+        rows.append(("shard.%s.collective_bytes" % model,
+                     rep.get("collective_bytes", 0)))
+        rows.append(("shard.%s.n_collectives" % model,
+                     rep.get("n_collectives", 0)))
+        for k, v in sorted(rep.get("extras", {}).items()):
+            if isinstance(v, (int, float)):
+                rows.append(("shard.%s.%s" % (model, k), v))
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("logfile", nargs="?", default="-")
@@ -88,8 +132,19 @@ def main():
             text = f.read()
     stripped = text.lstrip()
     if stripped.startswith("{"):
-        # telemetry metrics JSON (fit/launch dump), not a training log
+        # a versioned JSON document, not a training log: the analysis
+        # CLI output carries a findings list, the telemetry metrics
+        # dump a metrics map — both refuse newer schema_versions
         doc = json.loads(stripped)
+        if "findings" in doc:
+            rows = parse_analysis_json(doc)
+            print("# source=mxnet_tpu.analysis schema_version=%s"
+                  % doc.get("schema_version"))
+            for name, value in rows:
+                print("%s\t%s" % (
+                    name, "%.6g" % value
+                    if isinstance(value, (int, float)) else value))
+            return
         rows = parse_metrics_json(doc)
         print("# source=%s schema_version=%s"
               % (doc.get("source", "?"), doc.get("schema_version")))
