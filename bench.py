@@ -363,6 +363,16 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_TELEMETRY", "1") == "1":
         rec.stage("telemetry", 150, _telemetry_bench)
 
+    # -- mlops micro-bench, host-only and BEFORE backend acquisition
+    # (r05 pattern): simulator_accuracy_pct (fleet simulator vs the real
+    # host serving path, <= 15% error tolerance), promotion_decision_ms
+    # (one full canary-judge tick) and capacity_replicas_for_1m_dau (the
+    # pinned deterministic capacity answer) stay live when the TPU is
+    # down — the production loop's own numbers must never starve behind
+    # backend acquisition
+    if os.environ.get("MXTPU_BENCH_MLOPS", "1") == "1":
+        rec.stage("mlops", 150, _mlops_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -619,6 +629,28 @@ def _telemetry_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("telemetry bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _mlops_bench():
+    """simulator_accuracy_pct (discrete-event fleet simulator vs the
+    real host serving path under the parked-burst scenario),
+    promotion_decision_ms (a real train->canary->promote cycle's
+    terminal decision tick) and capacity_replicas_for_1m_dau (the
+    pinned deterministic capacity computation) through
+    mxnet_tpu/mlops/bench.py.  JAX_PLATFORMS=cpu subprocess — same
+    isolation contract as the serving/pipeline/cost stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.mlops.bench"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("mlops bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

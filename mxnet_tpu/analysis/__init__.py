@@ -27,6 +27,7 @@ from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
 from .source_lint import lint_source, lint_file
 from .serving_lint import (lint_serving, lint_fleet_hbm,
                            lint_deadline_propagation)
+from .mlops_lint import lint_wallclock_reads, lint_promotion_sources
 from .telemetry_lint import (lint_chaos_sites, probe_sites_used,
                              lint_attribution_phases,
                              attribution_phases_used,
@@ -47,6 +48,7 @@ __all__ = [
     "lint_registry", "lint_graph", "lint_source", "lint_file",
     "lint_symbol", "lint_serving", "lint_fleet_hbm",
     "lint_deadline_propagation", "lint_serving_sources",
+    "lint_wallclock_reads", "lint_promotion_sources",
     "lint_rule_docs", "self_check",
     "lint_shipped_loops", "lint_worker_loops",
     "lint_chaos_sites", "probe_sites_used", "lint_attribution_phases",
@@ -76,14 +78,16 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 
 def self_check(disable=(), with_coverage=True, with_cost=True,
                with_examples=True, with_workers=True, with_serving=True,
-               with_telemetry=True, with_shard=True):
+               with_telemetry=True, with_shard=True, with_mlops=True):
     """Registry lint over the live registry, the rule-table docs sync
     check, the cost-pass determinism check, the SRC004 sweep over the
     shipped training loops, the SRC005 sweep over the shipped worker
     loops, the SRV004 deadline-propagation sweep over the shipped
-    serving request paths, the telemetry sweeps — TEL001 chaos-probe
-    sites and TEL002 attribution phases — and the mxshard sweeps: the
-    golden sharded-step fixtures must lint clean and deterministically
+    serving request paths, the SRV005 wall-clock sweep over the
+    promotion/capacity decision path (``mlops/`` + the decision CLIs),
+    the telemetry sweeps — TEL001 chaos-probe sites and TEL002
+    attribution phases — and the mxshard sweeps: the golden sharded-step
+    fixtures must lint clean and deterministically
     (``shard_self_check``) and the shipped ring/Ulysses attention paths
     must pass the mixed-axis DST rules (``lint_parallel_sources``) —
     what CI runs.
@@ -102,6 +106,8 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += lint_worker_loops(disable=disable)
     if with_serving:
         findings += lint_serving_sources(disable=disable)
+    if with_mlops:
+        findings += lint_promotion_sources(disable=disable)
     if with_telemetry:
         findings += lint_chaos_sites(disable=disable)
         findings += lint_attribution_phases(disable=disable)

@@ -117,6 +117,12 @@ RULES = {
                       "request path binds deadline_ms but calls "
                       "submit()/infer() without propagating it (the "
                       "request can never be shed and rots in the queue)"),
+    "SRV005": (ERROR, "wall-clock read in the promotion/capacity decision "
+                      "path (time.time/monotonic/perf_counter, "
+                      "datetime.now, ...): promotion decisions must come "
+                      "from registry metrics and pinned schedules, or "
+                      "reruns stop being byte-identical and the audit "
+                      "trail stops being replayable"),
     # distributed-step pass (mxnet_tpu/analysis/dist_lint.py)
     "DST001": (ERROR, "a trainable parameter's gradient is never "
                       "psum/pmean-reduced over the data axis: replicas "

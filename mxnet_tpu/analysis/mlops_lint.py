@@ -1,0 +1,128 @@
+"""SRV005: promotion decisions come from registry metrics, never clocks.
+
+The promotion controller's whole value is that its decision sequence is
+*replayable*: the headline mlops test reruns a full train→canary→
+rollback cycle and byte-compares the audit trail.  One ``time.time()``
+in the decision path quietly breaks that — a ramp that advances "after
+30 seconds" instead of "after N canary requests" makes every rerun a
+different experiment, and an audit record stamped with wall-clock
+evidence can never be diffed.  The deterministic spine is: evidence =
+PR-9 registry metrics, ramp = pinned fraction schedule, traffic split =
+seeded hash.  This sweep keeps it that way structurally:
+
+- every ``mxnet_tpu/mlops/*.py`` file plus the decision CLIs
+  (``tools/promote.py``, ``tools/capacity.py``) is AST-scanned for
+  wall-clock reads: ``time.time/monotonic/perf_counter/process_time/
+  thread_time/monotonic_ns/time_ns/perf_counter_ns``, ``time.sleep``
+  (a sleep in a decision loop is a schedule-by-clock in disguise) and
+  ``datetime.now/utcnow/today/datetime.datetime.now``;
+- a finding is an ERROR; a *measurement* of the system under test (the
+  mlops bench timing the controller, a CLI's progress display) carries
+  an inline ``# mxlint: disable=SRV005`` with its justification — the
+  same escape hatch the SRC004 example sweeps use, visible in review.
+
+Wired into ``--self-check`` via ``lint_promotion_sources`` (the DOC001
+discipline: the rule row lives in docs/analysis.md).
+"""
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from .findings import Finding, filter_findings
+
+__all__ = ["lint_wallclock_reads", "lint_promotion_sources",
+           "WALLCLOCK_ATTRS"]
+
+# attribute names that read (or schedule by) the wall clock when called
+# on a time/datetime module or datetime class
+WALLCLOCK_ATTRS = frozenset({
+    "time", "monotonic", "perf_counter", "process_time", "thread_time",
+    "monotonic_ns", "time_ns", "perf_counter_ns", "sleep",
+    "now", "utcnow", "today",
+})
+
+# receivers the attribute must hang off for a confident match: bare
+# ``obj.now()`` on an arbitrary object is not a clock read, but
+# ``time.``/``datetime.``/``date.`` prefixed calls are
+_CLOCK_ROOTS = frozenset({"time", "datetime", "date"})
+
+
+def _line_suppressions(source):
+    """{lineno: rule ids} from trailing ``# mxlint: disable=...``."""
+    from .findings import _DISABLE_RE
+    out = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _DISABLE_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",")
+                      if r.strip()}
+    return out
+
+
+def _clock_root(node):
+    """The dotted root name of an attribute chain (``time`` in
+    ``time.perf_counter``, ``datetime`` in ``datetime.datetime.now``),
+    or None for computed receivers."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def lint_wallclock_reads(path=None, source=None):
+    """Scan one source file for wall-clock reads (see module docstring).
+    Pure AST; honors inline ``# mxlint: disable=SRV005`` per line."""
+    if source is None:
+        with open(path) as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path or "<string>")
+    except SyntaxError as e:
+        return [Finding("SRV005", path or "<string>",
+                        "source does not parse: %s" % e)]
+    suppressed = _line_suppressions(source)
+    subject = path or "<string>"
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr not in WALLCLOCK_ATTRS:
+            continue
+        root = _clock_root(node.func.value)
+        if root not in _CLOCK_ROOTS:
+            continue
+        if "SRV005" in suppressed.get(node.lineno, ()):
+            continue
+        out.append(Finding(
+            "SRV005", "%s:%d" % (subject, node.lineno),
+            "wall-clock call %s.%s() in the promotion/capacity decision "
+            "path — decisions must be driven by registry metrics and "
+            "pinned schedules so reruns replay byte-identically; if "
+            "this line only *measures* the system under test, mark it "
+            "with an inline `# mxlint: disable=SRV005` and say why"
+            % (root, attr)))
+    return out
+
+
+def lint_promotion_sources(disable=(), root=None):
+    """The SRV005 sweep ``--self-check`` runs: ``mxnet_tpu/mlops/*.py``
+    plus the decision CLIs (``tools/promote.py``, ``tools/capacity.py``).
+    Skipped silently outside a repo checkout (tools absent)."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    root = root or os.path.dirname(pkg)           # mxnet_tpu/
+    repo = os.path.dirname(root)
+    targets = sorted(glob.glob(os.path.join(root, "mlops", "*.py")))
+    for name in ("promote.py", "capacity.py"):
+        path = os.path.join(repo, "tools", name)
+        if os.path.isfile(path):
+            targets.append(path)
+    findings = []
+    for path in targets:
+        try:
+            findings += lint_wallclock_reads(os.path.normpath(path))
+        except OSError:
+            continue
+    return filter_findings(findings, disable)

@@ -1,0 +1,47 @@
+"""mxnet_tpu.mlops — the production loop, closed.
+
+Training produces checkpoints; serving hosts fleets; telemetry measures
+both.  This package is the control plane that connects them (ROADMAP
+item 5, the train/serve ecosystem of the TensorFlow system paper):
+
+- :mod:`.promote` — the **promotion controller**: watches a checkpoint
+  directory, ramps each new candidate onto a deterministic canary slice
+  of the live fleet's traffic (seeded hash split, pinned fraction
+  schedule), judges it from PR-9 registry metrics (tier p99 vs SLO,
+  shed rate, breaker state, golden-set output parity vs the incumbent)
+  and promotes or rolls back automatically — every decision a versioned
+  JSON audit record plus a flight-ring event.  CLI: ``tools/promote.py``.
+- :mod:`.simulator` — the **fleet capacity simulator**: a deterministic
+  discrete-event replay of seeded millions-of-users traffic (diurnal +
+  burst generators) against the *modeled* batcher/tier-shed/breaker/
+  degraded-mode policies, with service time from the PR-4 modeled cost,
+  validated against the real host serving bench within a documented
+  tolerance.  "How many replicas for 1M DAU at gold SLO?" becomes
+  :func:`~mxnet_tpu.mlops.simulator.required_replicas` — and
+  ``tools/capacity.py``.
+- :mod:`.bench` — the host-only bench stage (r05 subprocess pattern)
+  emitting ``simulator_accuracy_pct``, ``promotion_decision_ms`` and
+  ``capacity_replicas_for_1m_dau``, gated by ``tools/bench_compare.py``.
+
+Everything here is host-only (stdlib + the existing serving/resilience/
+telemetry tiers; jax only transitively through runners the caller
+builds), deterministic for a fixed seed, and free of wall-clock reads in
+the decision path — the SRV005 lint sweeps the package in
+``--self-check``.  See docs/mlops.md.
+"""
+from __future__ import annotations
+
+from .promote import (AUDIT_SCHEMA_VERSION, PromotionController,
+                      golden_parity, read_audit_records,
+                      runner_from_trainer_checkpoint)
+from .simulator import (FleetSimulator, SimConfig, SimReport, burst_trace,
+                        diurnal_trace, required_replicas,
+                        service_ms_from_modeled_cost, trace_for_dau)
+
+__all__ = [
+    "PromotionController", "AUDIT_SCHEMA_VERSION", "golden_parity",
+    "read_audit_records", "runner_from_trainer_checkpoint",
+    "FleetSimulator", "SimConfig", "SimReport", "burst_trace",
+    "diurnal_trace", "trace_for_dau", "required_replicas",
+    "service_ms_from_modeled_cost",
+]

@@ -1,0 +1,352 @@
+"""Host-only mlops micro-bench: ``python -m mxnet_tpu.mlops.bench``.
+
+Run by ``bench.py``'s ``mlops`` stage as a ``JAX_PLATFORMS=cpu``
+subprocess BEFORE backend acquisition (the r05 pattern), so the numbers
+stay live when the TPU is down.  Prints ONE JSON line:
+
+- ``simulator_accuracy_pct`` — fidelity of the discrete-event fleet
+  simulator vs the *real* host serving path: the same seeded burst is
+  run through a live Runner→Batcher and through
+  :class:`~mxnet_tpu.mlops.simulator.FleetSimulator` with service times
+  calibrated from a separate warmup measurement; accuracy =
+  ``100 - max relative error`` over reqs/sec and per-tier p99.  The
+  documented tolerance is <= 15 % error (accuracy >= 85), asserted
+  tier-1 in tests/test_mlops.py.
+- ``promotion_decision_ms`` — wall time of one full promotion decision
+  tick (golden parity + registry scrape + judge + audit write + hot
+  swap) on the terminal promote of a real train→canary→promote cycle.
+- ``capacity_replicas_for_1m_dau`` — the deterministic capacity answer:
+  replicas needed for 1M DAU at the pinned gold SLO under the pinned
+  service-time model (no measured inputs — byte-identical on any host,
+  which is what lets bench_compare gate it with near-zero slack).
+- ``simulator_events_per_sec`` — raw simulator throughput (how cheap a
+  capacity question is to ask).
+
+Wall-clock use in this file is measurement of the thing under test, not
+promotion decision logic — the inline SRV005 disables mark exactly those
+lines (the sweep keeps the rest of the package honest).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _build_runner(buckets=(1, 4, 16), feat=32, hidden=64, seed=0):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.serving import ModelRunner
+
+    mx.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(hidden, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return ModelRunner(net, buckets=buckets, example_shape=(feat,),
+                       warmup=True)
+
+
+def _calibrate_service_ms(runner, batch_timeout_ms=1.0, repeats=5):
+    """Measured per-bucket service time through a REAL batcher (median
+    of ``repeats``, coalescing window subtracted) — the calibration
+    input the simulator's validation contract allows: a *separate*
+    measurement of the same pipeline, never the run being predicted.
+    Going through the batcher (not bare ``forward_batch``) folds the
+    per-batch stack/split/stats overhead into the service time, which is
+    exactly what the simulated batches cost too."""
+    from mxnet_tpu.serving.batcher import Batcher
+
+    b = Batcher(runner, batch_timeout_ms=batch_timeout_ms, max_queue=512)
+    x = np.zeros(runner.example_shape, np.float32)
+    b.infer(x, timeout=30)   # warm the path outside any timed window
+    table = {}
+    for bucket in runner.buckets:
+        if bucket == runner.max_batch:
+            continue   # calibrated under load below
+        # a partial bucket waits out the full coalescing window before
+        # executing; subtract it
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()  # mxlint: disable=SRV005 — calibration measurement
+            futs = [b.submit(x) for _ in range(bucket)]
+            for f in futs:
+                f.result(30)
+            dt = (time.perf_counter() - t0) * 1e3  # mxlint: disable=SRV005
+            times.append(max(dt - batch_timeout_ms, 0.01))
+        table[bucket] = sorted(times)[len(times) // 2]
+    # the max bucket — what a sustained burst actually runs — is
+    # calibrated under a deep queue (8 back-to-back full batches), so
+    # submit-thread GIL contention and deep-heap admission costs land in
+    # the figure exactly as they do in the predicted run
+    n_cal = 8 * runner.max_batch
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()  # mxlint: disable=SRV005 — calibration measurement
+        futs = [b.submit(x) for _ in range(n_cal)]
+        for f in futs:
+            f.result(30)
+        dt = (time.perf_counter() - t0) * 1e3  # mxlint: disable=SRV005
+        times.append(max((dt - batch_timeout_ms) / 8.0, 0.01))
+    table[runner.max_batch] = sorted(times)[len(times) // 2]
+    b.drain()
+    return table
+
+
+def _parked_burst(runner, n_requests, batch_timeout_ms=1.0):
+    """One real bench-fleet run in the parked-worker pattern the fleet
+    chaos tests pin (deterministic structure on a 1-core host): the
+    worker is gated inside a primer batch, the whole tiered burst queues
+    behind it, the gate opens, and the backlog drains in (tier,
+    deadline, arrival) order.  Returns ``(arrivals, free_ms, report)``
+    where ``free_ms`` is when the server came free for the backlog —
+    the instant the simulator models via ``server_free_at_ms``."""
+    import threading
+
+    from mxnet_tpu.serving.batcher import Batcher
+
+    gate = threading.Event()
+    released = [None]
+    orig = runner.forward_batch
+    first = [True]
+
+    def gated(x):
+        if first[0]:
+            first[0] = False
+            gate.wait(60)
+            out = orig(x)
+            released[0] = time.perf_counter()  # mxlint: disable=SRV005 — measuring the real run
+            return out
+        return orig(x)
+
+    runner.forward_batch = gated
+    try:
+        batcher = Batcher(runner, batch_timeout_ms=batch_timeout_ms,
+                          max_queue=max(1024, n_requests))
+        rng = np.random.RandomState(0)
+        examples = rng.rand(64, runner.example_shape[0]) \
+            .astype(np.float32)
+        tiers = ["gold", "silver", "bronze"]
+        t0 = time.perf_counter()  # mxlint: disable=SRV005 — measuring the real run
+        batcher.submit(examples[0], tier="gold")   # the parked primer
+        deadline = t0 + 30.0
+        while batcher._batch_started is None:
+            if time.perf_counter() > deadline:  # mxlint: disable=SRV005 — watchdog on the real run
+                raise RuntimeError("worker never parked in the primer")
+            time.sleep(0.0005)  # mxlint: disable=SRV005 — polling the real run
+        arrivals = []
+        for i in range(n_requests):
+            tier = tiers[i % 3]
+            t_sub = (time.perf_counter() - t0) * 1e3  # mxlint: disable=SRV005
+            batcher.submit(examples[0], tier=tier)
+            arrivals.append((t_sub, tier, None))
+        gate.set()
+        batcher.drain(timeout=240)
+        t_end = time.perf_counter()  # mxlint: disable=SRV005 — measuring the real run
+        free_ms = (released[0] - t0) * 1e3
+        drain_ms = (t_end - released[0]) * 1e3
+        report = {
+            "free_ms": free_ms,
+            "batches": batcher.stats.batches_total - 1,   # minus primer
+            "drain_ms": drain_ms,
+            "reqs_per_sec": n_requests / (drain_ms / 1e3),
+            "tiers": {t: batcher.stats.tier_latency_ms(t)
+                      for t in tiers},
+        }
+        return arrivals, free_ms, report
+    finally:
+        runner.forward_batch = orig
+
+
+def _validate_pair(runner, partial, n_requests, buckets):
+    """One tightly-paired (calibrate, predict) round: a calibration
+    burst immediately followed by the predicted burst, so host drift
+    hits both sides of the pair equally.  Returns the error dict."""
+    from mxnet_tpu.mlops.simulator import FleetSimulator, SimConfig
+
+    _, _, cal = _parked_burst(runner, n_requests)
+    table = dict(partial)
+    table[runner.max_batch] = cal["drain_ms"] / max(1, cal["batches"])
+    arrivals, free_ms, real = _parked_burst(runner, n_requests)
+    cfg = SimConfig(service_ms=lambda bucket: table[bucket],
+                    buckets=buckets, batch_timeout_ms=1.0,
+                    max_queue=max(1024, n_requests))
+    sim = FleetSimulator(cfg, replicas=1).run(
+        arrivals, server_free_at_ms=free_ms)
+    # sim reqs/sec over the drain span (release -> last completion), the
+    # same denominator the real report uses
+    t0 = min(t for t, _, _ in arrivals)
+    sim_drain_ms = (sim["span_ms"] + t0) - free_ms
+    sim_rps = n_requests / (sim_drain_ms / 1e3)
+    errs = {"reqs_per_sec": abs(sim_rps - real["reqs_per_sec"])
+            / max(real["reqs_per_sec"], 1e-9)}
+    for tier in ("gold", "silver", "bronze"):
+        sim_p99 = sim["tiers"].get(tier, {}).get("p99_ms", 0.0)
+        real_p99 = real["tiers"][tier][1]
+        errs["%s_p99" % tier] = abs(sim_p99 - real_p99) \
+            / max(real_p99, 1e-9)
+    return errs, real, sim_rps
+
+
+def simulator_validation(n_requests=240, buckets=(1, 4, 16), feat=64,
+                         hidden=256, repeats=5):
+    """Real parked bursts vs their simulation; returns the accuracy
+    keys.
+
+    ``repeats`` tightly-interleaved (calibration burst, predicted
+    burst) pairs of the identical workload: each calibration run sets
+    the per-batch service time ((drain wall) / batches — contention and
+    batcher overhead included) and the run right after it is predicted.
+    The reported accuracy is the MEDIAN pair's (the repo's interleaved
+    min/median-of-N discipline: a single load spike on a 1-core CI host
+    would otherwise poison one side of one pair and read as simulator
+    error).  Accuracy is judged on reqs/sec and per-tier p99
+    (documented tolerance: every error <= 15 %)."""
+    runner = _build_runner(buckets=buckets, feat=feat, hidden=hidden)
+    partial = _calibrate_service_ms(runner, batch_timeout_ms=1.0)
+    pairs = [_validate_pair(runner, partial, n_requests, buckets)
+             for _ in range(int(repeats))]
+    pairs.sort(key=lambda p: max(p[0].values()))
+    errs, real, sim_rps = pairs[len(pairs) // 2]   # the median pair
+    worst = max(errs, key=lambda k: errs[k])
+    return {
+        "simulator_accuracy_pct": round(100.0 * (1.0 - errs[worst]), 2),
+        "simulator_worst_metric": worst,
+        "simulator_real_reqs_per_sec": round(real["reqs_per_sec"], 2),
+        "simulator_sim_reqs_per_sec": round(sim_rps, 2),
+        "simulator_errors_pct": {k: round(100 * v, 2)
+                                 for k, v in sorted(errs.items())},
+        "simulator_pair_accuracies_pct": [
+            round(100.0 * (1.0 - max(e.values())), 2)
+            for e, _, _ in pairs],
+    }
+
+
+def promotion_cycle(feat=16):
+    """A real train→checkpoint→canary→promote cycle; returns the
+    decision-latency key (the terminal promote tick, measured)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.mlops import (PromotionController,
+                                 runner_from_trainer_checkpoint)
+    from mxnet_tpu.parallel import DataParallelTrainer
+    from mxnet_tpu.serving import ModelFleet
+
+    def build_net():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+        return net
+
+    def train(seed, steps, ckdir, run_id):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        net = build_net()
+        net.initialize(mx.init.Xavier())
+        trainer = DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.05}, run_id=run_id)
+        rng = np.random.RandomState(seed)
+        for i in range(steps):
+            trainer.step(mx.nd.array(rng.rand(8, feat).astype(np.float32)),
+                         mx.nd.array(rng.randint(0, 4, 8).astype(np.int64)))
+        trainer.flush()
+        trainer.save_checkpoint(ckdir, epoch=0, nbatch=steps)
+
+    root = tempfile.mkdtemp(prefix="mxtpu_mlops_bench_")
+    try:
+        ck_inc = os.path.join(root, "incumbent")
+        ck_watch = os.path.join(root, "watch")
+        train(0, 2, ck_inc, "bench-incumbent")
+
+        def factory(path, rec):
+            return runner_from_trainer_checkpoint(
+                rec, build_net, example_shape=(feat,), buckets=(1, 4))
+
+        from mxnet_tpu.resilience.checkpoint import latest_checkpoint
+        inc_runner, _ = factory(*latest_checkpoint(ck_inc))
+        fleet = ModelFleet(batch_timeout_ms=0.5)
+        fleet.register("model", inc_runner,
+                       tier_slos={"gold": 10000.0},
+                       service_time_hint_ms=5.0)
+        rng = np.random.RandomState(1)
+        golden = rng.rand(16, feat).astype(np.float32)
+        ctrl = PromotionController(
+            fleet, "model", ck_watch, factory, golden=golden,
+            audit_dir=os.path.join(root, "audit"),
+            schedule=(0.5,), min_stage_requests=8,
+            # one optimizer step apart: high-but-not-total parity is
+            # expected; the bench judges decision latency, not the model
+            parity_threshold=0.5,
+            register_kwargs={"service_time_hint_ms": 5.0})
+        train(0, 3, ck_watch, "bench-candidate")
+        ctrl.poll()
+        X = rng.rand(64, feat).astype(np.float32)
+        for i in range(64):
+            fleet.infer(X[i % 64], model="model", request_id=i, timeout=30)
+        t0 = time.perf_counter()  # mxlint: disable=SRV005 — measuring the controller under test
+        rec = ctrl.evaluate()
+        decision_ms = (time.perf_counter() - t0) * 1e3  # mxlint: disable=SRV005
+        fleet.drain()
+        ok = rec is not None and rec["decision"]["decision"] == "promote"
+        return {
+            "promotion_decision_ms": round(decision_ms, 3),
+            "promotion_cycle_ok": bool(ok),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# the pinned capacity scenario: 1M DAU, 20 requests/user/day, diurnal
+# peak 2x, judged on a 20 s crest window; service model pinned (32 ms
+# per max bucket of 8) so the answer is byte-identical on any host
+CAPACITY_DAU = 1_000_000
+CAPACITY_GOLD_SLO_MS = 250.0
+_CAPACITY_SERVICE_MS = {1: 8.0, 4: 18.0, 8: 32.0}
+
+
+def capacity_answer():
+    from mxnet_tpu.mlops.simulator import (SimConfig, required_replicas,
+                                           trace_for_dau)
+
+    cfg = SimConfig(service_ms=lambda b: _CAPACITY_SERVICE_MS[b],
+                    buckets=(1, 4, 8), batch_timeout_ms=2.0,
+                    max_queue=128)
+    trace = trace_for_dau(CAPACITY_DAU, window_s=20.0, seed=0,
+                          deadlines_ms={"gold": CAPACITY_GOLD_SLO_MS,
+                                        "silver": 400.0, "bronze": 150.0})
+    t0 = time.perf_counter()  # mxlint: disable=SRV005 — measuring simulator throughput
+    replicas, report = required_replicas(
+        cfg, trace, slo_tier="gold", slo_p99_ms=CAPACITY_GOLD_SLO_MS,
+        max_shed_rate=0.0)
+    dt = time.perf_counter() - t0  # mxlint: disable=SRV005
+    return {
+        "capacity_replicas_for_1m_dau": replicas,
+        "capacity_trace_arrivals": report["arrivals"],
+        "capacity_gold_p99_ms": report["tiers"]["gold"]["p99_ms"],
+        "simulator_events_per_sec": round(report["arrivals"]
+                                          / max(dt, 1e-9), 1),
+    }
+
+
+def main():
+    out = {}
+    out.update(simulator_validation())
+    out.update(promotion_cycle())
+    out.update(capacity_answer())
+    print(json.dumps(out), flush=True)
+    # the stage contract: the cycle promoted and the simulator held its
+    # documented <= 15 % tolerance
+    ok = out.get("promotion_cycle_ok") \
+        and out.get("simulator_accuracy_pct", 0) >= 85.0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

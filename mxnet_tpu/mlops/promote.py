@@ -1,0 +1,441 @@
+"""Promotion controller: train→canary→serve, closed automatically.
+
+The missing wire between three finished subsystems (ROADMAP item 5, the
+train/serve ecosystem loop of the TensorFlow system paper, arxiv
+1605.08695): PR-6 checkpoints land in a directory, the PR-8 fleet can
+hot-swap models under drain, and the PR-9 registry already measures
+everything — but a new checkpoint still reached traffic by hand.  This
+controller closes the loop:
+
+1. **watch** — :meth:`PromotionController.poll` scans the checkpoint
+   directory; a snapshot whose provenance digest differs from the
+   incumbent's (and from every digest already judged) becomes the
+   *candidate*;
+2. **canary** — the candidate is loaded (``runner_factory``), registered
+   beside the incumbent and armed as a deterministic traffic split
+   (``ModelFleet.set_canary``: seeded hash of the request id, fraction
+   ramped along the pinned ``schedule`` — never by wall clock);
+3. **judge** — each :meth:`evaluate` tick reads its evidence from the
+   PR-9 metrics registry (canary tier p99 vs the declared SLO, canary
+   shed rate, breaker state) plus output parity vs the incumbent on a
+   pinned *golden request set* (computed, published to the registry,
+   then read back like every other metric — the SRV005 lint pins this:
+   no wall-clock reads anywhere in the decision path);
+4. **decide** — all checks green with enough canary traffic advances the
+   ramp; green at the final stage **promotes** (hot swap under drain,
+   canary deregistered); any red check **rolls back** (split cleared,
+   candidate deregistered, digest remembered so a bad checkpoint is
+   never retried).
+
+Every decision writes a versioned JSON audit record
+(``audit-<seq>.json``, schema pinned by :data:`AUDIT_SCHEMA_VERSION`)
+carrying the decision, the failed metric (if any), both checkpoint
+digests and the full evidence — plus a flight-ring event
+(``mlops.promotion``) and a registry counter.  The decision-relevant
+subset (:meth:`decisions`) is deterministic by construction: the
+headline chaos test replays a full train→canary→rollback sequence twice
+and byte-compares it.
+
+Chaos probe site: ``mlops.decision`` fires at the top of every evaluate
+tick (count = tick ordinal, ctx = (model, state)) so fault schedules can
+kill or stall the controller at any decision boundary.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..resilience import chaos as _chaos
+from ..resilience import checkpoint as _ckpt
+from ..serving.fleet import DEFAULT_CANARY_SCHEDULE
+
+__all__ = ["PromotionController", "AUDIT_SCHEMA_VERSION", "golden_parity",
+           "runner_from_trainer_checkpoint", "read_audit_records"]
+
+# bump when the audit-record layout changes; readers refuse newer
+AUDIT_SCHEMA_VERSION = 1
+
+# default pinned golden set size (overridable per controller)
+DEFAULT_GOLDEN_N = 32
+
+
+def golden_parity(incumbent_runner, candidate_runner, golden):
+    """Output parity of two runners on the pinned golden request set:
+    the fraction of rows whose argmax agrees (multi-output heads), or
+    whose values agree within 1e-3 relative (scalar heads).  Pure
+    function of the two parameter sets and the golden bytes — the same
+    checkpoints always score the same parity."""
+    a = _np.asarray(incumbent_runner.forward_batch(golden))
+    b = _np.asarray(candidate_runner.forward_batch(golden))
+    if a.ndim >= 2 and a.shape[-1] > 1:
+        agree = _np.argmax(a, axis=-1) == _np.argmax(b, axis=-1)
+    else:
+        agree = _np.isclose(a, b, rtol=1e-3, atol=1e-5).reshape(len(a), -1) \
+            .all(axis=1)
+    return float(_np.mean(agree))
+
+
+def runner_from_trainer_checkpoint(path_or_record, net_builder,
+                                   example_shape, buckets=(1, 4, 16),
+                                   dtype="float32", **runner_kwargs):
+    """Build a serving :class:`ModelRunner` from a trainer ``.mxckpt``
+    snapshot: ``net_builder()`` reconstructs the architecture (a fresh
+    hybridizable Gluon block), checkpoint params map onto it positionally
+    with shape checks (the trainer's gensym-shift discipline), and the
+    checkpoint's provenance rides the runner into fleet ``/stats``.
+    Returns ``(runner, provenance_dict)``."""
+    from ..serving.runner import ModelRunner
+
+    if isinstance(path_or_record, dict):
+        rec = path_or_record
+    else:
+        rec = _ckpt.load_checkpoint(path_or_record)
+    payload = rec["payload"]
+    net = net_builder()
+    params = net.collect_params()
+    names_ckpt = list(payload["params"])
+    names_net = list(params.keys())
+    if len(names_ckpt) != len(names_net):
+        raise MXNetError(
+            "checkpoint has %d params, net_builder() built %d — "
+            "different architecture" % (len(names_ckpt), len(names_net)))
+    for cn, nn in zip(names_ckpt, names_net):
+        value = _ckpt.decode_array(payload["params"][cn])
+        p = params[nn]
+        # deferred dims show as 0: only fully-known shapes are checked
+        # (set_data adopts the checkpoint shape into deferred params)
+        if p.shape is not None and 0 not in tuple(p.shape) \
+                and tuple(p.shape) != tuple(value.shape):
+            raise MXNetError(
+                "checkpoint param %r %r does not fit net param %r %r"
+                % (cn, tuple(value.shape), nn, tuple(p.shape)))
+        p.set_data(_np.asarray(value, dtype=p.dtype or value.dtype))
+    net.hybridize()
+    runner = ModelRunner(net, buckets=buckets, example_shape=example_shape,
+                         dtype=dtype, provenance=_ckpt.provenance(rec),
+                         **runner_kwargs)
+    return runner, _ckpt.provenance(rec)
+
+
+def read_audit_records(audit_dir):
+    """Load every audit record in ``audit_dir`` ascending by seq,
+    refusing records written by a newer schema (the parse_log
+    discipline)."""
+    out = []
+    try:
+        names = sorted(n for n in os.listdir(audit_dir)
+                       if n.startswith("audit-") and n.endswith(".json"))
+    except OSError:
+        return []
+    for name in names:
+        with open(os.path.join(audit_dir, name)) as f:
+            rec = json.load(f)
+        ver = rec.get("schema_version")
+        if ver is not None and ver > AUDIT_SCHEMA_VERSION:
+            raise ValueError(
+                "audit record %s has schema_version %s > supported %d — "
+                "refusing to misread a newer controller's trail"
+                % (name, ver, AUDIT_SCHEMA_VERSION))
+        out.append(rec)
+    return out
+
+
+class PromotionController:
+    """Watch a checkpoint directory; canary, judge and promote/rollback
+    candidates automatically.  See the module docstring for the state
+    machine; ``docs/mlops.md`` documents every knob and the audit
+    schema.
+
+    Parameters
+    ----------
+    fleet : the live :class:`~mxnet_tpu.serving.fleet.ModelFleet`
+    model : name of the incumbent entry to ramp candidates against
+    checkpoint_dir : directory of ``.mxckpt`` snapshots to watch
+    runner_factory : ``(path, record) -> (runner, provenance)`` — how a
+        candidate snapshot becomes a servable runner
+        (:func:`runner_from_trainer_checkpoint` curried, usually)
+    golden : pinned golden request array ``(n,) + example_shape`` for
+        the output-parity check (None skips parity)
+    audit_dir : where ``audit-<seq>.json`` records land (required)
+    schedule / split_seed : the pinned canary ramp + hash seed
+    min_stage_requests : canary requests served before a stage is judged
+    parity_threshold : golden parity below this fails the candidate
+    max_shed_rate : canary shed rate above this fails the candidate
+    slo_tier : tier whose canary p99 is judged against the incumbent's
+        declared ``tier_slos`` (stages with no declared SLO skip it)
+    register_kwargs : forwarded to ``fleet.register`` for the canary
+        (service hints, queue depth, ...)
+    """
+
+    CANARY_SUFFIX = "__canary"
+
+    def __init__(self, fleet, model, checkpoint_dir, runner_factory,
+                 golden=None, audit_dir=None,
+                 schedule=DEFAULT_CANARY_SCHEDULE, split_seed=0,
+                 min_stage_requests=16, parity_threshold=0.8,
+                 max_shed_rate=0.05, slo_tier="gold",
+                 register_kwargs=None, registry=None):
+        if audit_dir is None:
+            raise MXNetError("audit_dir is required: undocumented "
+                             "promotion decisions are the failure mode "
+                             "this controller exists to end")
+        self.fleet = fleet
+        self.model = str(model)
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.runner_factory = runner_factory
+        self.golden = None if golden is None else _np.asarray(golden)
+        self.audit_dir = str(audit_dir)
+        os.makedirs(self.audit_dir, exist_ok=True)
+        self.schedule = tuple(schedule)
+        self.split_seed = int(split_seed)
+        self.min_stage_requests = int(min_stage_requests)
+        self.parity_threshold = float(parity_threshold)
+        self.max_shed_rate = float(max_shed_rate)
+        self.slo_tier = str(slo_tier)
+        self.register_kwargs = dict(register_kwargs or {})
+        if registry is None:
+            from .. import telemetry as _tele
+            registry = _tele.registry()
+        self.registry = registry
+        self.state = "idle"            # idle | canary
+        self.candidate = None          # dict while a canary is ramping
+        self._judged_digests = set()   # never re-canary a judged digest
+        self._seq = len(read_audit_records(self.audit_dir))
+        self._ticks = 0
+        self._stage_base_requests = 0  # canary requests when stage began
+        self._decisions = []
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def canary_name(self):
+        return self.model + self.CANARY_SUFFIX
+
+    def incumbent_digest(self):
+        prov = getattr(self.fleet.entry(self.model).runner,
+                       "provenance", None)
+        return prov.get("digest") if prov else None
+
+    def decisions(self):
+        """The deterministic decision sequence: every audit record's
+        ``decision`` section, in order — what the headline test
+        byte-compares across reruns."""
+        return list(self._decisions)
+
+    def decisions_blob(self):
+        return json.dumps(self._decisions, sort_keys=True)
+
+    # -- registry access (the SRV005 contract) -----------------------------
+    def _scrape(self):
+        """One registry scrape -> ``{(name, (label pairs)): value}``.
+        EVERY judged number flows through here: promotion evidence is
+        registry metrics, never ad-hoc reads."""
+        doc = self.registry.to_json(source="mlops.promote")["metrics"]
+        out = {}
+        for name, entry in doc.items():
+            for sample in entry.get("samples", ()):
+                labels = tuple(sorted(
+                    (str(k), str(v))
+                    for k, v in (sample.get("labels") or {}).items()))
+                if "value" in sample:
+                    out[(name, labels)] = sample["value"]
+                elif "p99" in sample:   # histogram cells
+                    out[(name + ":p99", labels)] = sample["p99"]
+        return out
+
+    @staticmethod
+    def _get(scrape, name, **labels):
+        """Look up a sample whose labels contain ``labels``."""
+        want = set((str(k), str(v)) for k, v in labels.items())
+        for (n, lab), value in scrape.items():
+            if n == name and want <= set(lab):
+                return value
+        return None
+
+    # -- watching ----------------------------------------------------------
+    def poll(self):
+        """Scan the checkpoint directory; start a canary for a fresh
+        candidate digest.  Returns the start decision record, or None."""
+        if self.state != "idle":
+            return None
+        found = _ckpt.latest_checkpoint(self.checkpoint_dir)
+        if found is None:
+            return None
+        path, rec = found
+        prov = _ckpt.provenance(rec) or {}
+        digest = prov.get("digest")
+        if digest is None or digest in self._judged_digests:
+            return None
+        if digest == self.incumbent_digest():
+            self._judged_digests.add(digest)
+            return None
+        runner, prov = self.runner_factory(path, rec)
+        self.fleet.register(self.canary_name, runner,
+                            **self.register_kwargs)
+        split = self.fleet.set_canary(self.model, self.canary_name,
+                                      schedule=self.schedule,
+                                      seed=self.split_seed)
+        self.state = "canary"
+        self.candidate = {"digest": digest, "path": path,
+                          "provenance": prov, "runner": runner}
+        self._stage_base_requests = 0
+        return self._audit("start_canary", stage=split.stage,
+                           fraction=split.fraction,
+                           evidence={"checkpoint": os.path.basename(path)})
+
+    # -- judging -----------------------------------------------------------
+    def _evidence(self):
+        """Gather the decision evidence from one registry scrape (plus
+        the parity gauge this tick published).  Returns (evidence dict,
+        failed metric name or None)."""
+        canary = self.canary_name
+        # golden parity: computed, PUBLISHED to the registry, then read
+        # back out of the same scrape every other metric comes from
+        if self.golden is not None:
+            parity = golden_parity(self.fleet.runner(self.model),
+                                   self.candidate["runner"], self.golden)
+            self.registry.gauge(
+                "mxtpu_canary_golden_parity",
+                "output parity candidate vs incumbent on the golden "
+                "set").set(parity, model=self.model, canary=canary)
+        scrape = self._scrape()
+        requests = self._get(scrape, "mxtpu_serving_requests_total",
+                             model=canary) or 0
+        shed = self._get(scrape, "mxtpu_serving_shed_total",
+                         model=canary) or 0
+        breaker = self._get(scrape, "mxtpu_serving_breaker_state",
+                            model=canary) or 0
+        parity_v = self._get(scrape, "mxtpu_canary_golden_parity",
+                             model=self.model, canary=canary)
+        p99 = self._get(scrape, "mxtpu_serving_tier_p99_ms",
+                        model=canary, tier=self.slo_tier)
+        slo = self.fleet.entry(self.model).tier_slos.get(self.slo_tier)
+        arrived = requests + shed
+        shed_rate = (shed / float(arrived)) if arrived else 0.0
+        evidence = {
+            "canary_requests": int(requests),
+            "canary_shed": int(shed),
+            "canary_shed_rate": round(shed_rate, 6),
+            "breaker_state": int(breaker),
+            "golden_parity": None if parity_v is None
+            else round(float(parity_v), 6),
+            "slo_tier": self.slo_tier,
+            "canary_p99_ms": None if p99 is None else float(p99),
+            "slo_p99_ms": slo,
+        }
+        if breaker:
+            return evidence, "breaker_state"
+        if parity_v is not None and parity_v < self.parity_threshold:
+            return evidence, "golden_parity"
+        if shed_rate > self.max_shed_rate:
+            return evidence, "canary_shed_rate"
+        if slo is not None and p99 is not None and p99 > float(slo):
+            return evidence, "canary_p99_ms"
+        return evidence, None
+
+    def evaluate(self):
+        """One decision tick.  Returns the decision record written (or
+        None when idle / still gathering evidence)."""
+        self._ticks += 1
+        _chaos.maybe_inject("mlops.decision", count=self._ticks,
+                            ctx=(self.model, self.state))
+        if self.state != "canary":
+            return None
+        split = self.fleet.entry(self.model).canary
+        if split is None:   # externally cleared — resync
+            self.state = "idle"
+            return None
+        evidence, failed = self._evidence()
+        stage_requests = evidence["canary_requests"] \
+            - self._stage_base_requests
+        if failed is None and stage_requests < self.min_stage_requests:
+            return None     # not enough canary evidence yet: no decision
+        if failed is not None:
+            return self._rollback(split, evidence, failed)
+        if split.final_stage:
+            return self._promote(split, evidence)
+        self._stage_base_requests = evidence["canary_requests"]
+        fraction = self.fleet.advance_canary(self.model)
+        return self._audit("advance", stage=split.stage,
+                           fraction=fraction, evidence=evidence)
+
+    # -- terminal decisions ------------------------------------------------
+    def _promote(self, split, evidence):
+        digest = self.candidate["digest"]
+        stage, fraction = split.stage, split.fraction
+        self.fleet.clear_canary(self.model)
+        # hot swap under drain: the candidate runner replaces the
+        # incumbent's; queued requests are served by the promoted model,
+        # zero in-flight failures (the PR-8 contract)
+        self.fleet.swap(self.model, self.candidate["runner"])
+        self.fleet.deregister(self.canary_name)
+        self._judged_digests.add(digest)
+        self.candidate = None
+        self.state = "idle"
+        return self._audit("promote", stage=stage, fraction=fraction,
+                           evidence=evidence, digest=digest)
+
+    def _rollback(self, split, evidence, failed):
+        digest = self.candidate["digest"]
+        stage, fraction = split.stage, split.fraction
+        self.fleet.clear_canary(self.model)
+        self.fleet.deregister(self.canary_name)
+        self._judged_digests.add(digest)
+        self.candidate = None
+        self.state = "idle"
+        return self._audit("rollback", stage=stage, fraction=fraction,
+                           evidence=evidence, failed_metric=failed,
+                           digest=digest)
+
+    # -- the audit trail ---------------------------------------------------
+    def _audit(self, decision, stage, fraction, evidence,
+               failed_metric=None, digest=None):
+        self._seq += 1
+        dec = {
+            "seq": self._seq,
+            "model": self.model,
+            "decision": decision,
+            "stage": int(stage),
+            "fraction": float(fraction),
+            "candidate_digest": digest if digest is not None
+            else (self.candidate or {}).get("digest"),
+            "incumbent_digest": self.incumbent_digest(),
+            "failed_metric": failed_metric,
+        }
+        record = {
+            "schema_version": AUDIT_SCHEMA_VERSION,
+            "decision": dec,
+            "evidence": evidence,
+        }
+        path = os.path.join(self.audit_dir, "audit-%06d.json" % self._seq)
+        tmp = path + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(record, f, sort_keys=True, indent=1)
+        os.replace(tmp, path)
+        self._decisions.append(dec)
+        from .. import telemetry as _tele
+        _tele.record("mlops.promotion", **dec)
+        self.registry.counter(
+            "mxtpu_promotion_decisions_total",
+            "promotion controller decisions by kind").inc(
+                model=self.model, decision=decision)
+        return record
+
+    # -- convenience -------------------------------------------------------
+    def run(self, pump=None, max_ticks=200):
+        """Poll + evaluate until a terminal decision.  ``pump(tick)`` is
+        called before each evaluate while a canary ramps (the caller's
+        traffic driver — tests and the demo CLI feed seeded request
+        streams through it).  Returns the terminal record, or None when
+        ``max_ticks`` ran out."""
+        for tick in range(int(max_ticks)):
+            self.poll()
+            if self.state == "canary" and pump is not None:
+                pump(tick)
+            rec = self.evaluate()
+            if rec and rec["decision"]["decision"] in ("promote",
+                                                       "rollback"):
+                return rec
+        return None

@@ -1,0 +1,550 @@
+"""Deterministic discrete-event fleet simulator: capacity questions as
+a gated computation.
+
+The serving fleet's policies — deadline-aware coalescing, shed-before-rot
+admission control, tier-ordered eviction, circuit breaking, degraded-mode
+fallback — are all *deterministic arithmetic* (serving/batcher.py runs on
+a pinned ``service_time_hint_ms`` exactly so chaos tests can replay shed
+decisions byte-for-byte).  That makes the fleet simulable: this module
+replays seeded traffic traces (diurnal + burst generators scaled to
+millions of DAU) against the *modeled* policies on a virtual clock, with
+per-batch service time taken from the PR-4 modeled cost
+(:func:`service_ms_from_modeled_cost`) or calibrated from one real
+measurement — so "how many replicas for 1M DAU at gold SLO?" is answered
+by :func:`required_replicas` (tools/capacity.py) as a deterministic
+computation, not a load-test guess.
+
+Fidelity contract: the same admission arithmetic as the live Batcher
+(``(position // max_batch + 1 + in_flight) * est_batch_ms`` vs deadline,
+tier-ordered queue, worst-ranked eviction under a full queue, the
+hopeless-request sweep before each batch), validated against the real
+host serving bench within a documented tolerance (<= 15 % on reqs/sec
+and per-tier p99 — asserted tier-1 in tests/test_mlops.py and reported
+as ``simulator_accuracy_pct`` by the bench's ``mlops`` stage).
+
+Everything runs on a virtual millisecond clock: no wall-clock reads (the
+SRV005 sweep enforces this for the whole package) and no global RNG —
+traces are built from seeded ``random.Random`` instances, so every
+report is byte-identical for a fixed seed.
+"""
+from __future__ import annotations
+
+import bisect
+import heapq
+import math
+import random
+
+from ..serving.batcher import tier_name, tier_rank
+
+__all__ = ["SimConfig", "FleetSimulator", "SimReport",
+           "diurnal_trace", "burst_trace", "trace_for_dau",
+           "service_ms_from_modeled_cost", "required_replicas",
+           "percentile"]
+
+# pinned reference throughput constants for converting the PR-4 modeled
+# cost into host-free service times (a "capacity planning chip": the
+# numbers only need to be *consistent*, budget-style, not measured —
+# capacity answers gate on determinism, and real-host validation runs
+# through the calibrated path instead)
+DEFAULT_FLOPS_PER_S = 50e9
+DEFAULT_BYTES_PER_S = 25e9
+DEFAULT_OVERHEAD_MS = 1.0
+
+
+def percentile(samples, q):
+    """Nearest-rank percentile (the serving/stats.py convention, kept
+    local so the simulator stays importable host-only)."""
+    data = sorted(samples)
+    if not data:
+        return 0.0
+    rank = max(0, min(len(data) - 1,
+                      int(round(q / 100.0 * (len(data) - 1)))))
+    return data[rank]
+
+
+def service_ms_from_modeled_cost(cost_row, flops_per_s=DEFAULT_FLOPS_PER_S,
+                                 bytes_per_s=DEFAULT_BYTES_PER_S,
+                                 overhead_ms=DEFAULT_OVERHEAD_MS):
+    """Modeled per-batch service time from one bucket's mxcost row
+    (``ModelRunner.modeled_cost()[bucket]``): the roofline max of
+    compute time and memory time plus a fixed dispatch overhead."""
+    flops = float(cost_row.get("flops", 0))
+    moved = float(cost_row.get("bytes_read", 0)
+                  + cost_row.get("bytes_written", 0))
+    return max(flops / flops_per_s, moved / bytes_per_s) * 1e3 \
+        + float(overhead_ms)
+
+
+# ---------------------------------------------------------------------------
+# traffic traces
+# ---------------------------------------------------------------------------
+def _mixed(seq, tier_mix):
+    """Deterministic tier for request ordinal ``seq`` under a mix like
+    ``{"gold": 0.5, "silver": 0.3, "bronze": 0.2}`` — cycled by weight
+    so every rerun sees the identical tier sequence."""
+    # build the smallest repeating pattern once per mix
+    names = sorted(tier_mix)
+    weights = [tier_mix[n] for n in names]
+    total = sum(weights)
+    pattern = []
+    counts = [0.0] * len(names)
+    for _ in range(max(1, int(round(total * 20)) or 20)):
+        # largest-remainder round-robin: deterministic, proportionate
+        i = max(range(len(names)),
+                key=lambda j: (weights[j] / total) * (len(pattern) + 1)
+                - counts[j])
+        counts[i] += 1
+        pattern.append(names[i])
+    return pattern[seq % len(pattern)]
+
+
+def diurnal_trace(duration_s, mean_rps, seed=0,
+                  tier_mix=None, deadlines_ms=None, peak_factor=2.0,
+                  period_s=86400.0, phase_s=0.0):
+    """Seeded open-loop arrivals with a sinusoidal diurnal envelope:
+    instantaneous rate = ``mean_rps * (1 + (peak_factor-1)/(peak_factor+1)
+    * sin(...))`` so the peak:mean ratio is ``peak_factor`` : 1 at the
+    crest.  Returns ``[(t_ms, tier, deadline_ms), ...]`` sorted by time;
+    byte-identical for a fixed seed."""
+    tier_mix = tier_mix or {"gold": 0.2, "silver": 0.3, "bronze": 0.5}
+    deadlines_ms = deadlines_ms or {"gold": 500.0, "silver": 250.0,
+                                    "bronze": 100.0}
+    rng = random.Random(int(seed))
+    amp = (float(peak_factor) - 1.0) / (float(peak_factor) + 1.0)
+    base = float(mean_rps) * (1.0 + amp)   # rate at the crest envelope
+    out, t, seq = [], 0.0, 0
+    horizon = float(duration_s) * 1000.0
+    while True:
+        # thinned Poisson process: draw at the crest rate, keep with
+        # probability rate(t)/base — exact for inhomogeneous arrivals
+        t += rng.expovariate(base) * 1000.0
+        if t >= horizon:
+            break
+        frac = (t / 1000.0 + phase_s) / float(period_s)
+        rate = float(mean_rps) * (1.0 + amp * math.sin(2 * math.pi * frac))
+        if rng.random() * base > rate:
+            continue
+        tier = _mixed(seq, tier_mix)
+        out.append((t, tier, deadlines_ms.get(tier)))
+        seq += 1
+    return out
+
+
+def burst_trace(n, at_ms=0.0, tier_cycle=("gold", "silver", "bronze"),
+                deadlines_ms=None, spacing_ms=0.0):
+    """``n`` arrivals at/after ``at_ms`` cycling the given tiers — the
+    overload burst (all at one instant when ``spacing_ms`` is 0)."""
+    deadlines_ms = deadlines_ms or {}
+    return [(float(at_ms) + i * float(spacing_ms),
+             tier_cycle[i % len(tier_cycle)],
+             deadlines_ms.get(tier_cycle[i % len(tier_cycle)]))
+            for i in range(int(n))]
+
+
+def trace_for_dau(dau, window_s=60.0, requests_per_user_per_day=20.0,
+                  seed=0, at_peak=True, peak_factor=2.0, tier_mix=None,
+                  deadlines_ms=None):
+    """The millions-of-users scenario as a trace: ``dau`` daily active
+    users at ``requests_per_user_per_day`` give a mean request rate;
+    capacity planning simulates a ``window_s`` slice at the diurnal crest
+    (``at_peak``) — the window the fleet must be provisioned for."""
+    mean_rps = float(dau) * float(requests_per_user_per_day) / 86400.0
+    return diurnal_trace(
+        window_s, mean_rps, seed=seed, tier_mix=tier_mix,
+        deadlines_ms=deadlines_ms, peak_factor=peak_factor,
+        # phase the window onto the sine crest: sin = 1 at period/4
+        phase_s=86400.0 / 4.0 - window_s / 2.0 if at_peak else 0.0)
+
+
+# ---------------------------------------------------------------------------
+# the simulator proper
+# ---------------------------------------------------------------------------
+class SimConfig:
+    """Modeled serving policies for one simulated model tier.
+
+    Mirrors the live knobs: ``buckets``/``max_batch`` (padding ladder and
+    coalescing bound), ``batch_timeout_ms`` (fill window),
+    ``max_queue`` (bounded admission queue), ``service_ms`` (scalar
+    per-batch time, or a ``bucket -> ms`` callable from
+    :func:`service_ms_from_modeled_cost`), ``breaker_threshold`` /
+    ``breaker_open_ms`` (circuit breaker), ``fail_batches`` (injected
+    batch failures by global batch ordinal — the chaos analogue), and
+    ``fallback`` (a cheaper :class:`SimConfig` absorbing shed/refused
+    traffic in degraded mode)."""
+
+    def __init__(self, service_ms, buckets=(1, 4, 16, 64), max_batch=None,
+                 batch_timeout_ms=2.0, max_queue=256,
+                 breaker_threshold=3, breaker_open_ms=500.0,
+                 fail_batches=(), fallback=None):
+        self.buckets = tuple(sorted(int(b) for b in set(buckets)))
+        self.max_batch = int(max_batch) if max_batch else self.buckets[-1]
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.max_queue = int(max_queue)
+        if callable(service_ms):
+            self._service = service_ms
+        else:
+            self._service = lambda bucket, _ms=float(service_ms): _ms
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_open_ms = float(breaker_open_ms)
+        self.fail_batches = frozenset(int(b) for b in fail_batches)
+        self.fallback = fallback
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def service_ms(self, n):
+        return float(self._service(self.bucket_for(n)))
+
+    def est_batch_ms(self):
+        """The admission-control scalar (the live batcher's pinned
+        ``service_time_hint_ms`` analogue): the max-bucket service
+        time."""
+        return float(self._service(self.buckets[-1]))
+
+
+class _SimReq:
+    __slots__ = ("t_arrive", "rank", "deadline_ms", "t_deadline", "seq")
+
+    def __init__(self, t, tier, deadline_ms, seq):
+        self.t_arrive = t
+        self.rank = tier_rank(tier)
+        self.deadline_ms = deadline_ms
+        self.t_deadline = (t + deadline_ms) if deadline_ms is not None \
+            else None
+        self.seq = seq
+
+    def key(self):
+        return (self.rank,
+                self.t_deadline if self.t_deadline is not None
+                else float("inf"),
+                self.seq)
+
+    @property
+    def tier(self):
+        return tier_name(self.rank)
+
+
+class _Replica:
+    """One modeled replica: a tier-ordered queue + a single in-flight
+    batch slot, the live Batcher's worker discipline on virtual time."""
+
+    __slots__ = ("idx", "cfg", "queue", "busy_until", "window_until",
+                 "consecutive_failures", "breaker_open_until", "trips")
+
+    def __init__(self, idx, cfg):
+        self.idx = idx
+        self.cfg = cfg
+        self.queue = []              # sorted by _SimReq.key()
+        self.busy_until = None       # t the in-flight batch completes
+        self.window_until = None     # coalescing window close
+        self.consecutive_failures = 0
+        self.breaker_open_until = None
+        self.trips = 0
+
+    def load(self):
+        return len(self.queue) + (1 if self.busy_until is not None else 0)
+
+    def breaker_open(self, now):
+        return self.breaker_open_until is not None \
+            and now < self.breaker_open_until
+
+    def modeled_wait_ms(self, position):
+        est = self.cfg.est_batch_ms()
+        in_flight = 1 if self.busy_until is not None else 0
+        return (position // self.cfg.max_batch + 1 + in_flight) * est
+
+
+class SimReport(dict):
+    """Plain dict with the stable keys (documented in docs/mlops.md):
+    served/shed/degraded counts, per-tier p50/p99, reqs_per_sec, breaker
+    trips, span_ms — everything deterministic for a fixed trace."""
+
+    def render(self):
+        lines = ["simulated %d arrivals over %.1fs -> %.1f reqs/sec "
+                 "served (%d served, %d shed, %d rejected, %d degraded, "
+                 "%d breaker trips)"
+                 % (self["arrivals"], self["span_ms"] / 1e3,
+                    self["reqs_per_sec"], self["served"],
+                    self["shed_total"], self["rejected_total"],
+                    self["degraded_total"], self["breaker_trips"])]
+        for tier, row in sorted(self["tiers"].items()):
+            lines.append("  %-7s n=%-6d p50=%7.2fms p99=%7.2fms shed=%d"
+                         % (tier, row["count"], row["p50_ms"],
+                            row["p99_ms"], row["shed"]))
+        return "\n".join(lines)
+
+
+class FleetSimulator:
+    """Replay a trace against ``replicas`` modeled servers of ``cfg``.
+
+    Arrivals route to the least-loaded replica (deterministic tie-break
+    by index — the ordinal dispatch a front-end LB approximates);
+    everything after that is the live Batcher's arithmetic on virtual
+    time.  ``run()`` returns a :class:`SimReport`; two runs over the
+    same trace are byte-identical.
+    """
+
+    # event-kind ordering at equal timestamps: finish batches before
+    # admitting new arrivals before closing coalescing windows — the
+    # tie-break is part of the determinism contract
+    _DONE, _ARRIVE, _WINDOW = 0, 1, 2
+
+    def __init__(self, cfg, replicas=1, fallback_replicas=1):
+        self.cfg = cfg
+        self.replicas = [_Replica(i, cfg) for i in range(int(replicas))]
+        self.fallback = None
+        if cfg.fallback is not None:
+            self.fallback = FleetSimulator(cfg.fallback,
+                                           replicas=int(fallback_replicas))
+
+    # -- the admission path (the Batcher's submit(), virtualized) ----------
+    def _admit(self, rep, req, now, out):
+        position = bisect.bisect_left([r.key() for r in rep.queue],
+                                      req.key())
+        if req.deadline_ms is not None:
+            wait = rep.modeled_wait_ms(position)
+            if wait > req.deadline_ms:
+                out.shed(req, "admit")
+                return False
+        if len(rep.queue) >= self.cfg.max_queue:
+            if rep.queue and req.key() < rep.queue[-1].key():
+                victim = rep.queue.pop()
+                out.shed(victim, "evict")
+            else:
+                out.reject(req)
+                return False
+        keys = [r.key() for r in rep.queue]
+        rep.queue.insert(bisect.bisect_left(keys, req.key()), req)
+        return True
+
+    def _sweep(self, rep, now, out):
+        keep = []
+        for pos, r in enumerate(rep.queue):
+            if r.t_deadline is not None and \
+                    now + rep.modeled_wait_ms(pos) > r.t_deadline:
+                out.shed(r, "sweep")
+            else:
+                keep.append(r)
+        rep.queue = keep
+
+    def run(self, trace, server_free_at_ms=None):
+        """Simulate ``trace`` (``[(t_ms, tier, deadline_ms), ...]``) to
+        completion; returns the :class:`SimReport`.
+
+        ``server_free_at_ms`` models servers that are busy until a known
+        instant (the parked-worker validation scenario: a fully-queued
+        backlog released at once) — every replica starts draining then."""
+        out = _Collector()
+        events = []
+        # the third tuple slot is a globally-unique event ordinal: equal
+        # (t, kind) events pop in push order and the heap never falls
+        # through to comparing payloads
+        event_seq = [0]
+
+        def push(t, kind, payload):
+            heapq.heappush(events, (t, kind, event_seq[0], payload))
+            event_seq[0] += 1
+
+        for seq, (t, tier, deadline) in enumerate(sorted(trace)):
+            push(float(t), self._ARRIVE,
+                 _SimReq(float(t), tier, deadline, seq))
+        if server_free_at_ms is not None:
+            for rep in self.replicas:
+                rep.busy_until = float(server_free_at_ms)
+                push(float(server_free_at_ms), self._DONE, (rep, [], None))
+        batch_ordinal = [0]
+        degraded = []            # requests rerouted to the fallback
+
+        def start_batch(rep, now):
+            self._sweep(rep, now, out)
+            if not rep.queue:
+                rep.window_until = None
+                return
+            n = min(len(rep.queue), self.cfg.max_batch)
+            batch, rep.queue = rep.queue[:n], rep.queue[n:]
+            svc = self.cfg.service_ms(n)
+            ordinal = batch_ordinal[0]
+            batch_ordinal[0] += 1
+            done = now + svc
+            rep.busy_until = done
+            rep.window_until = None
+            push(done, self._DONE, (rep, batch, ordinal))
+
+        while events:
+            now, kind, _, payload = heapq.heappop(events)
+            if kind == self._ARRIVE:
+                req = payload
+                live = [r for r in self.replicas
+                        if not r.breaker_open(now)]
+                if not live:
+                    # fleet-wide open breakers: degraded mode or drop
+                    (degraded if self.fallback is not None
+                     else out.breaker_refused).append(req)
+                    out.degraded_total += 1 if self.fallback is not None \
+                        else 0
+                    continue
+                rep = min(live, key=lambda r: (r.load(), r.idx))
+                if not self._admit(rep, req, now, out):
+                    if self.fallback is not None:
+                        degraded.append(req)
+                        out.degraded_total += 1
+                    continue
+                if rep.busy_until is None and rep.window_until is None:
+                    if len(rep.queue) >= self.cfg.max_batch:
+                        start_batch(rep, now)
+                    else:
+                        rep.window_until = now + self.cfg.batch_timeout_ms
+                        push(rep.window_until, self._WINDOW, rep)
+                elif rep.busy_until is None and \
+                        len(rep.queue) >= self.cfg.max_batch:
+                    start_batch(rep, now)
+            elif kind == self._WINDOW:
+                rep = payload
+                if rep.busy_until is None and rep.window_until is not None \
+                        and now >= rep.window_until:
+                    start_batch(rep, now)
+            else:  # _DONE
+                rep, batch, ordinal = payload
+                rep.busy_until = None
+                failed = ordinal in self.cfg.fail_batches
+                if failed:
+                    rep.consecutive_failures += 1
+                    out.failed.extend(batch)
+                    if rep.consecutive_failures >= \
+                            self.cfg.breaker_threshold:
+                        rep.breaker_open_until = \
+                            now + self.cfg.breaker_open_ms
+                        rep.trips += 1
+                        rep.consecutive_failures = 0
+                else:
+                    rep.consecutive_failures = 0
+                    for r in batch:
+                        out.serve(r, now)
+                if rep.queue:
+                    if len(rep.queue) >= self.cfg.max_batch:
+                        start_batch(rep, now)
+                    else:
+                        rep.window_until = now + self.cfg.batch_timeout_ms
+                        push(rep.window_until, self._WINDOW, rep)
+
+        report = out.report(trace,
+                            trips=sum(r.trips for r in self.replicas),
+                            replicas=len(self.replicas))
+        if degraded and self.fallback is not None:
+            # degraded-mode slice: replay onto the cheaper variant with
+            # original arrival times (deadlines intact)
+            sub = self.fallback.run(
+                [(r.t_arrive, r.tier, r.deadline_ms) for r in degraded])
+            report["fallback"] = sub
+        return report
+
+
+class _Collector:
+    def __init__(self):
+        self.latency_by_tier = {}
+        self.shed_by_tier = {}
+        self.shed_by_at = {"admit": 0, "evict": 0, "sweep": 0}
+        self.rejected = []
+        self.failed = []
+        self.breaker_refused = []
+        self.degraded_total = 0
+        self.served_n = 0
+        self.last_done = 0.0
+
+    def serve(self, req, now):
+        self.served_n += 1
+        self.last_done = max(self.last_done, now)
+        self.latency_by_tier.setdefault(req.tier, []).append(
+            now - req.t_arrive)
+
+    def shed(self, req, at):
+        self.shed_by_tier[req.tier] = self.shed_by_tier.get(req.tier, 0) + 1
+        self.shed_by_at[at] += 1
+
+    def reject(self, req):
+        self.rejected.append(req)
+
+    def report(self, trace, trips, replicas):
+        tiers = {}
+        for tier in sorted(set(self.latency_by_tier)
+                           | set(self.shed_by_tier)):
+            lat = self.latency_by_tier.get(tier, [])
+            tiers[tier] = {
+                "count": len(lat),
+                "p50_ms": round(percentile(lat, 50), 3),
+                "p99_ms": round(percentile(lat, 99), 3),
+                "shed": self.shed_by_tier.get(tier, 0),
+            }
+        t0 = min((t for t, _, _ in trace), default=0.0)
+        span = max(self.last_done - t0, 1e-9)
+        return SimReport(
+            arrivals=len(trace),
+            served=self.served_n,
+            shed_total=sum(self.shed_by_tier.values()),
+            shed_at=dict(self.shed_by_at),
+            rejected_total=len(self.rejected),
+            failed_total=len(self.failed),
+            degraded_total=self.degraded_total,
+            breaker_refused=len(self.breaker_refused),
+            breaker_trips=trips,
+            replicas=replicas,
+            span_ms=round(span, 3),
+            reqs_per_sec=round(self.served_n / (span / 1e3), 3),
+            tiers=tiers,
+        )
+
+
+def required_replicas(cfg, trace, slo_tier="gold", slo_p99_ms=None,
+                      max_shed_rate=0.0, max_total_shed_rate=0.01,
+                      max_replicas=4096, fallback_replicas=1):
+    """Smallest replica count whose simulated ``slo_tier`` p99 meets
+    ``slo_p99_ms`` with at most ``max_shed_rate`` of that tier shed AND
+    at most ``max_total_shed_rate`` of ALL traffic shed/rejected — the
+    capacity answer, by exponential probe + binary search (both
+    deterministic).  The total-shed bound matters: tier-ordered shedding
+    will happily sacrifice bronze to keep gold green, so judging gold
+    alone would under-provision the fleet by exactly the overload the
+    lowest tier silently absorbs.  Returns ``(replicas, report)``;
+    raises when even ``max_replicas`` cannot meet the SLO (the trace is
+    beyond this service-time model)."""
+    if slo_p99_ms is None:
+        raise ValueError("slo_p99_ms is required")
+
+    def meets(k):
+        rep = FleetSimulator(cfg, replicas=k,
+                             fallback_replicas=fallback_replicas).run(trace)
+        row = rep["tiers"].get(slo_tier,
+                               {"count": 0, "p99_ms": 0.0, "shed": 0})
+        n = row["count"] + row["shed"]
+        shed_rate = (row["shed"] / float(n)) if n else 0.0
+        dropped = rep["shed_total"] + rep["rejected_total"] \
+            + rep["breaker_refused"]
+        total_rate = dropped / float(max(1, rep["arrivals"]))
+        ok = row["p99_ms"] <= float(slo_p99_ms) \
+            and shed_rate <= float(max_shed_rate) \
+            and total_rate <= float(max_total_shed_rate)
+        return ok, rep
+
+    lo, hi, best = 1, 1, None
+    while hi <= int(max_replicas):
+        ok, rep = meets(hi)
+        if ok:
+            best = (hi, rep)
+            break
+        lo, hi = hi + 1, hi * 2
+    if best is None:
+        raise ValueError(
+            "no replica count <= %d meets %s p99 <= %.1fms for this "
+            "trace" % (max_replicas, slo_tier, float(slo_p99_ms)))
+    hi = best[0]
+    while lo < hi:
+        mid = (lo + hi) // 2
+        ok, rep = meets(mid)
+        if ok:
+            hi, best = mid, (mid, rep)
+        else:
+            lo = mid + 1
+    return best

@@ -13,6 +13,7 @@ ICI, parameters donated so updates happen in place in HBM.
 from __future__ import annotations
 
 import collections
+import os
 import time
 
 import numpy as np
@@ -85,12 +86,19 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss, optimizer, optimizer_params=None,
                  mesh=None, param_spec_fn=None, data_axis="data",
-                 kvstore=None, input_transform=None):
+                 kvstore=None, input_transform=None, run_id=None):
         from .. import kvstore as kvs
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss
         self._input_transform = input_transform
+        # training-run identity carried into every checkpoint's
+        # provenance (ISSUE 12): the promotion audit trail names the run
+        # that produced the bytes it promoted.  Deterministic by
+        # construction — caller-supplied or MXTPU_RUN_ID; never a
+        # timestamp (reruns must produce identical provenance).
+        self.run_id = run_id if run_id is not None else \
+            os.environ.get("MXTPU_RUN_ID")
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self._opt = optimizer
@@ -802,8 +810,21 @@ class DataParallelTrainer:
             "setup_desc": self._setup_desc,
             "groups": [list(g) for g in self._groups],
         }
-        path = _ckpt.save_checkpoint(directory, payload, self._step_count,
-                                     keep=keep)
+        # provenance digest over NAME-CANONICALIZED content: gluon
+        # gensyms shift per process (dense0 vs dense12 for the same
+        # architecture — the positional-mapping case restore_checkpoint
+        # already handles), so the digest maps param names to their
+        # position before hashing.  Two reruns of the same training
+        # therefore name the same bytes — what makes promotion audit
+        # trails replayable.
+        order = {name: "p%05d" % i for i, name in enumerate(params)}
+        canon = dict(payload,
+                     params={order[n]: enc for n, enc in params.items()},
+                     groups=[[order[n] for n in g] for g in self._groups])
+        path = _ckpt.save_checkpoint(
+            directory, payload, self._step_count, keep=keep,
+            provenance={"epoch": epoch, "train_run_id": self.run_id,
+                        "digest": _ckpt.payload_digest(canon)})
         if _tele._ENABLED:
             _tele.attribution().add_phase(
                 "checkpoint", time.perf_counter() - t_ckpt)

@@ -72,6 +72,8 @@ SITES = {
     "serving.batch": "count = batch number; delay = runner stall",
     "serving.route": "count = routed-request ordinal; ctx = (model, tier)",
     "serving.swap": "fleet hot swap; ctx = model name",
+    "mlops.decision": "count = promotion evaluate tick; "
+                      "ctx = (model, state)",
     "engine.flush": "run-ahead ring drain",
     "backend.init": "count = bench.py acquisition attempt",
     "checkpoint.save": "mid-checkpoint-write (atomicity tests)",

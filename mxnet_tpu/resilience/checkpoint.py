@@ -15,6 +15,16 @@ NOT give and this module does:
   state, RNG state AND the iterator cursor (epoch/batch), so ``resume=``
   replays to *bitwise-identical* post-crash convergence — not merely
   "params restored".
+- **provenance** (the train→serve handoff, ISSUE 12): every snapshot
+  embeds a content digest (sha256 over the encoded payload bytes — the
+  exact bytes a restore would decode) plus the training coordinates
+  ``(epoch, step, train_run_id)`` the caller supplies.  The serving
+  fleet surfaces this through ``/stats`` and the promotion controller
+  writes it into every audit record, so "which checkpoint is live?" has
+  a byte-exact answer.  Same-content snapshots digest identically
+  (deterministic pickling of a deterministically-built payload), which
+  is what lets the mlops headline test prove byte-identical promotion
+  decisions across full retrain+repromote reruns.
 
 Format (version 1): one pickled dict — ``{"version", "step", "payload"}``
 where arrays are encoded as ``("nd", dtype_str, shape, raw_bytes)``
@@ -25,6 +35,7 @@ tooling that inspects checkpoints without a backend).
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import re
@@ -35,7 +46,7 @@ from . import chaos as _chaos
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
            "list_checkpoints", "encode_array", "decode_array",
-           "CKPT_SUFFIX", "FORMAT_VERSION"]
+           "payload_digest", "provenance", "CKPT_SUFFIX", "FORMAT_VERSION"]
 
 CKPT_SUFFIX = ".mxckpt"
 FORMAT_VERSION = 1
@@ -59,18 +70,48 @@ def _ckpt_path(directory, step):
     return os.path.join(directory, "ckpt-%012d%s" % (int(step), CKPT_SUFFIX))
 
 
-def save_checkpoint(directory, payload, step, keep=3):
+def payload_digest(payload):
+    """sha256 hex digest of the pickled payload — the byte-exact identity
+    of a checkpoint's content.  Pickling an insertion-ordered dict of
+    ``encode_array`` tuples is deterministic, so the same training state
+    always names the same digest (the property promotion audit records
+    rely on)."""
+    return hashlib.sha256(pickle.dumps(
+        payload, protocol=pickle.HIGHEST_PROTOCOL)).hexdigest()
+
+
+def provenance(record):
+    """The provenance dict of a loaded checkpoint record, or ``None``
+    for a pre-provenance snapshot (records stay back/forward readable:
+    provenance is an additive key)."""
+    if not isinstance(record, dict):
+        return None
+    return record.get("provenance")
+
+
+def save_checkpoint(directory, payload, step, keep=3, provenance=None):
     """Atomically write ``payload`` as the step-``step`` checkpoint.
 
     The bytes are written to a tmp file, fsynced, then ``os.replace``d —
     the checkpoint either exists completely or not at all.  After a
     successful install, older checkpoints beyond ``keep`` (and stray tmp
-    files from crashed saves) are pruned.  Returns the final path."""
+    files from crashed saves) are pruned.  Returns the final path.
+
+    ``provenance`` (optional dict, e.g. ``{"epoch", "train_run_id"}``)
+    is embedded in the record beside an always-computed ``digest`` of
+    the payload bytes and the ``step`` — the identity the serving fleet
+    and the promotion controller surface."""
     os.makedirs(directory, exist_ok=True)
     final = _ckpt_path(directory, step)
     tmp = final + ".tmp.%d" % os.getpid()
+    prov = dict(provenance or {})
+    prov.setdefault("step", int(step))
+    # a caller may pre-compute a canonicalized digest (the trainer
+    # digests gensym-invariant content, so rebuilt-architecture reruns
+    # name the same bytes); otherwise digest the payload as-is
+    prov.setdefault("digest", payload_digest(payload))
     blob = pickle.dumps({"version": FORMAT_VERSION, "step": int(step),
-                         "payload": payload},
+                         "payload": payload, "provenance": prov},
                         protocol=pickle.HIGHEST_PROTOCOL)
     with open(tmp, "wb") as f:
         # two-part write with a probe between: the chaos harness kills

@@ -20,8 +20,12 @@ symbolic executor with packaged inference).  Four layers:
   one routing surface: HBM-aware packing at registration (modeled cost
   vs the SRV004 cap), per-model circuit breakers
   (:class:`~mxnet_tpu.serving.fleet.CircuitBreaker`), degraded-mode
-  rerouting to a registered cheaper variant (the int8 path), and hot
-  model swap under drain with zero failed in-flight requests;
+  rerouting to a registered cheaper variant (the int8 path), hot
+  model swap under drain with zero failed in-flight requests, and a
+  deterministic canary traffic split
+  (:class:`~mxnet_tpu.serving.fleet.CanarySplit` — seeded request-id
+  hash, pinned fraction ramp, per-variant attribution; the routing
+  substrate ``mxnet_tpu.mlops`` promotes over);
 - :class:`~mxnet_tpu.serving.server.Server` — a stdlib-HTTP front end
   with ``/predict`` (model/tier/deadline routing), per-model
   ``/readyz`` vs process ``/livez``, ``/healthz``, ``/stats``, bounded
@@ -35,12 +39,14 @@ from __future__ import annotations
 from .runner import ModelRunner, DEFAULT_BUCKETS
 from .batcher import (Batcher, ServerBusy, Draining, RequestShed,
                       TIERS, DEFAULT_TIER, tier_rank, tier_name)
-from .fleet import ModelFleet, CircuitBreaker, BreakerOpen, UnknownModel
+from .fleet import (ModelFleet, CircuitBreaker, BreakerOpen, UnknownModel,
+                    CanarySplit, DEFAULT_CANARY_SCHEDULE)
 from .server import Server
 from .stats import ServingStats, percentile
 
 __all__ = ["ModelRunner", "DEFAULT_BUCKETS", "Batcher", "ServerBusy",
            "Draining", "RequestShed", "TIERS", "DEFAULT_TIER",
            "tier_rank", "tier_name", "ModelFleet", "CircuitBreaker",
-           "BreakerOpen", "UnknownModel", "Server", "ServingStats",
+           "BreakerOpen", "UnknownModel", "CanarySplit",
+           "DEFAULT_CANARY_SCHEDULE", "Server", "ServingStats",
            "percentile"]

@@ -27,6 +27,15 @@ with the failure modes of a production fleet handled explicitly —
 - **hot swap under drain**: ``swap()`` replaces a model's runner after
   the in-flight batch completes; queued requests are served by the
   replacement — zero failed in-flight requests, with the blip measured.
+- **deterministic canary traffic split** (ISSUE 12): ``set_canary()``
+  arms a :class:`CanarySplit` on a model — a seeded hash of each
+  request id decides incumbent vs canary (pure function: byte-identical
+  request sets across reruns, unaffected by hot swaps), the canary
+  fraction ramps along a *pinned schedule* advanced explicitly by the
+  promotion controller (never by wall clock), and attribution is
+  per-variant: a canary refusal (shed / full queue / open breaker)
+  falls back to the incumbent with the degrade billed to the CANARY's
+  stats — canary trouble never dirties the incumbent's ledger.
 
 Chaos probe sites (``resilience/chaos.py``): ``serving.route`` fires per
 routed request (count = request ordinal, ctx = (model, tier)) and
@@ -35,6 +44,7 @@ story is tested by deterministic fault injection, not by prod incidents.
 """
 from __future__ import annotations
 
+import hashlib
 import math
 import threading
 import time
@@ -44,7 +54,103 @@ from ..resilience.backoff import BackoffPolicy
 from .batcher import Batcher, DEFAULT_TIER, RequestShed, ServerBusy
 from .stats import ServingStats
 
-__all__ = ["ModelFleet", "CircuitBreaker", "BreakerOpen", "UnknownModel"]
+__all__ = ["ModelFleet", "CircuitBreaker", "BreakerOpen", "UnknownModel",
+           "CanarySplit", "DEFAULT_CANARY_SCHEDULE"]
+
+# the pinned default ramp: 1% -> 5% -> 25% of traffic.  Stages advance
+# only via CanarySplit.advance() (the promotion controller's explicit
+# decision), never on a timer — rerunning a seeded workload replays the
+# exact same ramp at the exact same request ordinals.
+DEFAULT_CANARY_SCHEDULE = (0.01, 0.05, 0.25)
+
+
+class CanarySplit:
+    """Deterministic canary routing state for one model.
+
+    ``routes_to_canary(request_id)`` is a pure function of
+    ``(seed, request_id, fraction)``: sha256 of ``"<seed>:<id>"`` mapped
+    onto [0, 1) and compared against the current stage's fraction.  Two
+    reruns with the same seed and request-id stream therefore split into
+    byte-identical canary/incumbent request sets — at 1%, 5% and 25%,
+    through hot swaps (the hash never looks at the runner) and across
+    processes.  Thread-safe; the only mutable state is the stage index
+    and the per-variant routed counters.
+    """
+
+    __slots__ = ("canary", "schedule", "seed", "_stage", "_lock",
+                 "routed_canary", "routed_incumbent")
+
+    def __init__(self, canary, schedule=DEFAULT_CANARY_SCHEDULE, seed=0):
+        schedule = tuple(float(f) for f in schedule)
+        if not schedule or not all(0.0 < f <= 1.0 for f in schedule):
+            raise MXNetError(
+                "canary schedule must be non-empty fractions in (0, 1], "
+                "got %r" % (schedule,))
+        if list(schedule) != sorted(schedule):
+            raise MXNetError(
+                "canary schedule must ramp monotonically, got %r"
+                % (schedule,))
+        self.canary = str(canary)
+        self.schedule = schedule
+        self.seed = int(seed)
+        self._stage = 0
+        self._lock = threading.Lock()
+        self.routed_canary = 0
+        self.routed_incumbent = 0
+
+    @property
+    def stage(self):
+        return self._stage
+
+    @property
+    def fraction(self):
+        return self.schedule[self._stage]
+
+    @property
+    def final_stage(self):
+        return self._stage == len(self.schedule) - 1
+
+    def advance(self):
+        """Step the pinned ramp (controller decision); returns the new
+        fraction.  Idempotent at the last stage."""
+        with self._lock:
+            if self._stage < len(self.schedule) - 1:
+                self._stage += 1
+            return self.schedule[self._stage]
+
+    def routes_to_canary(self, request_id):
+        """True when ``request_id`` falls in the canary slice at the
+        current fraction.  Stable under ramp-up: a request id routed to
+        the canary at 1% is still canary at 5% and 25% (the hash point
+        does not move; only the threshold does)."""
+        h = hashlib.sha256(
+            ("%d:%s" % (self.seed, request_id)).encode()).digest()
+        point = int.from_bytes(h[:8], "big") / float(1 << 64)
+        return point < self.fraction
+
+    def record_route(self, to_canary):
+        with self._lock:
+            if to_canary:
+                self.routed_canary += 1
+            else:
+                self.routed_incumbent += 1
+
+    def state_dict(self):
+        with self._lock:
+            return {
+                "canary": self.canary,
+                "fraction": self.schedule[self._stage],
+                "stage": self._stage,
+                "schedule": list(self.schedule),
+                "seed": self.seed,
+                "final_stage": self._stage == len(self.schedule) - 1,
+                "routed_canary": self.routed_canary,
+                "routed_incumbent": self.routed_incumbent,
+            }
+
+    def __repr__(self):
+        return "<CanarySplit ->%s %.3g stage=%d/%d>" % (
+            self.canary, self.fraction, self._stage, len(self.schedule))
 
 
 class BreakerOpen(MXNetError):
@@ -151,10 +257,12 @@ class CircuitBreaker:
 
 class _Entry:
     """One hosted model: runner (behind its batcher), breaker, packing
-    bytes, fallback route, declared SLOs, swap bookkeeping."""
+    bytes, fallback route, declared SLOs, swap bookkeeping, and — when a
+    traffic split is armed — the canary wiring (``canary`` on the
+    incumbent, ``canary_of`` on the canary variant)."""
 
     __slots__ = ("name", "batcher", "breaker", "hbm_bytes", "fallback",
-                 "tier_slos", "last_swap_blip_ms")
+                 "tier_slos", "last_swap_blip_ms", "canary", "canary_of")
 
     def __init__(self, name, batcher, breaker, hbm_bytes, fallback,
                  tier_slos):
@@ -165,6 +273,8 @@ class _Entry:
         self.fallback = fallback
         self.tier_slos = dict(tier_slos or {})
         self.last_swap_blip_ms = None
+        self.canary = None         # CanarySplit while this model ramps one
+        self.canary_of = None      # incumbent name while serving as canary
 
     @property
     def runner(self):
@@ -218,6 +328,26 @@ class ModelFleet:
             entries = list(self._entries.values())
         for e in entries:
             labels = {"model": e.name}
+            # per-VARIANT attribution: a canary's counters carry the
+            # incumbent's name as `canary_of`, so dashboards (and the
+            # promotion controller) can tell canary shed/degrade/breaker
+            # trips from incumbent ones without string surgery
+            if e.canary_of:
+                labels["canary_of"] = e.canary_of
+            if e.canary is not None:
+                split = e.canary.state_dict()
+                cl = {"model": e.name, "canary": split["canary"]}
+                samples.append(("mxtpu_serving_canary_fraction", cl,
+                                split["fraction"]))
+                samples.append(("mxtpu_serving_canary_stage", cl,
+                                split["stage"]))
+                samples.append((
+                    "mxtpu_serving_canary_routed_total",
+                    dict(cl, variant="canary"), split["routed_canary"]))
+                samples.append((
+                    "mxtpu_serving_canary_routed_total",
+                    dict(cl, variant="incumbent"),
+                    split["routed_incumbent"]))
             st = e.batcher.stats
             samples.append(("mxtpu_serving_breaker_state", labels,
                             self._BREAKER_STATE_ENUM.get(e.breaker.state,
@@ -321,6 +451,17 @@ class ModelFleet:
                 self._default = name
         return entry
 
+    def provenance_digests(self):
+        """{model: checkpoint digest or None} — the hello-path summary
+        of what bytes are live (full provenance rides ``stats_dict``)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        out = {}
+        for e in entries:
+            prov = getattr(e.runner, "provenance", None)
+            out[e.name] = prov.get("digest") if prov else None
+        return out
+
     def modeled_hbm_total(self):
         """Summed modeled peak HBM over registered models (None-modeled
         runners excluded) — the packing ledger /stats exposes."""
@@ -328,9 +469,68 @@ class ModelFleet:
             return sum(e.hbm_bytes for e in self._entries.values()
                        if e.hbm_bytes)
 
+    # -- canary traffic split ----------------------------------------------
+    def set_canary(self, model, canary, schedule=DEFAULT_CANARY_SCHEDULE,
+                   seed=0):
+        """Arm a deterministic traffic split: ``canary`` (an already-
+        registered model, typically the promotion candidate) receives
+        the seeded hash slice of ``model``'s requests at the schedule's
+        current fraction.  The split is advanced explicitly
+        (:meth:`advance_canary` — the promotion controller's decision),
+        never by wall clock.  Returns the :class:`CanarySplit`.
+
+        The canary runner must share the incumbent's ``example_shape``
+        (the same request bytes must be valid on either variant)."""
+        entry = self.entry(model)
+        c_entry = self.entry(canary)
+        if c_entry is entry:
+            raise MXNetError("a model cannot canary itself (%r)" % model)
+        if tuple(c_entry.runner.example_shape) != \
+                tuple(entry.runner.example_shape):
+            raise MXNetError(
+                "canary refused: example_shape %r != incumbent's %r — "
+                "split traffic would feed one variant bad geometry"
+                % (tuple(c_entry.runner.example_shape),
+                   tuple(entry.runner.example_shape)))
+        split = CanarySplit(c_entry.name, schedule=schedule, seed=seed)
+        with self._lock:
+            if entry.canary_of:
+                raise MXNetError(
+                    "model %r is itself the canary of %r — clear that "
+                    "split first" % (entry.name, entry.canary_of))
+            entry.canary = split
+            c_entry.canary_of = entry.name
+        return split
+
+    def clear_canary(self, model):
+        """Disarm ``model``'s traffic split (rollback or post-promotion
+        cleanup); returns the removed :class:`CanarySplit` or None."""
+        entry = self.entry(model)
+        with self._lock:
+            split, entry.canary = entry.canary, None
+            if split is not None:
+                c = self._entries.get(split.canary)
+                if c is not None and c.canary_of == entry.name:
+                    c.canary_of = None
+        return split
+
+    def advance_canary(self, model):
+        """Step ``model``'s canary ramp to the next pinned fraction;
+        returns the new fraction."""
+        split = self.entry(model).canary
+        if split is None:
+            raise MXNetError("model %r has no canary armed" % (model,))
+        return split.advance()
+
+    def canary_state(self, model):
+        """The split's state dict (fraction/stage/routed counts), or
+        None when no split is armed."""
+        split = self.entry(model).canary
+        return None if split is None else split.state_dict()
+
     # -- routing -----------------------------------------------------------
     def submit(self, example, model=None, tier=DEFAULT_TIER,
-               deadline_ms=None):
+               deadline_ms=None, request_id=None):
         """Route one example: returns a future-like with ``.result()``.
 
         Overload ladder: an open breaker or a shed/full-queue refusal on
@@ -338,6 +538,13 @@ class ModelFleet:
         mode) when that variant is warm and closed; only when the
         fallback also refuses does the caller see the original
         :class:`RequestShed` / :class:`BreakerOpen` / :class:`ServerBusy`.
+
+        With a canary split armed on the routed model, ``request_id``
+        seeds the deterministic hash split (falls back to the fleet's
+        route ordinal when absent — still deterministic within a seeded
+        run).  A canary-routed request the canary refuses falls back to
+        the incumbent, billed to the *canary's* degraded counter — the
+        incumbent's ledger never pays for canary trouble.
         """
         from ..resilience import chaos as _chaos
         entry = self.entry(model)
@@ -347,6 +554,26 @@ class ModelFleet:
         _chaos.maybe_inject("serving.route", count=seq,
                             ctx=(entry.name, tier))
         self._check_shape(entry, example)
+        split = entry.canary
+        if split is not None:
+            rid = request_id if request_id is not None else seq
+            to_canary = split.routes_to_canary(rid)
+            split.record_route(to_canary)
+            if to_canary:
+                c_entry = self.entry(split.canary)
+                try:
+                    # no registered-fallback hop for the canary slice:
+                    # its safety net is the incumbent itself, below
+                    return self._submit_entry(c_entry, example, tier,
+                                              deadline_ms,
+                                              allow_fallback=False)
+                except (RequestShed, ServerBusy, BreakerOpen):
+                    # canary refused -> the incumbent absorbs; the
+                    # degrade bills the CANARY (per-variant attribution)
+                    c_entry.batcher.stats.on_degraded()
+                    return self._submit_entry(entry, example, tier,
+                                              deadline_ms,
+                                              allow_fallback=True)
         return self._submit_entry(entry, example, tier, deadline_ms,
                                   allow_fallback=True)
 
@@ -399,10 +626,11 @@ class ModelFleet:
                                       allow_fallback=False)
 
     def infer(self, example, model=None, tier=DEFAULT_TIER,
-              deadline_ms=None, timeout=30.0):
+              deadline_ms=None, timeout=30.0, request_id=None):
         """Blocking convenience: route + wait for the result row."""
         return self.submit(example, model=model, tier=tier,
-                           deadline_ms=deadline_ms).result(timeout)
+                           deadline_ms=deadline_ms,
+                           request_id=request_id).result(timeout)
 
     # -- hot swap ----------------------------------------------------------
     def swap(self, name, runner, warmup=True, timeout=30.0):
@@ -424,6 +652,34 @@ class ModelFleet:
         entry.last_swap_blip_ms = (time.monotonic() - t0) * 1000.0
         entry.breaker.reset()
         return old
+
+    def deregister(self, name, timeout=30.0):
+        """Remove model ``name`` from the fleet after draining its
+        batcher (queued requests complete; new ones 404).  Refused while
+        the model is the default, someone's fallback, or half of an
+        armed canary split — routing must never dangle.  Returns the
+        removed runner (the promotion controller's rollback path)."""
+        entry = self.entry(name)
+        with self._lock:
+            if self._default == entry.name and len(self._entries) > 1:
+                raise MXNetError(
+                    "cannot deregister the default model %r" % name)
+            if entry.canary is not None or entry.canary_of:
+                raise MXNetError(
+                    "model %r is part of an armed canary split; "
+                    "clear_canary() first" % name)
+            dependents = [e.name for e in self._entries.values()
+                          if e.fallback == entry.name]
+            if dependents:
+                raise MXNetError(
+                    "model %r is the registered fallback of %s — "
+                    "re-point them first" % (name, dependents))
+        entry.batcher.drain(timeout=timeout)
+        with self._lock:
+            self._entries.pop(entry.name, None)
+            if self._default == entry.name:
+                self._default = next(iter(self._entries), None)
+        return entry.runner
 
     # -- readiness ---------------------------------------------------------
     def unready(self):
@@ -473,6 +729,14 @@ class ModelFleet:
             d["modeled_wait_ms"] = round(e.batcher.modeled_wait_ms(), 3)
             d["recompiles"] = e.runner.recompiles_since_warmup()
             d["buckets_configured"] = list(e.runner.buckets)
+            # checkpoint provenance: which exact bytes this entry serves
+            # (digest + epoch/step/train_run_id, or None for untracked
+            # runners) — what promotion audit records cross-reference
+            d["provenance"] = getattr(e.runner, "provenance", None)
+            if e.canary is not None:
+                d["canary"] = e.canary.state_dict()
+            if e.canary_of:
+                d["canary_of"] = e.canary_of
             if e.last_swap_blip_ms is not None:
                 d["last_swap_blip_ms"] = round(e.last_swap_blip_ms, 3)
             models[e.name] = d

@@ -48,12 +48,18 @@ class ModelRunner:
     """
 
     def __init__(self, model, buckets=DEFAULT_BUCKETS, example_shape=None,
-                 dtype=None, lint=True, warmup=True, hbm_cap_bytes=None):
+                 dtype=None, lint=True, warmup=True, hbm_cap_bytes=None,
+                 provenance=None):
         import os
         if hbm_cap_bytes is None:
             hbm_cap_bytes = int(os.environ.get(
                 "MXTPU_SERVING_HBM_CAP", "0")) or None
         self.hbm_cap_bytes = hbm_cap_bytes
+        # which checkpoint bytes this runner serves: the resilience
+        # checkpoint's provenance dict (digest + epoch/step/train_run_id),
+        # surfaced through fleet /stats and named by promotion audit
+        # records.  None for runners not built from a tracked checkpoint.
+        self.provenance = dict(provenance) if provenance else None
         if not buckets:
             raise MXNetError("ModelRunner needs at least one bucket")
         self.buckets = tuple(sorted(int(b) for b in set(buckets)))

@@ -97,7 +97,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         srv = self._srv
         if self.path == "/healthz":
-            body = {"status": srv.status, "alive": True, "ready": srv.ready}
+            body = {"status": srv.status, "alive": True, "ready": srv.ready,
+                    # the hello-path provenance surface: which checkpoint
+                    # bytes each model serves (digest or null) — the
+                    # quick answer to "what is live right now?"
+                    "provenance": srv.fleet.provenance_digests()}
             self._reply(200 if srv.ready else 503, body)
         elif self.path == "/livez":
             # liveness: answering at all IS the signal — never 503 here,
@@ -171,6 +175,10 @@ class _Handler(BaseHTTPRequestHandler):
             model = payload.get("model")
             tier = payload.get("tier", "gold")
             deadline_ms = payload.get("deadline_ms")
+            # request_id seeds the deterministic canary hash split; a
+            # client that wants stable variant assignment (or replayable
+            # routing) sends one — absent, the route ordinal is used
+            request_id = payload.get("request_id")
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)
             tier_rank(tier)  # validate before routing: bad tier is a 400
@@ -193,7 +201,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             pending = [srv.fleet.submit(row, model=entry.name, tier=tier,
-                                        deadline_ms=deadline_ms)
+                                        deadline_ms=deadline_ms,
+                                        request_id=request_id)
                        for row in batch]
             outs = [p.result(srv.request_timeout_s) for p in pending]
         except ServerBusy as e:

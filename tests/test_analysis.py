@@ -1033,6 +1033,58 @@ def test_srv004_fleet_registration_refused_end_to_end():
     fleet.drain()
 
 
+def test_srv005_wallclock_reads_flagged_and_suppressed():
+    """SRV005: wall-clock calls in promotion/capacity decision code are
+    errors; an inline justified disable (the measurement escape hatch)
+    and non-clock receivers are clean."""
+    from mxnet_tpu.analysis import lint_wallclock_reads
+    bad = (
+        "import time, datetime\n"
+        "def decide(metrics):\n"
+        "    t0 = time.monotonic()\n"
+        "    stamp = datetime.datetime.now()\n"
+        "    time.sleep(1.0)\n"
+        "    return t0, stamp\n")
+    found = lint_wallclock_reads(source=bad)
+    assert [f.rule_id for f in found] == ["SRV005"] * 3
+    assert all(f.severity == "error" for f in found)
+    assert "time.monotonic" in found[0].message
+    # the justified-measurement escape hatch: inline disable per line
+    ok = bad.replace(
+        "time.monotonic()",
+        "time.monotonic()  # mxlint: disable=SRV005 - measuring")
+    assert len(lint_wallclock_reads(source=ok)) == 2
+    # an arbitrary object's .now()/.sleep() is not a clock read
+    clean = ("def decide(sched):\n"
+             "    return sched.now() + queue.sleep(3)\n")
+    assert lint_wallclock_reads(source=clean) == []
+
+
+def test_srv005_shipped_mlops_sources_clean():
+    """The --self-check sweep: mxnet_tpu/mlops/ plus the decision CLIs
+    (tools/promote.py, tools/capacity.py) carry no unjustified
+    wall-clock reads — promotion reruns stay byte-identical."""
+    from mxnet_tpu.analysis import lint_promotion_sources
+    assert lint_promotion_sources() == []
+
+
+def test_srv005_sweep_catches_injected_clock(tmp_path):
+    """End-to-end through the sweep plumbing: a wall-clock read written
+    into a fake mlops/ tree is found by the same path --self-check
+    runs."""
+    from mxnet_tpu.analysis.mlops_lint import lint_promotion_sources
+    root = tmp_path / "mxnet_tpu"
+    (root / "mlops").mkdir(parents=True)
+    (root / "mlops" / "promote.py").write_text(
+        "import time\n"
+        "def evaluate():\n"
+        "    if time.time() % 60 < 30:\n"
+        "        return 'promote'\n")
+    found = lint_promotion_sources(root=str(root))
+    assert [f.rule_id for f in found] == ["SRV005"]
+    assert "promote.py:3" in found[0].subject
+
+
 def test_serving_stats_expose_modeled_cost():
     from mxnet_tpu.serving.stats import ServingStats  # noqa: F401  (sanity)
     import mxnet_tpu.serving as serving

@@ -1,0 +1,663 @@
+"""mxnet_tpu.mlops: train→canary→serve auto-promotion + the fleet
+capacity simulator (tier-1, ISSUE 12).
+
+Contract points:
+(a) checkpoint provenance: digest + (epoch, step, train_run_id) embedded
+    at save, content-stable, surfaced by runners / fleet `/stats` /
+    `/healthz`;
+(b) the canary traffic split is deterministic: seeded hash-split reruns
+    produce byte-identical canary/incumbent request sets at 1%/5%/25%,
+    including under a mid-ramp hot swap;
+(c) per-variant attribution: canary shed/degrade/breaker trouble never
+    bills the incumbent's counters;
+(d) the promotion controller promotes a good candidate through the full
+    pinned ramp and rolls back a bad one, with a versioned audit trail
+    (newer schemas refused);
+(e) the simulator is deterministic, reproduces the tier-shed/breaker/
+    degraded policies, and predicts the real host serving path within
+    the documented <= 15% tolerance (reqs/sec + per-tier p99);
+(f) capacity answers (required_replicas / tools/capacity.py) are
+    deterministic and monotone;
+(g) THE headline: a seeded chaos run where an injected-regression
+    candidate is auto-rolled-back from canary with zero gold-tier SLO
+    violations, the audit record naming the failed metric and the
+    candidate's digest, and the decision sequence byte-identical across
+    two full (retrain included) reruns.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.mlops import (AUDIT_SCHEMA_VERSION, PromotionController,
+                             read_audit_records,
+                             runner_from_trainer_checkpoint)
+from mxnet_tpu.mlops.simulator import (FleetSimulator, SimConfig,
+                                       burst_trace, diurnal_trace,
+                                       required_replicas, trace_for_dau)
+from mxnet_tpu.parallel import DataParallelTrainer
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.resilience import checkpoint as ckpt
+from mxnet_tpu.serving import ModelFleet, ModelRunner, RequestShed
+from mxnet_tpu.serving.fleet import CanarySplit
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+FEAT = 8
+NCLS = 3
+
+
+def _build_net(hidden=16):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(hidden, activation="relu"))
+    net.add(gluon.nn.Dense(NCLS))
+    return net
+
+
+def _train_checkpoint(seed, steps, ckdir, run_id, scramble=False):
+    """A tiny deterministic training run ending in one snapshot.  With
+    ``scramble`` the params are deterministically trashed afterwards —
+    the injected regression the headline rolls back."""
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = _build_net()
+    net.initialize(mx.init.Xavier())
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05}, run_id=run_id)
+    rng = np.random.RandomState(seed)
+    for i in range(steps):
+        trainer.step(mx.nd.array(rng.rand(8, FEAT).astype(np.float32)),
+                     mx.nd.array(rng.randint(0, NCLS, 8).astype(np.int64)))
+    trainer.flush()
+    if scramble:
+        srng = np.random.RandomState(1234)
+        for _, p in trainer._params_by_name.items():
+            raw = np.asarray(p.data()._data)
+            p.data()._set_data(
+                (srng.rand(*raw.shape) * 4 - 2).astype(raw.dtype))
+    return trainer.save_checkpoint(ckdir, epoch=0, nbatch=steps)
+
+
+def _factory(path, rec):
+    return runner_from_trainer_checkpoint(
+        rec, _build_net, example_shape=(FEAT,), buckets=(1, 4))
+
+
+def _hybrid_runner(seed=0, hidden=16, buckets=(1, 4)):
+    mx.random.seed(seed)
+    net = _build_net(hidden)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    return ModelRunner(net, buckets=buckets, example_shape=(FEAT,))
+
+
+# ------------------------------------------------------------ provenance
+def test_checkpoint_provenance_digest_and_surfacing(tmp_path):
+    """Snapshots embed a content digest + (epoch, step, train_run_id);
+    identical content digests identically; the digest rides the runner
+    into fleet /stats and the /healthz hello."""
+    d = str(tmp_path / "ck")
+    path = _train_checkpoint(5, 2, d, "prov-run")
+    rec = ckpt.load_checkpoint(path)
+    prov = ckpt.provenance(rec)
+    assert prov["train_run_id"] == "prov-run"
+    assert prov["epoch"] == 0 and prov["step"] == 2
+    assert len(prov["digest"]) == 64
+    # content-stable ACROSS RERUNS: the identical training repeated (new
+    # gluon gensym names and all) digests identically; different
+    # training content does not
+    rerun = ckpt.load_checkpoint(
+        _train_checkpoint(5, 2, str(tmp_path / "ck_rr"), "prov-run"))
+    assert ckpt.provenance(rerun)["digest"] == prov["digest"]
+    other = ckpt.load_checkpoint(
+        _train_checkpoint(6, 2, str(tmp_path / "ck2"), "prov-run"))
+    assert ckpt.provenance(other)["digest"] != prov["digest"]
+    # the generic digest helper is itself content-stable
+    assert ckpt.payload_digest({"a": 1}) == ckpt.payload_digest({"a": 1})
+    assert ckpt.payload_digest({"a": 1}) != ckpt.payload_digest({"a": 2})
+
+    runner, rprov = _factory(path, rec)
+    assert rprov["digest"] == prov["digest"]
+    assert runner.provenance["digest"] == prov["digest"]
+    fleet = ModelFleet(batch_timeout_ms=0.5)
+    fleet.register("m", runner)
+    st = fleet.stats_dict()
+    assert st["models"]["m"]["provenance"]["digest"] == prov["digest"]
+    assert st["models"]["m"]["provenance"]["train_run_id"] == "prov-run"
+    assert fleet.provenance_digests() == {"m": prov["digest"]}
+    fleet.drain()
+
+
+def test_provenance_additive_and_loadable_back():
+    """A pre-provenance record (no key) reads as None — the format stays
+    backward readable."""
+    assert ckpt.provenance({"version": 1, "step": 0, "payload": {}}) is None
+    assert ckpt.provenance("junk") is None
+
+
+# --------------------------------------------------- traffic split (b)
+def _split_sets(schedule, seed, n=400):
+    split = CanarySplit("c", schedule=schedule, seed=seed)
+    out = []
+    for _ in schedule:
+        out.append(frozenset(i for i in range(n)
+                             if split.routes_to_canary(i)))
+        split.advance()
+    return out
+
+
+def test_traffic_split_deterministic_and_monotone():
+    """Seeded hash-split reruns produce byte-identical canary request
+    sets at 1%/5%/25%; ramping only grows the set; a different seed
+    moves it."""
+    a = _split_sets((0.01, 0.05, 0.25), seed=7, n=2000)
+    b = _split_sets((0.01, 0.05, 0.25), seed=7, n=2000)
+    assert a == b
+    assert a[0] <= a[1] <= a[2]
+    assert 2 <= len(a[0]) <= 60 and 60 <= len(a[1]) <= 140
+    assert 400 <= len(a[2]) <= 600
+    assert _split_sets((0.01, 0.05, 0.25), seed=8, n=2000)[2] != a[2]
+
+
+def test_traffic_split_identical_under_mid_ramp_hot_swap():
+    """The live-fleet half of (b): two reruns of a seeded request
+    stream against a real fleet — with a ramp advance AND a hot swap of
+    the incumbent mid-stream — route byte-identical canary/incumbent
+    request-id sets at every fraction."""
+    def run_once():
+        fleet = ModelFleet(batch_timeout_ms=0.5)
+        fleet.register("m", _hybrid_runner(seed=40))
+        fleet.register("mc", _hybrid_runner(seed=41))
+        fleet.set_canary("m", "mc", schedule=(0.01, 0.05, 0.25), seed=3)
+        X = np.random.RandomState(0).rand(32, FEAT).astype(np.float32)
+        routed = {0.01: [], 0.05: [], 0.25: []}
+        frac = 0.01
+        before = {}
+        for i in range(300):
+            if i == 100:
+                frac = fleet.advance_canary("m")
+            if i == 150:
+                fleet.swap("m", _hybrid_runner(seed=42))  # mid-ramp swap
+            if i == 200:
+                frac = fleet.advance_canary("m")
+            before[i] = fleet.entry("mc").batcher.stats.requests_total
+            fleet.infer(X[i % 32], model="m", request_id=i, timeout=30)
+            if fleet.entry("mc").batcher.stats.requests_total > before[i]:
+                routed[frac].append(i)
+        state = fleet.canary_state("m")
+        fleet.drain()
+        return routed, state
+
+    r1, s1 = run_once()
+    r2, s2 = run_once()
+    assert r1 == r2
+    assert s1 == s2
+    assert s1["routed_canary"] == sum(len(v) for v in r1.values())
+    # every fraction stage actually routed something at 5%/25%
+    assert r1[0.25] and r1[0.05]
+
+
+# ------------------------------------------- per-variant attribution (c)
+def test_canary_shed_and_degrade_never_bills_incumbent():
+    """The regression test the fleet satellite demands: a canary that
+    sheds (tiny queue, pinned service hint, deadline'd requests) falls
+    back to the incumbent — degraded/shed/rejected land on the CANARY's
+    stats and the incumbent's ledger stays clean."""
+    fleet = ModelFleet(batch_timeout_ms=0.0)
+    fleet.register("m", _hybrid_runner(seed=50),
+                   service_time_hint_ms=1.0, max_batch=4)
+    # canary with a pinned huge service time: any deadline'd request
+    # routed to it is shed at admission, deterministically
+    fleet.register("mc", _hybrid_runner(seed=51),
+                   service_time_hint_ms=100000.0, max_batch=4)
+    fleet.set_canary("m", "mc", schedule=(0.5,), seed=0)
+    X = np.random.RandomState(1).rand(16, FEAT).astype(np.float32)
+    served = 0
+    for i in range(120):
+        fleet.infer(X[i % 16], model="m", request_id=i,
+                    deadline_ms=5000.0, timeout=30)
+        served += 1
+    st = fleet.stats_dict()
+    inc, can = st["models"]["m"], st["models"]["mc"]
+    assert served == 120
+    split = st["models"]["m"]["canary"]
+    assert split["routed_canary"] > 20          # the 50% slice
+    # every canary-routed request was shed by the canary and absorbed by
+    # the incumbent — billed to the canary, never the incumbent
+    assert can["shed_total"] == split["routed_canary"]
+    assert can["degraded_total"] == split["routed_canary"]
+    assert inc["shed_total"] == 0
+    assert inc["degraded_total"] == 0
+    assert inc["requests_total"] == 120         # it served everything
+    assert can["requests_total"] == 0
+    fleet.drain()
+
+
+def test_canary_metrics_carry_variant_labels():
+    """Registry samples split per variant: canary entries label
+    canary_of, the split exports fraction/stage/routed counters."""
+    fleet = ModelFleet(batch_timeout_ms=0.5)
+    fleet.register("m", _hybrid_runner(seed=60))
+    fleet.register("mc", _hybrid_runner(seed=61))
+    fleet.set_canary("m", "mc", schedule=(0.25,), seed=0)
+    samples = fleet._metrics_samples()
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    shed = {tuple(sorted(lab.items())): v
+            for lab, v in by_name["mxtpu_serving_shed_total"]}
+    assert (("canary_of", "m"), ("model", "mc")) in shed
+    fr = by_name["mxtpu_serving_canary_fraction"]
+    assert fr[0][0] == {"model": "m", "canary": "mc"}
+    assert fr[0][1] == 0.25
+    routed = {lab["variant"]: v
+              for lab, v in by_name["mxtpu_serving_canary_routed_total"]}
+    assert set(routed) == {"canary", "incumbent"}
+    fleet.drain()
+
+
+def test_canary_guards_and_deregister_protection():
+    fleet = ModelFleet(batch_timeout_ms=0.5)
+    fleet.register("m", _hybrid_runner(seed=70))
+    fleet.register("mc", _hybrid_runner(seed=71))
+    fleet.register("other", _hybrid_runner(seed=72, buckets=(1, 2)))
+    with pytest.raises(MXNetError, match="canary itself"):
+        fleet.set_canary("m", "m")
+    with pytest.raises(MXNetError, match="schedule"):
+        fleet.set_canary("m", "mc", schedule=(0.5, 0.1))
+    fleet.set_canary("m", "mc", schedule=(0.1,), seed=0)
+    # both halves of an armed split are deregister-protected
+    with pytest.raises(MXNetError, match="canary"):
+        fleet.deregister("mc")
+    with pytest.raises(MXNetError, match="default"):
+        fleet.deregister("m")
+    fleet.clear_canary("m")
+    assert fleet.canary_state("m") is None
+    fleet.deregister("mc")
+    assert "mc" not in fleet.models()
+    fleet.drain()
+
+
+# ------------------------------------------------ promotion controller
+def _controller(fleet, watch, audit, golden, **kw):
+    kw.setdefault("schedule", (0.01, 0.05, 0.25))
+    kw.setdefault("min_stage_requests", 8)
+    kw.setdefault("parity_threshold", 0.8)
+    kw.setdefault("register_kwargs", {"service_time_hint_ms": 5.0})
+    return PromotionController(fleet, "model", watch, _factory,
+                               golden=golden, audit_dir=audit, **kw)
+
+
+def _pump(fleet, X, rid, n=96, collect=None):
+    for _ in range(n):
+        i = rid[0]
+        rid[0] += 1
+        tier = ("gold", "silver", "bronze")[i % 3]
+        t0 = time.perf_counter()
+        try:
+            fleet.infer(X[i % len(X)], model="model", tier=tier,
+                        request_id=i, timeout=60)
+        except RequestShed as e:
+            if collect is not None:
+                collect.append((tier, "shed", e.shed_at))
+            continue
+        if collect is not None:
+            collect.append((tier, "served",
+                            (time.perf_counter() - t0) * 1e3))
+
+
+def test_promotion_good_candidate_promotes_through_ramp(tmp_path):
+    """A good candidate (identical training, more steps) rides the full
+    pinned 1%→5%→25% ramp and is promoted by hot swap; the audit trail
+    is start→advance→advance→promote and the registry counted it."""
+    ck_inc = str(tmp_path / "inc")
+    watch = str(tmp_path / "watch")
+    audit = str(tmp_path / "audit")
+    path = _train_checkpoint(0, 2, ck_inc, "tp-inc")
+    inc_runner, _ = _factory(path, ckpt.load_checkpoint(path))
+    fleet = ModelFleet(batch_timeout_ms=0.5)
+    fleet.register("model", inc_runner, tier_slos={"gold": 10000.0},
+                   service_time_hint_ms=5.0)
+    rng = np.random.RandomState(9)
+    golden = rng.rand(16, FEAT).astype(np.float32)
+    ctrl = _controller(fleet, watch, audit, golden, parity_threshold=0.5)
+    _train_checkpoint(0, 3, watch, "tp-cand")
+    cand_digest = ckpt.provenance(
+        ckpt.latest_checkpoint(watch)[1])["digest"]
+    X = rng.rand(64, FEAT).astype(np.float32)
+    rid = [0]
+    rec = ctrl.run(pump=lambda t: _pump(fleet, X, rid))
+    assert rec is not None and rec["decision"]["decision"] == "promote"
+    decisions = [d["decision"] for d in ctrl.decisions()]
+    assert decisions == ["start_canary", "advance", "advance", "promote"]
+    fracs = [d["fraction"] for d in ctrl.decisions()]
+    assert fracs == [0.01, 0.05, 0.25, 0.25]
+    # promoted: the incumbent now serves the candidate's exact bytes
+    assert ctrl.incumbent_digest() == cand_digest
+    assert fleet.models() == ["model"]          # canary cleaned up
+    assert fleet.canary_state("model") is None
+    # audit trail on disk matches, registry counted the decisions
+    trail = read_audit_records(audit)
+    assert [r["decision"]["decision"] for r in trail] == decisions
+    assert all(r["schema_version"] == AUDIT_SCHEMA_VERSION
+               for r in trail)
+    n = ctrl.registry.counter(
+        "mxtpu_promotion_decisions_total").value(
+            model="model", decision="promote")
+    assert n >= 1
+    # the same digest is never re-canaried
+    assert ctrl.poll() is None
+    fleet.drain()
+
+
+def test_audit_records_newer_schema_refused(tmp_path):
+    audit = str(tmp_path)
+    with open(os.path.join(audit, "audit-000001.json"), "w") as f:
+        json.dump({"schema_version": AUDIT_SCHEMA_VERSION + 1,
+                   "decision": {}}, f)
+    with pytest.raises(ValueError, match="schema_version"):
+        read_audit_records(audit)
+
+
+def test_chaos_site_mlops_decision_is_wired(tmp_path):
+    """The new probe site fires per evaluate tick with (model, state)
+    ctx — a schedule can kill the controller at any decision boundary."""
+    fleet = ModelFleet(batch_timeout_ms=0.5)
+    fleet.register("model", _hybrid_runner(seed=80),
+                   service_time_hint_ms=5.0)
+    ctrl = _controller(fleet, str(tmp_path / "w"), str(tmp_path / "a"),
+                       golden=None)
+    chaos.install([chaos.Fault("mlops.decision", 2, "raise")])
+    try:
+        assert ctrl.evaluate() is None          # tick 1: clean
+        with pytest.raises(chaos.ChaosError):   # tick 2: injected
+            ctrl.evaluate()
+        assert chaos.triggered()
+    finally:
+        chaos.uninstall()
+    fleet.drain()
+
+
+# ----------------------------------------------------------- simulator
+def test_simulator_deterministic_and_tier_ordered():
+    cfg = SimConfig(service_ms=5.0, buckets=(1, 4, 8),
+                    batch_timeout_ms=2.0, max_queue=64)
+    tr = diurnal_trace(8.0, 150.0, seed=3)
+    r1 = FleetSimulator(cfg, replicas=2).run(tr)
+    r2 = FleetSimulator(cfg, replicas=2).run(tr)
+    assert json.dumps(r1, sort_keys=True) == json.dumps(r2, sort_keys=True)
+    assert r1["served"] + r1["shed_total"] + r1["rejected_total"] \
+        == r1["arrivals"]
+    # an overload burst sheds the deadline'd lowest tier, never gold
+    b = burst_trace(240, deadlines_ms={"bronze": 30.0})
+    rb = FleetSimulator(cfg, replicas=1).run(b)
+    assert rb["tiers"]["bronze"]["shed"] > 0
+    assert rb["tiers"].get("gold", {}).get("shed", 0) == 0
+    # tier ordering: on a deadline-free contended burst the gold tail
+    # beats silver beats bronze (the queue is (tier, deadline, seq))
+    big = SimConfig(service_ms=5.0, buckets=(1, 4, 8),
+                    batch_timeout_ms=2.0, max_queue=1024)
+    rq = FleetSimulator(big, replicas=1).run(burst_trace(240))
+    assert rq["shed_total"] == 0 and rq["rejected_total"] == 0
+    assert rq["tiers"]["gold"]["p99_ms"] < rq["tiers"]["silver"]["p99_ms"] \
+        < rq["tiers"]["bronze"]["p99_ms"]
+
+
+def test_simulator_breaker_and_degraded_policies():
+    """Injected batch failures trip the modeled breaker; with a modeled
+    fallback the refused slice is absorbed in degraded mode."""
+    fallback = SimConfig(service_ms=2.0, buckets=(1, 4, 8),
+                         batch_timeout_ms=1.0, max_queue=256)
+    cfg = SimConfig(service_ms=5.0, buckets=(1, 4, 8),
+                    batch_timeout_ms=1.0, max_queue=256,
+                    breaker_threshold=3, breaker_open_ms=1000.0,
+                    fail_batches=range(0, 6), fallback=fallback)
+    tr = burst_trace(200, spacing_ms=2.0)
+    rep = FleetSimulator(cfg, replicas=1).run(tr)
+    assert rep["breaker_trips"] >= 1
+    assert rep["failed_total"] > 0
+    assert rep["degraded_total"] > 0
+    assert rep["fallback"]["served"] == rep["degraded_total"]
+    # no fallback -> the same refused slice is dropped, not served
+    cfg2 = SimConfig(service_ms=5.0, buckets=(1, 4, 8),
+                     batch_timeout_ms=1.0, max_queue=256,
+                     breaker_threshold=3, breaker_open_ms=1000.0,
+                     fail_batches=range(0, 6))
+    rep2 = FleetSimulator(cfg2, replicas=1).run(tr)
+    assert rep2["breaker_refused"] > 0 and rep2["degraded_total"] == 0
+
+
+def test_simulator_validation_within_documented_tolerance():
+    """The acceptance gate: modeled reqs/sec and per-tier p99 within
+    15% of the real host serving bench — the exact bench-fleet scenario
+    (parked-burst pattern, interleaved calibrate/predict pairs, median
+    pair reported)."""
+    from mxnet_tpu.mlops.bench import simulator_validation
+    out = simulator_validation()
+    assert out["simulator_accuracy_pct"] >= 85.0, out
+    assert all(err <= 15.0
+               for err in out["simulator_errors_pct"].values()), out
+
+
+def test_capacity_deterministic_and_monotone():
+    svc = {1: 8.0, 4: 18.0, 8: 32.0}
+    cfg = SimConfig(service_ms=lambda b: svc[b], buckets=(1, 4, 8),
+                    batch_timeout_ms=2.0, max_queue=128)
+    deadlines = {"gold": 250.0, "silver": 400.0, "bronze": 150.0}
+    tr = trace_for_dau(1_000_000, window_s=8.0, seed=0,
+                       deadlines_ms=deadlines)
+    k1, rep1 = required_replicas(cfg, tr, slo_tier="gold",
+                                 slo_p99_ms=250.0)
+    k2, rep2 = required_replicas(cfg, tr, slo_tier="gold",
+                                 slo_p99_ms=250.0)
+    assert (k1, rep1) == (k2, rep2)
+    assert k1 >= 1 and rep1["tiers"]["gold"]["p99_ms"] <= 250.0
+    # more users can never need fewer replicas
+    tr_big = trace_for_dau(4_000_000, window_s=8.0, seed=0,
+                           deadlines_ms=deadlines)
+    k_big, _ = required_replicas(cfg, tr_big, slo_tier="gold",
+                                 slo_p99_ms=250.0)
+    assert k_big >= k1
+
+
+def test_capacity_cli(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "capacity_tool", os.path.join(_ROOT, "tools", "capacity.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    args = tool.parse_args(["--dau", "1000000", "--slo-ms", "250",
+                            "--window-s", "8"])
+    k1, trace1, rep1 = tool.answer(args)
+    k2, trace2, rep2 = tool.answer(args)
+    assert k1 == k2 and trace1 == trace2
+    assert rep1["tiers"]["gold"]["p99_ms"] <= 250.0
+    assert tool.parse_service_ms("1=8,4=18") == {1: 8.0, 4: 18.0}
+    with pytest.raises(SystemExit):
+        tool.parse_service_ms("nonsense")
+
+
+# ------------------------------------------------------ serve CLI (tools)
+def test_serve_cli_canary_flags(tmp_path):
+    """--canary NAME=PREFIX[@EPOCH] + --canary-fraction arm a
+    single-stage deterministic split on the fleet; legacy flags parse
+    unchanged."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_canary_tool", os.path.join(_ROOT, "tools", "serve.py"))
+    serve = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="cn_fc1")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=NCLS, name="cn_fc2"),
+        name="softmax")
+    mod = mx.mod.Module(out)
+    mod.bind(data_shapes=[("data", (4, FEAT))],
+             label_shapes=[("softmax_label", (4,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1)
+
+    args = serve.parse_args([
+        "--model", "mlp=%s@1" % prefix,
+        "--canary", "mlp=%s@1" % prefix,
+        "--canary-fraction", "0.25", "--canary-seed", "7",
+        "--data-shape", str(FEAT), "--buckets", "1,4"])
+    fleet = serve.build_fleet(args)
+    assert fleet.models() == ["mlp", "mlp__canary"]
+    state = fleet.canary_state("mlp")
+    assert state["fraction"] == 0.25 and state["seed"] == 7
+    fleet.drain()
+    # a canary for an unregistered model is refused at parse/build
+    bad = serve.parse_args(["--model", "mlp=%s@1" % prefix,
+                            "--canary", "ghost=%s@1" % prefix,
+                            "--data-shape", str(FEAT),
+                            "--buckets", "1,4"])
+    with pytest.raises(SystemExit, match="unregistered"):
+        serve.build_fleet(bad)
+
+
+def test_promote_cli_inspect_renders_audit(tmp_path, capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "promote_tool", os.path.join(_ROOT, "tools", "promote.py"))
+    tool = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tool)
+    rec = {"schema_version": AUDIT_SCHEMA_VERSION,
+           "decision": {"seq": 1, "model": "m", "decision": "rollback",
+                        "stage": 0, "fraction": 0.01,
+                        "candidate_digest": "ab" * 32,
+                        "incumbent_digest": "cd" * 32,
+                        "failed_metric": "golden_parity"},
+           "evidence": {"golden_parity": 0.1}}
+    with open(str(tmp_path / "audit-000001.json"), "w") as f:
+        json.dump(rec, f)
+    text = tool.render_audit([rec])
+    assert "rollback" in text and "golden_parity" in text \
+        and "abababab" in text
+    assert tool.main(["--inspect", str(tmp_path)]) == 0
+    assert "rollback" in capsys.readouterr().out
+    # no mode given: usage hint, exit 2
+    assert tool.main([]) == 2
+
+
+def test_mlops_bench_keys():
+    from mxnet_tpu.mlops.bench import capacity_answer
+    out = capacity_answer()
+    assert out["capacity_replicas_for_1m_dau"] >= 1
+    assert out["capacity_trace_arrivals"] > 1000
+    assert out["simulator_events_per_sec"] > 0
+    # deterministic: the pinned scenario always answers the same
+    assert capacity_answer()["capacity_replicas_for_1m_dau"] \
+        == out["capacity_replicas_for_1m_dau"]
+
+
+# ------------------------------------------------------- the headline
+def _headline_run(root):
+    """One full seeded chaos run: train incumbent, serve it with a gold
+    SLO, train + scramble a candidate (the injected regression), run
+    the controller loop under live tiered traffic with a chaos stall on
+    the serving path.  Returns every observable the acceptance criteria
+    assert on."""
+    chaos.install([chaos.Fault("serving.batch", 3, "delay", 0.05)])
+    try:
+        ck_inc = os.path.join(root, "inc")
+        watch = os.path.join(root, "watch")
+        audit = os.path.join(root, "audit")
+        path = _train_checkpoint(0, 3, ck_inc, "hl-incumbent")
+        inc_runner, inc_prov = _factory(path, ckpt.load_checkpoint(path))
+        fleet = ModelFleet(batch_timeout_ms=0.5)
+        fleet.register("model", inc_runner,
+                       tier_slos={"gold": 2000.0},
+                       service_time_hint_ms=5.0)
+        rng = np.random.RandomState(11)
+        golden = rng.rand(16, FEAT).astype(np.float32)
+        ctrl = _controller(fleet, watch, audit, golden)
+        _train_checkpoint(0, 5, watch, "hl-candidate", scramble=True)
+        cand_digest = ckpt.provenance(
+            ckpt.latest_checkpoint(watch)[1])["digest"]
+        X = rng.rand(64, FEAT).astype(np.float32)
+        rid = [0]
+        outcomes = []
+        rec = ctrl.run(
+            pump=lambda t: _pump(fleet, X, rid, collect=outcomes))
+        stats = fleet.stats_dict()
+        slo = fleet.entry("model").tier_slos["gold"]
+        gold_lat = [v for tier, kind, v in outcomes
+                    if tier == "gold" and kind == "served"]
+        gold_shed = [v for tier, kind, v in outcomes
+                     if tier == "gold" and kind == "shed"]
+        triggered = chaos.triggered()
+        fleet.drain()
+        return {
+            "terminal": rec,
+            "decisions_blob": ctrl.decisions_blob(),
+            "audit": read_audit_records(audit),
+            "incumbent_digest": inc_prov["digest"],
+            "candidate_digest": cand_digest,
+            "stats": stats,
+            "slo": slo,
+            "gold_lat": gold_lat,
+            "gold_shed": gold_shed,
+            "triggered": triggered,
+            "models_after": sorted(stats["models"]),
+        }
+    finally:
+        chaos.uninstall()
+
+
+def test_headline_regression_rollback_chaos(tmp_path):
+    """THE acceptance test: an injected-regression candidate is
+    auto-rolled-back from canary with zero gold-tier SLO violations,
+    the audit record names the failed metric and the candidate's
+    checkpoint digest, and the promote/rollback decision sequence is
+    byte-identical across two full (retrain included) reruns."""
+    r1 = _headline_run(str(tmp_path / "run1"))
+    r2 = _headline_run(str(tmp_path / "run2"))
+
+    for r in (r1, r2):
+        # auto-rollback happened
+        term = r["terminal"]
+        assert term is not None
+        assert term["decision"]["decision"] == "rollback"
+        # the audit record names the metric and the checkpoint digest
+        # that failed
+        assert term["decision"]["failed_metric"] == "golden_parity"
+        assert term["decision"]["candidate_digest"] == r["candidate_digest"]
+        assert term["evidence"]["golden_parity"] < 0.8
+        # the incumbent still serves its original bytes, canary gone
+        m = r["stats"]["models"]["model"]
+        assert m["provenance"]["digest"] == r["incumbent_digest"]
+        assert r["models_after"] == ["model"]
+        # zero gold-tier SLO violations: every gold request served, none
+        # shed, and every end-to-end latency inside the declared SLO
+        assert r["gold_shed"] == []
+        assert r["gold_lat"] and max(r["gold_lat"]) <= r["slo"]
+        assert m["tiers"].get("gold", {}).get("shed", 0) == 0
+        # the chaos stall really fired during the run
+        assert any(site == "serving.batch"
+                   for site, _, _, _ in r["triggered"])
+        # audit trail: start_canary then rollback, schema pinned
+        kinds = [a["decision"]["decision"] for a in r["audit"]]
+        assert kinds == ["start_canary", "rollback"]
+        assert all(a["schema_version"] == AUDIT_SCHEMA_VERSION
+                   for a in r["audit"])
+
+    # byte-identical decision sequences across the two full reruns —
+    # training, canary start, judgement and rollback included
+    assert r1["decisions_blob"] == r2["decisions_blob"]
+    assert json.dumps([a["decision"] for a in r1["audit"]],
+                      sort_keys=True) \
+        == json.dumps([a["decision"] for a in r2["audit"]],
+                      sort_keys=True)
+    # the retrained checkpoints digest identically too (full determinism)
+    assert r1["candidate_digest"] == r2["candidate_digest"]
+    assert r1["incumbent_digest"] == r2["incumbent_digest"]

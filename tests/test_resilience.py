@@ -769,7 +769,10 @@ def test_serving_liveness_vs_readiness():
         # warming: alive but NOT ready
         status, body = _get(port, "/healthz")
         assert status == 503
-        assert body == {"status": "warming", "alive": True, "ready": False}
+        assert body == {"status": "warming", "alive": True, "ready": False,
+                        # the hello-path provenance surface (ISSUE 12):
+                        # untracked runners report a null digest
+                        "provenance": {"default": None}}
         assert _get(port, "/livez") == (200, {"alive": True})
         assert _get(port, "/readyz")[0] == 503
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Capacity CLI: "how many replicas for N DAU at gold SLO?" — answered
+deterministically by the mlops fleet simulator.
+
+    python tools/capacity.py --dau 1000000 --slo-ms 250
+    python tools/capacity.py --dau 5000000 --slo-ms 100 \
+        --service-ms 1=8,4=18,8=32 --window-s 60 --json
+
+The traffic model is the seeded diurnal generator scaled to ``--dau``
+(mean rate = dau x requests/user/day / 86400, judged on a window at the
+diurnal crest where the rate is ``--peak-factor`` x the mean); the
+service model is the pinned per-bucket table (``--service-ms``) so the
+answer is byte-identical on any host — regenerate the table from a real
+measurement (mxnet_tpu/mlops/bench.py's calibration) or from the mxcost
+modeled cost (``service_ms_from_modeled_cost``) when the model changes.
+The SLO is met only when the judged tier's simulated p99 fits AND total
+shed stays under ``--max-total-shed-rate`` (tier-ordered shedding would
+otherwise sacrifice bronze to flatter the answer).  Exit 0 with the
+answer, 3 when no replica count can meet the SLO.  See docs/mlops.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# the pinned default service table (ms per padded batch) — matches the
+# mlops bench's capacity scenario so the CLI and the gated bench key
+# answer the same question
+DEFAULT_SERVICE_MS = "1=8,4=18,8=32"
+
+
+def parse_service_ms(spec):
+    """``"1=8,4=18,8=32"`` -> {bucket: ms} (buckets ascending)."""
+    table = {}
+    for part in str(spec).split(","):
+        if not part.strip():
+            continue
+        bucket, sep, ms = part.partition("=")
+        if not sep:
+            raise SystemExit("bad --service-ms entry %r (want B=MS)"
+                             % (part,))
+        table[int(bucket)] = float(ms)
+    if not table:
+        raise SystemExit("empty --service-ms table")
+    return table
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="deterministic fleet capacity answers "
+                    "(mxnet_tpu.mlops.simulator)")
+    p.add_argument("--dau", type=float, required=True,
+                   help="daily active users the fleet must carry")
+    p.add_argument("--requests-per-user-per-day", type=float, default=20.0)
+    p.add_argument("--peak-factor", type=float, default=2.0,
+                   help="diurnal peak:mean rate ratio; capacity is "
+                        "judged at the crest")
+    p.add_argument("--window-s", type=float, default=20.0,
+                   help="crest window simulated")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--slo-tier", default="gold")
+    p.add_argument("--slo-ms", type=float, required=True,
+                   help="p99 budget for --slo-tier (also its admission "
+                        "deadline)")
+    p.add_argument("--max-shed-rate", type=float, default=0.0,
+                   help="tolerated shed fraction within --slo-tier")
+    p.add_argument("--max-total-shed-rate", type=float, default=0.01,
+                   help="tolerated shed/reject fraction over ALL tiers")
+    p.add_argument("--service-ms", default=DEFAULT_SERVICE_MS,
+                   help="pinned per-bucket batch service times, B=MS "
+                        "pairs (default: the bench capacity scenario)")
+    p.add_argument("--batch-timeout-ms", type=float, default=2.0)
+    p.add_argument("--max-queue", type=int, default=128)
+    p.add_argument("--max-replicas", type=int, default=4096)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    return p.parse_args(argv)
+
+
+def answer(args):
+    from mxnet_tpu.mlops.simulator import (SimConfig, required_replicas,
+                                           trace_for_dau)
+
+    table = parse_service_ms(args.service_ms)
+    buckets = tuple(sorted(table))
+    cfg = SimConfig(service_ms=lambda b: table[b], buckets=buckets,
+                    batch_timeout_ms=args.batch_timeout_ms,
+                    max_queue=args.max_queue)
+    deadlines = {"gold": 500.0, "silver": 400.0, "bronze": 150.0}
+    deadlines[args.slo_tier] = float(args.slo_ms)
+    trace = trace_for_dau(
+        args.dau, window_s=args.window_s,
+        requests_per_user_per_day=args.requests_per_user_per_day,
+        seed=args.seed, peak_factor=args.peak_factor,
+        deadlines_ms=deadlines)
+    replicas, report = required_replicas(
+        cfg, trace, slo_tier=args.slo_tier, slo_p99_ms=args.slo_ms,
+        max_shed_rate=args.max_shed_rate,
+        max_total_shed_rate=args.max_total_shed_rate,
+        max_replicas=args.max_replicas)
+    return replicas, trace, report
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    try:
+        replicas, trace, report = answer(args)
+    except ValueError as e:
+        print("UNSATISFIABLE: %s" % e)
+        return 3
+    if args.as_json:
+        print(json.dumps({"replicas": replicas, "dau": args.dau,
+                          "slo_tier": args.slo_tier,
+                          "slo_p99_ms": args.slo_ms,
+                          "arrivals": len(trace),
+                          "report": report}, indent=1, sort_keys=True,
+                         default=str))
+    else:
+        mean_rps = args.dau * args.requests_per_user_per_day / 86400.0
+        print("%.0f DAU -> %.1f reqs/s mean, ~%.1f at the diurnal crest"
+              % (args.dau, mean_rps, mean_rps * args.peak_factor))
+        print("replicas needed for %s p99 <= %.0fms: %d"
+              % (args.slo_tier, args.slo_ms, replicas))
+        print(report.render())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

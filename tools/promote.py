@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Promotion controller CLI: watch a checkpoint dir, canary, promote.
+
+The train→canary→serve loop (mxnet_tpu.mlops.promote) as a tool:
+
+    # inspect an audit trail
+    python tools/promote.py --inspect /path/to/audit
+
+    # end-to-end demo: trains an incumbent + a candidate MLP, serves the
+    # incumbent in a fleet, canaries the candidate on a seeded hash
+    # split (1% -> 5% -> 25%), judges it from registry metrics + golden
+    # parity, promotes — then repeats with an injected-regression
+    # candidate and proves the auto-rollback
+    python tools/promote.py --demo --workdir /tmp/promo
+
+Decisions are driven exclusively by registry metrics and pinned
+schedules (the SRV005 sweep covers this file): the ramp advances on
+canary request counts, never on a timer.  Every decision lands in
+``<audit-dir>/audit-<seq>.json`` (schema pinned, see docs/mlops.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="train->canary->serve promotion controller "
+                    "(mxnet_tpu.mlops)")
+    p.add_argument("--inspect", metavar="AUDIT_DIR",
+                   help="render an audit trail and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--demo", action="store_true",
+                   help="run the in-process end-to-end demo "
+                        "(train -> canary -> promote, then an injected "
+                        "regression -> rollback)")
+    p.add_argument("--workdir", default=None,
+                   help="demo working directory (default: a tmpdir)")
+    p.add_argument("--schedule", default="0.01,0.05,0.25",
+                   help="pinned canary fraction ramp")
+    p.add_argument("--seed", type=int, default=0,
+                   help="traffic-split hash seed + demo data seed")
+    p.add_argument("--min-stage-requests", type=int, default=8,
+                   help="canary requests served before a stage is judged")
+    p.add_argument("--parity-threshold", type=float, default=0.5,
+                   help="golden-parity floor below which a candidate "
+                        "rolls back")
+    p.add_argument("--golden", type=int, default=32,
+                   help="golden request set size for the parity check")
+    p.add_argument("--traffic-per-tick", type=int, default=96,
+                   help="demo requests pumped between decision ticks")
+    return p.parse_args(argv)
+
+
+def render_audit(records):
+    lines = []
+    for rec in records:
+        d = rec["decision"]
+        ev = rec.get("evidence", {})
+        extra = ""
+        if d.get("failed_metric"):
+            extra = "  FAILED %s=%r" % (d["failed_metric"],
+                                        ev.get(d["failed_metric"]))
+        lines.append(
+            "#%03d %-13s %-8s stage=%d frac=%-5g cand=%s%s"
+            % (d["seq"], d["decision"], d["model"], d["stage"],
+               d["fraction"],
+               (d.get("candidate_digest") or "?")[:12], extra))
+    if not lines:
+        lines.append("(no audit records)")
+    return "\n".join(lines)
+
+
+def run_demo(args):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.mlops import (PromotionController,
+                                 runner_from_trainer_checkpoint)
+    from mxnet_tpu.parallel import DataParallelTrainer
+    from mxnet_tpu.resilience.checkpoint import latest_checkpoint
+    from mxnet_tpu.serving import ModelFleet
+
+    feat, ncls = 16, 4
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+        workdir = tempfile.mkdtemp(prefix="mxtpu_promote_demo_")
+    schedule = tuple(float(f) for f in args.schedule.split(","))
+
+    def build_net():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(ncls))
+        return net
+
+    def train(seed, steps, ckdir, run_id, scramble=False):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        net = build_net()
+        net.initialize(mx.init.Xavier())
+        trainer = DataParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.05}, run_id=run_id)
+        rng = np.random.RandomState(seed)
+        for i in range(steps):
+            trainer.step(
+                mx.nd.array(rng.rand(8, feat).astype(np.float32)),
+                mx.nd.array(rng.randint(0, ncls, 8).astype(np.int64)))
+        trainer.flush()
+        if scramble:
+            # the injected regression: deterministic param scrambling —
+            # the candidate trains fine but serves garbage (the failure
+            # class golden parity exists to catch)
+            srng = np.random.RandomState(1234)
+            for _, p in trainer._params_by_name.items():
+                raw = np.asarray(p.data()._data)
+                p.data()._set_data(
+                    (srng.rand(*raw.shape) * 4 - 2).astype(raw.dtype))
+        trainer.save_checkpoint(ckdir, epoch=0, nbatch=steps)
+
+    def factory(path, rec):
+        return runner_from_trainer_checkpoint(
+            rec, build_net, example_shape=(feat,), buckets=(1, 4))
+
+    ck_inc = os.path.join(workdir, "incumbent")
+    ck_watch = os.path.join(workdir, "watch")
+    audit = os.path.join(workdir, "audit")
+    train(args.seed, 2, ck_inc, "demo-incumbent")
+    inc_runner, prov = factory(*latest_checkpoint(ck_inc))
+    fleet = ModelFleet(batch_timeout_ms=0.5)
+    fleet.register("model", inc_runner, tier_slos={"gold": 10000.0},
+                   service_time_hint_ms=5.0)
+    rng = np.random.RandomState(args.seed + 1)
+    golden = rng.rand(args.golden, feat).astype(np.float32)
+    ctrl = PromotionController(
+        fleet, "model", ck_watch, factory, golden=golden,
+        audit_dir=audit, schedule=schedule, split_seed=args.seed,
+        min_stage_requests=args.min_stage_requests,
+        parity_threshold=args.parity_threshold,
+        register_kwargs={"service_time_hint_ms": 5.0})
+
+    X = rng.rand(256, feat).astype(np.float32)
+    rid = [0]
+
+    def pump(_tick):
+        for _ in range(args.traffic_per_tick):
+            i = rid[0]
+            rid[0] += 1
+            fleet.infer(X[i % 256], model="model", request_id=i,
+                        timeout=60)
+
+    results = {}
+    print("== phase 1: a good candidate promotes ==")
+    train(args.seed, 4, ck_watch, "demo-candidate-good")
+    rec = ctrl.run(pump=pump)
+    results["good_candidate"] = rec["decision"] if rec else None
+    print(render_audit([rec] if rec else []))
+
+    print("== phase 2: an injected-regression candidate rolls back ==")
+    train(args.seed, 6, ck_watch, "demo-candidate-bad", scramble=True)
+    rec = ctrl.run(pump=pump)
+    results["bad_candidate"] = rec["decision"] if rec else None
+    print(render_audit([rec] if rec else []))
+
+    from mxnet_tpu.mlops import read_audit_records
+    trail = read_audit_records(audit)
+    fleet.drain()
+    if args.as_json:
+        print(json.dumps({"results": results,
+                          "audit": [r["decision"] for r in trail]},
+                         indent=1, sort_keys=True))
+    else:
+        print("== full audit trail (%s) ==" % audit)
+        print(render_audit(trail))
+    ok = (results["good_candidate"] or {}).get("decision") == "promote" \
+        and (results["bad_candidate"] or {}).get("decision") == "rollback"
+    print("demo %s" % ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.inspect:
+        from mxnet_tpu.mlops import read_audit_records
+        records = read_audit_records(args.inspect)
+        if args.as_json:
+            print(json.dumps(records, indent=1, sort_keys=True))
+        else:
+            print(render_audit(records))
+        return 0
+    if args.demo:
+        return run_demo(args)
+    print("give --demo or --inspect AUDIT_DIR (see --help)")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

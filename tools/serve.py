@@ -59,6 +59,19 @@ def parse_args(argv=None):
                    help="degraded mode: overflow NAME sheds (or refuses "
                         "with an open breaker) reroutes to VARIANT. "
                         "Repeatable.")
+    p.add_argument("--canary", action="append", default=[],
+                   metavar="NAME=PREFIX[@EPOCH]",
+                   help="arm a deterministic canary traffic split on "
+                        "fleet model NAME: the checkpoint at PREFIX[@"
+                        "EPOCH] is loaded as NAME__canary and receives "
+                        "the seeded hash slice of NAME's requests at "
+                        "--canary-fraction.  Repeatable (one per model).")
+    p.add_argument("--canary-fraction", type=float, default=0.05,
+                   help="fraction of request-id hash space routed to "
+                        "each --canary variant (a single pinned stage; "
+                        "ramped schedules belong to tools/promote.py)")
+    p.add_argument("--canary-seed", type=int, default=0,
+                   help="hash seed for the canary traffic split")
     p.add_argument("--hbm-cap", type=int, default=None,
                    help="fleet modeled-HBM packing cap in bytes (SRV004; "
                         "default: MXTPU_SERVING_HBM_CAP, 0 disables)")
@@ -218,6 +231,23 @@ def build_fleet(args):
     if unknown or missing:
         raise SystemExit("--fallback names unregistered models: %s"
                          % sorted(unknown | missing))
+    # canary variants ride the same --model parsing (NAME=PREFIX[@EPOCH],
+    # :int8 allowed): each loads as NAME__canary and splits NAME's
+    # traffic by the seeded request-id hash — legacy flags untouched
+    for spec in args.canary:
+        name, prefix, epoch, int8 = parse_model_spec(spec)
+        if name not in names:
+            raise SystemExit("--canary names unregistered model %r "
+                             "(give --model %s=... too)" % (name, name))
+        mod = _load_module(prefix, epoch, args.data_name, example_shape,
+                           buckets, int8=int8)
+        runner = ModelRunner(mod, buckets=buckets, dtype=args.dtype,
+                             warmup=not args.no_warmup)
+        canary_name = name + "__canary"
+        fleet.register(canary_name, runner, max_batch=args.max_batch)
+        fleet.set_canary(name, canary_name,
+                         schedule=(args.canary_fraction,),
+                         seed=args.canary_seed)
     return fleet
 
 
