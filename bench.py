@@ -356,6 +356,15 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_RESILIENCE", "1") == "1":
         rec.stage("resilience", 150, _resilience_bench)
 
+    # -- elastic-tier micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): zero1_modeled_hbm_drop_pct (the ZeRO-1
+    # memory win from the RUNTIME tape), reshard_restore_ms (the
+    # resize-on-resume restore path) and supervisor_failover_steps_lost
+    # (a real chaos SIGKILL -> shrink -> resume through the elastic
+    # supervisor) stay live when the TPU is down
+    if os.environ.get("MXTPU_BENCH_ELASTIC", "1") == "1":
+        rec.stage("elastic", 150, _elastic_bench)
+
     # -- telemetry micro-bench, host-only and BEFORE backend acquisition
     # (r05 pattern): the observability layer's own cost must be provable
     # cheap — telemetry_overhead_pct (<= 1% gate), metrics_scrape_ms and
@@ -675,6 +684,31 @@ def _resilience_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("resilience bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _elastic_bench():
+    """zero1_modeled_hbm_drop_pct + reshard_restore_ms +
+    supervisor_failover_steps_lost through the elastic harness
+    (mxnet_tpu/resilience/elastic_bench.py): the runtime-tape ZeRO-1
+    memory proof, a 4-way shard checkpoint restored into a 2-way
+    trainer (bitwise-checked), and a real supervisor failover (chaos
+    SIGKILL of 1-of-2 ranks, auto-shrink + resume, steps_lost from the
+    audit record).  JAX_PLATFORMS=cpu subprocess with a 4-device
+    virtual mesh — same isolation contract as the other host stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the reshard stage needs a 4-way virtual mesh in the child
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.resilience.elastic_bench"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("elastic bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

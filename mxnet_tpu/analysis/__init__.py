@@ -27,7 +27,8 @@ from .graph_lint import lint_graph, LOSS_OPS, LARGE_CONST_BYTES
 from .source_lint import lint_source, lint_file
 from .serving_lint import (lint_serving, lint_fleet_hbm,
                            lint_deadline_propagation)
-from .mlops_lint import lint_wallclock_reads, lint_promotion_sources
+from .mlops_lint import (lint_wallclock_reads, lint_promotion_sources,
+                         lint_supervisor_sources)
 from .telemetry_lint import (lint_chaos_sites, probe_sites_used,
                              lint_attribution_phases,
                              attribution_phases_used,
@@ -49,6 +50,7 @@ __all__ = [
     "lint_symbol", "lint_serving", "lint_fleet_hbm",
     "lint_deadline_propagation", "lint_serving_sources",
     "lint_wallclock_reads", "lint_promotion_sources",
+    "lint_supervisor_sources",
     "lint_rule_docs", "self_check",
     "lint_shipped_loops", "lint_worker_loops",
     "lint_chaos_sites", "probe_sites_used", "lint_attribution_phases",
@@ -108,6 +110,7 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += lint_serving_sources(disable=disable)
     if with_mlops:
         findings += lint_promotion_sources(disable=disable)
+        findings += lint_supervisor_sources(disable=disable)
     if with_telemetry:
         findings += lint_chaos_sites(disable=disable)
         findings += lint_attribution_phases(disable=disable)

@@ -192,7 +192,183 @@ def zero1_mlp_train_step():
             100.0 * drop / twin.peak_hbm_bytes, 2)
         if twin.peak_hbm_bytes else 0.0,
     })
+    # the RUNTIME half (ISSUE 13): the real DataParallelTrainer(zero=1)
+    # step tape must satisfy the same budget — parity with the fixture
+    # the row pins, the ZeRO-1 HBM relation against its own per-replica
+    # twin, the mixed-axis DST lint (a deleted runtime all-gather is
+    # DST007 -> rc 2) and reduce-scatter/all-gather byte parity with the
+    # collectives the global-view mxshard pass infers for the
+    # replicated spelling
+    rt_findings, rt_extras = zero1_runtime_checks(report)
+    findings += rt_findings
+    shard.extras.update(rt_extras)
     return report, findings, shard
+
+
+def _zero1_geometry_trainer(zero):
+    """A real ``DataParallelTrainer`` at the pinned ``ZERO1_GEOMETRY``
+    (the fixture's 3-layer MLP), on the 1-cpu-device mesh — hardware-
+    free analysis subject for the runtime half of the ZeRO-1 proof."""
+    import jax
+
+    from .. import init as mx_init
+    from ..gluon import loss as gloss
+    from ..gluon import nn
+    from ..parallel.trainer import DataParallelTrainer
+    from . import shard_fixtures as sf
+
+    g = sf.ZERO1_GEOMETRY
+    net = nn.HybridSequential()
+    for h in g["hidden"]:
+        net.add(nn.Dense(h, activation="relu"))
+    net.add(nn.Dense(g["classes"]))
+    net.initialize(mx_init.Xavier())
+    return DataParallelTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": g["lr"], "momentum": g["momentum"]},
+        mesh=_cpu_mesh(), zero=zero)
+
+
+def zero1_runtime_checks(fixture_report, tolerance_pct=10.0):
+    """Gate the zero=1 trainer's REAL step tape against the
+    ``zero1_mlp_train_step`` budget: ``(findings, extras)``.
+
+    - the runtime DST/mixed-axis lint (``trainer.zero_report``): a
+      deleted runtime all-gather (``parallel/zero.py``'s
+      ``ZERO1_RUNTIME_ALL_GATHER`` seam) fails here with DST007;
+    - flops/transcendentals/transfer/collective parity with the fixture
+      the budget row pins (two-sided, the gate tolerance) and peak HBM
+      no worse than the fixture's (one-sided: the runtime spelling
+      donates tighter and is allowed to be better);
+    - the ZeRO-1 relation on the runtime pair: modeled peak HBM at
+      least optimizer-state x (1 - 1/K) below the trainer's OWN
+      per-replica replicated twin;
+    - reduce-scatter + all-gather wire bytes equal to the gradient
+      psum bytes the global-view mxshard pass infers for the
+      replicated spelling, up to the flat-padding ring bytes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from . import shard_fixtures as sf
+    from .cost import analyze_fn
+    from .findings import Finding
+
+    g = sf.ZERO1_GEOMETRY
+    k = DECLARED_AXIS
+    tol = float(tolerance_pct) / 100.0
+    data_shape = (g["batch"] * k, g["in_dim"])
+    label_shape = (g["batch"] * k,)
+    findings = []
+
+    trainer = _zero1_geometry_trainer(zero=1)
+    rt_report, rt_findings, rt_shard = trainer.zero_report(
+        data_shape=data_shape, label_shape=label_shape,
+        label_dtype="int32", declared_axis_size=k)
+    findings += rt_findings
+
+    # metric parity with the fixture (== the pinned budget row)
+    fx = fixture_report.as_dict()
+    rt = rt_report.as_dict()
+    for metric in ("flops", "transcendentals", "transfer_bytes",
+                   "collective_bytes"):
+        want, got = float(fx[metric]), float(rt[metric])
+        if want and abs(got - want) > tol * want:
+            findings.append(Finding(
+                "COST001", "zero1_mlp_train_step.runtime.%s" % metric,
+                "the zero=1 trainer's REAL step tape models %s = %d "
+                "but the budgeted fixture pins %d (tolerance %.0f%%): "
+                "the runtime and the proven spelling have drifted "
+                "apart" % (metric, int(got), int(want), tol * 100)))
+    if rt["peak_hbm_bytes"] > fx["peak_hbm_bytes"] * (1 + tol):
+        findings.append(Finding(
+            "COST001", "zero1_mlp_train_step.runtime.peak_hbm_bytes",
+            "the zero=1 trainer's REAL step models peak HBM %d, over "
+            "the budgeted fixture's %d (tolerance %.0f%%) — the "
+            "runtime lost the ZeRO-1 memory story"
+            % (int(rt["peak_hbm_bytes"]), int(fx["peak_hbm_bytes"]),
+               tol * 100)))
+
+    # the ZeRO-1 relation against the trainer's own per-replica twin
+    twin = _zero1_geometry_trainer(zero=0)
+    train_vals = None
+    try:
+        import numpy as _onp
+
+        from ..ndarray import NDArray
+        x0 = NDArray(jnp.zeros(data_shape, _onp.float32))
+        y0 = NDArray(jnp.zeros(label_shape, _onp.int32))
+        twin._setup(x0, y0)
+        train_vals = tuple(twin._params_by_name[n].data()._data
+                           for n in twin._train_names)
+        aux_vals = tuple(twin._params_by_name[n].data()._data
+                         for n in twin._aux_names)
+        states = tuple(twin._states_raw)
+        xs = jax.ShapeDtypeStruct((g["batch"], g["in_dim"]),
+                                  _onp.float32)
+        ys = jax.ShapeDtypeStruct((g["batch"],), _onp.int32)
+        key = jax.ShapeDtypeStruct((2,), _onp.uint32)
+        twin_rep = analyze_fn(
+            twin._build_replica_step(), train_vals, states, aux_vals,
+            xs, ys, key, jnp.float32(0.01), jnp.int32(1),
+            axis_env=[("data", k)], donate_argnums=(0, 1),
+            host_argnums=(3, 4))
+    except Exception as e:
+        findings.append(Finding(
+            "COST001", "zero1_mlp_train_step.runtime",
+            "the replicated twin of the runtime ZeRO-1 proof no longer "
+            "traces: %s: %s" % (type(e).__name__, str(e)[:200])))
+        return findings, {}
+
+    state_bytes = sf.zero1_state_bytes(k)
+    floor = state_bytes * (k - 1) // k
+    drop = twin_rep.peak_hbm_bytes - rt_report.peak_hbm_bytes
+    if drop < floor:
+        findings.append(Finding(
+            "COST001", "zero1_mlp_train_step.runtime.peak_hbm_bytes",
+            "ZeRO-1 runtime proof violated: the zero=1 trainer's "
+            "modeled peak HBM is only %d bytes below its replicated "
+            "twin (%d vs %d) — the sharded update must save at least "
+            "optimizer-state-bytes x (1 - 1/%d) = %d bytes; the "
+            "optimizer state is no longer sharded at runtime"
+            % (drop, rt_report.peak_hbm_bytes, twin_rep.peak_hbm_bytes,
+               k, floor)))
+
+    # collective-byte parity with the global-view mxshard pass: the
+    # explicit rs+ag pair must carry what GSPMD's inferred gradient
+    # psum would, up to the flat zero-padding's ring bytes
+    global_view = twin.shard_report(
+        data_shape=data_shape, label_shape=label_shape,
+        label_dtype="int32", declared_axis_size=k)
+    inferred = sum(ev.wire_bytes for ev in global_view.schedule
+                   if ev.inferred)
+    rs_ag = sum(ev.wire_bytes for ev in rt_shard.schedule
+                if ev.prim in ("reduce_scatter", "all_gather"))
+    pad_ring = 2 * (k - 1) * ((rt_shard.extras.get("zero1_plan") or {})
+                              .get("padded", 0)
+                              - (rt_shard.extras.get("zero1_plan") or {})
+                              .get("total", 0)) * 4 // max(k, 1)
+    slack = max(64, pad_ring)
+    if abs(rs_ag - inferred) > slack:
+        findings.append(Finding(
+            "COST001", "zero1_mlp_train_step.runtime.collective_bytes",
+            "runtime reduce-scatter+all-gather wire bytes (%d) do not "
+            "match the gradient psum the global-view mxshard pass "
+            "infers for the replicated spelling (%d, slack %d): the "
+            "ZeRO-1 pair moves different bytes than the collective it "
+            "replaces" % (rs_ag, inferred, slack)))
+
+    extras = {
+        "runtime_zero1_peak_hbm_bytes": int(rt_report.peak_hbm_bytes),
+        "runtime_twin_peak_hbm_bytes": int(twin_rep.peak_hbm_bytes),
+        "runtime_hbm_drop_bytes": int(drop),
+        "runtime_zero1_hbm_drop_pct": round(
+            100.0 * drop / twin_rep.peak_hbm_bytes, 2)
+        if twin_rep.peak_hbm_bytes else 0.0,
+        "runtime_rs_ag_bytes": int(rs_ag),
+        "runtime_inferred_psum_bytes": int(inferred),
+    }
+    return findings, extras
 
 
 def ring_attention_fwd():

@@ -33,7 +33,7 @@ import os
 from .findings import Finding, filter_findings
 
 __all__ = ["lint_wallclock_reads", "lint_promotion_sources",
-           "WALLCLOCK_ATTRS"]
+           "lint_supervisor_sources", "WALLCLOCK_ATTRS"]
 
 # attribute names that read (or schedule by) the wall clock when called
 # on a time/datetime module or datetime class
@@ -119,6 +119,31 @@ def lint_promotion_sources(disable=(), root=None):
         path = os.path.join(repo, "tools", name)
         if os.path.isfile(path):
             targets.append(path)
+    findings = []
+    for path in targets:
+        try:
+            findings += lint_wallclock_reads(os.path.normpath(path))
+        except OSError:
+            continue
+    return filter_findings(findings, disable)
+
+
+def lint_supervisor_sources(disable=(), root=None):
+    """The SRV005 sweep over the elastic supervisor's decision path
+    (``resilience/supervisor.py`` plus the ``tools/train_elastic.py``
+    driver): shrink/grow/steps-lost decisions must be pure functions of
+    heartbeat counters, manifest steps and exit codes so the audit
+    trail replays byte-identically — the same no-wall-clock contract
+    the promotion controller carries.  The watch loop's child-process
+    poll pacing is measurement and carries the inline justified
+    ``# mxlint: disable=SRV005`` escape.  Wired into ``--self-check``."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    root = root or os.path.dirname(pkg)           # mxnet_tpu/
+    repo = os.path.dirname(root)
+    targets = [os.path.join(root, "resilience", "supervisor.py")]
+    driver = os.path.join(repo, "tools", "train_elastic.py")
+    if os.path.isfile(driver):
+        targets.append(driver)
     findings = []
     for path in targets:
         try:

@@ -18,8 +18,10 @@ distribution stack (SURVEY.md §2.2):
 New-capability axes the reference lacks (documented in SURVEY.md §2.2):
 tensor parallelism (shard params on a ``model`` axis) and sequence
 parallelism — ring attention over ``ppermute`` and Ulysses all-to-all
-(``ring_attention.py``).
+(``ring_attention.py``) — and the ZeRO-1 sharded optimizer runtime
+(``zero.py``, ``DataParallelTrainer(zero=1)``, docs/elastic.md).
 """
+from . import zero
 from .mesh import make_mesh, data_parallel_mesh, local_device_count
 from .trainer import DataParallelTrainer
 from .functional import functionalize_forward, functional_optimizer_update
@@ -28,7 +30,7 @@ from .ring_attention import (ring_attention, ulysses_attention,
                              ulysses_attention_sharded)
 
 __all__ = [
-    "make_mesh", "data_parallel_mesh", "local_device_count",
+    "zero", "make_mesh", "data_parallel_mesh", "local_device_count",
     "DataParallelTrainer", "functionalize_forward",
     "functional_optimizer_update", "ring_attention", "ulysses_attention",
     "local_attention", "ring_attention_sharded", "ulysses_attention_sharded",

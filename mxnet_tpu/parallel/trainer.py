@@ -86,7 +86,8 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss, optimizer, optimizer_params=None,
                  mesh=None, param_spec_fn=None, data_axis="data",
-                 kvstore=None, input_transform=None, run_id=None):
+                 kvstore=None, input_transform=None, run_id=None,
+                 zero=0):
         from .. import kvstore as kvs
         from .. import optimizer as opt_mod
         self._block = block
@@ -156,6 +157,34 @@ class DataParallelTrainer:
                         "with kvstore set the mesh must span only this "
                         "process's devices (cross-process reduction rides "
                         "the kvstore, not the mesh)")
+        # ZeRO-1 (docs/elastic.md, arxiv 2004.13336): optimizer states
+        # sharded over the data axis — reduce-scatter grads, shard-local
+        # update, all-gather params.  The sharding rides the in-process
+        # mesh; a multi-process kvstore already owns the cross-process
+        # reduction and composing the two would double-count it.
+        self._zero = int(zero or 0)
+        if self._zero not in (0, 1):
+            raise ValueError("zero must be 0 (replicated optimizer "
+                             "state) or 1 (ZeRO-1 sharded), got %r"
+                             % (zero,))
+        if self._zero:
+            if self._kv is not None:
+                raise ValueError(
+                    "zero=1 shards optimizer state over the mesh data "
+                    "axis; combining it with a multi-process kvstore is "
+                    "not supported (the kvstore path keeps the full "
+                    "flat gradient per rank)")
+            if type(self._opt).__name__ not in _ELEMENTWISE_OPTIMIZERS:
+                raise ValueError(
+                    "zero=1 updates a flat concatenated parameter shard "
+                    "and therefore needs a purely elementwise optimizer "
+                    "(%s); got %s"
+                    % (", ".join(sorted(_ELEMENTWISE_OPTIMIZERS)),
+                       type(self._opt).__name__))
+        self._zero_plan = None
+        self._zero_treedef = None
+        self._zero_grad_fn = None
+        self._zero_update_fn = None
         self._ready = False
         self._step_fn = None
         self._grad_fn = None
@@ -208,61 +237,69 @@ class DataParallelTrainer:
             self._param_shardings[name] = sh
             p._data._set_data(jax.device_put(p.data()._data, sh))
 
-        # group parameters into fused update buckets (reference precedent:
-        # multi-tensor optimizer launches, docs/faq/perf.md:214-216
-        # "grouped updates" lever): every elementwise optimizer applies the
-        # identical per-scalar rule, so same-hyper same-dtype replicated
-        # params can be updated as ONE flat concatenated vector — dozens of
-        # small per-param fusions collapse into a handful of launches.
-        import os as _os
-        # opt-in: fused buckets measured ~2-4%% SLOWER end to end on
-        # resnet-50/v5e even when restricted to tiny BN/bias params — the
-        # concat barriers the backward->optimizer overlap that XLA
-        # otherwise schedules per-gradient (docs/perf_resnet50_tpu.md
-        # "levers measured and rejected").  Kept env-gated for workloads
-        # with thousands of small params.
-        groupable = type(self._opt).__name__ in _ELEMENTWISE_OPTIMIZERS \
-            and _os.environ.get("MXTPU_GROUP_UPDATES", "0") == "1"
-        max_group_elems = int(_os.environ.get(
-            "MXTPU_GROUP_MAX_ELEMS", str(65536)))
-        buckets = {}
-        self._groups = []  # list of [name, ...]
-        for name in self._train_names:
-            p = self._params_by_name[name]
-            spec = self._param_spec_fn(name, p.shape)
-            psize = 1
-            for d in p.shape:
-                psize *= int(d)
-            if not groupable or spec != PartitionSpec() or \
-                    psize > max_group_elems:
-                self._groups.append([name])
-                continue
-            key = (float(p.lr_mult), float(p.wd_mult),
-                   str(np.dtype(p.dtype) if p.dtype else "float32"))
-            buckets.setdefault(key, []).append(name)
-        self._groups = [v for v in buckets.values()] + self._groups
+        if self._zero:
+            self._setup_zero_states()
+        else:
+            # group parameters into fused update buckets (reference
+            # precedent: multi-tensor optimizer launches,
+            # docs/faq/perf.md:214-216 "grouped updates" lever): every
+            # elementwise optimizer applies the identical per-scalar
+            # rule, so same-hyper same-dtype replicated params can be
+            # updated as ONE flat concatenated vector — dozens of small
+            # per-param fusions collapse into a handful of launches.
+            import os as _os
+            # opt-in: fused buckets measured ~2-4%% SLOWER end to end on
+            # resnet-50/v5e even when restricted to tiny BN/bias params
+            # — the concat barriers the backward->optimizer overlap that
+            # XLA otherwise schedules per-gradient
+            # (docs/perf_resnet50_tpu.md "levers measured and
+            # rejected").  Kept env-gated for workloads with thousands
+            # of small params.
+            groupable = type(self._opt).__name__ in \
+                _ELEMENTWISE_OPTIMIZERS \
+                and _os.environ.get("MXTPU_GROUP_UPDATES", "0") == "1"
+            max_group_elems = int(_os.environ.get(
+                "MXTPU_GROUP_MAX_ELEMS", str(65536)))
+            buckets = {}
+            self._groups = []  # list of [name, ...]
+            for name in self._train_names:
+                p = self._params_by_name[name]
+                spec = self._param_spec_fn(name, p.shape)
+                psize = 1
+                for d in p.shape:
+                    psize *= int(d)
+                if not groupable or spec != PartitionSpec() or \
+                        psize > max_group_elems:
+                    self._groups.append([name])
+                    continue
+                key = (float(p.lr_mult), float(p.wd_mult),
+                       str(np.dtype(p.dtype) if p.dtype else "float32"))
+                buckets.setdefault(key, []).append(name)
+            self._groups = [v for v in buckets.values()] + self._groups
 
-        # optimizer states live next to their (possibly sharded) params;
-        # grouped buckets get one state over the flat concatenation
-        self._states_raw = []
-        self._group_shardings = []
-        for gi, names in enumerate(self._groups):
-            ps = [self._params_by_name[n] for n in names]
-            if len(names) == 1:
-                wflat = ps[0].data()._data
-                sh = self._param_shardings[names[0]]
-            else:
-                wflat = jnp.concatenate([p.data()._data.ravel() for p in ps])
-                sh = NamedSharding(mesh, PartitionSpec())
-            self._group_shardings.append(sh)
-            state = self._opt.create_state_multi_precision(gi, NDArray(wflat))
-            raw = tree_raw(state)
-            self._states_raw.append(jax.tree_util.tree_map(
-                lambda v: jax.device_put(v, sh), raw))
-            if ps[0].lr_mult != 1.0:
-                self._opt.lr_mult.setdefault(gi, ps[0].lr_mult)
-            if ps[0].wd_mult != 1.0:
-                self._opt.wd_mult.setdefault(gi, ps[0].wd_mult)
+            # optimizer states live next to their (possibly sharded)
+            # params; grouped buckets get one state over the flat concat
+            self._states_raw = []
+            self._group_shardings = []
+            for gi, names in enumerate(self._groups):
+                ps = [self._params_by_name[n] for n in names]
+                if len(names) == 1:
+                    wflat = ps[0].data()._data
+                    sh = self._param_shardings[names[0]]
+                else:
+                    wflat = jnp.concatenate([p.data()._data.ravel()
+                                             for p in ps])
+                    sh = NamedSharding(mesh, PartitionSpec())
+                self._group_shardings.append(sh)
+                state = self._opt.create_state_multi_precision(
+                    gi, NDArray(wflat))
+                raw = tree_raw(state)
+                self._states_raw.append(jax.tree_util.tree_map(
+                    lambda v: jax.device_put(v, sh), raw))
+                if ps[0].lr_mult != 1.0:
+                    self._opt.lr_mult.setdefault(gi, ps[0].lr_mult)
+                if ps[0].wd_mult != 1.0:
+                    self._opt.wd_mult.setdefault(gi, ps[0].wd_mult)
 
         def run(x, y):
             if self._input_transform is not None:
@@ -335,6 +372,196 @@ class DataParallelTrainer:
                 "sum gradients from different models"
                 % (self._kv.rank, sig, self._flat_key,
                    tuple(self._flat_sizes), got, want))
+
+    # -- ZeRO-1 sharded optimizer runtime (parallel/zero.py) ---------------
+    @property
+    def zero(self):
+        return self._zero
+
+    def _zero_axis_size(self):
+        sizes = dict(zip(self._mesh.axis_names, self._mesh.devices.shape))
+        return int(sizes.get(self._data_axis, 1))
+
+    def _setup_zero_states(self):
+        """Build the flat ZeRO-1 plan and the sharded optimizer state:
+        one ``(padded,)`` f32 array per state leaf, ``P(data)``-sharded
+        over the mesh so each device physically holds its 1/K shard."""
+        from . import zero as _zero
+        mesh = self._mesh
+        for name in self._train_names:
+            p = self._params_by_name[name]
+            if self._param_spec_fn(name, p.shape) != PartitionSpec():
+                raise ValueError(
+                    "zero=1 flattens the trainable parameters over the "
+                    "data axis and needs them replicated; param %r has "
+                    "a non-trivial PartitionSpec" % (name,))
+            if p.lr_mult != 1.0 or p.wd_mult != 1.0:
+                raise ValueError(
+                    "zero=1 applies one flat optimizer update and "
+                    "cannot honor per-parameter lr_mult/wd_mult "
+                    "(param %r)" % (name,))
+        k = self._zero_axis_size()
+        plan = _zero.Zero1Plan(
+            self._train_names,
+            [self._params_by_name[n].shape for n in self._train_names],
+            [str(np.dtype(self._params_by_name[n].dtype
+                          or "float32")) for n in self._train_names],
+            self._data_axis, k)
+        self._zero_plan = plan
+        state_sh = NamedSharding(mesh, PartitionSpec(self._data_axis))
+        flat_w = jnp.zeros((plan.padded,), jnp.float32)
+        state = self._opt.create_state_multi_precision(0, NDArray(flat_w))
+        raw = tree_raw(state)
+        leaves, treedef = jax.tree_util.tree_flatten(raw)
+        for li, leaf in enumerate(leaves):
+            shape = tuple(getattr(leaf, "shape", ()))
+            if shape != (plan.padded,):
+                raise ValueError(
+                    "zero=1 needs every optimizer-state leaf shaped "
+                    "like the flat weight vector; leaf %d of %s has "
+                    "shape %r (flat is (%d,))"
+                    % (li, type(self._opt).__name__, shape, plan.padded))
+        self._zero_treedef = treedef
+        raw = jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, state_sh), raw)
+        self._groups = [list(self._train_names)]
+        self._group_shardings = [state_sh]
+        self._states_raw = [raw]
+
+    def _zero_leaves(self):
+        return tuple(jax.tree_util.tree_leaves(self._states_raw[0]))
+
+    def _zero_step(self, train_vals, aux_vals, x, y, rng, lr_host,
+                   tele_on, attr, t1):
+        """ZeRO-1 split step: local grads + reduce-scatter (one jitted
+        shard_map program), then shard-local update + all-gather (a
+        second one).  The split mirrors ``_dist_step``'s grad→exchange→
+        update shape: the collective program's host time bills to the
+        ``collective_or_ps`` attribution phase, so the doctor sees the
+        reduce-scatter/all-gather shift under zero=1."""
+        from . import zero as _zero
+        if self._zero_grad_fn is None:
+            self._zero_grad_fn, self._zero_update_fn = \
+                _zero.build_runtime_fns(self._fwd, self._opt,
+                                        self._zero_plan,
+                                        self._zero_treedef, self._mesh)
+            if tele_on:
+                attr.set_context("collective_or_ps", "zero1")
+        g_sh, loss_val, muts = self._zero_grad_fn(
+            train_vals, aux_vals, x, y, rng)
+        if tele_on:
+            t2 = time.perf_counter()
+            attr.add_phase("dispatch", t2 - t1)
+        new_vals, new_leaves = self._zero_update_fn(
+            train_vals, self._zero_leaves(), g_sh,
+            jnp.float32(lr_host), jnp.int32(self._step_count))
+        self._states_raw = [jax.tree_util.tree_unflatten(
+            self._zero_treedef, list(new_leaves))]
+        if tele_on:
+            attr.add_phase("collective_or_ps",
+                           time.perf_counter() - t2)
+        return loss_val, new_vals, muts
+
+    def _build_zero_replica_step(self, declared_k=None):
+        """The per-replica spelling of the zero=1 step at a *declared*
+        axis size (no devices needed): the analysis twin of the runtime
+        shard_map programs, built from the same ``parallel/zero.py``
+        parts so the executed and analyzed programs cannot drift.
+        Returns ``(step_fn, plan)``."""
+        from . import zero as _zero
+        k = int(declared_k or self._zero_axis_size())
+        plan = _zero.Zero1Plan(
+            self._train_names,
+            [self._params_by_name[n].shape for n in self._train_names],
+            [str(np.dtype(self._params_by_name[n].dtype
+                          or "float32")) for n in self._train_names],
+            self._data_axis, k)
+        return _zero.build_replica_step(
+            self._fwd, self._opt, plan, self._zero_treedef), plan
+
+    def zero_report(self, data_shape=None, label_shape=None,
+                    data_dtype="float32", label_dtype="int32",
+                    declared_axis_size=None):
+        """Static proof bundle of the zero=1 runtime step:
+        ``(CostReport, [Finding], ShardReport)`` over the REAL runtime
+        spelling traced at a declared axis size — the mxcost tape
+        (peak HBM with params/states donated, batch host-fed), the
+        mixed-axis DST lint (a deleted runtime all-gather is DST007)
+        and the priced reduce-scatter/all-gather schedule.  Hardware-
+        free; what ``analysis/budget_models.zero1_mlp_train_step``
+        gates against ``STATIC_BUDGETS.json``."""
+        import numpy as _onp
+
+        from ..analysis import cost as _cost
+        from ..analysis import shard_prop as _sp
+
+        if not self._zero:
+            raise ValueError("zero_report needs a zero=1 trainer")
+        if not self._ready:
+            if data_shape is None:
+                raise ValueError(
+                    "trainer has not stepped yet: pass data_shape (and "
+                    "label_shape)")
+            x0 = NDArray(jnp.zeros(tuple(data_shape),
+                                   _onp.dtype(data_dtype)))
+            y0 = NDArray(jnp.zeros(
+                tuple(label_shape or (data_shape[0],)),
+                _onp.dtype(label_dtype)))
+            self._setup(x0, y0)
+        data_shape = tuple(data_shape)
+        label_shape = tuple(label_shape or (data_shape[0],))
+        k = int(declared_axis_size or self._zero_axis_size())
+        step, plan = self._build_zero_replica_step(k)
+        shard_local = max(data_shape[0] // max(k, 1), 1)
+        train_avals = tuple(
+            jax.ShapeDtypeStruct(
+                tuple(self._params_by_name[n].shape),
+                _onp.dtype(self._params_by_name[n].dtype or "float32"))
+            for n in self._train_names)
+        n_leaves = len(self._zero_leaves())
+        state_avals = tuple(
+            jax.ShapeDtypeStruct((plan.shard,), _onp.float32)
+            for _ in range(n_leaves))
+        aux_avals = tuple(
+            jax.ShapeDtypeStruct(
+                tuple(self._params_by_name[n].shape),
+                _onp.dtype(self._params_by_name[n].dtype or "float32"))
+            for n in self._aux_names)
+        xs = jax.ShapeDtypeStruct((shard_local,) + data_shape[1:],
+                                  _onp.dtype(data_dtype))
+        ys = jax.ShapeDtypeStruct((shard_local,) + label_shape[1:],
+                                  _onp.dtype(label_dtype))
+        key = jax.ShapeDtypeStruct((2,), _onp.uint32)
+        closed = jax.make_jaxpr(
+            step, axis_env=[(self._data_axis, k)])(
+            train_avals, state_avals, aux_avals, xs, ys, key,
+            jnp.float32(0.01), jnp.int32(1))
+        n_train = len(train_avals)
+        host = [n_train + n_leaves + len(aux_avals),
+                n_train + n_leaves + len(aux_avals) + 1]
+        donated = list(range(n_train + n_leaves))
+        report = _cost.analyze_jaxpr(
+            closed, axis_sizes={self._data_axis: k},
+            donated_invars=donated, host_invars=host)
+        report.transfer_d2h_bytes = 4    # only the loss comes back
+        mesh = _sp.MeshSpec({self._data_axis: k})
+        findings = _sp.lint_sharded_step(
+            closed, mesh, data_axes=(self._data_axis,),
+            varying_invars=host,
+            shard_dims={n_train + li: {0: (self._data_axis,)}
+                        for li in range(n_leaves)},
+            param_outvars=list(range(1, 1 + n_train)),
+            param_names=list(self._train_names),
+            subject="DataParallelTrainer(zero=1)")
+        findings += _cost.unpriced_findings(
+            report, subject="DataParallelTrainer(zero=1)")
+        shard = _sp.collective_schedule(
+            closed, mesh, subject="DataParallelTrainer(zero=1)")
+        shard.extras.update({
+            "zero1_plan": plan.describe(),
+            "runtime_peak_hbm_bytes": int(report.peak_hbm_bytes),
+        })
+        return report, findings, shard
 
     # -- the compiled step -------------------------------------------------
     def _apply_groups(self, train_vals, states, grads, lr, t):
@@ -431,7 +658,16 @@ class DataParallelTrainer:
         """DST lint of the distributed step (analysis/dist_lint.py):
         every trainable gradient reduced over the data axis exactly
         once, sharding-spec consistency, collective dtype promotion,
-        baked step constants.  Hardware-free; returns Finding records."""
+        baked step constants.  Hardware-free; returns Finding records.
+        A zero=1 trainer routes to the mixed-axis rules over the real
+        runtime spelling instead (``zero_report``)."""
+        if self._zero:
+            _, findings, _ = self.zero_report(
+                data_shape=data_shape, label_shape=label_shape,
+                data_dtype=data_dtype, label_dtype=label_dtype,
+                declared_axis_size=declared_axis_size)
+            from ..analysis.findings import filter_findings
+            return filter_findings(findings, disable)
         from ..analysis.dist_lint import lint_trainer
         return lint_trainer(self, data_shape=data_shape,
                             label_shape=label_shape,
@@ -446,10 +682,19 @@ class DataParallelTrainer:
         """Static CostReport of one training step (analysis/cost.py):
         FLOPs/bytes/peak-HBM of the full-batch program (params + states
         donated, batch host-fed, loss fetched) plus per-axis collective
-        bytes from the per-replica trace.  Never executes or compiles."""
+        bytes from the per-replica trace.  Never executes or compiles.
+        A zero=1 trainer reports over the real runtime spelling
+        (``zero_report``), whose collectives are explicit."""
         import numpy as _onp
 
         from ..analysis import cost as _cost
+
+        if self._zero:
+            report, _, _ = self.zero_report(
+                data_shape=data_shape, label_shape=label_shape,
+                data_dtype=data_dtype, label_dtype=label_dtype,
+                declared_axis_size=declared_axis_size)
+            return report
 
         if not self._ready:
             if data_shape is None:
@@ -754,6 +999,14 @@ class DataParallelTrainer:
         if self._kv is not None:
             loss_val, new_vals, new_states, muts = self._dist_step(
                 train_vals, aux_vals, x, y, rng, lr_host)
+            self._states_raw = list(new_states)
+        elif self._zero:
+            # split step: grads + reduce-scatter, then sharded update +
+            # all-gather — states updated inside (they live as one
+            # sharded flat tree, not per-group)
+            loss_val, new_vals, muts = self._zero_step(
+                train_vals, aux_vals, x, y, rng, lr_host,
+                tele_on, attr, t1 if tele_on else 0.0)
         else:
             # jax.jit itself retraces and caches per input shape/dtype
             if self._step_fn is None:
@@ -761,6 +1014,7 @@ class DataParallelTrainer:
             loss_val, new_vals, new_states, muts = self._step_fn(
                 train_vals, tuple(self._states_raw), aux_vals, x, y, rng,
                 jnp.float32(lr_host), jnp.int32(self._step_count))
+            self._states_raw = list(new_states)
             if tele_on:
                 # "dispatch" spans from the batch being device-ready to
                 # the step program dispatched — step bookkeeping (arg
@@ -769,7 +1023,6 @@ class DataParallelTrainer:
 
         for name, val in zip(self._train_names, new_vals):
             self._params_by_name[name]._data._set_data(val)
-        self._states_raw = list(new_states)
         for name, val in zip(self._fwd.mut_names or (), muts):
             self._params_by_name[name]._data._set_data(val)
         self._track_inflight(loss_val)
@@ -794,6 +1047,13 @@ class DataParallelTrainer:
         # only the encode + atomic write below is checkpoint time (the
         # phases stay disjoint, so per-window sums reconcile)
         t_ckpt = time.perf_counter() if _tele._ENABLED else 0.0
+        if self._zero:
+            path = self._save_sharded(directory, epoch=epoch,
+                                      nbatch=nbatch, keep=keep)
+            if _tele._ENABLED:
+                _tele.attribution().add_phase(
+                    "checkpoint", time.perf_counter() - t_ckpt)
+            return path
         params = {name: _ckpt.encode_array(p.data()._data)
                   for name, p in self._params_by_name.items()}
         states = []
@@ -830,6 +1090,137 @@ class DataParallelTrainer:
                 "checkpoint", time.perf_counter() - t_ckpt)
         return path
 
+    def _save_sharded(self, directory, epoch=None, nbatch=None, keep=3):
+        """Shard-parallel snapshot of a zero=1 trainer: the rank-
+        agnostic payload (params, RNG, cursor, flat-layout plan) rides
+        the manifest; every rank's 1/K optimizer-state slice is its own
+        atomically-installed shard file (``resilience.checkpoint``
+        sharded format, docs/elastic.md).  A fleet of a *different*
+        size restores by reassembling the full flat state and
+        re-sharding deterministically."""
+        from .. import _rng
+        from ..resilience import checkpoint as _ckpt
+        plan = self._zero_plan
+        params = {name: _ckpt.encode_array(p.data()._data)
+                  for name, p in self._params_by_name.items()}
+        leaves = [np.asarray(v) for v in self._zero_leaves()]
+        payload = {
+            "params": params,
+            "step_count": self._step_count,
+            "rng": _rng.get_state(),
+            "numpy_global": np.random.get_state(),
+            "cursor": {"epoch": epoch, "nbatch": nbatch},
+            "setup_desc": self._setup_desc,
+            "zero_plan": plan.describe(),
+            "state_leaf_count": len(leaves),
+        }
+        shards = []
+        for r in range(plan.k):
+            sl = slice(r * plan.shard, (r + 1) * plan.shard)
+            shards.append({"states": [_ckpt.encode_array(leaf[sl])
+                                      for leaf in leaves]})
+        # provenance digest over NAME-CANONICALIZED content (the
+        # monolithic discipline): gensym-shifted reruns name the same
+        # bytes, and the digest covers the FULL state — independent of
+        # the fleet size it happens to be sharded at
+        order = {name: "p%05d" % i for i, name in enumerate(params)}
+        canon = dict(payload,
+                     params={order[n]: enc for n, enc in params.items()},
+                     zero_plan=dict(plan.describe(),
+                                    names=[order[n] for n in
+                                           plan.names]))
+        canon.pop("state_leaf_count", None)
+        canon["full_state"] = [
+            _ckpt.encode_array(leaf[:plan.total]) for leaf in leaves]
+        for key in ("k", "padded", "shard"):
+            canon["zero_plan"].pop(key, None)
+        return _ckpt.save_sharded_checkpoint(
+            directory, payload, shards, self._step_count, keep=keep,
+            provenance={"epoch": epoch, "train_run_id": self.run_id,
+                        "digest": _ckpt.payload_digest(canon)})
+
+    def _restore_sharded(self, rec):
+        """Restore a sharded-checkpoint record into this (zero=1)
+        trainer, re-sharding the optimizer state for the CURRENT axis
+        size: shards are concatenated in rank order, the zero padding
+        tail truncated at the recorded ``total`` (provably zero — see
+        ``parallel/zero.py``), re-padded for the new K and placed
+        ``P(data)``-sharded.  1→2→4→1 round-trips bitwise."""
+        from .. import _rng
+        from ..resilience import checkpoint as _ckpt
+        payload = rec["payload"]
+        if not self._ready:
+            dshape, ddt = payload["setup_desc"]["data"]
+            lshape, ldt = payload["setup_desc"]["label"]
+            self._setup(NDArray(jnp.zeros(dshape, np.dtype(ddt))),
+                        NDArray(jnp.zeros(lshape, np.dtype(ldt))))
+        if not self._zero:
+            raise RuntimeError(
+                "sharded checkpoint (ZeRO-1 optimizer shards) cannot "
+                "restore into a zero=0 trainer — construct with zero=1")
+        mapping = self._map_checkpoint_params(payload["params"])
+        for cn, enc in payload["params"].items():
+            name = mapping[cn]
+            p = self._params_by_name[name]
+            p._data._set_data(jax.device_put(
+                jnp.asarray(_ckpt.decode_array(enc)),
+                self._param_shardings[name]))
+        plan_old = payload["zero_plan"]
+        plan = self._zero_plan
+        if int(plan_old["total"]) != plan.total:
+            raise RuntimeError(
+                "sharded checkpoint's flat parameter space has %d "
+                "elements, this trainer's has %d — different model"
+                % (int(plan_old["total"]), plan.total))
+        n_leaves = int(payload["state_leaf_count"])
+        cur_leaves = self._zero_leaves()
+        if n_leaves != len(cur_leaves):
+            raise RuntimeError(
+                "optimizer state leaf count mismatch (%d vs %d): "
+                "different optimizer?" % (n_leaves, len(cur_leaves)))
+        from . import zero as _zero
+        state_sh = self._group_shardings[0]
+        new_leaves = []
+        for li in range(n_leaves):
+            full = _zero.reassemble_state(
+                [_ckpt.decode_array(sh["states"][li])
+                 for sh in rec["shards"]], plan.total)
+            arr = np.zeros((plan.padded,), np.float32)
+            arr[:plan.total] = full
+            new_leaves.append(jax.device_put(jnp.asarray(arr), state_sh))
+        self._states_raw = [jax.tree_util.tree_unflatten(
+            self._zero_treedef, new_leaves)]
+        self._step_count = int(payload["step_count"])
+        self._opt.num_update = self._step_count
+        _rng.set_state(payload["rng"])
+        np.random.set_state(payload["numpy_global"])
+        self._inflight.clear()
+        return dict(payload["cursor"], step=self._step_count)
+
+    def _map_checkpoint_params(self, params_ckpt):
+        """checkpoint-name -> live-name mapping: exact names when they
+        match, else positional (gluon gensyms shift per process) with a
+        per-param shape check — a genuinely different model fails."""
+        names_ckpt = list(params_ckpt)
+        names_cur = list(self._params_by_name)
+        if set(names_ckpt) == set(names_cur):
+            return {n: n for n in names_ckpt}
+        if len(names_ckpt) == len(names_cur):
+            mapping = dict(zip(names_ckpt, names_cur))
+            for cn, name in mapping.items():
+                shape = tuple(params_ckpt[cn][2])
+                cur = tuple(int(d) for d in
+                            self._params_by_name[name].shape)
+                if shape != cur:
+                    raise RuntimeError(
+                        "checkpoint param %r %r does not match model "
+                        "param %r %r (different architecture)"
+                        % (cn, shape, name, cur))
+            return mapping
+        raise RuntimeError(
+            "checkpoint has %d params, model has %d — different "
+            "architecture" % (len(names_ckpt), len(names_cur)))
+
     def restore_checkpoint(self, path_or_dir):
         """Restore a :meth:`save_checkpoint` snapshot (a file, or a
         directory whose newest loadable checkpoint is taken).  Re-runs
@@ -845,11 +1236,21 @@ class DataParallelTrainer:
         from .. import _rng
         from ..resilience import checkpoint as _ckpt
         if _os.path.isdir(path_or_dir):
+            if self._zero:
+                found = _ckpt.latest_sharded_checkpoint(path_or_dir)
+                if found is None:
+                    raise FileNotFoundError(
+                        "no loadable sharded checkpoint (manifest) "
+                        "under %r" % (path_or_dir,))
+                return self._restore_sharded(found[1])
             found = _ckpt.latest_checkpoint(path_or_dir)
             if found is None:
                 raise FileNotFoundError(
                     "no loadable checkpoint under %r" % (path_or_dir,))
             _, rec = found
+        elif str(path_or_dir).endswith(_ckpt.MANIFEST_SUFFIX):
+            return self._restore_sharded(
+                _ckpt.load_sharded_checkpoint(path_or_dir))
         else:
             rec = _ckpt.load_checkpoint(path_or_dir)
         payload = rec["payload"]
@@ -863,25 +1264,7 @@ class DataParallelTrainer:
         # gets shifted names.  Exact names map directly; otherwise map
         # positionally (collect_params order is construction order) with
         # a per-param shape check — a genuinely different model fails.
-        names_ckpt = list(payload["params"])
-        names_cur = list(self._params_by_name)
-        if set(names_ckpt) == set(names_cur):
-            mapping = {n: n for n in names_ckpt}
-        elif len(names_ckpt) == len(names_cur):
-            mapping = dict(zip(names_ckpt, names_cur))
-            for cn, name in mapping.items():
-                shape = tuple(payload["params"][cn][2])
-                cur = tuple(int(d) for d in
-                            self._params_by_name[name].shape)
-                if shape != cur:
-                    raise RuntimeError(
-                        "checkpoint param %r %r does not match model "
-                        "param %r %r (different architecture)"
-                        % (cn, shape, name, cur))
-        else:
-            raise RuntimeError(
-                "checkpoint has %d params, model has %d — different "
-                "architecture" % (len(names_ckpt), len(names_cur)))
+        mapping = self._map_checkpoint_params(payload["params"])
         groups_ckpt = [[mapping[n] for n in g] for g in payload["groups"]]
         if groups_ckpt != [list(g) for g in self._groups]:
             raise RuntimeError(
@@ -965,7 +1348,9 @@ class DataParallelTrainer:
         start_epoch, skip_batches = 0, 0
         if checkpoint_dir and resume:
             from ..resilience import checkpoint as _ckpt
-            if _ckpt.latest_checkpoint(checkpoint_dir) is not None:
+            if (_ckpt.latest_sharded_checkpoint(checkpoint_dir)
+                    if self._zero else
+                    _ckpt.latest_checkpoint(checkpoint_dir)) is not None:
                 cursor = self.restore_checkpoint(checkpoint_dir)
                 if cursor.get("epoch") is not None:
                     start_epoch = int(cursor["epoch"])

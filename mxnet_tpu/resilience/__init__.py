@@ -31,21 +31,29 @@ stays live when the TPU backend is down (the r05 bench pattern).
 """
 from __future__ import annotations
 
-from . import backoff, chaos, checkpoint, heartbeat, server_state
+from . import backoff, chaos, checkpoint, heartbeat, server_state, \
+    supervisor
 from .backoff import BackoffPolicy, RetriesExhausted, retry_call
 from .chaos import (ChaosError, ChaosSchedule, Fault, install,
                     install_from_env, maybe_inject, triggered, uninstall)
-from .checkpoint import (latest_checkpoint, list_checkpoints,
-                         load_checkpoint, save_checkpoint)
+from .checkpoint import (ShardIntegrityError, latest_checkpoint,
+                         latest_sharded_checkpoint, list_checkpoints,
+                         load_checkpoint, load_sharded_checkpoint,
+                         save_checkpoint, save_sharded_checkpoint)
 from .heartbeat import HeartbeatMonitor, HeartbeatSender
 from .server_state import ServerStateStore
+from .supervisor import ElasticSupervisor, SupervisorHalted
 
 __all__ = [
     "backoff", "chaos", "checkpoint", "heartbeat", "server_state",
+    "supervisor",
     "BackoffPolicy", "RetriesExhausted", "retry_call",
     "ChaosError", "ChaosSchedule", "Fault", "install", "install_from_env",
     "maybe_inject", "triggered", "uninstall",
     "save_checkpoint", "load_checkpoint", "latest_checkpoint",
-    "list_checkpoints",
+    "list_checkpoints", "save_sharded_checkpoint",
+    "load_sharded_checkpoint", "latest_sharded_checkpoint",
+    "ShardIntegrityError",
     "HeartbeatMonitor", "HeartbeatSender", "ServerStateStore",
+    "ElasticSupervisor", "SupervisorHalted",
 ]

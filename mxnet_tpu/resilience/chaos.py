@@ -77,6 +77,14 @@ SITES = {
     "engine.flush": "run-ahead ring drain",
     "backend.init": "count = bench.py acquisition attempt",
     "checkpoint.save": "mid-checkpoint-write (atomicity tests)",
+    "ckpt.shard_write": "before each shard install of a shard-parallel "
+                        "snapshot; ctx = (step, rank)",
+    "train.step": "elastic worker per-rank step probe "
+                  "(tools/train_elastic.py); count = (step-1)*world + "
+                  "rank position + 1; ctx = (rank, step)",
+    "supervisor.decision": "before each elastic-supervisor decision "
+                           "commit; count = decision seq; ctx = the "
+                           "decision dict",
 }
 
 

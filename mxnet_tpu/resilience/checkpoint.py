@@ -46,11 +46,33 @@ from . import chaos as _chaos
 
 __all__ = ["save_checkpoint", "load_checkpoint", "latest_checkpoint",
            "list_checkpoints", "encode_array", "decode_array",
-           "payload_digest", "provenance", "CKPT_SUFFIX", "FORMAT_VERSION"]
+           "payload_digest", "provenance", "CKPT_SUFFIX", "FORMAT_VERSION",
+           "ShardIntegrityError", "save_sharded_checkpoint",
+           "load_sharded_checkpoint", "latest_sharded_checkpoint",
+           "list_manifests", "SHARD_SUFFIX", "MANIFEST_SUFFIX",
+           "SHARD_FORMAT_VERSION"]
 
 CKPT_SUFFIX = ".mxckpt"
 FORMAT_VERSION = 1
 _NAME_RE = re.compile(r"^ckpt-(\d+)" + re.escape(CKPT_SUFFIX) + r"$")
+
+# shard-parallel snapshots (ZeRO-1 elastic training, docs/elastic.md):
+# one <step>.shard-<r>-of-<K> file per rank plus a last-committed
+# manifest — the manifest is the COMMIT POINT (written last), so a rank
+# SIGKILLed mid shard write leaves the previous complete checkpoint
+# authoritative
+SHARD_SUFFIX = ".mxshard"
+MANIFEST_SUFFIX = ".mxmanifest"
+SHARD_FORMAT_VERSION = 1
+_MANIFEST_RE = re.compile(r"^ckpt-(\d+)" + re.escape(MANIFEST_SUFFIX)
+                          + r"$")
+
+
+class ShardIntegrityError(RuntimeError):
+    """A manifest references a shard that is missing or whose bytes do
+    not match its recorded digest — the checkpoint is NOT loadable and
+    the error names the shard and the reason (provenance for what used
+    to surface as an anonymous load-time exception)."""
 
 
 def encode_array(x):
@@ -177,3 +199,179 @@ def latest_checkpoint(directory):
         except Exception:
             continue
     return None
+
+
+# ---------------------------------------------------------------------------
+# shard-parallel snapshots: per-rank shard files + a last-committed manifest
+# ---------------------------------------------------------------------------
+def _shard_name(step, rank, world):
+    return "ckpt-%012d.shard-%05d-of-%05d%s" % (int(step), int(rank),
+                                                int(world), SHARD_SUFFIX)
+
+
+def _manifest_path(directory, step):
+    return os.path.join(directory,
+                        "ckpt-%012d%s" % (int(step), MANIFEST_SUFFIX))
+
+
+def _atomic_write(path, blob):
+    """fsync + rename install of ``blob`` at ``path`` (the snapshot
+    discipline): the file exists completely or not at all."""
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def save_sharded_checkpoint(directory, payload, shards, step, keep=3,
+                            provenance=None):
+    """Shard-parallel atomic snapshot: write one shard file per rank,
+    then commit the manifest.  Returns the manifest path.
+
+    ``payload`` is the rank-agnostic common state (params, RNG, cursor,
+    layout plan); ``shards[r]`` is rank ``r``'s own slice (its ZeRO-1
+    optimizer-state shard).  Each shard is fsync+renamed into place
+    with its sha256 digest recorded; the manifest — written LAST, same
+    discipline — is the commit point: a rank SIGKILLed mid shard write
+    (chaos site ``ckpt.shard_write``) leaves only tmp debris and the
+    previous complete checkpoint stays the loadable latest.  Pruning
+    keeps ``keep`` manifests and only deletes shard files no retained
+    manifest references."""
+    os.makedirs(directory, exist_ok=True)
+    world = len(shards)
+    entries = []
+    for rank, shard_payload in enumerate(shards):
+        blob = pickle.dumps(
+            {"version": SHARD_FORMAT_VERSION, "step": int(step),
+             "rank": int(rank), "world": int(world),
+             "payload": shard_payload},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        name = _shard_name(step, rank, world)
+        # chaos probe: a scheduled fault (SIGKILL while writing shard
+        # N) fires before the shard is installed — the atomicity test's
+        # injection point
+        _chaos.maybe_inject("ckpt.shard_write", ctx=(int(step), rank))
+        _atomic_write(os.path.join(directory, name), blob)
+        entries.append({"file": name, "rank": int(rank),
+                        "digest": hashlib.sha256(blob).hexdigest(),
+                        "bytes": len(blob)})
+    prov = dict(provenance or {})
+    prov.setdefault("step", int(step))
+    prov.setdefault("digest", payload_digest(
+        {"payload": payload, "shards": [e["digest"] for e in entries]}))
+    blob = pickle.dumps(
+        {"version": SHARD_FORMAT_VERSION, "step": int(step),
+         "world": int(world), "payload": payload, "shards": entries,
+         "provenance": prov},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    final = _manifest_path(directory, step)
+    _atomic_write(final, blob)
+    _prune_sharded(directory, keep)
+    return final
+
+
+def list_manifests(directory):
+    """[(step, manifest_path)] ascending; tmp/garbage names ignored."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _MANIFEST_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort()
+    return out
+
+
+def load_sharded_checkpoint(manifest_path):
+    """Load + verify one sharded checkpoint -> ``{"version", "step",
+    "world", "payload", "shards": [per-rank payloads], "provenance"}``.
+
+    Every shard the manifest references must exist with byte-exact
+    digest; a missing or corrupt shard raises
+    :class:`ShardIntegrityError` naming the shard and the reason —
+    callers (``latest_sharded_checkpoint``) fall back to an older
+    complete checkpoint."""
+    with open(manifest_path, "rb") as f:
+        rec = pickle.load(f)
+    if not isinstance(rec, dict) or \
+            rec.get("version") != SHARD_FORMAT_VERSION:
+        raise ValueError("not a version-%d sharded checkpoint manifest: "
+                         "%r" % (SHARD_FORMAT_VERSION, manifest_path))
+    directory = os.path.dirname(os.path.abspath(manifest_path))
+    shard_payloads = []
+    for entry in rec["shards"]:
+        path = os.path.join(directory, entry["file"])
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise ShardIntegrityError(
+                "manifest %s references missing shard %s (rank %d): %s"
+                % (os.path.basename(manifest_path), entry["file"],
+                   entry.get("rank", -1), e))
+        got = hashlib.sha256(blob).hexdigest()
+        if got != entry["digest"]:
+            raise ShardIntegrityError(
+                "shard %s (rank %d) is corrupt: digest %s does not "
+                "match the manifest's %s"
+                % (entry["file"], entry.get("rank", -1), got[:16],
+                   entry["digest"][:16]))
+        shard_payloads.append(pickle.loads(blob)["payload"])
+    return {"version": rec["version"], "step": int(rec["step"]),
+            "world": int(rec["world"]), "payload": rec["payload"],
+            "shards": shard_payloads,
+            "provenance": rec.get("provenance")}
+
+
+def latest_sharded_checkpoint(directory):
+    """Newest *complete* sharded checkpoint -> ``(manifest_path,
+    record)`` or ``None``.  A manifest whose shard set fails the digest
+    check (:class:`ShardIntegrityError`) falls back to the next-newest
+    — the last-committed-manifest-wins semantics."""
+    for step, path in reversed(list_manifests(directory)):
+        try:
+            return path, load_sharded_checkpoint(path)
+        except Exception:
+            continue
+    return None
+
+
+def _prune_sharded(directory, keep):
+    """Drop manifests beyond ``keep`` plus every shard file no retained
+    manifest references, and tmp debris from crashed saves."""
+    manifests = list_manifests(directory)
+    dropped = manifests[:-int(keep)] if keep else []
+    kept = manifests[len(dropped):]
+    referenced = set()
+    for _, path in kept:
+        try:
+            with open(path, "rb") as f:
+                rec = pickle.load(f)
+            for entry in rec.get("shards", []):
+                referenced.add(entry["file"])
+        except Exception:
+            continue
+    for _, path in dropped:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    for name in os.listdir(directory):
+        full = os.path.join(directory, name)
+        if name.endswith(SHARD_SUFFIX) and name not in referenced:
+            try:
+                os.remove(full)
+            except OSError:
+                pass
+        elif ".tmp." in name and (
+                name.split(".tmp.")[0].endswith(SHARD_SUFFIX)
+                or name.split(".tmp.")[0].endswith(MANIFEST_SUFFIX)):
+            try:
+                os.remove(full)
+            except OSError:
+                pass

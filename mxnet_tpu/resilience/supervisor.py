@@ -1,0 +1,381 @@
+"""Elastic training supervisor: rank death → shrink, rejoin → grow.
+
+The TensorFlow-paper ecosystem (arxiv 1605.08695 §4.2) treats
+checkpoints as the unit of fault tolerance and fleet size as a variable;
+this module is that policy for the ZeRO-1 elastic tier
+(docs/elastic.md): it launches the SPMD training job
+(``tools/train_elastic.py``) over a rank set, watches the per-rank
+heartbeat records the job writes, and when the job dies it names the
+dead rank, **shrinks** the rank set, and relaunches with ``--resume`` —
+the shard-parallel checkpoint re-shards to the new size on load.  A
+rank announcing itself (a join record) triggers a **grow** the same
+way: the running job is asked to yield (SIGTERM → checkpoint + clean
+exit), then relaunched one rank larger.
+
+Decision discipline (the PR-12 promotion-controller contract, SRV005):
+:func:`ElasticSupervisor.decide` is a *pure* function of an observation
+dict — no clock ever feeds a decision.  Liveness is process exit (the
+real signal when one host of an SPMD job dies, the whole job dies),
+victim naming comes from the heartbeat step counters (the unique rank
+that *entered* the last started step but never completed its probe),
+and ``steps_lost`` is heartbeat-trained-step minus manifest step.
+Every committed decision is:
+
+- a versioned JSON audit record (``audit-<seq>.json``, atomic rename,
+  ``schema_version`` 1, readers refuse newer) carrying the decision AND
+  the observation it was made from;
+- a chaos probe hit (site ``supervisor.decision``, count = seq) so
+  schedules can fault the supervisor itself;
+- a telemetry flight-ring event + ``mxtpu_supervisor_decisions_total``
+  counter when telemetry is armed.
+
+Heartbeat/join records are plain JSON files in the work directory
+(atomic rename), written by the training job — see
+:func:`write_heartbeat` / :func:`write_join_request`.
+
+jax is imported nowhere here: the supervisor must run on a host whose
+backend is wedged (that is rather the point).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import time
+
+from . import chaos as _chaos
+from . import checkpoint as _ckpt
+
+__all__ = ["AUDIT_SCHEMA_VERSION", "ElasticSupervisor", "write_heartbeat",
+           "read_heartbeats", "write_join_request", "read_join_requests",
+           "read_audit", "SupervisorHalted"]
+
+AUDIT_SCHEMA_VERSION = 1
+
+# exit code a worker uses for "yielded cleanly for a fleet change"
+# (SIGTERM handled: checkpoint written, not a crash, not completion)
+YIELD_EXIT_CODE = 3
+
+
+class SupervisorHalted(RuntimeError):
+    """The supervisor gave up (below min fleet size, or the restart
+    budget for deaths it could not attribute is exhausted)."""
+
+
+def _atomic_json(path, doc):
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat / join records (written by the training job)
+# ---------------------------------------------------------------------------
+def write_heartbeat(directory, rank, enter_step, done_step, trained_step):
+    """Atomically publish rank ``rank``'s liveness record.  ``enter``/
+    ``done`` bracket the rank's per-step probe (``train.step``):
+    a rank that entered step *s* but never completed it is the
+    supervisor's victim candidate; ``trained_step`` is the last step
+    whose update actually committed (what ``steps_lost`` measures
+    against the manifest)."""
+    _atomic_json(os.path.join(directory, "hb-%05d.json" % int(rank)),
+                 {"rank": int(rank), "enter_step": int(enter_step),
+                  "done_step": int(done_step),
+                  "trained_step": int(trained_step),
+                  "pid": os.getpid()})
+
+
+def read_heartbeats(directory):
+    """{rank: record} of every parseable heartbeat file."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(directory, "hb-*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            out[int(rec["rank"])] = rec
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
+def clear_heartbeats(directory):
+    for path in glob.glob(os.path.join(directory, "hb-*.json")):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def write_join_request(directory, rank):
+    """A (re)joining rank announces itself; the supervisor grows the
+    fleet at the next safe point (job yield)."""
+    _atomic_json(os.path.join(directory, "join-%05d.json" % int(rank)),
+                 {"rank": int(rank), "pid": os.getpid()})
+
+
+def read_join_requests(directory):
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory, "join-*.json"))):
+        try:
+            with open(path) as f:
+                out.append(int(json.load(f)["rank"]))
+        except (OSError, ValueError, KeyError):
+            continue
+    return sorted(set(out))
+
+
+def clear_join_requests(directory):
+    for path in glob.glob(os.path.join(directory, "join-*.json")):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def read_audit(directory):
+    """The committed decision trail, ascending by seq.  Refuses records
+    from a NEWER schema (the PR-12 versioned-reader discipline)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "audit-*.json"))):
+        with open(path) as f:
+            doc = json.load(f)
+        ver = int(doc.get("schema_version", 0))
+        if ver > AUDIT_SCHEMA_VERSION:
+            raise ValueError(
+                "audit record %s has schema_version %d; this reader "
+                "understands <= %d — upgrade the reader, do not guess "
+                "at decision records" % (os.path.basename(path), ver,
+                                         AUDIT_SCHEMA_VERSION))
+        out.append(doc)
+    out.sort(key=lambda d: d.get("seq", 0))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------------
+class ElasticSupervisor:
+    """Launch/watch/heal one elastic training job (module docstring).
+
+    Parameters
+    ----------
+    workdir : str — heartbeats, join records, the audit trail and (by
+        convention) the job's checkpoint directory live here.
+    launch : callable(ranks, resume, extra_env) -> subprocess.Popen —
+        starts the training job over ``ranks``.  ``extra_env`` carries
+        the first-launch-only chaos spec (a respawned job must not
+        re-arm the fault that killed its predecessor).
+    ranks : initial rank ids (fleet size = len(ranks)).
+    min_size : refuse to shrink below this many ranks.
+    max_restarts : budget for deaths with NO attributable dead rank
+        (a crash-looping job must not restart forever).
+    target_steps : the job's step goal — recorded in audit evidence.
+    chaos_env : optional {var: value} merged into the FIRST launch only.
+    poll_interval_s : how often the watch loop samples the child and
+        the join records (measurement, never a decision input).
+    """
+
+    def __init__(self, workdir, launch, ranks, min_size=1,
+                 max_restarts=2, target_steps=None, chaos_env=None,
+                 poll_interval_s=0.1, logger=None):
+        import logging
+        self.workdir = str(workdir)
+        self.audit_dir = os.path.join(self.workdir, "audit")
+        os.makedirs(self.audit_dir, exist_ok=True)
+        self._launch = launch
+        self.ranks = sorted(int(r) for r in ranks)
+        self.min_size = int(min_size)
+        self.max_restarts = int(max_restarts)
+        self.target_steps = target_steps
+        self._chaos_env = dict(chaos_env or {})
+        self._poll_s = float(poll_interval_s)
+        self._seq = 0
+        self._restarts_used = 0
+        self._launches = 0
+        self.log = logger or logging.getLogger("mxtpu.supervisor")
+
+    # -- observation -------------------------------------------------------
+    def observe(self, exit_code):
+        """Snapshot the facts a decision may depend on: the job's exit
+        code, the per-rank heartbeat counters, the newest committed
+        manifest step and any pending join requests.  Pure reads — the
+        returned dict IS the decision input and is embedded verbatim in
+        the audit record."""
+        found = _ckpt.latest_sharded_checkpoint(self.workdir)
+        manifest_step = int(found[1]["step"]) if found else 0
+        return {
+            "exit_code": exit_code,
+            "ranks": list(self.ranks),
+            "heartbeats": {str(r): rec for r, rec in
+                           sorted(read_heartbeats(self.workdir).items())},
+            "manifest_step": manifest_step,
+            "join_requests": read_join_requests(self.workdir),
+            "target_steps": self.target_steps,
+            "restarts_used": self._restarts_used,
+        }
+
+    # -- the pure decision rule -------------------------------------------
+    @staticmethod
+    def decide(obs, min_size=1, max_restarts=2):
+        """Pure decision function: observation dict -> decision dict
+        (``action`` ∈ start/complete/grow/shrink/restart/halt, plus
+        ``ranks``/``dead_rank``/``steps_lost``/``reason``).  No clock,
+        no randomness, no IO — byte-identical reruns make byte-identical
+        decisions (the SRV005 contract; tests replay it).
+
+        Victim rule: among the current ranks, the unique rank whose
+        heartbeat *entered* the most recent step but never completed its
+        probe (``done_step < enter_step``) with the HIGHEST
+        ``enter_step`` is the dead rank — per-rank probes run in rank
+        order, so the first rank that fails to complete the step the
+        fleet was starting is the one whose host died; later ranks
+        never reached it."""
+        ranks = list(obs["ranks"])
+        hbs = {int(r): rec for r, rec in obs["heartbeats"].items()
+               if int(r) in ranks}
+        trained = max([rec.get("trained_step", 0)
+                       for rec in hbs.values()] or [0])
+        steps_lost = max(0, trained - int(obs["manifest_step"]))
+        joins = [r for r in obs.get("join_requests", ())
+                 if r not in ranks]
+        exit_code = obs["exit_code"]
+
+        if exit_code == 0:
+            return {"action": "complete", "ranks": ranks,
+                    "dead_rank": None, "steps_lost": 0,
+                    "reason": "job finished its step budget"}
+        if exit_code == YIELD_EXIT_CODE:
+            new_ranks = sorted(ranks + joins)
+            return {"action": "grow" if joins else "restart",
+                    "ranks": new_ranks, "dead_rank": None,
+                    "steps_lost": steps_lost,
+                    "reason": "job yielded for a fleet change"}
+
+        # crashed: name the victim from the heartbeat counters
+        candidates = [
+            (rec.get("enter_step", 0), r) for r, rec in hbs.items()
+            if rec.get("done_step", 0) < rec.get("enter_step", 0)]
+        dead = max(candidates)[1] if candidates else None
+        if dead is not None:
+            survivors = [r for r in ranks if r != dead]
+            if len(survivors) >= min_size:
+                return {"action": "shrink", "ranks": survivors,
+                        "dead_rank": dead, "steps_lost": steps_lost,
+                        "reason": "rank %d entered step %d and never "
+                                  "completed its probe (exit %s); "
+                                  "resuming at size %d from manifest "
+                                  "step %d"
+                                  % (dead, max(candidates)[0],
+                                     exit_code, len(survivors),
+                                     obs["manifest_step"])}
+            return {"action": "halt", "ranks": ranks, "dead_rank": dead,
+                    "steps_lost": steps_lost,
+                    "reason": "rank %d died but shrinking below "
+                              "min_size=%d is refused" % (dead,
+                                                          min_size)}
+        if int(obs.get("restarts_used", 0)) < max_restarts:
+            return {"action": "restart", "ranks": ranks,
+                    "dead_rank": None, "steps_lost": steps_lost,
+                    "reason": "job died (exit %s) with no attributable "
+                              "dead rank; restart %d/%d"
+                              % (exit_code,
+                                 int(obs.get("restarts_used", 0)) + 1,
+                                 max_restarts)}
+        return {"action": "halt", "ranks": ranks, "dead_rank": None,
+                "steps_lost": steps_lost,
+                "reason": "restart budget exhausted (exit %s)"
+                          % (exit_code,)}
+
+    # -- decision commit: chaos probe + audit + telemetry ------------------
+    def _commit(self, decision, obs):
+        self._seq += 1
+        seq = self._seq
+        # chaos first: an injected fault here models a supervisor that
+        # dies BEFORE committing — no audit record may be written for
+        # an uncommitted decision
+        _chaos.maybe_inject("supervisor.decision", seq, ctx=decision)
+        record = {"schema_version": AUDIT_SCHEMA_VERSION, "seq": seq,
+                  "decision": dict(decision), "evidence": dict(obs)}
+        _atomic_json(os.path.join(self.audit_dir,
+                                  "audit-%06d.json" % seq), record)
+        try:
+            from .. import telemetry as _tele
+            if _tele.enabled():
+                _tele.record("supervisor.decision", seq=seq,
+                             action=decision["action"],
+                             dead_rank=decision.get("dead_rank"),
+                             size=len(decision.get("ranks", ())),
+                             steps_lost=decision.get("steps_lost"))
+            from ..telemetry.metrics import registry as _registry
+            _registry().counter(
+                "mxtpu_supervisor_decisions_total",
+                "elastic supervisor decisions by action").inc(
+                action=decision["action"])
+        except Exception:
+            pass  # telemetry must never block or reorder a decision
+        self.log.info("supervisor decision #%d: %s (%s)", seq,
+                      decision["action"], decision["reason"])
+        return decision
+
+    # -- the watch loop ----------------------------------------------------
+    def _spawn(self, ranks, resume):
+        extra = dict(self._chaos_env) if self._launches == 0 else {}
+        self._launches += 1
+        clear_heartbeats(self.workdir)
+        return self._launch(list(ranks), resume, extra)
+
+    def _wait(self, proc):
+        """Block until the job exits; a NEW join request asks the job to
+        yield (SIGTERM) so the fleet can grow.  This loop is
+        measurement/IO pacing only — nothing it reads from the clock
+        feeds a decision."""
+        asked_to_yield = False
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            if not asked_to_yield and read_join_requests(self.workdir):
+                if any(r not in self.ranks for r in
+                       read_join_requests(self.workdir)):
+                    asked_to_yield = True
+                    try:
+                        proc.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+            time.sleep(self._poll_s)  # mxlint: disable=SRV005 — child-process poll pacing, not a decision input
+
+    def run(self):
+        """Supervise to completion.  Returns the final decision dict
+        (action ``complete``); raises :class:`SupervisorHalted` when
+        healing is impossible."""
+        obs = self.observe(exit_code=None)
+        decision = self._commit(
+            {"action": "start", "ranks": list(self.ranks),
+             "dead_rank": None, "steps_lost": 0,
+             "reason": "initial launch at size %d" % len(self.ranks)},
+            obs)
+        resume = _ckpt.latest_sharded_checkpoint(self.workdir) is not None
+        while True:
+            proc = self._spawn(decision["ranks"], resume)
+            rc = self._wait(proc)
+            obs = self.observe(exit_code=rc)
+            decision = self._commit(
+                self.decide(obs, min_size=self.min_size,
+                            max_restarts=self.max_restarts), obs)
+            action = decision["action"]
+            if action == "complete":
+                return decision
+            if action == "halt":
+                raise SupervisorHalted(decision["reason"])
+            if action == "restart":
+                self._restarts_used += 1
+            if action in ("grow", "shrink"):
+                self.ranks = list(decision["ranks"])
+                clear_join_requests(self.workdir)
+            resume = True

@@ -60,9 +60,9 @@ import threading
 import time
 from collections import deque
 
-__all__ = ["PHASES", "HINTS", "StepAttribution", "StragglerDetector",
-           "attribution", "reset_attribution", "dominant_phase_or_none",
-           "doctor_report", "render_doctor"]
+__all__ = ["PHASES", "HINTS", "CONTEXT_HINTS", "StepAttribution",
+           "StragglerDetector", "attribution", "reset_attribution",
+           "dominant_phase_or_none", "doctor_report", "render_doctor"]
 
 # The step wall-clock decomposition.  Every name here must (a) be used
 # by an ``add_phase`` call somewhere in the shipped sources, (b) have a
@@ -102,6 +102,18 @@ HINTS = {
                     "flush boundaries (wider callback intervals)",
     "checkpoint": "snapshot cost dominates: raise checkpoint_every "
                   "(fewer snapshots) or lower checkpoint_keep",
+}
+
+# context-specialized hints: when a rank's attribution context tags a
+# phase with a mode, the doctor prints the mode's hint instead of the
+# generic one.  Keyed (phase, context-tag); the phase key set is a
+# subset of PHASES (TEL002 pins PHASES/HINTS; this map only refines).
+CONTEXT_HINTS = {
+    ("collective_or_ps", "zero1"):
+        "the zero1 collective dominates: the ZeRO-1 reduce-scatter/"
+        "all-gather program is the bottleneck — grow the per-replica "
+        "batch so compute amortizes the gather, or drop zero=1 if the "
+        "optimizer state fits replicated (docs/elastic.md)",
 }
 
 
@@ -183,11 +195,28 @@ class StepAttribution:
         self.queue_growth_factor = float(os.environ.get(
             "MXTPU_QUEUE_GROWTH_FACTOR", "2.0"))
         self._queue_growth = 0
+        # free-form phase context: instrumented sites tag WHAT a phase
+        # is measuring in their mode (e.g. the zero=1 trainer tags
+        # collective_or_ps as "zero1" so the doctor can name the ZeRO
+        # collective as the knob instead of the PS round).  Snapshot-
+        # carried; never touched on the hot path.
+        self._context = {}
         # registry export: one weakly-held collector (the PipelineStats
         # discipline) — a reset drops the old instance out of the scrape
         from .metrics import registry as _registry
         _registry().register_collector(self._metrics_samples,
                                        name="attribution")
+
+    def set_context(self, phase, tag):
+        """Tag ``phase`` with a mode string (off the hot path — called
+        once at setup).  Lands in :meth:`snapshot` as ``context`` and in
+        the metrics dump, where the doctor reads it to specialize the
+        phase's hint (docs/observability.md "zero1 collective")."""
+        if phase not in self._phase_set:
+            raise ValueError("unknown attribution phase %r (PHASES=%r)"
+                             % (phase, PHASES))
+        with self._lock:
+            self._context[str(phase)] = str(tag)
 
     # -- hot path ----------------------------------------------------------
     def add_phase(self, name, seconds):
@@ -438,6 +467,7 @@ class StepAttribution:
                 "dominant_phase": self._dominant_locked(),
                 "anomalies": self._anomalies,
                 "queue_growth_events": self._queue_growth,
+                "context": dict(self._context),
             }
 
     def _metrics_samples(self):
@@ -663,6 +693,7 @@ def doctor_report(directory, factor=None):
             unattributed_s=attr.get("unattributed_s", 0.0),
             step_p50_s=attr.get("step_p50_s", 0.0),
             anomalies=attr.get("anomalies", 0),
+            context=dict(attr.get("context") or {}),
         )
         rec["source"].append(os.path.basename(path))
     for path in sorted(_glob.glob(os.path.join(str(directory),
@@ -713,7 +744,12 @@ def doctor_report(directory, factor=None):
             if phases[dominant] <= 0:
                 dominant = None
         rec["dominant_phase"] = dominant
-        rec["hint"] = HINTS.get(dominant) if dominant else None
+        hint = HINTS.get(dominant) if dominant else None
+        if dominant:
+            tag = (rec.get("context") or {}).get(dominant)
+            if tag is not None:
+                hint = CONTEXT_HINTS.get((dominant, tag), hint)
+        rec["hint"] = hint
         wall = rec.get("wall_s") or 0.0
         if wall and dominant:
             rec["dominant_share"] = round(phases[dominant] / wall, 4)

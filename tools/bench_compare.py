@@ -39,6 +39,12 @@ Gated metrics (direction, tolerance)::
                                        tick on a noisy 1-core host)
     capacity_replicas_for_1m_dau       lower, 10% relative (pinned
                                        deterministic capacity answer)
+    zero1_modeled_hbm_drop_pct         higher, 2% relative (runtime-tape
+                                       ZeRO-1 memory win; deterministic)
+    reshard_restore_ms                 lower, +150 abs slack (resize-on-
+                                       resume restore, noisy 1-core host)
+    supervisor_failover_steps_lost     lower, zero slack (checkpoint-
+                                       every-step failover must lose 0)
 
 A metric with fewer than two live occurrences has no prior bar and
 passes vacuously (the r01–r05 lineage: ``value`` is live in r01+r02,
@@ -93,6 +99,15 @@ GATES = {
     "simulator_accuracy_pct": ("higher", 0.10),
     "promotion_decision_ms": ("lower_abs", 25.0),
     "capacity_replicas_for_1m_dau": ("lower_rel", 0.10),
+    # elastic stage (r06 onward): the RUNTIME-tape ZeRO-1 memory win is
+    # deterministic (2% covers intentional model retunes shipped with
+    # their PR); the resize-restore path is wall time on a noisy 1-core
+    # host (absolute slack); steps lost at checkpoint-every-step cadence
+    # is a pure policy computation — any loss is a regression, zero
+    # slack
+    "zero1_modeled_hbm_drop_pct": ("higher", 0.02),
+    "reshard_restore_ms": ("lower_abs", 150.0),
+    "supervisor_failover_steps_lost": ("lower_abs", 0.0),
 }
 
 _RECORD_KEYS = ("n", "cmd", "rc", "parsed")
