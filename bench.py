@@ -382,6 +382,15 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_MLOPS", "1") == "1":
         rec.stage("mlops", 150, _mlops_bench)
 
+    # -- transformer mesh-tier micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): tp_modeled_model_axis_bytes (the pinned
+    # fixture's tensor-parallel wire bytes), seqpar_tokens_per_sec_host
+    # (a real data=2 x model=2 x sequence=2 train loop on the virtual
+    # mesh) and tp_numerics_ok (mesh losses == replicated baseline) stay
+    # live when the TPU is down — docs/transformer.md
+    if os.environ.get("MXTPU_BENCH_TRANSFORMER", "1") == "1":
+        rec.stage("transformer", 150, _transformer_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -709,6 +718,30 @@ def _elastic_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("elastic bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _transformer_bench():
+    """tp_modeled_model_axis_bytes + seqpar_tokens_per_sec_host +
+    tp_numerics_ok through the transformer mesh-tier harness
+    (mxnet_tpu/transformer/bench.py): the pinned
+    tp_transformer_train_step fixture's per-axis modeled schedule, a
+    real 2x2x2 mesh train loop on an 8-device virtual host mesh, and
+    the mesh-vs-replicated loss-parity contract.  JAX_PLATFORMS=cpu
+    subprocess — same isolation contract as the other host stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the 2x2x2 mesh needs an 8-way virtual device pool in the child
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.transformer.bench"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("transformer bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

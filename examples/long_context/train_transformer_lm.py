@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Train a transformer LM end-to-end on a ``data × model × sequence`` mesh.
+
+The second half of the long-context story: ``ring_attention_demo.py``
+benchmarks the attention kernels; this script TRAINS with them —
+``DataParallelTrainer(mesh_plan=...)`` over
+``mxnet_tpu.transformer.TransformerLM`` (docs/transformer.md), with
+tensor-parallel layers over ``model``, ring (or Ulysses) attention over
+``sequence`` and optional ZeRO-1 optimizer sharding over ``data``.
+
+Runs on host CPU with a virtual mesh:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python train_transformer_lm.py --data 2 --model 2 --sequence 2
+
+The corpus is a seeded Markov-bigram token stream, so the loss drop is
+deterministic and the same at every mesh shape (the numerics contract
+tests/test_transformer.py asserts).  The loop carries the elastic tier's
+``train.step`` chaos probe, so seeded fault schedules (MXTPU_CHAOS or
+--chaos) can kill/delay any step — the PR-13 supervisor failover story
+covers this tier too.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def make_corpus(vocab, length, seed=7):
+    """Seeded Markov-bigram stream: each token strongly prefers one
+    successor, so even a small LM has structure to learn and the loss
+    curve is deterministic."""
+    rng = np.random.RandomState(seed)
+    succ = rng.permutation(vocab)
+    out = np.empty(length, np.int32)
+    tok = 0
+    for i in range(length):
+        out[i] = tok
+        tok = int(succ[tok]) if rng.rand() < 0.8 \
+            else int(rng.randint(vocab))
+    return out
+
+
+def batches(corpus, batch, seq_len, steps, seed=11):
+    """Deterministic (tokens, shifted-labels) windows; labels are the
+    GLOBALLY shifted next tokens, so sequence-parallel chunks need no
+    cross-rank label exchange."""
+    rng = np.random.RandomState(seed)
+    hi = len(corpus) - seq_len - 1
+    for _ in range(steps):
+        starts = rng.randint(0, hi, size=batch)
+        x = np.stack([corpus[s:s + seq_len] for s in starts])
+        y = np.stack([corpus[s + 1:s + seq_len + 1] for s in starts])
+        yield x, y
+
+
+def train(args, logger=print):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import DataParallelTrainer, MeshPlan
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.transformer import TransformerLM, TransformerLMConfig
+
+    if args.chaos:
+        os.environ["MXTPU_CHAOS"] = args.chaos
+        chaos.install_from_env()
+    mx.random.seed(args.seed)
+    cfg = TransformerLMConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.heads, n_layers=args.layers, d_ff=args.d_ff,
+        seq_len=args.seq_len, attention=args.attention)
+    plan = MeshPlan(data=args.data, model=args.model,
+                    sequence=args.sequence)
+    trainer = DataParallelTrainer(
+        TransformerLM(cfg), None, "sgd",
+        {"learning_rate": args.lr, "momentum": 0.9},
+        mesh_plan=plan, zero=args.zero)
+
+    corpus = make_corpus(args.vocab, 4096, seed=args.seed + 7)
+    losses = []
+    t0 = time.perf_counter()
+    for step, (x, y) in enumerate(
+            batches(corpus, args.batch, args.seq_len, args.steps,
+                    seed=args.seed + 11), 1):
+        # the elastic tier's per-step probe (tools/train_elastic.py):
+        # seeded schedules can kill/delay this tier's steps too
+        chaos.maybe_inject("train.step", step, ctx=step)
+        loss = trainer.step(NDArray(jnp.asarray(x)),
+                            NDArray(jnp.asarray(y)))
+        losses.append(loss)
+        if step % args.log_every == 0:
+            logger("step %4d  loss %.4f" % (step, float(loss.asnumpy())))
+    trainer.flush()
+    wall = time.perf_counter() - t0
+    vals = [float(v.asnumpy()) for v in losses]
+    head = float(np.mean(vals[:3])) if len(vals) >= 3 else vals[0]
+    tail = float(np.mean(vals[-3:]))
+    tokens = args.batch * args.seq_len * args.steps
+    stats = {
+        "plan": trainer.mesh_plan.describe(),
+        "first_loss": vals[0], "head_loss": head, "final_loss": tail,
+        "losses": vals, "tokens_per_sec": tokens / max(wall, 1e-9),
+        "steps": args.steps,
+    }
+    logger("trained %d steps (%s attention) on %s: loss %.4f -> %.4f, "
+           "%.0f tokens/s"
+           % (args.steps, cfg.attention, trainer.mesh_plan.describe(),
+              head, tail, stats["tokens_per_sec"]))
+    if args.report:
+        _, findings, shard = trainer.mesh_report(
+            data_shape=(args.batch, args.seq_len))
+        per_axis = shard.collective_bytes_per_axis
+        logger("modeled collective bytes/step per axis: %s (DST "
+               "findings: %d)" % (per_axis, len(findings)))
+        stats["collective_bytes_per_axis"] = dict(per_axis)
+    return stats
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="train a transformer LM over data x model x "
+                    "sequence (docs/transformer.md)")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--vocab", type=int, default=64)
+    parser.add_argument("--d-model", type=int, default=64)
+    parser.add_argument("--heads", type=int, default=4)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--d-ff", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.5)
+    parser.add_argument("--data", type=int, default=None,
+                        help="data-axis size (default: fill devices)")
+    parser.add_argument("--model", type=int, default=1)
+    parser.add_argument("--sequence", type=int, default=1)
+    parser.add_argument("--zero", type=int, default=0, choices=(0, 1))
+    parser.add_argument("--attention", default="ring",
+                        choices=("ring", "ulysses", "auto"))
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--log-every", type=int, default=10)
+    parser.add_argument("--chaos", default="",
+                        help="chaos spec, e.g. 'train.step:12:raise'")
+    parser.add_argument("--report", action="store_true",
+                        help="print the modeled mixed-axis collective "
+                             "schedule after training")
+    args = parser.parse_args(argv)
+    stats = train(args)
+    if stats["final_loss"] >= stats["head_loss"]:
+        print("WARNING: loss did not decrease (%.4f -> %.4f)"
+              % (stats["head_loss"], stats["final_loss"]))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -359,18 +359,20 @@ def lint_parallel_sources(disable=()):
             shard_dims={i: {1: ("sequence",)} for i in range(3)},
             param_outvars=[], subject=subject)
 
-    from ..parallel.ring_attention import ulysses_attention
-    import jax.numpy as jnp
-    aval = jax.ShapeDtypeStruct((1, 8, 4, 8), jnp.float32)
-    uclosed = jax.make_jaxpr(
-        lambda q, kk, v: ulysses_attention(q, kk, v, "sequence"),
-        axis_env=[("sequence", k)])(aval, aval, aval)
-    findings += sp.lint_sharded_step(
-        uclosed, mesh, data_axes=("sequence",),
-        varying_invars=[0, 1, 2],
-        shard_dims={i: {1: ("sequence",)} for i in range(3)},
-        param_outvars=[],
-        subject="parallel/ring_attention.py:ulysses")
+    from .shard_fixtures import ulysses_attention_program
+    for tag, with_grad in (("ulysses", False),
+                           ("ulysses fwd+bwd", True)):
+        fn, args = ulysses_attention_program(
+            k=k, batch=1, t_global=32, heads=4, head_dim=8,
+            causal=True, with_grad=with_grad)
+        uclosed = jax.make_jaxpr(
+            fn, axis_env=[("sequence", k)])(*args)
+        findings += sp.lint_sharded_step(
+            uclosed, mesh, data_axes=("sequence",),
+            varying_invars=[0, 1, 2],
+            shard_dims={i: {1: ("sequence",)} for i in range(3)},
+            param_outvars=[],
+            subject="parallel/ring_attention.py:%s" % tag)
     return filter_findings(findings, disable)
 
 

@@ -421,6 +421,269 @@ def ring_attention_fwd():
     return report, findings, shard
 
 
+def ulysses_attention():
+    """The shipped Ulysses all-to-all attention (forward + backward) on
+    a declared 8-way ``sequence`` axis: pins the all_to_all wire bytes
+    and DST-checks the swap-back pair — the traced program must carry
+    exactly 4 sequence→head and 4 head→sequence reshards (3 inputs + 1
+    output, each direction mirrored in the VJP) whose bytes match the
+    closed-form (K-1)/K × payload formula."""
+    import jax
+
+    from . import shard_fixtures as sf
+    from . import shard_prop as sp
+    from .cost import analyze_jaxpr, unpriced_findings
+    from .findings import Finding
+
+    k = 8
+    mesh = sp.MeshSpec({"sequence": k})
+    fn, args = sf.ulysses_attention_program(k=k)
+    closed = jax.make_jaxpr(fn, axis_env=[("sequence", k)])(*args)
+    report = analyze_jaxpr(closed, axis_sizes={"sequence": k},
+                           host_invars=[])
+    shard = sp.collective_schedule(closed, mesh,
+                                   subject="ulysses_attention")
+    findings = sp.lint_sharded_step(
+        closed, mesh, data_axes=("sequence",),
+        varying_invars=[0, 1, 2],
+        shard_dims={i: {1: ("sequence",)} for i in range(3)},
+        param_outvars=[], subject="ulysses_attention")
+    findings += unpriced_findings(report, subject="ulysses_attention")
+
+    # the swap-back pair proof: every seq→head reshard (the head-group
+    # dim scatters out: split_axis > concat_axis in jax's canonicalized
+    # untiled spelling) must be matched by a head→seq reshard
+    # (split_axis < concat_axis), and fwd+bwd carries 4 of each;
+    # direction read off the traced eqn params
+    from .cost import build_tape as _bt
+    s2h = h2s = 0
+    tape = _bt(closed, axis_sizes={"sequence": k})
+    for op in tape.ops:
+        if op.prim != "all_to_all" or "sequence" not in op.axes:
+            continue
+        split = int(op.params.get("split_axis", -1))
+        concat = int(op.params.get("concat_axis", -1))
+        if split > concat:
+            s2h += 1
+        else:
+            h2s += 1
+    if s2h != 4 or h2s != 4:
+        findings.append(Finding(
+            "DST009", "ulysses_attention",
+            "the Ulysses swap-back pair is broken: traced %d "
+            "sequence→head and %d head→sequence all_to_all reshards "
+            "(want 4+4: q/k/v in + output out, mirrored by the VJP) — "
+            "an unpaired reshard leaves the output head-sharded or "
+            "drops a gradient swap" % (s2h, h2s)))
+
+    b, tl, h, d = args[0].shape
+    payload = b * tl * h * d * 4
+    formula = 8 * (k - 1) * payload // k
+    if shard.collective_bytes != formula:
+        findings.append(Finding(
+            "DST009", "ulysses_attention",
+            "modeled Ulysses collective bytes %d do not match the "
+            "closed-form formula %d (= 8 all_to_alls x (K-1)/K x "
+            "%d-byte payload): a reshard was lost or duplicated"
+            % (shard.collective_bytes, formula, payload)))
+    shard.extras.update({
+        "ulysses_modeled_collective_bytes": int(shard.collective_bytes),
+        "ulysses_formula_bytes": int(formula),
+        "payload_bytes": int(payload),
+        "seq2head_reshards": int(s2h),
+        "head2seq_reshards": int(h2s),
+    })
+    return report, findings, shard
+
+
+# the pinned tp_transformer_train_step geometry: a 2-layer transformer
+# LM at data=2 × model=2 × sequence=2 (the acceptance-criteria mesh),
+# small enough to trace in seconds on the 1-core CI host but with every
+# collective class present: vocab-parallel embedding + loss psums and
+# row-parallel psums over `model`, the ring attention ppermute schedule
+# over `sequence`, and the grads pmean over `data × sequence`
+TP_GEOMETRY = {
+    "vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 2,
+    "d_ff": 64, "seq_len": 64, "attention": "ring",
+    "batch": 8, "data": 2, "model": 2, "sequence": 2,
+    "momentum": 0.9, "lr": 0.1,
+}
+
+
+def _tp_plan_and_program():
+    from ..parallel.mesh import MeshPlan
+    from ..transformer import TransformerLM, TransformerLMConfig
+
+    g = TP_GEOMETRY
+    cfg = TransformerLMConfig(
+        vocab_size=g["vocab_size"], d_model=g["d_model"],
+        n_heads=g["n_heads"], n_layers=g["n_layers"], d_ff=g["d_ff"],
+        seq_len=g["seq_len"], attention=g["attention"])
+    plan = MeshPlan(data=g["data"], model=g["model"],
+                    sequence=g["sequence"])
+    return plan, TransformerLM(cfg).mesh_program(plan), TransformerLM(cfg)
+
+
+def tp_transformer_train_step():
+    """The 2-3D-mesh transformer train step (docs/transformer.md) as a
+    static proof: the per-replica spelling of ``transformer/step.py``
+    at the pinned ``TP_GEOMETRY`` — fixture optimizer is the inline
+    SGD+momentum — traced hardware-free over the declared
+    ``data=2 × model=2 × sequence=2`` mesh.  The budget row pins its
+    metrics; the builder runs the mixed-axis DST lint (deleting the
+    row-parallel output psum via ``transformer/layers.py``'s
+    ``TP_ROW_PSUM`` seam fails the gate rc=2 with the pending
+    partial-sum DST001 named per parameter), proves the ring attention
+    schedule (DST009) over ``sequence``, and gates the REAL
+    ``DataParallelTrainer(mesh_plan=...)`` runtime tape against the
+    fixture (``tp_runtime_checks``, the PR-13 ``zero1_runtime_checks``
+    pattern)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..transformer import step as tstep
+    from . import shard_prop as sp
+    from .cost import analyze_jaxpr, unpriced_findings
+
+    g = TP_GEOMETRY
+    plan, program, _ = _tp_plan_and_program()
+    mesh = sp.MeshSpec(plan.axis_sizes())
+    n = len(program.param_names)
+    counts = [1] * n     # one momentum leaf per parameter
+    step = tstep.build_replica_step(
+        program, tstep.sgd_momentum_update(g["momentum"]), counts)
+    train_avals = tuple(
+        jax.ShapeDtypeStruct(program.local_shape(nm), jnp.float32)
+        for nm in program.param_names)
+    state_avals = train_avals       # momentum mirrors each param shard
+    b_local, t_local = program.local_batch_shape(g["batch"])
+    xs = jax.ShapeDtypeStruct((b_local, t_local), jnp.int32)
+    ys = jax.ShapeDtypeStruct((b_local, t_local), jnp.int32)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    closed = jax.make_jaxpr(step, axis_env=plan.axis_env())(
+        train_avals, state_avals, xs, ys, key,
+        jnp.float32(g["lr"]), jnp.int32(1))
+
+    host = [2 * n, 2 * n + 1]
+    report = analyze_jaxpr(closed, axis_sizes=plan.axis_sizes(),
+                           donated_invars=list(range(2 * n)),
+                           host_invars=host)
+    report.transfer_d2h_bytes = 4    # only the loss comes back
+
+    shard_dims = {}
+    for i, nm in enumerate(program.param_names):
+        spec = program.partition_spec(nm)
+        dims = {d: (e,) for d, e in enumerate(spec) if e is not None}
+        if dims:
+            shard_dims[i] = dims
+            shard_dims[n + i] = dims
+    findings = sp.lint_sharded_step(
+        closed, mesh, data_axes=plan.batch_axes(),
+        varying_invars=host, shard_dims=shard_dims,
+        param_outvars=list(range(1, 1 + n)),
+        param_names=list(program.param_names),
+        subject="tp_transformer_train_step")
+    findings += sp.lint_ring_schedule(
+        closed, "sequence", plan.size("sequence"),
+        subject="tp_transformer_train_step")
+    findings += unpriced_findings(report,
+                                  subject="tp_transformer_train_step")
+
+    shard = sp.collective_schedule(closed, mesh,
+                                   subject="tp_transformer_train_step")
+    per_axis = shard.collective_bytes_per_axis
+    shard.extras.update({
+        "tp_geometry": dict(TP_GEOMETRY),
+        "attention_mode": program.attention_mode,
+        "tp_modeled_model_axis_bytes": int(per_axis.get("model", 0)),
+        "tp_modeled_sequence_axis_bytes": int(
+            per_axis.get("sequence", 0)),
+        "tp_modeled_data_axis_bytes": int(per_axis.get("data", 0)),
+    })
+    # the RUNTIME half: the real DataParallelTrainer(mesh_plan=...)
+    # tape must satisfy the same budget
+    rt_findings, rt_extras = tp_runtime_checks(report, shard)
+    findings += rt_findings
+    shard.extras.update(rt_extras)
+    return report, findings, shard
+
+
+def tp_runtime_checks(fixture_report, fixture_shard,
+                      tolerance_pct=10.0):
+    """Gate the ``DataParallelTrainer(mesh_plan=...)`` REAL step tape
+    against the ``tp_transformer_train_step`` fixture: the trainer's
+    ``mesh_report`` (gluon ``sgd`` via ``functional_optimizer_update``
+    instead of the fixture's inline rule) must match the pinned
+    metrics within tolerance, carry the same mixed-axis DST-clean
+    schedule, and move EXACTLY the fixture's per-axis collective bytes
+    — the runtime and the proven spelling can never drift."""
+    from ..parallel.mesh import MeshPlan
+    from ..parallel.trainer import DataParallelTrainer
+    from .findings import Finding
+
+    g = TP_GEOMETRY
+    tol = float(tolerance_pct) / 100.0
+    plan, _, block = _tp_plan_and_program()
+    findings = []
+    try:
+        trainer = DataParallelTrainer(
+            block, None, "sgd",
+            {"learning_rate": g["lr"], "momentum": g["momentum"]},
+            mesh_plan=MeshPlan(data=g["data"], model=g["model"],
+                               sequence=g["sequence"]))
+        rt_report, rt_findings, rt_shard = trainer.mesh_report(
+            data_shape=(g["batch"], g["seq_len"]))
+    except Exception as e:
+        findings.append(Finding(
+            "COST001", "tp_transformer_train_step.runtime",
+            "the mesh-tier trainer no longer traces: %s: %s"
+            % (type(e).__name__, str(e)[:200])))
+        return findings, {}
+    findings += rt_findings
+
+    fx = fixture_report.as_dict()
+    rt = rt_report.as_dict()
+    for metric in ("flops", "transcendentals", "transfer_bytes",
+                   "collective_bytes"):
+        want, got = float(fx[metric]), float(rt[metric])
+        if want and abs(got - want) > tol * want:
+            findings.append(Finding(
+                "COST001", "tp_transformer_train_step.runtime.%s"
+                % metric,
+                "the mesh-tier trainer's REAL step tape models %s = %d "
+                "but the budgeted fixture pins %d (tolerance %.0f%%): "
+                "the runtime and the proven spelling have drifted "
+                "apart" % (metric, int(got), int(want), tol * 100)))
+    if rt["peak_hbm_bytes"] > fx["peak_hbm_bytes"] * (1 + tol):
+        findings.append(Finding(
+            "COST001", "tp_transformer_train_step.runtime.peak_hbm_bytes",
+            "the mesh-tier trainer's REAL step models peak HBM %d, "
+            "over the budgeted fixture's %d (tolerance %.0f%%)"
+            % (int(rt["peak_hbm_bytes"]), int(fx["peak_hbm_bytes"]),
+               tol * 100)))
+
+    # per-axis collective parity is EXACT: both spellings run the same
+    # program code, and the optimizer difference is collective-free
+    fx_axis = fixture_shard.collective_bytes_per_axis
+    rt_axis = rt_shard.collective_bytes_per_axis
+    for axis in ("model", "sequence"):
+        if fx_axis.get(axis, 0) != rt_axis.get(axis, 0):
+            findings.append(Finding(
+                "COST001",
+                "tp_transformer_train_step.runtime.%s_axis_bytes" % axis,
+                "runtime %s-axis collective bytes (%d) differ from the "
+                "fixture's (%d): the trainer's step moves different "
+                "wire traffic than the proven schedule"
+                % (axis, rt_axis.get(axis, 0), fx_axis.get(axis, 0))))
+    extras = {
+        "runtime_peak_hbm_bytes": int(rt["peak_hbm_bytes"]),
+        "runtime_collective_bytes": int(rt["collective_bytes"]),
+        "runtime_model_axis_bytes": int(rt_axis.get("model", 0)),
+        "runtime_sequence_axis_bytes": int(rt_axis.get("sequence", 0)),
+    }
+    return findings, extras
+
+
 BUDGET_MODELS = {
     "mlp_train_step": mlp_train_step,
     "mlp_infer": mlp_infer,
@@ -428,6 +691,8 @@ BUDGET_MODELS = {
     "resnet50_train_step": resnet50_train_step,
     "zero1_mlp_train_step": zero1_mlp_train_step,
     "ring_attention_fwd": ring_attention_fwd,
+    "ulysses_attention": ulysses_attention,
+    "tp_transformer_train_step": tp_transformer_train_step,
 }
 
 

@@ -385,6 +385,7 @@ def build_tape(closed_jaxpr, axis_sizes=None):
         else:
             operand_atoms = eqn.invars
 
+        connected = True
         for si, (_, sj, sc) in enumerate(subs):
             inner_env = {}
             n = len(sj.invars)
@@ -402,6 +403,8 @@ def build_tape(closed_jaxpr, axis_sizes=None):
             else:
                 for var in sj.invars:
                     inner_env[var] = tape.fresh(var.aval)
+                if si == 0:
+                    connected = False
             walk(sj, list(sc), inner_env, sub_scale)
             if si == 0 and len(sj.outvars) == len(eqn.outvars):
                 for outer, inner in zip(eqn.outvars, sj.outvars):
@@ -413,8 +416,21 @@ def build_tape(closed_jaxpr, axis_sizes=None):
             elif si == 0:
                 for outer in eqn.outvars:
                     env[outer] = tape.fresh(outer.aval)
+                connected = False
             if prim == "custom_jvp_call":
                 break   # don't double-count the jvp rule
+        if not connected:
+            # a call whose operands/results could not be mapped 1:1
+            # onto its sub-jaxpr (pallas_call's ref-passing kernels):
+            # the body's COST is already on the tape, but its dataflow
+            # is severed — append a zero-cost connector op so liveness
+            # and the shard/variance propagation still see that the
+            # outputs derive from the operands
+            tape.ops.append(TapeOp(
+                prim, scale,
+                tuple(read(env, a) for a in eqn.invars),
+                tuple(env[v] for v in eqn.outvars),
+                0, 0, 0, 0, {}, (), {}))
 
     env = {}
     jaxpr = closed_jaxpr.jaxpr

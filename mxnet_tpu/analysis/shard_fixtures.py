@@ -29,6 +29,7 @@ import numpy as _np
 
 __all__ = ["ZERO1_GEOMETRY", "zero1_step_program", "zero1_state_bytes",
            "tp_matmul_program", "ring_attention_program",
+           "ulysses_attention_program",
            "ZERO1_ALL_GATHER", "ZERO1_SHARD_STATE"]
 
 # mutation seams (see module docstring) — flipped only by tests
@@ -189,4 +190,40 @@ def ring_attention_program(k=8, batch=2, t_global=512, heads=4,
     else:
         def fn(q, kk, v):
             return ring_attention(q, kk, v, "sequence", causal=causal)
+    return fn, (aval, aval, aval)
+
+
+def ulysses_attention_program(k=8, batch=2, t_global=512, heads=8,
+                              head_dim=32, causal=True, with_grad=True):
+    """(fn, args) — the shipped Ulysses all-to-all attention's
+    per-replica program at a pinned geometry (``heads % k == 0``):
+    local (B, T/K, H, D) chunks swap sequence sharding for head
+    sharding with one ``all_to_all`` per tensor, attend fully per
+    local head group, and swap back.  ``with_grad`` traces forward +
+    backward — the swap-back pair's VJPs are the inverse reshards, so
+    the traced program carries exactly 8 all_to_alls whose wire bytes
+    the ``ulysses_attention`` budget row pins.  Trace with
+    ``axis_env=[("sequence", k)]``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.ring_attention import ulysses_attention
+
+    if heads % k:
+        raise ValueError("ulysses needs heads %% k == 0 (got %d, %d)"
+                         % (heads, k))
+    t_local = t_global // k
+    aval = jax.ShapeDtypeStruct((batch, t_local, heads, head_dim),
+                                jnp.float32)
+
+    if with_grad:
+        def fn(q, kk, v):
+            return jax.grad(
+                lambda a, b, c: ulysses_attention(
+                    a, b, c, "sequence", causal=causal).sum(),
+                argnums=(0, 1, 2))(q, kk, v)
+    else:
+        def fn(q, kk, v):
+            return ulysses_attention(q, kk, v, "sequence",
+                                     causal=causal)
     return fn, (aval, aval, aval)

@@ -338,20 +338,23 @@ def _union_state(states, out_rank):
         if states else frozenset()
     scattered = frozenset().union(*(s.scattered for s in states)) \
         if states else frozenset()
-    # dims merge only when every same-rank operand agrees; a mismatch
-    # (or a rank change the handler didn't map) degrades to no layout —
-    # value-level sets survive, so the error rules stay sound
-    dims = None
-    for s in states:
-        if len(s.dims) != out_rank:
-            continue
-        if dims is None:
-            dims = s.dims
-        elif dims != s.dims:
-            dims = tuple(frozenset() for _ in range(out_rank))
-            break
-    if dims is None:
+    # dims merge PER DIMENSION across same-rank operands: a dim keeps
+    # its layout when every operand that declares one agrees (an empty
+    # dim is broadcast/replicated along it — elementwise ops preserve
+    # the sharded operand's layout); conflicting layouts degrade that
+    # dim to unknown.  Rank changes the handler didn't map degrade the
+    # whole layout — value-level sets survive, so the error rules stay
+    # sound either way.
+    cands = [s for s in states if len(s.dims) == out_rank]
+    if not cands:
         dims = tuple(frozenset() for _ in range(out_rank))
+    else:
+        dims = []
+        for d in range(out_rank):
+            declared = {s.dims[d] for s in cands if s.dims[d]}
+            dims.append(declared.pop() if len(declared) == 1
+                        else frozenset())
+        dims = tuple(dims)
     return _VState(content=content, dims=dims, partial=partial,
                    reduced=reduced, scattered=scattered)
 
@@ -444,8 +447,83 @@ def _replica_collect(tape, mesh, init_states, data_axes, on_reduce=None):
         elif op.prim == "axis_index":
             new = _VState(rank=out_rank, content=axes)
         elif op.prim == "dot_general":
+            # mirror the global view: batch/free layout dims map onto
+            # the output, a contracted layout-sharded dim becomes a
+            # pending partial-sum (each rank holds an addend — the
+            # row-parallel matmul whose completing psum DST rules watch)
             contracted = _dot_contracted_axes(op, in_states)
-            new = merged.clone(partial=merged.partial | contracted)
+            lhs = in_states[0] if in_states else _VState()
+            rhs = in_states[1] if len(in_states) > 1 else _VState()
+            (lc, rc), (lb, rb) = op.params["dimension_numbers"]
+            lhs_ok = len(lhs.dims) == _rank_of(
+                tape.avals.get(op.in_ids[0])) if op.in_ids else False
+            rhs_ok = len(rhs.dims) == _rank_of(
+                tape.avals.get(op.in_ids[1])) \
+                if len(op.in_ids) > 1 else False
+            if lhs_ok and rhs_ok:
+                lfree = [d for d in range(len(lhs.dims))
+                         if d not in set(lc) | set(lb)]
+                rfree = [d for d in range(len(rhs.dims))
+                         if d not in set(rc) | set(rb)]
+                dims = [lhs.dims[d] for d in lb] \
+                    + [lhs.dims[d] for d in lfree] \
+                    + [rhs.dims[d] for d in rfree]
+                dims = (dims + [frozenset()] * out_rank)[:out_rank]
+            else:
+                dims = list(merged.dims)
+            new = merged.clone(partial=merged.partial | contracted,
+                               dims=tuple(frozenset(d) for d in dims))
+        elif op.prim.startswith("reduce_") and "axes" in op.params \
+                and op.prim not in _COLLECTIVES and in_states:
+            # a plain reduce over a layout-sharded dim leaves each rank
+            # holding its shard's partial result — a pending partial-sum
+            # the completing pmax/psum (vocab-parallel logsumexp)
+            # resolves; non-reduced dims keep their layout
+            src = in_states[0]
+            red = set(op.params["axes"])
+            partial = set(merged.partial)
+            dims = []
+            if op.in_ids and len(src.dims) == _rank_of(
+                    tape.avals.get(op.in_ids[0])):
+                for d, axs in enumerate(src.dims):
+                    if d in red:
+                        partial |= set(axs)
+                    else:
+                        dims.append(axs)
+            if len(dims) != out_rank:
+                dims = [frozenset()] * out_rank
+            new = merged.clone(dims=tuple(dims),
+                               partial=frozenset(partial))
+        elif op.prim == "transpose" and in_states:
+            src = in_states[0]
+            perm = op.params.get("permutation", ())
+            if len(src.dims) == len(perm):
+                new = merged.clone(dims=tuple(
+                    src.dims[p] for p in perm))
+            else:
+                new = merged
+        elif op.prim == "broadcast_in_dim" and in_states:
+            src = in_states[0]
+            bdims = op.params.get("broadcast_dimensions", ())
+            dims = [frozenset()] * out_rank
+            if len(src.dims) == len(bdims):
+                for sd, od in enumerate(bdims):
+                    if od < out_rank:
+                        dims[od] = src.dims[sd]
+            new = merged.clone(dims=tuple(dims))
+        elif op.prim == "reshape" and in_states:
+            src = in_states[0]
+            src_shape = getattr(tape.avals.get(op.in_ids[0]), "shape",
+                                ()) if op.in_ids else ()
+            dst_shape = getattr(tape.avals.get(op.out_ids[0]), "shape",
+                                ()) if op.out_ids else ()
+            dims = [frozenset()] * out_rank
+            if len(src.dims) == len(src_shape):
+                dmap = _reshape_dim_map(src_shape, dst_shape)
+                for sd, od in dmap.items():
+                    if od < out_rank:
+                        dims[od] = src.dims[sd]
+            new = merged.clone(dims=tuple(dims))
         else:
             new = merged
         for o in op.out_ids:
@@ -573,6 +651,17 @@ def lint_sharded_step(closed_jaxpr, mesh, data_axes=("data",),
         if st is None:
             continue
         name = names[j] if j < len(names) else "output %d" % oi
+        if st.partial:
+            findings.append(Finding(
+                "DST001", name,
+                "new value of %r is a PENDING PARTIAL-SUM over mesh "
+                "axes %s: a completing psum was deleted (the "
+                "row-parallel output reduction of a tensor-parallel "
+                "layer) — every member of %s holds only its shard's "
+                "addend, so the replicas train on partial activations "
+                "and silently diverge"
+                % (name, sorted(st.partial), sorted(st.partial))))
+            continue
         if st.scattered:
             findings.append(Finding(
                 "DST007", name,

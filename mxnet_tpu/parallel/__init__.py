@@ -22,7 +22,8 @@ parallelism — ring attention over ``ppermute`` and Ulysses all-to-all
 (``zero.py``, ``DataParallelTrainer(zero=1)``, docs/elastic.md).
 """
 from . import zero
-from .mesh import make_mesh, data_parallel_mesh, local_device_count
+from .mesh import (make_mesh, data_parallel_mesh, local_device_count,
+                   MeshPlan)
 from .trainer import DataParallelTrainer
 from .functional import functionalize_forward, functional_optimizer_update
 from .ring_attention import (ring_attention, ulysses_attention,
@@ -31,7 +32,7 @@ from .ring_attention import (ring_attention, ulysses_attention,
 
 __all__ = [
     "zero", "make_mesh", "data_parallel_mesh", "local_device_count",
-    "DataParallelTrainer", "functionalize_forward",
+    "MeshPlan", "DataParallelTrainer", "functionalize_forward",
     "functional_optimizer_update", "ring_attention", "ulysses_attention",
     "local_attention", "ring_attention_sharded", "ulysses_attention_sharded",
 ]

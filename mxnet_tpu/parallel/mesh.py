@@ -14,8 +14,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "data_parallel_mesh", "local_device_count",
-           "replicated", "batch_sharded", "Mesh", "NamedSharding",
-           "PartitionSpec"]
+           "replicated", "batch_sharded", "MeshPlan", "Mesh",
+           "NamedSharding", "PartitionSpec"]
 
 
 def local_device_count():
@@ -42,6 +42,134 @@ def data_parallel_mesh(num=None):
     if num is not None:
         devices = devices[:num]
     return make_mesh((len(devices),), ("data",), devices)
+
+
+class MeshPlan:
+    """A 2-3D mesh as pure declaration: ``data × model × sequence``.
+
+    The multi-axis tier's single source of truth (docs/transformer.md):
+    the same plan drives the runtime ``Mesh`` construction, the
+    ``shard_map`` partition specs, and the hardware-free analysis
+    (``MeshSpec`` via :meth:`axis_sizes`, ``make_jaxpr(axis_env=...)``
+    via :meth:`axis_env`).  Any axis of size 1 **collapses**: it is
+    absent from the built mesh, from every partition spec and from every
+    collective — a ``MeshPlan(model=2)`` program contains no sequence
+    collectives at all, not degenerate 1-member ones.
+
+    ``data=None`` defers the data-axis size to :meth:`resolve` (fill
+    with whatever devices remain after ``model × sequence``), so a plan
+    can be declared before a backend exists — the analysis path never
+    needs devices.
+    """
+
+    AXES = ("data", "model", "sequence")
+
+    def __init__(self, data=None, model=1, sequence=1):
+        self.data = None if data is None else int(data)
+        self.model = int(model)
+        self.sequence = int(sequence)
+        for name in ("data", "model", "sequence"):
+            v = getattr(self, name)
+            if v is not None and v < 1:
+                raise ValueError("MeshPlan axis %r must be >= 1, got %r"
+                                 % (name, v))
+
+    @classmethod
+    def coerce(cls, plan):
+        """A MeshPlan from a MeshPlan / dict / (data, model, sequence)
+        tuple — the ``DataParallelTrainer(mesh_plan=...)`` accessor."""
+        if plan is None or isinstance(plan, cls):
+            return plan
+        if isinstance(plan, dict):
+            bad = set(plan) - set(cls.AXES)
+            if bad:
+                raise ValueError("MeshPlan axes are %r, got unknown %r"
+                                 % (cls.AXES, sorted(bad)))
+            return cls(**plan)
+        if isinstance(plan, (tuple, list)) and len(plan) == 3:
+            return cls(*plan)
+        raise ValueError("mesh_plan must be a MeshPlan, a "
+                         "{data/model/sequence: size} dict or a "
+                         "(data, model, sequence) tuple, got %r" % (plan,))
+
+    # -- declaration ------------------------------------------------------
+    def resolve(self, n_devices):
+        """Fill a deferred data-axis size from the device count.  Returns
+        a fully-specified plan; raises when the device pool does not
+        factor."""
+        ms = self.model * self.sequence
+        if self.data is not None:
+            return self
+        if n_devices % ms:
+            raise ValueError(
+                "cannot resolve MeshPlan(model=%d, sequence=%d) over %d "
+                "devices: model*sequence=%d does not divide the pool"
+                % (self.model, self.sequence, n_devices, ms))
+        return MeshPlan(data=n_devices // ms, model=self.model,
+                        sequence=self.sequence)
+
+    def size(self, axis):
+        v = getattr(self, axis)
+        return 1 if v is None else int(v)
+
+    @property
+    def total(self):
+        return self.size("data") * self.model * self.sequence
+
+    def present(self, axis):
+        """True when ``axis`` survives collapse (size > 1)."""
+        return self.size(axis) > 1
+
+    def axis_names(self):
+        """The collapsed axis tuple (size-1 axes dropped); a fully
+        degenerate plan keeps a single size-1 ``data`` axis so a mesh
+        can still be built."""
+        names = tuple(a for a in self.AXES if self.present(a))
+        return names or ("data",)
+
+    def axis_sizes(self):
+        """Collapsed ``{axis: size}`` — feeds ``analysis.MeshSpec``."""
+        return {a: self.size(a) for a in self.axis_names()}
+
+    def axis_env(self):
+        """``[(axis, size), ...]`` for ``jax.make_jaxpr(axis_env=...)``
+        — the hardware-free trace of the per-replica step."""
+        return [(a, self.size(a)) for a in self.axis_names()]
+
+    def batch_axes(self):
+        """The axes a (batch, tokens) batch is sharded over — what the
+        gradient pmean must cover (and nothing else: DST006)."""
+        return tuple(a for a in ("data", "sequence") if self.present(a))
+
+    def batch_spec(self):
+        """PartitionSpec for a rank-2 ``(batch, tokens)`` batch: batch
+        dim over ``data``, token dim over ``sequence``."""
+        return PartitionSpec("data" if self.present("data") else None,
+                             "sequence" if self.present("sequence")
+                             else None)
+
+    # -- runtime ----------------------------------------------------------
+    def build_mesh(self, devices=None):
+        if devices is None:
+            devices = jax.devices()
+        plan = self.resolve(len(devices))
+        names = plan.axis_names()
+        shape = tuple(plan.size(a) for a in names)
+        return make_mesh(shape, names, devices)
+
+    def describe(self):
+        return {"data": self.size("data"), "model": self.model,
+                "sequence": self.sequence,
+                "axes": list(self.axis_names())}
+
+    def __repr__(self):
+        return "MeshPlan(data=%r, model=%d, sequence=%d)" % (
+            self.data, self.model, self.sequence)
+
+    def __eq__(self, other):
+        return (isinstance(other, MeshPlan) and self.data == other.data
+                and self.model == other.model
+                and self.sequence == other.sequence)
 
 
 def replicated(mesh):

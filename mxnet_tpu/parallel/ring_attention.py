@@ -17,6 +17,15 @@ rebuild adds as first-class citizens:
 Both are pure jax functions usable inside ``shard_map`` (see
 ``ring_attention_sharded`` for the pre-wired entry point).
 
+**Now trained with, not just shipped**: the ``mxnet_tpu.transformer``
+mesh tier (docs/transformer.md) wires both paths into the real
+``DataParallelTrainer(mesh_plan=...)`` step — ring (or Ulysses, when
+the local head count divides the sequence axis) attention runs over the
+``sequence`` mesh axis inside the jitted training program, composing
+with tensor parallelism over ``model`` and ZeRO-1 over ``data``; the
+``tp_transformer_train_step`` and ``ulysses_attention`` budget rows in
+STATIC_BUDGETS.json pin the resulting collective schedules.
+
 The collective schedule here is a *proven* artifact: the analysis
 tier's mxshard passes (``docs/analysis.md`` "Sharding propagation")
 trace these functions on a declared ``sequence`` axis and verify that
@@ -220,39 +229,85 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     return _ring_core(q, k, v, axis_name, bool(causal), float(scale))
 
 
+def _seq2head_impl(x, axis_name):
+    # (B, Tl, H, D) -> (B, Tl, n, H/n, D) -> a2a over n -> (B, T, H/n, D)
+    n = lax.psum(1, axis_name)
+    B, Tl, H, D = x.shape
+    x = x.reshape(B, Tl, n, H // n, D)
+    x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=0,
+                       tiled=False)
+    # leading axis now n × B? all_to_all with split_axis=2, concat_axis=0
+    # yields (n*B, Tl, H/n, D) — reorder to (B, n*Tl, H/n, D)
+    x = x.reshape(n, B, Tl, H // n, D)
+    x = x.transpose(1, 0, 2, 3, 4).reshape(B, n * Tl, H // n, D)
+    return x
+
+
+def _head2seq_impl(x, axis_name):
+    # exact inverse of _seq2head_impl: (B, T, H/n, D) -> (B, Tl, H, D).
+    # concat_axis=2 puts the gathered head-GROUP axis back in front of
+    # the within-group axis, so the final reshape restores the original
+    # head order h = group * (H/n) + i (concat_axis=3 — the historical
+    # spelling — silently permuted heads whenever H/n > 1)
+    n = lax.psum(1, axis_name)
+    B, T, Hn, D = x.shape
+    Tl = T // n
+    x = x.reshape(B, n, Tl, Hn, D).transpose(1, 0, 2, 3, 4)
+    x = lax.all_to_all(x.reshape(n, B, Tl, Hn, D), axis_name,
+                       split_axis=0, concat_axis=2, tiled=False)
+    return x.reshape(B, Tl, Hn * n, D)
+
+
+# The two reshards are bijections (every element changes rank exactly
+# once), so each one's VJP is simply the other applied to the cotangent
+# — spelled as custom_vjp both because it is exact and because jax
+# 0.4.x mis-shapes the transpose of the untiled all_to_all, which would
+# otherwise make the Ulysses path untrainable.
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _seq2head(x, axis_name):
+    return _seq2head_impl(x, axis_name)
+
+
+def _seq2head_fwd(x, axis_name):
+    return _seq2head_impl(x, axis_name), None
+
+
+def _seq2head_bwd(axis_name, _res, g):
+    return (_head2seq_impl(g, axis_name),)
+
+
+_seq2head.defvjp(_seq2head_fwd, _seq2head_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _head2seq(x, axis_name):
+    return _head2seq_impl(x, axis_name)
+
+
+def _head2seq_fwd(x, axis_name):
+    return _head2seq_impl(x, axis_name), None
+
+
+def _head2seq_bwd(axis_name, _res, g):
+    return (_seq2head_impl(g, axis_name),)
+
+
+_head2seq.defvjp(_head2seq_fwd, _head2seq_bwd)
+
+
 def ulysses_attention(q, k, v, axis_name, causal=False, scale=None):
     """All-to-all (Ulysses) sequence parallelism.
 
     Local chunks (B, T/n, H, D) are re-sharded to (B, T, H/n, D) with one
     all_to_all, attended fully per local head group, and re-sharded back.
-    Requires H % n == 0."""
-    n = lax.psum(1, axis_name)
-    B, Tl, H, D = q.shape
-
-    def seq2head(x):
-        # (B, Tl, H, D) -> (B, Tl, n, H/n, D) -> a2a over n -> (B, T, H/n, D)
-        x = x.reshape(B, Tl, n, H // n, D)
-        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=0,
-                           tiled=False)
-        # leading axis now n × B? all_to_all with split_axis=2, concat_axis=0
-        # yields (n*B, Tl, H/n, D) — reorder to (B, n*Tl, H/n, D)
-        x = x.reshape(n, B, Tl, H // n, D)
-        x = x.transpose(1, 0, 2, 3, 4).reshape(B, n * Tl, H // n, D)
-        return x
-
-    def head2seq(x):
-        # inverse of seq2head
-        x = x.reshape(B, n, Tl, H // n, D).transpose(1, 0, 2, 3, 4)
-        x = x.reshape(n * B, Tl, H // n, D)
-        x = lax.all_to_all(x.reshape(n, B, Tl, H // n, D), axis_name,
-                           split_axis=0, concat_axis=3, tiled=False)
-        return x.reshape(B, Tl, H, D)
-
-    qg = seq2head(q)
-    kg = seq2head(k)
-    vg = seq2head(v)
+    Requires H % n == 0.  Differentiable: the swap-back pair's VJPs are
+    the inverse reshards, so forward+backward is 8 all_to_alls total —
+    the ``ulysses_attention`` budget row pins exactly those bytes."""
+    qg = _seq2head(q, axis_name)
+    kg = _seq2head(k, axis_name)
+    vg = _seq2head(v, axis_name)
     o = local_attention(qg, kg, vg, causal=causal, scale=scale)
-    return head2seq(o)
+    return _head2seq(o, axis_name)
 
 
 def _seq_sharded_spec(mesh, axis):

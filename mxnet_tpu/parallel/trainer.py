@@ -6,9 +6,14 @@ The reference splits this across four subsystems — per-device executors
 (``src/kvstore/comm.h:451``), the updater loop (``python/mxnet/model.py:157``)
 and the dependency engine ordering it all.  On TPU the whole iteration is a
 single XLA program: batch sharded over the ``data`` mesh axis, parameters
-replicated (or sharded over a ``model`` axis for tensor parallelism — a new
-capability, SURVEY.md §2.2), gradients reduced by compiler-inserted psum over
-ICI, parameters donated so updates happen in place in HBM.
+replicated, gradients reduced by compiler-inserted psum over ICI,
+parameters donated so updates happen in place in HBM.
+
+Tensor/sequence parallelism (a ``model`` axis sharding parameters, a
+``sequence`` axis sharding tokens) is NOT this replicated tier's job:
+pass ``mesh_plan=``/``model_parallel=``/``sequence_parallel=`` to route
+a mesh-program block (``mxnet_tpu.transformer.TransformerLM``) through
+the multi-axis tier instead — docs/transformer.md.
 """
 from __future__ import annotations
 
@@ -64,9 +69,18 @@ class DataParallelTrainer:
     loss : gluon.loss.Loss or callable(pred, label)->NDArray.
     optimizer : str or Optimizer (same registry as the eager path).
     mesh : jax.sharding.Mesh, default = all devices on one ``data`` axis.
-    param_spec_fn : callable(name, shape)->PartitionSpec for tensor
-        parallelism; default replicates every parameter.
+    param_spec_fn : callable(name, shape)->PartitionSpec overriding the
+        placement of individual parameters on the data mesh; default
+        replicates every parameter.  (Real tensor parallelism lives in
+        the mesh tier below, not here.)
     data_axis : mesh axis name the batch is sharded over.
+    mesh_plan / model_parallel / sequence_parallel : the multi-axis
+        tier (docs/transformer.md): a ``MeshPlan`` over
+        ``data × model × sequence`` (or per-axis sizes) training a
+        mesh-program block (``mxnet_tpu.transformer.TransformerLM``)
+        with Megatron-style tensor-parallel layers over ``model`` and
+        ring/Ulysses attention over ``sequence``, composing with
+        ``zero=1`` on the ``data`` axis.
     kvstore : str or KVStore, optional — a ``dist_sync`` store for
         multi-process gradient averaging (every process must construct
         its trainers in the same order).
@@ -87,12 +101,46 @@ class DataParallelTrainer:
     def __init__(self, block, loss, optimizer, optimizer_params=None,
                  mesh=None, param_spec_fn=None, data_axis="data",
                  kvstore=None, input_transform=None, run_id=None,
-                 zero=0):
+                 zero=0, mesh_plan=None, model_parallel=None,
+                 sequence_parallel=None):
         from .. import kvstore as kvs
         from .. import optimizer as opt_mod
         self._block = block
         self._loss = loss
         self._input_transform = input_transform
+        # multi-axis mesh tier (docs/transformer.md): a MeshPlan routes
+        # a mesh-program block through the tensor/sequence-parallel
+        # step instead of the replicated gluon path.  Mesh construction
+        # is DEFERRED (first step / batch_sharding): the analysis path
+        # (mesh_report, the tp_transformer_train_step budget model)
+        # declares axis sizes and never needs devices.
+        plan = mesh_mod.MeshPlan.coerce(mesh_plan)
+        if plan is None and (model_parallel or sequence_parallel):
+            plan = mesh_mod.MeshPlan(model=model_parallel or 1,
+                                     sequence=sequence_parallel or 1)
+        if plan is None and hasattr(block, "mesh_program"):
+            plan = mesh_mod.MeshPlan()
+        self._plan = plan
+        if plan is not None:
+            if not hasattr(block, "mesh_program"):
+                raise ValueError(
+                    "mesh_plan/model_parallel/sequence_parallel train a "
+                    "mesh-program block (mxnet_tpu.transformer."
+                    "TransformerLM — docs/transformer.md); %r does not "
+                    "implement mesh_program()" % type(block).__name__)
+            if mesh is not None:
+                raise ValueError("pass either mesh= or mesh_plan=, not "
+                                 "both: the plan builds its own mesh")
+            if kvstore is not None:
+                raise ValueError("the multi-axis mesh tier is "
+                                 "single-process (in-process mesh "
+                                 "collectives only); kvstore is not "
+                                 "supported")
+            if param_spec_fn is not None or input_transform is not None:
+                raise ValueError(
+                    "param_spec_fn/input_transform do not apply to the "
+                    "mesh tier: the mesh program owns its own sharding "
+                    "and feed (docs/transformer.md)")
         # training-run identity carried into every checkpoint's
         # provenance (ISSUE 12): the promotion audit trail names the run
         # that produced the bytes it promoted.  Deterministic by
@@ -103,7 +151,10 @@ class DataParallelTrainer:
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self._opt = optimizer
-        self._mesh = mesh if mesh is not None else mesh_mod.data_parallel_mesh()
+        # plan tier: mesh deferred to _ensure_mesh (devices may not even
+        # exist on an analysis-only host)
+        self._mesh = None if self._plan is not None else (
+            mesh if mesh is not None else mesh_mod.data_parallel_mesh())
         self._param_spec_fn = param_spec_fn or (lambda name, shape:
                                                 PartitionSpec())
         self._data_axis = data_axis
@@ -563,6 +614,394 @@ class DataParallelTrainer:
         })
         return report, findings, shard
 
+    # -- multi-axis mesh tier (mxnet_tpu.transformer) ----------------------
+    @property
+    def mesh_plan(self):
+        return self._plan
+
+    def _ensure_mesh(self):
+        """Resolve the plan against the live device pool and build the
+        collapsed mesh (deferred from __init__ so analysis-only hosts
+        never need the devices)."""
+        if self._mesh is not None:
+            return
+        self._plan = self._plan.resolve(len(jax.devices()))
+        self._mesh = self._plan.build_mesh()
+
+    def _mesh_apply_update(self, treedefs):
+        """The gluon optimizer as the mesh step's shard-local update:
+        the SAME ``Optimizer.update`` numerics as every other tier,
+        traced through ``functional_optimizer_update`` over the local
+        shard (elementwise rules are shard-invariant)."""
+        opt = self._opt
+
+        def apply_update(i, w, g, leaves, lr, t):
+            state = jax.tree_util.tree_unflatten(treedefs[i],
+                                                 list(leaves))
+            nw, ns = functional_optimizer_update(opt, i, w, g, state,
+                                                 lr, t)
+            return nw, tuple(jax.tree_util.tree_leaves(ns))
+
+        return apply_update
+
+    def _setup_mesh(self, data, label):
+        """Materialize the mesh tier: params placed per the program's
+        PartitionSpecs, optimizer state per-param (or ZeRO-1 flat over
+        ``model × data`` under ``zero=1``), the two jitted ``shard_map``
+        programs built from the ONE per-replica spelling
+        (``transformer/step.py``)."""
+        from ..transformer import step as _tstep
+        self._ensure_mesh()
+        plan, mesh = self._plan, self._mesh
+        program = self._block.mesh_program(plan)
+        self._mesh_program = program
+        self._setup_desc = {"data": self._desc_of(data),
+                            "label": self._desc_of(label)}
+        dshape = self._setup_desc["data"][0]
+        if len(dshape) != 2 or dshape[1] != program.cfg.seq_len:
+            raise ValueError(
+                "mesh-tier batches are (batch, tokens) int32 with "
+                "tokens == cfg.seq_len (%d); got shape %r"
+                % (program.cfg.seq_len, tuple(dshape)))
+        if dshape[0] % plan.size("data"):
+            raise ValueError(
+                "global batch %d must divide by the data axis %d "
+                "(plan %r)" % (dshape[0], plan.size("data"), plan))
+        params = program.init_params()
+        self._mesh_param_names = list(program.param_names)
+        self._mesh_params = {
+            name: jax.device_put(
+                params[name],
+                NamedSharding(mesh, program.partition_spec(name)))
+            for name in self._mesh_param_names}
+
+        from jax.sharding import PartitionSpec as P
+        if self._zero:
+            zp = _tstep.TPZeroPlan(program, plan.size("data"))
+            self._mesh_zero_plan = zp
+            template = self._opt.create_state_multi_precision(
+                0, NDArray(jnp.zeros((zp.shard,), jnp.float32)))
+            raw = tree_raw(template)
+            leaves, treedef = jax.tree_util.tree_flatten(raw)
+            for li, leaf in enumerate(leaves):
+                if tuple(getattr(leaf, "shape", ())) != (zp.shard,):
+                    raise ValueError(
+                        "zero=1 needs flat-shaped optimizer state "
+                        "leaves; leaf %d of %s has shape %r"
+                        % (li, type(self._opt).__name__,
+                           tuple(getattr(leaf, "shape", ()))))
+            self._mesh_state_treedefs = [treedef]
+            flat_axes = tuple(a for a in ("model", "data")
+                              if plan.present(a))
+            spec = P(flat_axes) if flat_axes else P()
+            self._mesh_state_specs = [spec] * len(leaves)
+            km = plan.size("model")
+            self._mesh_state_leaves = tuple(
+                jax.device_put(jnp.zeros((km * zp.padded,), jnp.float32),
+                               NamedSharding(mesh, spec))
+                for _ in leaves)
+            self._mesh_leaf_counts = None
+        else:
+            self._mesh_zero_plan = None
+            treedefs, leaf_counts, state_leaves, state_specs = \
+                [], [], [], []
+            for i, name in enumerate(self._mesh_param_names):
+                w = self._mesh_params[name]
+                state = self._opt.create_state_multi_precision(
+                    i, NDArray(jnp.asarray(params[name])))
+                raw = tree_raw(state)
+                leaves, treedef = jax.tree_util.tree_flatten(raw)
+                treedefs.append(treedef)
+                leaf_counts.append(len(leaves))
+                spec = program.partition_spec(name)
+                for leaf in leaves:
+                    state_leaves.append(jax.device_put(
+                        jnp.asarray(leaf), NamedSharding(mesh, spec)))
+                    state_specs.append(spec)
+            self._mesh_state_treedefs = treedefs
+            self._mesh_leaf_counts = leaf_counts
+            self._mesh_state_specs = state_specs
+            self._mesh_state_leaves = tuple(state_leaves)
+
+        apply_update = self._mesh_apply_update(self._mesh_state_treedefs)
+        self._mesh_grad_fn, self._mesh_update_fn = \
+            _tstep.build_runtime_fns(
+                program, apply_update, self._mesh_leaf_counts, mesh,
+                self._mesh_state_specs, zero=self._zero,
+                zero_plan=self._mesh_zero_plan)
+        if _tele._ENABLED:
+            _tele.attribution().set_context("collective_or_ps",
+                                            self._mesh_context_tag())
+        self._ready = True
+
+    def _mesh_context_tag(self):
+        """Which mesh axis the doctor should name when collective time
+        dominates: the axis carrying more MODELED wire bytes in the
+        step's priced schedule (docs/transformer.md; the CONTEXT_HINTS
+        entries in telemetry/attribution.py)."""
+        plan = self._plan
+        if plan.present("model") and not plan.present("sequence"):
+            return "tp_model"
+        if plan.present("sequence") and not plan.present("model"):
+            return "tp_sequence"
+        try:
+            desc = self._setup_desc["data"][0]
+            _, _, shard = self.mesh_report(
+                data_shape=tuple(desc), declared_plan=plan)
+            per_axis = shard.collective_bytes_per_axis
+            return ("tp_model"
+                    if per_axis.get("model", 0)
+                    >= per_axis.get("sequence", 0) else "tp_sequence")
+        except Exception:
+            return "tp_model"
+
+    def _step_mesh_tier(self, data, label):
+        """One mesh-tier training step (the ``step()`` route when a
+        MeshPlan is armed): same chaos probe, attribution phases and
+        run-ahead bookkeeping as the replicated step — grad program
+        bills ``dispatch``, update program (the ZeRO rs/ag under
+        ``zero=1``) bills ``collective_or_ps``."""
+        from .. import _rng
+        if not self._ready:
+            self._setup_mesh(data, label)
+        tele_on = _tele._ENABLED
+        attr = _tele.attribution() if tele_on else None
+        if tele_on:
+            attr.on_step(self._step_count + 1)
+        batch_sh = self.batch_sharding
+        t0 = time.perf_counter() if tele_on else 0.0
+        x = self._put_batch(data, batch_sh)
+        y = self._put_batch(label, batch_sh)
+        if tele_on:
+            t1 = time.perf_counter()
+            attr.add_phase("h2d_transfer", t1 - t0)
+        else:
+            t1 = 0.0
+        self._step_count += 1
+        _chaos.maybe_inject("trainer.step", self._step_count, ctx=self)
+        self._opt.num_update = self._step_count
+        lr_host = (self._opt.lr_scheduler(self._step_count)
+                   if self._opt.lr_scheduler else self._opt.lr)
+        train_vals = tuple(self._mesh_params[n]
+                           for n in self._mesh_param_names)
+        rng = _rng.next_key()
+        grads, loss_val = self._mesh_grad_fn(train_vals, x, y, rng)
+        if tele_on:
+            t2 = time.perf_counter()
+            attr.add_phase("dispatch", t2 - t1)
+        new_vals, new_leaves = self._mesh_update_fn(
+            train_vals, self._mesh_state_leaves, grads,
+            jnp.float32(lr_host), jnp.int32(self._step_count))
+        if tele_on:
+            attr.add_phase("collective_or_ps",
+                           time.perf_counter() - t2)
+        for name, val in zip(self._mesh_param_names, new_vals):
+            self._mesh_params[name] = val
+        self._mesh_state_leaves = tuple(new_leaves)
+        self._track_inflight(loss_val)
+        return NDArray(loss_val)
+
+    def mesh_report(self, data_shape=None, label_shape=None,
+                    declared_plan=None):
+        """Static proof bundle of the multi-axis step:
+        ``(CostReport, [Finding], ShardReport)`` over the REAL runtime
+        spelling traced at the plan's declared axis sizes — hardware
+        free.  The ShardReport prices the mixed-axis collective
+        schedule (``collective_bytes_per_axis`` splits ``model`` vs
+        ``sequence`` wire bytes); the findings run the mixed-axis DST
+        rules (a deleted row-parallel psum surfaces as a pending
+        partial-sum DST001 per parameter) and, under ring attention,
+        the DST009 ring proof over ``sequence``.  What the
+        ``tp_transformer_train_step`` budget model gates against
+        ``STATIC_BUDGETS.json``."""
+        import numpy as _onp
+
+        from ..analysis import cost as _cost
+        from ..analysis import shard_prop as _sp
+        from ..transformer import step as _tstep
+
+        if self._plan is None:
+            raise ValueError("mesh_report needs a mesh_plan trainer")
+        plan = mesh_mod.MeshPlan.coerce(declared_plan) or self._plan
+        if plan.data is None:
+            raise ValueError(
+                "mesh_report needs fully-declared axis sizes: pass "
+                "declared_plan=MeshPlan(data=K, ...) (the runtime plan "
+                "has a deferred data axis)")
+        program = self._block.mesh_program(plan)
+        if data_shape is None:
+            data_shape = (8 * plan.size("data"),
+                          program.cfg.seq_len)
+        b_local, t_local = program.local_batch_shape(int(data_shape[0]))
+
+        # optimizer-state leaf structure from a host-side template
+        if self._zero:
+            zp = _tstep.TPZeroPlan(program, plan.size("data"))
+            template = self._opt.create_state_multi_precision(
+                0, NDArray(jnp.zeros((zp.shard,), jnp.float32)))
+            leaves, treedef = jax.tree_util.tree_flatten(
+                tree_raw(template))
+            treedefs, leaf_counts = [treedef], None
+            state_avals = tuple(
+                jax.ShapeDtypeStruct((zp.shard,), _onp.float32)
+                for _ in leaves)
+            flat_axes = tuple(a for a in ("model", "data")
+                              if plan.present(a))
+            state_dims = {0: flat_axes} if flat_axes else {}
+            state_shard_dims = [state_dims] * len(leaves)
+        else:
+            zp = None
+            treedefs, leaf_counts = [], []
+            state_avals, state_shard_dims = [], []
+            for i, name in enumerate(program.param_names):
+                lshape = program.local_shape(name)
+                template = self._opt.create_state_multi_precision(
+                    i, NDArray(jnp.zeros(lshape, jnp.float32)))
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    tree_raw(template))
+                treedefs.append(treedef)
+                leaf_counts.append(len(leaves))
+                spec = program.partition_spec(name)
+                dims = {d: (e,) for d, e in enumerate(spec)
+                        if e is not None}
+                for leaf in leaves:
+                    state_avals.append(jax.ShapeDtypeStruct(
+                        tuple(leaf.shape), _onp.float32))
+                    state_shard_dims.append(dims)
+            state_avals = tuple(state_avals)
+
+        step = _tstep.build_replica_step(
+            program, self._mesh_apply_update(treedefs), leaf_counts,
+            zero=self._zero, zero_plan=zp)
+        train_avals = tuple(
+            jax.ShapeDtypeStruct(program.local_shape(n), _onp.float32)
+            for n in program.param_names)
+        xs = jax.ShapeDtypeStruct((b_local, t_local), _onp.int32)
+        ys = jax.ShapeDtypeStruct((b_local, t_local), _onp.int32)
+        key = jax.ShapeDtypeStruct((2,), _onp.uint32)
+        closed = jax.make_jaxpr(step, axis_env=plan.axis_env())(
+            train_avals, state_avals, xs, ys, key,
+            jnp.float32(0.01), jnp.int32(1))
+
+        n_train = len(train_avals)
+        n_state = len(state_avals)
+        host = [n_train + n_state, n_train + n_state + 1]
+        report = _cost.analyze_jaxpr(
+            closed, axis_sizes=plan.axis_sizes(),
+            donated_invars=list(range(n_train + n_state)),
+            host_invars=host)
+        report.transfer_d2h_bytes = 4    # only the loss comes back
+
+        mesh_spec = _sp.MeshSpec(plan.axis_sizes())
+        shard_dims = {}
+        for i, name in enumerate(program.param_names):
+            spec = program.partition_spec(name)
+            dims = {d: (e,) for d, e in enumerate(spec)
+                    if e is not None}
+            if dims:
+                shard_dims[i] = dims
+        for li, dims in enumerate(state_shard_dims):
+            if dims:
+                shard_dims[n_train + li] = dims
+        findings = _sp.lint_sharded_step(
+            closed, mesh_spec, data_axes=plan.batch_axes(),
+            varying_invars=host, shard_dims=shard_dims,
+            param_outvars=list(range(1, 1 + n_train)),
+            param_names=list(program.param_names),
+            subject="DataParallelTrainer(mesh_plan=%s)"
+                    % (plan.describe()["axes"],))
+        if plan.present("sequence") and \
+                program.attention_mode == "ring":
+            findings += _sp.lint_ring_schedule(
+                closed, "sequence", plan.size("sequence"),
+                subject="DataParallelTrainer.mesh ring attention")
+        findings += _cost.unpriced_findings(
+            report, subject="DataParallelTrainer(mesh_plan)")
+        shard = _sp.collective_schedule(
+            closed, mesh_spec,
+            subject="DataParallelTrainer(mesh_plan)")
+        per_axis = shard.collective_bytes_per_axis
+        shard.extras.update({
+            "plan": plan.describe(),
+            "program": program.describe(),
+            "attention_mode": program.attention_mode,
+            "tp_modeled_model_axis_bytes": int(per_axis.get("model", 0)),
+            "tp_modeled_sequence_axis_bytes": int(
+                per_axis.get("sequence", 0)),
+            "runtime_peak_hbm_bytes": int(report.peak_hbm_bytes),
+        })
+        if zp is not None:
+            shard.extras["tp_zero1_plan"] = zp.describe()
+        return report, findings, shard
+
+    # -- mesh-tier checkpointing -------------------------------------------
+    def _save_mesh(self, directory, epoch=None, nbatch=None, keep=3):
+        """Monolithic snapshot of the mesh tier (program param names are
+        deterministic — no gensym mapping needed; states are the flat
+        global leaves, fleet-size-free because the mesh is in-process)."""
+        from .. import _rng
+        from ..resilience import checkpoint as _ckpt
+        payload = {
+            "mesh_params": {
+                name: _ckpt.encode_array(self._mesh_params[name])
+                for name in self._mesh_param_names},
+            "mesh_states": [_ckpt.encode_array(v)
+                            for v in self._mesh_state_leaves],
+            "step_count": self._step_count,
+            "rng": _rng.get_state(),
+            "numpy_global": np.random.get_state(),
+            "cursor": {"epoch": epoch, "nbatch": nbatch},
+            "setup_desc": self._setup_desc,
+            "plan": self._plan.describe(),
+            "program": self._mesh_program.describe(),
+        }
+        return _ckpt.save_checkpoint(
+            directory, payload, self._step_count, keep=keep,
+            provenance={"epoch": epoch, "train_run_id": self.run_id,
+                        "digest": _ckpt.payload_digest(payload)})
+
+    def _restore_mesh(self, rec):
+        from .. import _rng
+        from ..resilience import checkpoint as _ckpt
+        payload = rec["payload"]
+        if "mesh_params" not in payload:
+            raise RuntimeError(
+                "checkpoint is not a mesh-tier snapshot (trained by a "
+                "different trainer tier?)")
+        if not self._ready:
+            dshape, ddt = payload["setup_desc"]["data"]
+            lshape, ldt = payload["setup_desc"]["label"]
+            self._setup_mesh(NDArray(jnp.zeros(dshape, np.dtype(ddt))),
+                             NDArray(jnp.zeros(lshape, np.dtype(ldt))))
+        if payload["program"] != self._mesh_program.describe():
+            raise RuntimeError(
+                "checkpoint program %r does not match this trainer's "
+                "%r (different config/plan)"
+                % (payload["program"], self._mesh_program.describe()))
+        mesh = self._mesh
+        for name in self._mesh_param_names:
+            self._mesh_params[name] = jax.device_put(
+                jnp.asarray(_ckpt.decode_array(
+                    payload["mesh_params"][name])),
+                NamedSharding(mesh,
+                              self._mesh_program.partition_spec(name)))
+        encs = payload["mesh_states"]
+        if len(encs) != len(self._mesh_state_leaves):
+            raise RuntimeError(
+                "optimizer state leaf count mismatch (%d vs %d): "
+                "different optimizer?"
+                % (len(encs), len(self._mesh_state_leaves)))
+        self._mesh_state_leaves = tuple(
+            jax.device_put(jnp.asarray(_ckpt.decode_array(e)),
+                           NamedSharding(mesh, spec))
+            for e, spec in zip(encs, self._mesh_state_specs))
+        self._step_count = int(payload["step_count"])
+        self._opt.num_update = self._step_count
+        _rng.set_state(payload["rng"])
+        np.random.set_state(payload["numpy_global"])
+        self._inflight.clear()
+        return dict(payload["cursor"], step=self._step_count)
+
     # -- the compiled step -------------------------------------------------
     def _apply_groups(self, train_vals, states, grads, lr, t):
         """Optimizer update for every group — traced inside the step jit
@@ -660,7 +1099,12 @@ class DataParallelTrainer:
         once, sharding-spec consistency, collective dtype promotion,
         baked step constants.  Hardware-free; returns Finding records.
         A zero=1 trainer routes to the mixed-axis rules over the real
-        runtime spelling instead (``zero_report``)."""
+        runtime spelling instead (``zero_report``); a mesh_plan trainer
+        to ``mesh_report``."""
+        if self._plan is not None:
+            _, findings, _ = self.mesh_report(data_shape=data_shape)
+            from ..analysis.findings import filter_findings
+            return filter_findings(findings, disable)
         if self._zero:
             _, findings, _ = self.zero_report(
                 data_shape=data_shape, label_shape=label_shape,
@@ -689,6 +1133,9 @@ class DataParallelTrainer:
 
         from ..analysis import cost as _cost
 
+        if self._plan is not None:
+            report, _, _ = self.mesh_report(data_shape=data_shape)
+            return report
         if self._zero:
             report, _, _ = self.zero_report(
                 data_shape=data_shape, label_shape=label_shape,
@@ -770,10 +1217,16 @@ class DataParallelTrainer:
         compiler would INSERT (the gradient psum appears as an inferred
         partial-sum reduction, without the per-replica spelling) plus
         any forced activation reshards (DST010 material).  Hardware-
-        free; never executes or compiles."""
+        free; never executes or compiles.  A mesh_plan trainer returns
+        its ``mesh_report`` ShardReport instead — the per-replica
+        EXPLICIT mixed-axis schedule, priced per axis."""
         import numpy as _onp
 
         from ..analysis import shard_prop as _sp
+
+        if self._plan is not None:
+            _, _, shard = self.mesh_report(data_shape=data_shape)
+            return shard
 
         if not self._ready:
             if data_shape is None:
@@ -883,9 +1336,13 @@ class DataParallelTrainer:
     @property
     def batch_sharding(self):
         """The NamedSharding step inputs are placed with (batch sharded
-        over the data axis).  A feeder that pre-places batches with this
+        over the data axis; under a MeshPlan, ``(batch, tokens)`` over
+        ``data × sequence``).  A feeder that pre-places batches with this
         sharding (``mx.io.PrefetchToDeviceIter``) hits ``step``'s
         fast path: the transfer is reused, not redone."""
+        if self._plan is not None:
+            self._ensure_mesh()
+            return NamedSharding(self._mesh, self._plan.batch_spec())
         return NamedSharding(self._mesh, PartitionSpec(self._data_axis))
 
     def _put_batch(self, arr, sharding):
@@ -958,6 +1415,8 @@ class DataParallelTrainer:
         (``mx.engine.set_bulk_size``) is full, and then on the *oldest*
         in-flight step (backpressure), not the newest."""
         from .. import _rng
+        if self._plan is not None:
+            return self._step_mesh_tier(data, label)
         if not self._ready:
             self._setup(data, label)
 
@@ -1047,6 +1506,13 @@ class DataParallelTrainer:
         # only the encode + atomic write below is checkpoint time (the
         # phases stay disjoint, so per-window sums reconcile)
         t_ckpt = time.perf_counter() if _tele._ENABLED else 0.0
+        if self._plan is not None:
+            path = self._save_mesh(directory, epoch=epoch,
+                                   nbatch=nbatch, keep=keep)
+            if _tele._ENABLED:
+                _tele.attribution().add_phase(
+                    "checkpoint", time.perf_counter() - t_ckpt)
+            return path
         if self._zero:
             path = self._save_sharded(directory, epoch=epoch,
                                       nbatch=nbatch, keep=keep)
@@ -1236,6 +1702,13 @@ class DataParallelTrainer:
         from .. import _rng
         from ..resilience import checkpoint as _ckpt
         if _os.path.isdir(path_or_dir):
+            if self._plan is not None:
+                found = _ckpt.latest_checkpoint(path_or_dir)
+                if found is None:
+                    raise FileNotFoundError(
+                        "no loadable checkpoint under %r"
+                        % (path_or_dir,))
+                return self._restore_mesh(found[1])
             if self._zero:
                 found = _ckpt.latest_sharded_checkpoint(path_or_dir)
                 if found is None:
@@ -1253,6 +1726,8 @@ class DataParallelTrainer:
                 _ckpt.load_sharded_checkpoint(path_or_dir))
         else:
             rec = _ckpt.load_checkpoint(path_or_dir)
+        if self._plan is not None:
+            return self._restore_mesh(rec)
         payload = rec["payload"]
         if not self._ready:
             dshape, ddt = payload["setup_desc"]["data"]
@@ -1349,7 +1824,7 @@ class DataParallelTrainer:
         if checkpoint_dir and resume:
             from ..resilience import checkpoint as _ckpt
             if (_ckpt.latest_sharded_checkpoint(checkpoint_dir)
-                    if self._zero else
+                    if (self._zero and self._plan is None) else
                     _ckpt.latest_checkpoint(checkpoint_dir)) is not None:
                 cursor = self.restore_checkpoint(checkpoint_dir)
                 if cursor.get("epoch") is not None:

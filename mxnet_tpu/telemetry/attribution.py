@@ -114,6 +114,17 @@ CONTEXT_HINTS = {
         "all-gather program is the bottleneck — grow the per-replica "
         "batch so compute amortizes the gather, or drop zero=1 if the "
         "optimizer state fits replicated (docs/elastic.md)",
+    ("collective_or_ps", "tp_model"):
+        "the model-axis (tensor-parallel) collectives dominate the "
+        "mesh step's modeled schedule: lower model_parallel, or grow "
+        "d_model/per-replica batch so the matmuls amortize the "
+        "row-parallel psums (docs/transformer.md)",
+    ("collective_or_ps", "tp_sequence"):
+        "the sequence-axis collectives dominate the mesh step's "
+        "modeled schedule: switch attention='ulysses' when local "
+        "heads divide the sequence axis (2 all_to_alls vs a K-hop "
+        "ppermute ring), or lower sequence_parallel "
+        "(docs/transformer.md)",
 }
 
 

@@ -1,0 +1,274 @@
+"""Transformer LM over a 2-3D ``data × model × sequence`` mesh.
+
+The model is spelled ONCE, per replica (the ``parallel/zero.py``
+discipline): :meth:`MeshProgram.loss_replica` is a pure jax function
+over LOCAL parameter shards and a LOCAL ``(B/Kd, T/Ks)`` token chunk,
+with every cross-replica collective explicit.  The same function is
+
+- jitted under ``shard_map`` by ``DataParallelTrainer(mesh_plan=...)``
+  (the runtime), and
+- traced with ``jax.make_jaxpr(axis_env=plan.axis_env())`` by
+  ``trainer.mesh_report()`` and the ``tp_transformer_train_step``
+  budget model (the hardware-free analysis),
+
+so the executed program and the proven program can never drift.
+
+Layer sharding (docs/transformer.md has the full table): token/output
+embeddings vocab-parallel over ``model``; QKV column-parallel (heads
+over ``model``); attention over the ``sequence`` axis via ring attention
+(``parallel/ring_attention.py``) or Ulysses all-to-all when the local
+head count divides; attention-out and MLP-down row-parallel with their
+completing psum (the ``TP_ROW_PSUM`` seam); LayerNorms replicated.
+Positions are global: each sequence rank offsets by
+``axis_index("sequence") * T_local``.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+
+__all__ = ["TransformerLMConfig", "TransformerLM", "MeshProgram"]
+
+
+class TransformerLMConfig:
+    """Pinned-geometry transformer-LM hyperparameters.
+
+    ``attention`` picks the sequence-parallel kernel: ``"ring"`` (K/V
+    chunks rotate over ``ppermute`` — any head count, O(T/K) memory),
+    ``"ulysses"`` (two all-to-alls swap sequence for head sharding —
+    needs ``(n_heads / model) % sequence == 0``) or ``"auto"`` (Ulysses
+    when the head count divides, else ring — the decision rule in
+    docs/transformer.md).  With a collapsed sequence axis all three are
+    plain local causal attention.
+    """
+
+    def __init__(self, vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                 d_ff=64, seq_len=64, attention="ring", init_seed=0,
+                 init_scale=0.02):
+        self.vocab_size = int(vocab_size)
+        self.d_model = int(d_model)
+        self.n_heads = int(n_heads)
+        self.n_layers = int(n_layers)
+        self.d_ff = int(d_ff)
+        self.seq_len = int(seq_len)
+        self.attention = str(attention)
+        self.init_seed = int(init_seed)
+        self.init_scale = float(init_scale)
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model %d must divide into n_heads %d"
+                             % (self.d_model, self.n_heads))
+        if self.attention not in ("ring", "ulysses", "auto"):
+            raise ValueError("attention must be ring/ulysses/auto, got %r"
+                             % (attention,))
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    def describe(self):
+        return {k: getattr(self, k) for k in
+                ("vocab_size", "d_model", "n_heads", "n_layers", "d_ff",
+                 "seq_len", "attention", "init_seed")}
+
+
+class TransformerLM:
+    """The block handed to ``DataParallelTrainer(mesh_plan=...)`` — a
+    thin config carrier implementing the mesh-program protocol the
+    trainer's multi-axis tier consumes (``mesh_program(plan)``)."""
+
+    def __init__(self, cfg):
+        if not isinstance(cfg, TransformerLMConfig):
+            cfg = TransformerLMConfig(**cfg)
+        self.cfg = cfg
+
+    def mesh_program(self, plan):
+        return MeshProgram(self.cfg, plan)
+
+
+def _attention_mode(cfg, plan):
+    """The ring-vs-Ulysses decision rule (docs/transformer.md): Ulysses
+    needs the LOCAL head count (heads already sharded over ``model``) to
+    divide by the sequence-axis size; ``auto`` prefers it when legal
+    (two all-to-alls move ~3x fewer bytes than a K-hop ring at moderate
+    sequence lengths), ring otherwise."""
+    if not plan.present("sequence"):
+        return "local"
+    h_local = cfg.n_heads // plan.size("model")
+    divides = h_local % plan.size("sequence") == 0
+    if cfg.attention == "ulysses":
+        if not divides:
+            raise ValueError(
+                "ulysses attention needs local heads (%d) divisible by "
+                "the sequence axis (%d); use attention='ring'"
+                % (h_local, plan.size("sequence")))
+        return "ulysses"
+    if cfg.attention == "auto" and divides:
+        return "ulysses"
+    return "ring"
+
+
+class MeshProgram:
+    """One (config, plan) pair's concrete sharded program: parameter
+    names/specs/local shapes, the deterministic global initializer, and
+    the per-replica loss function (module docstring)."""
+
+    def __init__(self, cfg, plan):
+        from jax.sharding import PartitionSpec as P
+        self.cfg = cfg
+        self.plan = plan
+        km, ks = plan.size("model"), plan.size("sequence")
+        if cfg.n_heads % km:
+            raise ValueError("n_heads %d must divide by the model axis %d"
+                             % (cfg.n_heads, km))
+        if cfg.d_ff % km:
+            raise ValueError("d_ff %d must divide by the model axis %d"
+                             % (cfg.d_ff, km))
+        if cfg.vocab_size % km:
+            raise ValueError("vocab_size %d must divide by the model "
+                             "axis %d" % (cfg.vocab_size, km))
+        if cfg.seq_len % max(ks, 1):
+            raise ValueError("seq_len %d must divide by the sequence "
+                             "axis %d" % (cfg.seq_len, ks))
+        self.attention_mode = _attention_mode(cfg, plan)
+        model = "model" if plan.present("model") else None
+        d, h, e, f, v = (cfg.d_model, cfg.n_heads, cfg.head_dim,
+                         cfg.d_ff, cfg.vocab_size)
+        # name -> (global shape, PartitionSpec) in parameter order; the
+        # spec's axis names are already collapsed (size-1 -> None)
+        specs = [("embed", (v, d), P(model, None)),
+                 ("pos_embed", (cfg.seq_len, d), P())]
+        for i in range(cfg.n_layers):
+            pre = "l%d_" % i
+            specs += [
+                (pre + "ln1_scale", (d,), P()),
+                (pre + "ln1_bias", (d,), P()),
+                (pre + "wq", (d, h, e), P(None, model, None)),
+                (pre + "wk", (d, h, e), P(None, model, None)),
+                (pre + "wv", (d, h, e), P(None, model, None)),
+                (pre + "wo", (h, e, d), P(model, None, None)),
+                (pre + "ln2_scale", (d,), P()),
+                (pre + "ln2_bias", (d,), P()),
+                (pre + "w1", (d, f), P(None, model)),
+                (pre + "b1", (f,), P(model)),
+                (pre + "w2", (f, d), P(model, None)),
+                (pre + "b2", (d,), P()),
+            ]
+        specs += [("lnf_scale", (d,), P()),
+                  ("lnf_bias", (d,), P()),
+                  ("w_out", (d, v), P(None, model))]
+        self.param_names = [n for n, _, _ in specs]
+        self._shapes = {n: s for n, s, _ in specs}
+        self._specs = {n: p for n, _, p in specs}
+
+    # -- layout -----------------------------------------------------------
+    def partition_spec(self, name):
+        return self._specs[name]
+
+    def global_shape(self, name):
+        return self._shapes[name]
+
+    def local_shape(self, name):
+        """The per-replica shard shape — what the ``axis_env`` trace and
+        the ``shard_map`` body see."""
+        spec = self._specs[name]
+        shape = list(self._shapes[name])
+        for dim, entry in enumerate(spec):
+            if entry is not None:
+                shape[dim] //= self.plan.size(entry)
+        return tuple(shape)
+
+    def local_batch_shape(self, global_batch):
+        b = global_batch // self.plan.size("data")
+        t = self.cfg.seq_len // self.plan.size("sequence")
+        return (b, t)
+
+    # -- init -------------------------------------------------------------
+    def init_params(self, seed=None):
+        """Deterministic GLOBAL parameter arrays, name -> float32
+        ndarray: scaled-normal weights, ones/zeros norms, zero biases.
+        Same seed => bitwise-identical params at ANY plan (the numerics
+        tests' baseline contract)."""
+        cfg = self.cfg
+        rng = _np.random.RandomState(
+            cfg.init_seed if seed is None else int(seed))
+        out = {}
+        for name in self.param_names:
+            shape = self._shapes[name]
+            if name.endswith(("_scale", "lnf_scale")):
+                out[name] = _np.ones(shape, _np.float32)
+            elif name.endswith(("_bias", "b1", "b2")):
+                out[name] = _np.zeros(shape, _np.float32)
+            elif name in ("embed", "pos_embed"):
+                out[name] = (rng.randn(*shape) * cfg.init_scale
+                             ).astype(_np.float32)
+            else:
+                # fan-in scaled: the contraction size of each matmul —
+                # wo contracts (heads, head_dim), everything else dim 0
+                fan_in = shape[0] * shape[1] if name.endswith("wo") \
+                    else shape[0]
+                out[name] = (rng.randn(*shape) / _np.sqrt(max(fan_in, 1))
+                             ).astype(_np.float32)
+        return out
+
+    # -- the per-replica forward + loss ------------------------------------
+    def _attend(self, q, k, v):
+        from ..parallel.ring_attention import (local_attention,
+                                               ring_attention,
+                                               ulysses_attention)
+        if self.attention_mode == "ring":
+            return ring_attention(q, k, v, "sequence", causal=True)
+        if self.attention_mode == "ulysses":
+            return ulysses_attention(q, k, v, "sequence", causal=True)
+        return local_attention(q, k, v, causal=True)
+
+    def loss_replica(self, train_vals, x, y, key):
+        """Mean causal-LM loss of the LOCAL token chunk.  ``train_vals``
+        follow ``param_names`` order (local shards); ``x``/``y`` are the
+        local ``(B/Kd, T/Ks)`` int32 token/label chunks (labels already
+        globally shifted by the feeder).  Collectives inside: the
+        ``model``-axis psums of the sharded layers and the ``sequence``
+        ring/all-to-all of attention — NO data/sequence gradient
+        reduction (the step wrapper owns that, exactly once: DST006)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from . import layers as L
+
+        cfg, plan = self.cfg, self.plan
+        p = dict(zip(self.param_names, train_vals))
+        t_local = x.shape[1]
+        h = L.vocab_parallel_embedding(p["embed"], x, plan)
+        start = L.sequence_offset(plan, t_local)
+        pos = lax.dynamic_slice(
+            p["pos_embed"], (start, 0), (t_local, cfg.d_model))
+        h = h + pos[None]
+        for i in range(cfg.n_layers):
+            pre = "l%d_" % i
+            a = L.layer_norm(h, p[pre + "ln1_scale"], p[pre + "ln1_bias"])
+            # Megatron f-op: every replicated activation entering a
+            # column-parallel region needs its cotangent psum'd back
+            a = L.copy_to_model(a, plan)
+            q = jnp.einsum("btd,dhe->bthe", a, p[pre + "wq"])
+            k = jnp.einsum("btd,dhe->bthe", a, p[pre + "wk"])
+            v = jnp.einsum("btd,dhe->bthe", a, p[pre + "wv"])
+            o = self._attend(q, k, v)
+            o = jnp.einsum("bthe,hed->btd", o, p[pre + "wo"])
+            h = h + L.row_parallel_out(o, plan)
+            m = L.layer_norm(h, p[pre + "ln2_scale"], p[pre + "ln2_bias"])
+            m = L.copy_to_model(m, plan)
+            f = L.column_parallel_dense(m, p[pre + "w1"], p[pre + "b1"])
+            f = jax.nn.gelu(f)
+            f = f @ p[pre + "w2"]
+            h = h + L.row_parallel_out(f, plan, bias=p[pre + "b2"])
+        hf = L.layer_norm(h, p["lnf_scale"], p["lnf_bias"])
+        hf = L.copy_to_model(hf, plan)
+        logits = hf @ p["w_out"]
+        tok_loss = L.vocab_parallel_cross_entropy(logits, y, plan)
+        return tok_loss.mean()
+
+    def describe(self):
+        return {"config": self.cfg.describe(),
+                "plan": self.plan.describe(),
+                "attention_mode": self.attention_mode,
+                "n_params": len(self.param_names)}

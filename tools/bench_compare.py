@@ -45,6 +45,15 @@ Gated metrics (direction, tolerance)::
                                        resume restore, noisy 1-core host)
     supervisor_failover_steps_lost     lower, zero slack (checkpoint-
                                        every-step failover must lose 0)
+    tp_modeled_model_axis_bytes        lower, 2% relative (modeled
+                                       tensor-parallel wire bytes; up
+                                       is the regression)
+    seqpar_tokens_per_sec_host         higher, 10% relative (2x2x2 mesh
+                                       train loop on the virtual host
+                                       mesh)
+    tp_numerics_ok                     higher, zero slack (mesh losses
+                                       must equal the replicated
+                                       baseline: 1.0 or regression)
 
 A metric with fewer than two live occurrences has no prior bar and
 passes vacuously (the r01–r05 lineage: ``value`` is live in r01+r02,
@@ -108,6 +117,16 @@ GATES = {
     "zero1_modeled_hbm_drop_pct": ("higher", 0.02),
     "reshard_restore_ms": ("lower_abs", 150.0),
     "supervisor_failover_steps_lost": ("lower_abs", 0.0),
+    # transformer mesh-tier stage (r06 onward): the fixture's modeled
+    # tensor-parallel wire bytes are deterministic (growing model-axis
+    # traffic is the regression; 2% covers intentional geometry retunes
+    # shipped with their PR); tokens/sec is wall time on the noisy
+    # 1-core host (10% rel); the mesh-vs-replicated loss parity is a
+    # hard contract — any drop from 1.0 is a numerics regression, zero
+    # slack
+    "tp_modeled_model_axis_bytes": ("lower_rel", 0.02),
+    "seqpar_tokens_per_sec_host": ("higher", 0.10),
+    "tp_numerics_ok": ("higher", 0.0),
 }
 
 _RECORD_KEYS = ("n", "cmd", "rc", "parsed")
