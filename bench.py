@@ -391,6 +391,16 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_TRANSFORMER", "1") == "1":
         rec.stage("transformer", 150, _transformer_bench)
 
+    # -- fusion-tier micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): fused_optimizer_speedup_host (measured
+    # unfused per-param update vs the fused flat Pallas kernel on the
+    # 1-core host), modeled_fusion_bytes_saved_pct (the fusion pass's
+    # deterministic win over the optimizer chain) and fusion_numerics_ok
+    # (fused == unfused Optimizer.update within tolerance, bitwise
+    # rerun) stay live when the TPU is down — docs/fusion.md
+    if os.environ.get("MXTPU_BENCH_FUSION", "1") == "1":
+        rec.stage("fusion", 150, _fusion_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -742,6 +752,29 @@ def _transformer_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("transformer bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _fusion_bench():
+    """fused_optimizer_speedup_host + modeled_fusion_bytes_saved_pct +
+    fusion_numerics_ok through the fusion-tier harness
+    (mxnet_tpu/fusion_bench.py): the measured unfused-vs-fused
+    optimizer update wall time on the host, the deterministic modeled
+    bytes-saved of the optimizer chain, and the fused-vs-unfused
+    numerics contract.  JAX_PLATFORMS=cpu subprocess — same isolation
+    contract as the other host stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual test mesh in the child
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.fusion_bench"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("fusion bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

@@ -37,7 +37,12 @@ from .coverage import load_test_map, generate_coverage_md
 from .report import (render_text, render_json, exit_code, worst_severity,
                      SCHEMA_VERSION)
 from .cost import (CostReport, analyze_jaxpr, analyze_fn, analyze_symbol,
-                   XLA_FLOP_RTOL, ring_bytes_per_axis, unpriced_findings)
+                   XLA_FLOP_RTOL, ring_bytes_per_axis, unpriced_findings,
+                   KERNEL_COSTS, declare_kernel_cost)
+from .fusion import (FusionReport, FusionChain, analyze_tape_fusion,
+                     fusion_from_jaxpr, fusion_from_fn,
+                     fusion_for_symbol, lint_kernel_costs,
+                     FUSION_HINT_MIN_PCT)
 from .dist_lint import lint_dist_step, lint_trainer, dist_summary
 from .shard_prop import (MeshSpec, ShardSpec, ShardReport, propagate,
                          collective_schedule, lint_sharded_step,
@@ -68,6 +73,10 @@ __all__ = [
     "collective_schedule", "lint_sharded_step", "lint_ring_schedule",
     "lint_global_sharding", "shard_summary", "shard_self_check",
     "lint_parallel_sources",
+    "FusionReport", "FusionChain", "analyze_tape_fusion",
+    "fusion_from_jaxpr", "fusion_from_fn", "fusion_for_symbol",
+    "lint_kernel_costs", "FUSION_HINT_MIN_PCT", "KERNEL_COSTS",
+    "declare_kernel_cost",
 ]
 
 
@@ -88,11 +97,12 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
     serving request paths, the SRV005 wall-clock sweep over the
     promotion/capacity decision path (``mlops/`` + the decision CLIs),
     the telemetry sweeps — TEL001 chaos-probe sites and TEL002
-    attribution phases — and the mxshard sweeps: the golden sharded-step
-    fixtures must lint clean and deterministically
+    attribution phases + context hints — the mxshard sweeps: the golden
+    sharded-step fixtures must lint clean and deterministically
     (``shard_self_check``) and the shipped ring/Ulysses attention paths
     must pass the mixed-axis DST rules (``lint_parallel_sources``) —
-    what CI runs.
+    and the declared-cost sweep over the shipped Pallas kernels
+    (``lint_kernel_costs``, COST005) — what CI runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -117,6 +127,10 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
     if with_shard:
         findings += shard_self_check(disable=disable)
         findings += lint_parallel_sources(disable=disable)
+    if with_cost:
+        # the declared-cost sweep (COST005): every shipped pallas_call
+        # must price itself — an un-annotated kernel fails CI here
+        findings += lint_kernel_costs(disable=disable)
     return findings
 
 

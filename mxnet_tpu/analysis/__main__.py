@@ -107,6 +107,12 @@ def main(argv=None):
                         "reshards and the ZeRO-1 memory proof for the "
                         "shard-aware budget models; adds the 'shard' "
                         "section to --json (schema_version 3)")
+    p.add_argument("--fusion", action="store_true",
+                   help="with --cost: run the mxfuse fusion-candidate "
+                        "pass — fusable chains ranked by modeled "
+                        "bytes-saved-if-fused over the budget models' "
+                        "unfused spellings (docs/fusion.md); adds the "
+                        "'fusion' section to --json (schema_version 4)")
     p.add_argument("--hbm-cap", type=int, default=0, dest="hbm_cap",
                    help="with --serving: flag buckets whose modeled peak "
                         "HBM exceeds this many bytes (SRV003)")
@@ -187,7 +193,8 @@ def _run_cost(args, disable):
             pass
 
     from . import render_json, render_text, exit_code, filter_findings
-    from .budget_models import BUDGET_MODELS, build_model, check_budgets
+    from .budget_models import (BUDGET_MODELS, build_model,
+                                build_fusion_report, check_budgets)
     from .dist_lint import dist_summary
     from .shard_prop import shard_summary
 
@@ -208,6 +215,12 @@ def _run_cost(args, disable):
                 shards[name] = shard
             findings += filter_findings(dst, disable)
         title = "mxcost %s" % ",".join(names)
+    fusion = {}
+    if args.fusion:
+        for name in sorted(cost):
+            frep = build_fusion_report(name)
+            if frep is not None:
+                fusion[name] = frep
     axis_sizes = {}
     for rep in cost.values():
         axis_sizes.update(rep.axis_sizes)
@@ -216,7 +229,8 @@ def _run_cost(args, disable):
             findings, cost=cost,
             dist=dist_summary(findings, axis_sizes=axis_sizes),
             shard=shard_summary(shards, findings)
-            if (args.shard and shards) else None))
+            if (args.shard and shards) else None,
+            fusion=fusion if (args.fusion and fusion) else None))
     else:
         print(render_text(findings, title=title))
         for name, rep in sorted(cost.items()):
@@ -224,6 +238,9 @@ def _run_cost(args, disable):
         if args.shard:
             for name, rep in sorted(shards.items()):
                 print(rep.render(title="mxshard %s" % name))
+        if args.fusion:
+            for name, rep in sorted(fusion.items()):
+                print(rep.render(title="mxfuse %s" % name))
     return exit_code(findings, strict=args.strict)
 
 

@@ -684,6 +684,204 @@ def tp_runtime_checks(fixture_report, fixture_shard,
     return findings, extras
 
 
+# the pinned fused-optimizer geometry (docs/fusion.md): parameter
+# shapes summing to exactly 32768 f32 elements — a whole number of
+# (256, 128) kernel tiles, so the flat space pads by ZERO and the
+# declared-vs-modeled byte parity below is EXACT
+FUSED_GEOMETRY = {
+    "shapes": [(128, 128), (64, 128), (32, 128), (24, 128), (1024,)],
+    "lr": 0.1, "momentum": 0.9, "wd": 1e-4,
+    "adam_lr": 0.001, "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+}
+
+
+def _fused_update_programs(kind):
+    """(unfused per-param program+avals, seam-honoring flat program+
+    avals, flat unfused twin program+avals, optimizer) for one
+    optimizer ``kind`` at the pinned geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import optimizer as opt_mod
+    from ..ops import fused_optimizer as fo
+    from ..parallel.functional import functional_optimizer_update
+
+    g = FUSED_GEOMETRY
+    if kind == "sgd":
+        opt = opt_mod.SGD(learning_rate=g["lr"], momentum=g["momentum"],
+                          wd=g["wd"])
+
+        def mk_state(aval):
+            return aval
+    else:
+        opt = opt_mod.Adam(learning_rate=g["adam_lr"], beta1=g["beta1"],
+                           beta2=g["beta2"], epsilon=g["epsilon"],
+                           wd=g["wd"])
+
+        def mk_state(aval):
+            return (aval, aval)
+
+    shapes = [tuple(s) for s in g["shapes"]]
+    total = sum(int(_np_prod(s)) for s in shapes)
+    param_avals = tuple(jax.ShapeDtypeStruct(s, jnp.float32)
+                        for s in shapes)
+    flat_aval = jax.ShapeDtypeStruct((total,), jnp.float32)
+
+    def unfused_per_param(ws, gs, states, lr, t):
+        new_w, new_s = [], []
+        for i, (w, grad, st) in enumerate(zip(ws, gs, states)):
+            nw, ns = functional_optimizer_update(opt, i, w, grad, st,
+                                                 lr, t)
+            new_w.append(nw)
+            new_s.append(ns)
+        return tuple(new_w), tuple(new_s)
+
+    def fused_flat(w, grad, st, lr, t):
+        # the seam: production traces the Pallas kernel; flipping
+        # FUSED_OPTIMIZER off degrades to the unfused eqn chain and the
+        # FUS001 checks below fail the gate rc=2
+        if fo.FUSED_OPTIMIZER:
+            return fo.fused_optimizer_update(opt, 0, w, grad, st, lr, t)
+        return functional_optimizer_update(opt, 0, w, grad, st, lr, t)
+
+    def unfused_flat(w, grad, st, lr, t):
+        return functional_optimizer_update(opt, 0, w, grad, st, lr, t)
+
+    args_pp = (param_avals, param_avals,
+               tuple(mk_state(a) for a in param_avals))
+    args_flat = (flat_aval, flat_aval, mk_state(flat_aval))
+    return (unfused_per_param, args_pp, fused_flat, unfused_flat,
+            args_flat, opt, total)
+
+
+def _np_prod(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def fused_update_fusion_numbers():
+    """Deterministic modeled numbers for the fused optimizer update
+    (shared by the ``fused_optimizer_update`` budget builder and the
+    host ``fusion`` bench stage): per-optimizer unfused/fused bytes,
+    bytes-saved, the declared kernel bytes and the chain parity facts."""
+    import jax
+    import jax.numpy as jnp
+
+    from .cost import _aval_bytes, build_tape
+    from .fusion import analyze_tape_fusion
+
+    out = {}
+    for kind in ("sgd", "adam"):
+        (unfused_pp, args_pp, fused_flat, unfused_flat, args_flat,
+         _opt, total) = _fused_update_programs(kind)
+        lr_t = (jnp.float32(0.1), jnp.int32(2))
+
+        closed_pp = jax.make_jaxpr(unfused_pp)(*args_pp, *lr_t)
+        fr_pp = analyze_tape_fusion(build_tape(closed_pp))
+
+        closed_tw = jax.make_jaxpr(unfused_flat)(*args_flat, *lr_t)
+        tape_tw = build_tape(closed_tw)
+        fr_tw = analyze_tape_fusion(tape_tw)
+
+        closed_f = jax.make_jaxpr(fused_flat)(*args_flat, *lr_t)
+        tape_f = build_tape(closed_f)
+        pallas = [op for op in tape_f.ops
+                  if op.prim == "pallas_call" and op.params.get("kernel")]
+        kernel_bytes = sum(op.bytes_read + op.bytes_written
+                           for op in pallas)
+        twin_bytes = sum(op.bytes_read + op.bytes_written
+                         for op in tape_tw.ops)
+        chain = fr_tw.top_chain
+        out[kind] = {
+            "params": int(total),
+            "per_param_chains": len(fr_pp.chains),
+            "per_param_bytes_saved": int(fr_pp.total_bytes_saved),
+            "unfused_bytes": int(twin_bytes),
+            "chain_fused_bytes": int(chain.fused_bytes) if chain else 0,
+            "chain_bytes_saved": int(chain.bytes_saved) if chain else 0,
+            "saved_pct": round(100.0 * chain.bytes_saved / twin_bytes,
+                               2) if (chain and twin_bytes) else 0.0,
+            "kernel_present": bool(pallas),
+            "kernel_bytes": int(kernel_bytes),
+            "unpriced_kernels": list(tape_f.unpriced_kernels),
+        }
+    out["modeled_fusion_bytes_saved_pct"] = out["sgd"]["saved_pct"]
+    return out
+
+
+def fused_optimizer_update():
+    """The fused optimizer update (docs/fusion.md headline) as a static
+    proof: the budget row pins the FUSED flat SGD+momentum spelling's
+    metrics; the builder runs the FUS001 byte contract for SGD+momentum
+    AND Adam — (a) the fused spelling must actually contain the
+    declared-cost Pallas kernel (flipping the ``FUSED_OPTIMIZER`` seam
+    degrades it to the unfused chain and fails the gate rc=2 naming
+    FUS001), (b) the kernel's declared bytes must equal the fusion
+    pass's modeled ``fused_bytes`` for the chain it replaces
+    (declared-vs-tape parity — EXACT at the pinned zero-padding
+    geometry, small slack for the SMEM scalar), and (c) the modeled
+    bytes-saved must stay a real win (>= 30% of the unfused chain)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .cost import analyze_jaxpr, unpriced_findings
+    from .findings import Finding
+
+    numbers = fused_update_fusion_numbers()
+    findings = []
+    for kind in ("sgd", "adam"):
+        n = numbers[kind]
+        subject = "fused_optimizer_update.%s" % kind
+        if not n["kernel_present"]:
+            findings.append(Finding(
+                "FUS001", subject,
+                "the fused optimizer spelling traces NO declared-cost "
+                "pallas_call: fusion is disabled (FUSED_OPTIMIZER seam) "
+                "or the kernel lost its declare_kernel_cost model — the "
+                "fused update would silently run as %d bytes of unfused "
+                "eqn chain instead of one %d-byte pass"
+                % (n["unfused_bytes"],
+                   n["chain_fused_bytes"])))
+            continue
+        slack = 256          # the SMEM lr scalar + rounding
+        if abs(n["kernel_bytes"] - n["chain_fused_bytes"]) > slack:
+            findings.append(Finding(
+                "FUS001", subject,
+                "declared-vs-tape byte parity broken: the kernel "
+                "declares %d HBM bytes but one fused pass over the "
+                "chain's external buffers moves %d (slack %d) — the "
+                "declared cost model and the fusion pass disagree about "
+                "what the kernel reads/writes"
+                % (n["kernel_bytes"], n["chain_fused_bytes"], slack)))
+        if n["chain_bytes_saved"] * 100 < 30 * n["unfused_bytes"]:
+            findings.append(Finding(
+                "FUS001", subject,
+                "the modeled fusion win collapsed: the optimizer chain "
+                "saves only %d of %d unfused bytes (< 30%%) — the "
+                "unfused spelling got thinner or the chain broke"
+                % (n["chain_bytes_saved"], n["unfused_bytes"])))
+        if n["unpriced_kernels"]:
+            findings.append(Finding(
+                "FUS001", subject,
+                "the fused spelling contains unpriced pallas_call "
+                "kernel(s) %r — they cost zero on the tape"
+                % (n["unpriced_kernels"],)))
+
+    # the pinned row: the fused flat SGD+momentum spelling (device-
+    # resident, donated in place — transfer is zero by construction)
+    (_pp, _args_pp, fused_flat, _tw, args_flat, _opt,
+     _total) = _fused_update_programs("sgd")
+    closed = jax.make_jaxpr(fused_flat)(*args_flat, jnp.float32(0.1),
+                                        jnp.int32(2))
+    report = analyze_jaxpr(closed, donated_invars=[0, 1, 2],
+                           host_invars=[], fetched_outvars=[])
+    findings += unpriced_findings(report,
+                                  subject="fused_optimizer_update")
+    return report, findings
+
+
 BUDGET_MODELS = {
     "mlp_train_step": mlp_train_step,
     "mlp_infer": mlp_infer,
@@ -693,7 +891,38 @@ BUDGET_MODELS = {
     "ring_attention_fwd": ring_attention_fwd,
     "ulysses_attention": ulysses_attention,
     "tp_transformer_train_step": tp_transformer_train_step,
+    "fused_optimizer_update": fused_optimizer_update,
 }
+
+
+def build_fusion_report(name):
+    """mxfuse FusionReport for one budget model's UNFUSED program (the
+    chains a fused kernel could still claim), or None for models whose
+    spelling the fusion CLI does not analyze.  ``--cost --fusion``."""
+    import jax
+    import jax.numpy as jnp
+
+    from .fusion import fusion_from_fn, fusion_from_jaxpr
+
+    if name == "fused_optimizer_update":
+        unfused_pp, args_pp, *_rest = _fused_update_programs("sgd")
+        return fusion_from_fn(unfused_pp, *args_pp, jnp.float32(0.1),
+                              jnp.int32(2))
+    if name == "mlp_train_step":
+        from ..gluon import loss as gloss
+        from ..parallel.trainer import DataParallelTrainer
+        trainer = DataParallelTrainer(
+            _mlp_block(), gloss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9}, mesh=_cpu_mesh())
+        return trainer.fusion_report(data_shape=(64, 16),
+                                     label_shape=(64,))
+    if name == "zero1_mlp_train_step":
+        from . import shard_fixtures as sf
+        k = DECLARED_AXIS
+        step, args = sf.zero1_step_program(k)
+        closed = jax.make_jaxpr(step, axis_env=[("data", k)])(*args)
+        return fusion_from_jaxpr(closed, axis_sizes={"data": k})
+    return None
 
 
 def build_model(name):

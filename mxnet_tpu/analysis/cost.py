@@ -35,7 +35,8 @@ import numpy as _np
 __all__ = ["CostReport", "TapeOp", "build_tape", "analyze_jaxpr",
            "analyze_fn", "analyze_symbol", "XLA_FLOP_RTOL",
            "collective_bytes", "ring_bytes_per_axis",
-           "unpriced_findings", "TRANSCENDENTALS"]
+           "unpriced_findings", "TRANSCENDENTALS",
+           "KERNEL_COSTS", "declare_kernel_cost", "kernel_name_of"]
 
 # documented cross-validation tolerance: |modeled - xla| / xla for the
 # golden single-primitive programs of tests/test_analysis.py on the CPU
@@ -165,6 +166,49 @@ def _axis_names(params):
 
 
 # ---------------------------------------------------------------------------
+# kernel-declared cost models for pallas_call
+# ---------------------------------------------------------------------------
+# kernel fn name -> cost fn(eqn) -> {"flops", "transcendentals",
+# "bytes_read", "bytes_written"}.  A ``pallas_call`` severs jaxpr
+# dataflow (the kernel body sees refs, not the call operands) and its
+# body is traced once — not once per grid step — so walking it prices
+# the kernel wrong in BOTH directions.  A shipped kernel therefore
+# DECLARES its cost here (shape arithmetic over the eqn's operand avals
+# + grid, deterministic); the tape consults the registry BEFORE falling
+# back to the body-walk + zero-cost connector, and an unannotated
+# shipped kernel is NAMED (``Tape.unpriced_kernels`` -> COST005) instead
+# of silently costing near-zero.  Keying is the kernel *function name*
+# (``name_and_src_info.name``, stable through functools.partial), the
+# same name the ``lint_kernel_costs`` AST sweep resolves.
+KERNEL_COSTS = {}
+
+
+def declare_kernel_cost(kernel_name):
+    """Decorator: register ``fn(eqn) -> cost dict`` for a Pallas kernel
+    (keyed by the kernel function's name as it appears in the traced
+    ``pallas_call`` eqn)."""
+    def wrap(fn):
+        KERNEL_COSTS[str(kernel_name)] = fn
+        return fn
+    return wrap
+
+
+def kernel_name_of(eqn):
+    """The kernel function name of a traced ``pallas_call`` eqn (the
+    registry key), or None when it cannot be determined."""
+    nsi = eqn.params.get("name_and_src_info")
+    name = getattr(nsi, "name", None)
+    if name:
+        return str(name)
+    return None
+
+
+def _grid_of(eqn):
+    grid = getattr(eqn.params.get("grid_mapping"), "grid", ()) or ()
+    return tuple(int(g) for g in grid if isinstance(g, int))
+
+
+# ---------------------------------------------------------------------------
 # per-primitive flop models
 # ---------------------------------------------------------------------------
 def _dot_general_flops(eqn):
@@ -267,6 +311,7 @@ class Tape:
         self.const_ids = []      # closure constants
         self.literal_ids = set()  # inline literals (e.g. the 1 in psum(1))
         self.unpriced = []       # [(prim, axis, reason)] — COST004 feed
+        self.unpriced_kernels = []  # [kernel name] — COST005 feed
         self.unbounded_loops = False
         self._next = 0
 
@@ -365,6 +410,26 @@ def build_tape(closed_jaxpr, axis_sizes=None):
         handling; anything else is traversed with fresh inner inputs
         (cost still counted, liveness approximate)."""
         import jax
+
+        if prim == "pallas_call":
+            # declared-cost fast path: one priced op with REAL dataflow
+            # (in place of the body walk, whose once-not-per-grid-step
+            # trace misprices the kernel, plus the zero-cost connector)
+            kname = kernel_name_of(eqn)
+            cost_fn = KERNEL_COSTS.get(kname)
+            if cost_fn is not None:
+                cost = cost_fn(eqn)
+                in_ids = tuple(read(env, a) for a in eqn.invars)
+                out_ids = tuple(bind_out(env, v) for v in eqn.outvars)
+                tape.ops.append(TapeOp(
+                    prim, scale, in_ids, out_ids,
+                    int(cost.get("flops", 0)) * scale,
+                    int(cost.get("transcendentals", 0)) * scale,
+                    int(cost.get("bytes_read", 0)) * scale,
+                    int(cost.get("bytes_written", 0)) * scale,
+                    {}, (), {"kernel": kname}))
+                return
+            tape.unpriced_kernels.append(kname or "<anonymous>")
 
         sub_scale = scale
         if prim == "scan":
@@ -502,7 +567,8 @@ class CostReport:
                  bytes_written, transfer_h2d_bytes, transfer_d2h_bytes,
                  collective_bytes_per_axis, peak_hbm_bytes, input_bytes,
                  output_bytes, const_bytes, n_eqns, axis_sizes,
-                 unbounded_loops=False, unpriced_collectives=()):
+                 unbounded_loops=False, unpriced_collectives=(),
+                 unpriced_kernels=()):
         self.per_primitive = per_primitive
         self.flops = flops
         self.transcendentals = transcendentals
@@ -521,6 +587,9 @@ class CostReport:
         # [(prim, axis, reason)]: collectives whose modeled wire bytes
         # are silently zero (unknown primitive / undeclared axis size)
         self.unpriced_collectives = list(unpriced_collectives)
+        # [kernel name]: pallas_call kernels with no declared cost model
+        # (priced off a once-per-trace body walk — wrong both ways)
+        self.unpriced_kernels = list(unpriced_kernels)
 
     @property
     def transfer_bytes(self):
@@ -554,6 +623,7 @@ class CostReport:
             "unpriced_collectives": [
                 {"prim": p, "axis": a, "reason": r}
                 for p, a, r in sorted(set(self.unpriced_collectives))],
+            "unpriced_kernels": sorted(set(self.unpriced_kernels)),
             "per_primitive": {
                 prim: {k: int(v) for k, v in sorted(row.items())}
                 for prim, row in sorted(self.per_primitive.items())},
@@ -624,7 +694,8 @@ def analyze_tape(tape, donated_ids=(), host_invar_ids=None,
         input_bytes=in_bytes, output_bytes=out_bytes,
         const_bytes=const_bytes, n_eqns=len(tape.ops),
         axis_sizes=axis_sizes, unbounded_loops=tape.unbounded_loops,
-        unpriced_collectives=tape.unpriced)
+        unpriced_collectives=tape.unpriced,
+        unpriced_kernels=tape.unpriced_kernels)
 
 
 def analyze_jaxpr(closed_jaxpr, axis_sizes=None, donated_invars=(),
@@ -692,16 +763,12 @@ def analyze_fn(fn, *args, axis_env=None, axis_sizes=None,
                          donated_invars=donated, host_invars=host)
 
 
-def analyze_symbol(symbol, shapes, type_dict=None, train=False,
-                   host_names=None):
-    """CostReport for a Symbol's forward program at concrete ``shapes``.
-
-    ``shapes`` must make the graph fully inferable (same contract as the
-    GRF006 trace).  ``host_names``: argument names fed from the host each
-    call (default: exactly the names in ``shapes`` — data/label; derived
-    parameter arguments are device-resident).  Returns None when the
-    graph is underspecified or does not trace.
-    """
+def symbol_closed_jaxpr(symbol, shapes, type_dict=None, train=False):
+    """Trace a Symbol's forward program at concrete ``shapes``:
+    ``(closed_jaxpr, args, aux)`` with args/aux the name→
+    ShapeDtypeStruct dicts (flat invar order follows their sorted
+    keys), or None when the graph is underspecified or does not trace.
+    Shared by :func:`analyze_symbol` and the fusion pass."""
     import jax
 
     from ..symbol.symbol import _infer_entry_shapes, make_graph_fn
@@ -726,8 +793,27 @@ def analyze_symbol(symbol, shapes, type_dict=None, train=False,
             args, aux, jax.random.PRNGKey(0))
     except Exception:
         return None
+    return closed, args, aux
+
+
+def analyze_symbol(symbol, shapes, type_dict=None, train=False,
+                   host_names=None):
+    """CostReport for a Symbol's forward program at concrete ``shapes``.
+
+    ``shapes`` must make the graph fully inferable (same contract as the
+    GRF006 trace).  ``host_names``: argument names fed from the host each
+    call (default: exactly the names in ``shapes`` — data/label; derived
+    parameter arguments are device-resident).  Returns None when the
+    graph is underspecified or does not trace.
+    """
+    traced = symbol_closed_jaxpr(symbol, shapes, type_dict=type_dict,
+                                 train=train)
+    if traced is None:
+        return None
+    closed, args, aux = traced
     # flat invar order follows the pytree flattening of (args, aux, key):
     # classify host-fed leaves by arg-dict key order (sorted by jax)
+    known = {k for k, v in (shapes or {}).items() if v is not None}
     host = set(host_names if host_names is not None else known)
     flat_names = sorted(args) + sorted(aux)
     host_idx = [i for i, name in enumerate(flat_names) if name in host]
@@ -759,4 +845,13 @@ def unpriced_findings(report_or_tape, subject="<program>", disable=()):
             "teach analysis/cost.py its ring formula — an unpriced "
             "collective makes every collective-byte budget a lie"
             % (prim, axis, reason)))
+    kernels = getattr(report_or_tape, "unpriced_kernels", [])
+    for kname in sorted(set(kernels)):
+        findings.append(Finding(
+            "COST005", subject,
+            "pallas_call kernel %r declares no cost model: its body is "
+            "costed once (not once per grid step) and its dataflow is "
+            "severed behind a zero-cost connector — register a "
+            "declare_kernel_cost(%r) model (analysis/cost.py) so the "
+            "budget gate prices it" % (kname, kname)))
     return filter_findings(findings, disable)

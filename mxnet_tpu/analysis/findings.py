@@ -175,6 +175,17 @@ RULES = {
                          "axis whose size was never declared — the "
                          "collective-byte budget silently understates "
                          "traffic"),
+    "COST005": (ERROR, "shipped pallas_call kernel declares no cost "
+                       "model: the tape prices it off a once-per-trace "
+                       "body walk (wrong in both directions) behind a "
+                       "zero-cost connector — register a "
+                       "declare_kernel_cost model"),
+    # fusion pass (mxnet_tpu/analysis/fusion.py)
+    "FUS001": (ERROR, "fused-kernel byte contract broken: the fused "
+                      "spelling's modeled HBM bytes do not realize the "
+                      "fusion pass's bytes-saved-if-fused for the chain "
+                      "it replaces, or the kernel's declared bytes "
+                      "differ from one pass over its operands/results"),
 }
 
 

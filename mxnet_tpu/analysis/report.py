@@ -24,8 +24,9 @@ __all__ = ["render_text", "render_json", "worst_severity", "exit_code",
 
 # bumped in PR 4 (cost/dist sections + the field itself); 3 adds the
 # shard section (mxshard collective schedules) and the
-# unpriced_collectives row inside each cost report
-SCHEMA_VERSION = 3
+# unpriced_collectives row inside each cost report; 4 adds the fusion
+# section (mxfuse chain rankings) and the unpriced_kernels row
+SCHEMA_VERSION = 4
 
 
 def _sorted(findings):
@@ -45,10 +46,11 @@ def render_text(findings, title="mxlint"):
     return "\n".join(lines)
 
 
-def render_json(findings, cost=None, dist=None, shard=None):
+def render_json(findings, cost=None, dist=None, shard=None, fusion=None):
     """``cost``: {target_name: CostReport-or-dict}; ``dist``: the
-    dist_summary dict; ``shard``: the shard_summary dict.  Sections
-    appear only when provided."""
+    dist_summary dict; ``shard``: the shard_summary dict; ``fusion``:
+    {target_name: FusionReport-or-dict} (schema 4).  Sections appear
+    only when provided."""
     counts = Counter(f.severity for f in findings)
     payload = {
         "version": 1,
@@ -64,6 +66,10 @@ def render_json(findings, cost=None, dist=None, shard=None):
         payload["dist"] = dist
     if shard is not None:
         payload["shard"] = shard
+    if fusion is not None:
+        payload["fusion"] = {
+            name: (rep.as_dict() if hasattr(rep, "as_dict") else rep)
+            for name, rep in sorted(fusion.items())}
     return json.dumps(payload, indent=2)
 
 

@@ -39,7 +39,7 @@ from .findings import Finding, filter_findings
 
 __all__ = ["lint_chaos_sites", "probe_sites_used", "SITE_DOC",
            "lint_attribution_phases", "attribution_phases_used",
-           "attribution_phase_decls"]
+           "attribution_phase_decls", "context_hint_decls"]
 
 # the documentation the probe table must live in (TEL001's third leg);
 # the TEL002 phase table lives in the same doc
@@ -207,6 +207,62 @@ def attribution_phase_decls(root=None, attribution_path=None):
     return phases, hint_keys
 
 
+def context_hint_decls(root=None, attribution_path=None):
+    """Parse ``telemetry/attribution.py`` (AST, no import) for the
+    ``CONTEXT_HINTS`` map's literal ``(phase, tag)`` keys.  Non-literal
+    keys come back as None placeholders so the lint can flag them."""
+    root = root or _pkg_root()
+    path = attribution_path or os.path.join(root, "telemetry",
+                                            "attribution.py")
+    pairs = []
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return pairs
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        if getattr(node.targets[0], "id", None) != "CONTEXT_HINTS":
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for key in node.value.keys:
+            if isinstance(key, ast.Tuple) and len(key.elts) == 2 and \
+                    all(isinstance(e, ast.Constant)
+                        and isinstance(e.value, str) for e in key.elts):
+                pairs.append((key.elts[0].value, key.elts[1].value))
+            else:
+                pairs.append(None)
+    return pairs
+
+
+def _documented_context_hints(repo, doc_path=None):
+    """(phase, tag) rows of the docs context-hint table: the table whose
+    header row starts ``| phase | context``.  None when the doc is
+    absent (installed package — doc legs skipped)."""
+    path = doc_path or os.path.join(repo, SITE_DOC)
+    if not os.path.isfile(path):
+        return None
+    with open(path) as f:
+        lines = f.read().splitlines()
+    pairs = set()
+    in_table = False
+    for line in lines:
+        if re.match(r"^\|\s*phase\s*\|\s*context", line):
+            in_table = True
+            continue
+        if in_table:
+            if not line.startswith("|"):
+                in_table = False
+                continue
+            m = re.match(r"^\|\s*`([a-z0-9_]+)`\s*\|\s*`([a-z0-9_]+)`",
+                         line)
+            if m:
+                pairs.add((m.group(1), m.group(2)))
+    return pairs
+
+
 def attribution_phases_used(root=None):
     """Scan the shipped sources (``mxnet_tpu/**``, ``bench.py``,
     ``tools/*.py``) for ``add_phase(<literal>, ...)`` calls — the
@@ -338,4 +394,38 @@ def lint_attribution_phases(disable=(), root=None, attribution_path=None,
                 "the %s phase table documents %r but attribution.PHASES "
                 "does not declare it — the docs promise a phase the "
                 "doctor cannot produce" % (SITE_DOC, name)))
+    # CONTEXT_HINTS legs: every (phase, tag) specialization must refine
+    # a declared phase and have its row in the docs context-hint table
+    # (both ways — a stale doc row promises a hint the doctor cannot
+    # print)
+    ctx_raw = context_hint_decls(root, attribution_path=attribution_path)
+    if None in ctx_raw:
+        findings.append(Finding(
+            "TEL002", "CONTEXT_HINTS",
+            "CONTEXT_HINTS contains non-literal (phase, tag) keys — "
+            "computed context hints can never be checked against "
+            "PHASES or the docs"))
+    ctx = {p for p in ctx_raw if p is not None}
+    for phase, tag in sorted(ctx):
+        if phase not in phases:
+            findings.append(Finding(
+                "TEL002", "%s:%s" % (phase, tag),
+                "CONTEXT_HINTS specializes phase %r (tag %r) which is "
+                "not in PHASES — a stale hint for a phase that no "
+                "longer exists" % (phase, tag)))
+    doc_ctx = _documented_context_hints(repo, doc_path=doc_path)
+    if doc_ctx is not None:
+        for phase, tag in sorted(ctx - doc_ctx):
+            findings.append(Finding(
+                "TEL002", "%s:%s" % (phase, tag),
+                "context hint (%r, %r) has no row in the %s "
+                "context-hint table (keep the doctor's specialized "
+                "hints and the docs in sync)" % (phase, tag, SITE_DOC)))
+        for phase, tag in sorted(doc_ctx - ctx):
+            findings.append(Finding(
+                "TEL002", "%s:%s" % (phase, tag),
+                "the %s context-hint table documents (%r, %r) but "
+                "attribution.CONTEXT_HINTS does not declare it — the "
+                "docs promise a hint the doctor cannot print"
+                % (SITE_DOC, phase, tag)))
     return filter_findings(findings, disable)

@@ -1,0 +1,123 @@
+"""Host-only fusion-tier bench (the r05 subprocess pattern).
+
+Run as ``python -m mxnet_tpu.fusion_bench`` under ``JAX_PLATFORMS=cpu``
+(bench.py's ``fusion`` stage does, BEFORE backend acquisition, so the
+keys stay live when the TPU is down).  Emits one JSON line:
+
+- ``fused_optimizer_speedup_host``: REAL measured wall-time ratio of
+  the unfused per-parameter optimizer update (what ``_apply_groups``
+  traces without fusion: one eqn chain per parameter) vs the shipped
+  fused flat kernel (``ops/fused_optimizer.py``, Pallas interpret on
+  the host — one pass, one dispatch).  Gated ``higher`` ≥1.2× in
+  tools/bench_compare.py from r06.
+- ``modeled_fusion_bytes_saved_pct``: the deterministic modeled win of
+  the optimizer chain from the ``fused_optimizer_update`` budget
+  builder (the fusion pass's bytes-saved over the unfused chain).
+- ``fusion_numerics_ok``: 1.0 iff fused SGD+momentum AND Adam match
+  the unfused ``Optimizer.update`` spelling within FLOAT_TOL and the
+  fused path is bitwise-deterministic across two runs — gated at zero
+  slack.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+FLOAT_TOL = 1e-5      # fused vs unfused update, after BENCH_STEPS steps
+BENCH_REPS = 40       # timing samples per arm (median)
+NPAR, PSIZE = 96, 4096   # 96 parameters x 4096 f32 — the many-small-
+#                          params regime where unfused dispatch hurts
+
+
+def _bench(fn, args, reps=BENCH_REPS):
+    import jax
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.analysis.budget_models import (
+        fused_update_fusion_numbers)
+    from mxnet_tpu.ops import fused_optimizer as fo
+    from mxnet_tpu.parallel.functional import functional_optimizer_update
+
+    out = {}
+
+    # modeled (deterministic, device-free): the budget builder's numbers
+    numbers = fused_update_fusion_numbers()
+    out["modeled_fusion_bytes_saved_pct"] = numbers[
+        "modeled_fusion_bytes_saved_pct"]
+    out["modeled_adam_bytes_saved_pct"] = numbers["adam"]["saved_pct"]
+
+    # measured: unfused per-param chain vs the fused flat kernel
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4)
+    rng = np.random.RandomState(7)
+    ws = [jnp.asarray(rng.randn(PSIZE).astype("f")) for _ in range(NPAR)]
+    gs = [jnp.asarray(rng.randn(PSIZE).astype("f")) for _ in range(NPAR)]
+    ms = [jnp.asarray(rng.randn(PSIZE).astype("f")) for _ in range(NPAR)]
+    wf = jnp.concatenate(ws)
+    gf = jnp.concatenate(gs)
+    mf = jnp.concatenate(ms)
+    lr = jnp.float32(0.1)
+
+    @jax.jit
+    def unfused(ws, gs, ms, lr):
+        outs = [functional_optimizer_update(opt, 0, w, g, m, lr, 1)
+                for w, g, m in zip(ws, gs, ms)]
+        return [o[0] for o in outs], [o[1] for o in outs]
+
+    @jax.jit
+    def fused(wf, gf, mf, lr):
+        return fo.fused_sgd_momentum(wf, gf, mf, lr, momentum=0.9,
+                                     wd=1e-4, interpret=True)
+
+    nw_u, nm_u = unfused(ws, gs, ms, lr)          # warm (compile)
+    jax.block_until_ready((nw_u, nm_u))
+    nw_f, nm_f = fused(wf, gf, mf, lr)
+    jax.block_until_ready((nw_f, nm_f))
+
+    t_unfused = _bench(unfused, (ws, gs, ms, lr))
+    t_fused = _bench(fused, (wf, gf, mf, lr))
+    out["fused_optimizer_unfused_ms"] = round(t_unfused * 1e3, 4)
+    out["fused_optimizer_fused_ms"] = round(t_fused * 1e3, 4)
+    out["fused_optimizer_speedup_host"] = round(t_unfused / t_fused, 3)
+
+    # numerics: fused == unfused within FLOAT_TOL (sgd-momentum above,
+    # adam below), and the fused path bitwise-repeats
+    err = max(float(jnp.max(jnp.abs(jnp.concatenate(nw_u) - nw_f))),
+              float(jnp.max(jnp.abs(jnp.concatenate(nm_u) - nm_f))))
+    nw_f2, nm_f2 = fused(wf, gf, mf, lr)
+    bitwise = bool((np.asarray(nw_f) == np.asarray(nw_f2)).all()
+                   and (np.asarray(nm_f) == np.asarray(nm_f2)).all())
+
+    adam = opt_mod.Adam(learning_rate=0.01, wd=1e-4)
+    vf = jnp.asarray(np.abs(rng.randn(NPAR * PSIZE)).astype("f"))
+    t = jnp.int32(3)
+    aw_u, astate_u = functional_optimizer_update(
+        adam, 0, wf, gf, (mf, vf), jnp.float32(0.01), t)
+    aw_f, astate_f = fo.fused_optimizer_update(
+        adam, 0, wf, gf, (mf, vf), jnp.float32(0.01), t, interpret=True)
+    err = max(err, float(jnp.max(jnp.abs(aw_u - aw_f))),
+              float(jnp.max(jnp.abs(astate_u[0] - astate_f[0]))),
+              float(jnp.max(jnp.abs(astate_u[1] - astate_f[1]))))
+    out["fusion_numerics_max_err"] = float(err)
+    out["fusion_numerics_ok"] = 1.0 if (err <= FLOAT_TOL
+                                        and bitwise) else 0.0
+
+    print(json.dumps(out))
+    return 0 if out["fusion_numerics_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

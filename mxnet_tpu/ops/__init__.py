@@ -11,6 +11,7 @@ from . import optimizer_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import pallas_kernels  # noqa: F401
+from . import fused_optimizer  # noqa: F401
 from . import linalg  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import quantization  # noqa: F401

@@ -749,3 +749,117 @@ def conv3x3_epilogue(x, w, scale, shift, relu=True, out_dtype=None,
         interpret=interpret,
     )(xp, wcol, scale, shift)
     return out if Cop == Cout else out[..., :Cout]
+
+
+# ---------------------------------------------------------------------------
+# declared cost models (analysis/cost.py KERNEL_COSTS): pallas_call's
+# body traces once — not once per grid step — so the tape consults these
+# shape-arithmetic models instead (docs/fusion.md "kernel cost
+# declaration contract").  bytes model the BLOCKED access pattern: a
+# block re-fetched per grid step along an axis bills once per step.
+# ---------------------------------------------------------------------------
+from ..analysis.cost import declare_kernel_cost as _declare_cost
+from ..analysis.cost import _grid_of
+
+
+def _nbytes(aval):
+    import numpy as _onp
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n * _onp.dtype(aval.dtype).itemsize
+
+
+def _out_bytes(eqn):
+    return sum(_nbytes(v.aval) for v in eqn.outvars)
+
+
+@_declare_cost("_fa_kernel")
+def _cost_fa_fwd(eqn):
+    q, k, v = (a.aval for a in eqn.invars[:3])
+    bh, t, d = (int(x) for x in q.shape)
+    tk = int(k.shape[1])
+    grid = _grid_of(eqn)
+    nq = grid[1] if len(grid) == 3 else 1
+    return {
+        # qk^T and pv dots (causal masking not discounted: upper bound)
+        "flops": 4 * bh * t * tk * d,
+        "transcendentals": bh * t * tk + bh * t,      # exp + final log
+        # q resident across the inner k sweep; k/v re-fetched per q block
+        "bytes_read": _nbytes(q) + nq * (_nbytes(k) + _nbytes(v)),
+        "bytes_written": _out_bytes(eqn),             # out + lse
+    }
+
+
+@_declare_cost("_fa_dq_kernel")
+def _cost_fa_dq(eqn):
+    q, k, v, do = (a.aval for a in eqn.invars[:4])
+    bh, t, d = (int(x) for x in q.shape)
+    tk = int(k.shape[1])
+    grid = _grid_of(eqn)
+    nq = grid[1] if len(grid) == 3 else 1
+    rows = sum(_nbytes(a.aval) for a in eqn.invars[4:6])   # lse, delta
+    return {
+        "flops": 6 * bh * t * tk * d,                 # s, dp, ds·k dots
+        "transcendentals": bh * t * tk,               # p recompute
+        "bytes_read": _nbytes(q) + _nbytes(do) + rows
+        + nq * (_nbytes(k) + _nbytes(v)),
+        "bytes_written": _out_bytes(eqn),             # dq
+    }
+
+
+@_declare_cost("_fa_dkv_kernel")
+def _cost_fa_dkv(eqn):
+    q, k, v, do = (a.aval for a in eqn.invars[:4])
+    bh, t, d = (int(x) for x in q.shape)
+    tk = int(k.shape[1])
+    grid = _grid_of(eqn)
+    nk = grid[1] if len(grid) == 3 else 1
+    rows = sum(_nbytes(a.aval) for a in eqn.invars[4:6])
+    return {
+        "flops": 8 * bh * t * tk * d,          # s, dv, dp, dk dots
+        "transcendentals": bh * t * tk,
+        "bytes_read": _nbytes(k) + _nbytes(v)
+        + nk * (_nbytes(q) + _nbytes(do) + rows),
+        "bytes_written": _out_bytes(eqn),      # dk + dv
+    }
+
+
+@_declare_cost("_qmm_requant_kernel")
+def _cost_qmm(eqn):
+    x, w = eqn.invars[0].aval, eqn.invars[1].aval
+    m, kk = (int(d) for d in x.shape)
+    n = int(w.shape[1])
+    grid = _grid_of(eqn)
+    ni = grid[0] if len(grid) == 2 else 1
+    nj = grid[1] if len(grid) == 2 else 1
+    return {
+        "flops": 2 * m * n * kk + 3 * m * n,   # MXU dot + epilogue
+        "transcendentals": 0,
+        # x streamed once per N tile, w once per M tile, bias per tile
+        "bytes_read": nj * _nbytes(x) + ni * _nbytes(w)
+        + ni * _nbytes(eqn.invars[2].aval),
+        "bytes_written": _out_bytes(eqn),
+    }
+
+
+@_declare_cost("_conv3x3_kernel")
+def _cost_conv3x3(eqn):
+    xp, wcol = eqn.invars[0].aval, eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    cin9 = int(wcol.shape[0])                  # 9 * Cp
+    out_n = 1
+    for d in out.shape:
+        out_n *= int(d)
+    grid = _grid_of(eqn)
+    nh_tiles = (grid[0] * grid[1]) if len(grid) == 3 else 1
+    return {
+        "flops": 2 * out_n * cin9 + 2 * out_n,  # im2col GEMM + epilogue
+        "transcendentals": 0,
+        # the halo patch DMAs once per (n, h) tile (co reuses it); the
+        # weight/scale/shift tiles stream once per (n, h) tile
+        "bytes_read": _nbytes(xp)
+        + nh_tiles * (_nbytes(wcol) + _nbytes(eqn.invars[2].aval)
+                      + _nbytes(eqn.invars[3].aval)),
+        "bytes_written": _out_bytes(eqn),
+    }

@@ -305,12 +305,20 @@ class DataParallelTrainer:
             # XLA otherwise schedules per-gradient
             # (docs/perf_resnet50_tpu.md "levers measured and
             # rejected").  Kept env-gated for workloads with thousands
-            # of small params.
+            # of small params.  The FUSED Pallas update (docs/fusion.md)
+            # rides the same bucket machinery: one flat f32 space, one
+            # kernel pass — on by default on TPU for SGD/Adam, forced
+            # elsewhere via MXTPU_FUSED_OPTIMIZER=1.
+            from ..ops import fused_optimizer as _fused
+            fused_on = (_fused.fused_update_enabled()
+                        and _fused.supports(self._opt) is not None)
             groupable = type(self._opt).__name__ in \
                 _ELEMENTWISE_OPTIMIZERS \
-                and _os.environ.get("MXTPU_GROUP_UPDATES", "0") == "1"
+                and (_os.environ.get("MXTPU_GROUP_UPDATES", "0") == "1"
+                     or fused_on)
             max_group_elems = int(_os.environ.get(
-                "MXTPU_GROUP_MAX_ELEMS", str(65536)))
+                "MXTPU_GROUP_MAX_ELEMS",
+                str((1 << 62) if fused_on else 65536)))
             buckets = {}
             self._groups = []  # list of [name, ...]
             for name in self._train_names:
@@ -612,6 +620,9 @@ class DataParallelTrainer:
             "zero1_plan": plan.describe(),
             "runtime_peak_hbm_bytes": int(report.peak_hbm_bytes),
         })
+        # traced program + axis sizes for fusion_report (private: the
+        # fusion pass re-walks the same tape the cost pass priced)
+        shard._fusion_ctx = (closed, {self._data_axis: k})
         return report, findings, shard
 
     # -- multi-axis mesh tier (mxnet_tpu.transformer) ----------------------
@@ -932,6 +943,8 @@ class DataParallelTrainer:
         })
         if zp is not None:
             shard.extras["tp_zero1_plan"] = zp.describe()
+        # traced program + axis sizes for fusion_report (private)
+        shard._fusion_ctx = (closed, dict(plan.axis_sizes()))
         return report, findings, shard
 
     # -- mesh-tier checkpointing -------------------------------------------
@@ -1005,26 +1018,52 @@ class DataParallelTrainer:
     # -- the compiled step -------------------------------------------------
     def _apply_groups(self, train_vals, states, grads, lr, t):
         """Optimizer update for every group — traced inside the step jit
-        (single-process) or the update jit (dist split-step)."""
+        (single-process) or the update jit (dist split-step).  With the
+        fused Pallas update enabled (docs/fusion.md) a group's update
+        runs as ONE kernel pass over its flat f32 space instead of the
+        unfused elementwise eqn chain; numerics mirror
+        ``Optimizer.update`` exactly."""
+        from ..ops import fused_optimizer as _fused
+
         opt, groups = self._opt, self._groups
+        fused_on = (_fused.fused_update_enabled()
+                    and _fused.supports(opt) is not None)
         name_to_idx = {n: i for i, n in enumerate(self._train_names)}
         new_vals = [None] * len(train_vals)
         new_states = []
+
+        def _fused_flat(gi, wf, gf):
+            sf = jax.tree_util.tree_map(jnp.ravel, states[gi])
+            nwf, nsf = _fused.fused_optimizer_update(
+                opt, gi, wf.ravel(), gf.ravel(), sf, lr, t)
+            ns = jax.tree_util.tree_map(
+                lambda n, o: n.reshape(o.shape), nsf, states[gi])
+            return nwf, ns
+
         for gi, names in enumerate(groups):
             idxs = [name_to_idx[n] for n in names]
             if len(idxs) == 1:
                 i = idxs[0]
-                nw, ns = functional_optimizer_update(
-                    opt, gi, train_vals[i], grads[i], states[gi], lr, t)
+                if fused_on and train_vals[i].dtype == jnp.float32:
+                    nwf, ns = _fused_flat(gi, train_vals[i], grads[i])
+                    nw = nwf.reshape(train_vals[i].shape)
+                else:
+                    nw, ns = functional_optimizer_update(
+                        opt, gi, train_vals[i], grads[i], states[gi],
+                        lr, t)
                 new_vals[i] = nw
             else:
-                # fused bucket: one flat elementwise update for the
-                # whole group instead of len(group) small fusions
+                # fused bucket: one flat update for the whole group
+                # instead of len(group) small fusions — a single Pallas
+                # pass when the fused kernels are enabled
                 wf = jnp.concatenate(
                     [train_vals[i].ravel() for i in idxs])
                 gf = jnp.concatenate([grads[i].ravel() for i in idxs])
-                nwf, ns = functional_optimizer_update(
-                    opt, gi, wf, gf, states[gi], lr, t)
+                if fused_on and wf.dtype == jnp.float32:
+                    nwf, ns = _fused_flat(gi, wf, gf)
+                else:
+                    nwf, ns = functional_optimizer_update(
+                        opt, gi, wf, gf, states[gi], lr, t)
                 off = 0
                 for i in idxs:
                     sz = train_vals[i].size
@@ -1284,6 +1323,88 @@ class DataParallelTrainer:
                      PartitionSpec(self._data_axis), None, None, None]
         return _sp.propagate(closed, mesh, in_specs,
                              subject="DataParallelTrainer")
+
+    def fusion_report(self, data_shape=None, label_shape=None,
+                      data_dtype="float32", label_dtype="int32",
+                      declared_axis_size=None):
+        """mxfuse FusionReport of one training step
+        (``analysis/fusion.py``): the step tape segmented into fusable
+        chains ranked by modeled bytes-saved-if-fused.  Hardware-free;
+        a zero=1 trainer analyzes the runtime reduce-scatter/update/
+        all-gather spelling, a mesh_plan trainer the mesh-tier replica
+        step.  When telemetry is armed and the top chain covers more
+        than ``FUSION_HINT_MIN_PCT`` of step bytes, the dispatch /
+        collective phases are context-tagged ``fusable`` so ``telemetry
+        doctor`` names the fusion knob (docs/fusion.md)."""
+        import numpy as _onp
+
+        from ..analysis import fusion as _fusion
+
+        if self._plan is not None:
+            _, _, shard = self.mesh_report(data_shape=data_shape)
+            closed, axis_sizes = shard._fusion_ctx
+            report = _fusion.fusion_from_jaxpr(closed,
+                                               axis_sizes=axis_sizes)
+        elif self._zero:
+            _, _, shard = self.zero_report(
+                data_shape=data_shape, label_shape=label_shape,
+                data_dtype=data_dtype, label_dtype=label_dtype,
+                declared_axis_size=declared_axis_size)
+            closed, axis_sizes = shard._fusion_ctx
+            report = _fusion.fusion_from_jaxpr(closed,
+                                               axis_sizes=axis_sizes)
+        else:
+            if not self._ready:
+                if data_shape is None:
+                    raise ValueError(
+                        "trainer has not stepped yet: pass data_shape "
+                        "(and label_shape)")
+                x0 = NDArray(jnp.zeros(tuple(data_shape),
+                                       _onp.dtype(data_dtype)))
+                y0 = NDArray(jnp.zeros(
+                    tuple(label_shape or (data_shape[0],)),
+                    _onp.dtype(label_dtype)))
+                self._setup(x0, y0)
+            data_shape = tuple(data_shape)
+            label_shape = tuple(label_shape or (data_shape[0],))
+            train_vals = tuple(self._params_by_name[n].data()._data
+                               for n in self._train_names)
+            aux_vals = tuple(self._params_by_name[n].data()._data
+                             for n in self._aux_names)
+            states = tuple(self._states_raw)
+            x = jax.ShapeDtypeStruct(data_shape, _onp.dtype(data_dtype))
+            y = jax.ShapeDtypeStruct(label_shape,
+                                     _onp.dtype(label_dtype))
+            key = jax.ShapeDtypeStruct((2,), _onp.uint32)
+            fwd = self._fwd
+
+            def pure_step(train_vals, states, aux_vals, x, y, key, lr,
+                          t):
+                def loss_of(tv):
+                    outs, muts = fwd(tv, aux_vals, (x, y), key)
+                    return outs[0], muts
+
+                (loss_val, muts), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_vals)
+                new_vals, new_states = self._apply_groups(
+                    train_vals, states, grads, lr, t)
+                return loss_val, new_vals, new_states, muts
+
+            report = _fusion.fusion_from_fn(
+                pure_step, train_vals, states, aux_vals, x, y, key,
+                jnp.float32(0.01), jnp.int32(1))
+
+        self._last_fusion_report = report
+        # doctor follow-through: a dominant dispatch/collective phase
+        # plus a big fusable chain means the fusion knob is the hint
+        top = report.top_chain_pct
+        if _tele._ENABLED and top > _fusion.FUSION_HINT_MIN_PCT:
+            attr = _tele.attribution()
+            context = attr.snapshot().get("context") or {}
+            for phase in ("dispatch", "collective_or_ps"):
+                if phase not in context:
+                    attr.set_context(phase, "fusable")
+        return report
 
     def _build_grad_step(self):
         """Dist split-step, part 1: loss + local gradients (no update) —

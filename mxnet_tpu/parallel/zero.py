@@ -145,13 +145,23 @@ def build_parts(fwd, opt, plan, state_treedef):
         return g_sh, loss_val, muts
 
     def update_part(train_vals, state_leaves, g_sh, lr, t):
+        from ..ops import fused_optimizer as _fused
+
         flat_w = _flatten_pad(train_vals, plan, jnp)
         idx = lax.axis_index(axis)
         w_sh = lax.dynamic_slice(flat_w, (idx * shard,), (shard,))
         state = jax.tree_util.tree_unflatten(state_treedef,
                                              list(state_leaves))
-        new_w_sh, new_state = functional_optimizer_update(
-            opt, 0, w_sh, g_sh, state, lr, t)
+        if _fused.fused_update_enabled() and _fused.supports(opt):
+            # the rs → FUSED-update → ag spelling (docs/fusion.md): the
+            # shard-local optimizer chain runs as one Pallas pass over
+            # the owned 1/K slice; state stays physically sharded and
+            # the kernel's numerics mirror Optimizer.update exactly
+            new_w_sh, new_state = _fused.fused_optimizer_update(
+                opt, 0, w_sh, g_sh, state, lr, t)
+        else:
+            new_w_sh, new_state = functional_optimizer_update(
+                opt, 0, w_sh, g_sh, state, lr, t)
         if ZERO1_RUNTIME_ALL_GATHER:
             new_flat = lax.all_gather(new_w_sh, axis, tiled=True)
         else:

@@ -548,6 +548,17 @@ class Symbol:
         return analyze_symbol(self, shapes=shapes, type_dict=type_dict,
                               train=train, host_names=host_names)
 
+    def fusion_report(self, shapes, type_dict=None, train=False):
+        """mxfuse fusion-candidate report of this graph's forward
+        program (mxnet_tpu.analysis.fusion): the cost tape segmented
+        into fusable elementwise/broadcast/cast/reduction-epilogue
+        chains ranked by modeled bytes-saved-if-fused (docs/fusion.md).
+        Same tracing contract as ``cost_report``; returns a
+        ``FusionReport`` or None if the graph does not trace."""
+        from ..analysis.fusion import fusion_for_symbol
+        return fusion_for_symbol(self, shapes=shapes,
+                                 type_dict=type_dict, train=train)
+
     def shard_report(self, shapes, mesh_axes, in_specs=None,
                      type_dict=None, train=False, data_axis="data"):
         """mxshard global-view sharding propagation of this graph's

@@ -125,6 +125,20 @@ CONTEXT_HINTS = {
         "heads divide the sequence axis (2 all_to_alls vs a K-hop "
         "ppermute ring), or lower sequence_parallel "
         "(docs/transformer.md)",
+    # tagged by trainer.fusion_report() when the top fusable chain
+    # covers > FUSION_HINT_MIN_PCT of step bytes (docs/fusion.md)
+    ("dispatch", "fusable"):
+        "dispatch dominates and the fusion report ranks a chain "
+        "covering a large share of step bytes: enable the fused "
+        "optimizer update (MXTPU_FUSED_OPTIMIZER=1 off-TPU; on by "
+        "default on TPU) and check `--fusion` for further chains "
+        "(docs/fusion.md)",
+    ("collective_or_ps", "fusable"):
+        "the collective/update program dominates and the fusion "
+        "report ranks a chain covering a large share of step bytes: "
+        "the fused reduce-scatter→update→all-gather spelling "
+        "(MXTPU_FUSED_OPTIMIZER=1 off-TPU) collapses the shard-local "
+        "update to one HBM pass (docs/fusion.md)",
 }
 
 

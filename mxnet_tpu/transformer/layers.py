@@ -108,7 +108,19 @@ def copy_to_model(x, plan):
 
 
 def layer_norm(x, scale, bias, eps=1e-5):
-    """LayerNorm over the (replicated) feature dim — no collectives."""
+    """LayerNorm over the (replicated) feature dim — no collectives.
+
+    The layernorm→dense chain is a top-ranked mxfuse candidate
+    (docs/fusion.md): when the fused kernel is enabled (TPU with a
+    lane-aligned f32 feature dim, or ``MXTPU_FUSED_LAYERNORM=1``), the
+    normalization runs as ONE Pallas pass over HBM instead of the
+    mean/var/normalize eqn chain; numerics match this spelling to float
+    tolerance (tests/test_fusion.py) and the backward recomputes
+    statistics flash-style."""
+    from ..ops import fused_optimizer as _fused
+    if _fused.fused_layernorm_enabled(feature_dim=x.shape[-1],
+                                      dtype=x.dtype):
+        return _fused.fused_layer_norm(x, scale, bias, eps)
     mu = x.mean(axis=-1, keepdims=True)
     var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
     return (x - mu) * lax.rsqrt(var + eps) * scale + bias

@@ -100,6 +100,38 @@ def test_malformed_record_fails_fast(tmp_path):
         bc.load_record(str(bad))
 
 
+def test_fusion_keys_gated(tmp_path):
+    """The r06 fusion-stage keys gate like any other: a slower fused
+    update, a thinner modeled win, or a numerics drop all regress; the
+    zero-slack numerics gate bites on ANY drop from 1.0."""
+    def rec(n, parsed):
+        return {"n": n, "cmd": "bench", "rc": 0, "tail": "",
+                "parsed": parsed}
+    a = tmp_path / "BENCH_r06.json"
+    b = tmp_path / "BENCH_r07.json"
+    base = {"fused_optimizer_speedup_host": 2.2,
+            "modeled_fusion_bytes_saved_pct": 70.6,
+            "fusion_numerics_ok": 1.0}
+    a.write_text(json.dumps(rec(6, base)))
+    b.write_text(json.dumps(rec(7, dict(base))))
+    report = bc.compare([str(a), str(b)])
+    assert report["regressions"] == []
+    # speedup collapse past 10% regresses
+    b.write_text(json.dumps(rec(7, dict(base,
+                                        fused_optimizer_speedup_host=1.5))))
+    report = bc.compare([str(a), str(b)])
+    assert report["regressions"] == ["fused_optimizer_speedup_host"]
+    # modeled bytes-saved is near-deterministic: 2% rel
+    b.write_text(json.dumps(rec(
+        7, dict(base, modeled_fusion_bytes_saved_pct=60.0))))
+    report = bc.compare([str(a), str(b)])
+    assert report["regressions"] == ["modeled_fusion_bytes_saved_pct"]
+    # numerics: zero slack — any drop from 1.0 regresses
+    b.write_text(json.dumps(rec(7, dict(base, fusion_numerics_ok=0.0))))
+    report = bc.compare([str(a), str(b)])
+    assert report["regressions"] == ["fusion_numerics_ok"]
+
+
 def test_gate_math_directions(tmp_path):
     """lower_abs gates (overhead pcts near zero) use absolute slack;
     higher gates use relative tolerance."""

@@ -1,0 +1,651 @@
+"""Fusion tier (mxnet_tpu/analysis/fusion.py + ops/fused_optimizer.py;
+docs/fusion.md): chain segmentation goldens on hand-built jaxprs,
+byte-deterministic ranking, fused-vs-unfused optimizer numerics on CPU
+interpret mode, the ZeRO-1 composition (fused shard update bitwise-
+stable and tolerance-equal to the PR-13 runtime), the FUSED_OPTIMIZER
+mutation seam killed through the real STATIC_BUDGETS.json gate
+(subprocess rc=2, FUS001 named), the COST005 declared-cost lint, the
+`--fusion` CLI/schema-4 JSON section, the doctor's `fusable` context
+hint, and the host fusion-bench keys gated by bench_compare.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.analysis import fusion as mxfuse
+from mxnet_tpu.analysis.cost import build_tape
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ops import fused_optimizer as fo
+from mxnet_tpu.ops import optimizer_ops as oo
+from mxnet_tpu.parallel.trainer import DataParallelTrainer
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FLOAT_TOL = 1e-5
+
+
+def _cpu_env(devices=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if devices:
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=%d" % devices)
+    else:
+        env.pop("XLA_FLAGS", None)
+    env.pop("MXTPU_CHAOS", None)
+    env.pop("MXTPU_FUSED_OPTIMIZER", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+# ---------------------------------------------------------------------------
+# chain segmentation goldens on hand-built jaxprs
+# ---------------------------------------------------------------------------
+def _sgd_mom_chain(w, g, m, lr):
+    nm = 0.9 * m - lr * 1e-4 * w - lr * g
+    return w + nm, nm
+
+
+def test_elementwise_chain_found_and_ranked():
+    shapes = [(256, 128), (128,), (64, 32)]
+    avals = tuple(jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes)
+
+    def unfused(ws, gs, ms, lr):
+        outs = [_sgd_mom_chain(w, g, m, lr)
+                for w, g, m in zip(ws, gs, ms)]
+        return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+    rep = mxfuse.fusion_from_fn(unfused, avals, avals, avals,
+                                jnp.float32(0.1))
+    # one chain per parameter, ranked by bytes saved: largest first
+    assert len(rep.chains) == 3
+    assert [c.kind for c in rep.chains] == ["elementwise"] * 3
+    sizes = sorted((int(np.prod(s)) for s in shapes), reverse=True)
+    assert [c.bytes_saved for c in rep.chains] == \
+        sorted((c.bytes_saved for c in rep.chains), reverse=True)
+    # the biggest chain belongs to the biggest parameter
+    assert rep.chains[0].unfused_bytes > rep.chains[-1].unfused_bytes
+    assert rep.total_bytes_saved > 0 and rep.bytes_saved_pct > 40
+    assert sizes[0] > sizes[-1]  # geometry sanity
+
+
+def test_ranking_is_byte_deterministic():
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    closed = jax.make_jaxpr(
+        lambda w, g, m: _sgd_mom_chain(w, g, m, jnp.float32(0.1)))(
+        aval, aval, aval)
+    a = json.dumps(mxfuse.fusion_from_jaxpr(closed).as_dict(),
+                   sort_keys=True)
+    b = json.dumps(mxfuse.fusion_from_jaxpr(closed).as_dict(),
+                   sort_keys=True)
+    assert a == b
+
+
+def test_dot_breaks_chain():
+    aval = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+
+    def f(w, g):
+        h = jnp.tanh(w) * 2.0
+        y = h @ g                       # breaker
+        return y * 3.0 + 1.0
+
+    rep = mxfuse.fusion_from_fn(f, aval, aval)
+    assert len(rep.chains) == 2
+    for c in rep.chains:
+        assert "dot_general" not in c.prims
+
+
+def test_collective_breaks_chain():
+    aval = jax.ShapeDtypeStruct((64,), jnp.float32)
+
+    def f(w):
+        h = w * 2.0 + 1.0
+        r = lax.psum(h, "data")         # breaker
+        return r * 3.0 - 1.0
+
+    rep = mxfuse.fusion_from_fn(f, aval, axis_env=[("data", 8)])
+    for c in rep.chains:
+        assert "psum" not in c.prims
+    # the two elementwise pairs stay separate chains
+    assert len(rep.chains) == 2
+
+
+def test_relayout_movement_breaks_chain():
+    aval = jax.ShapeDtypeStruct((32, 64), jnp.float32)
+
+    def f(w):
+        h = w * 2.0 + 1.0
+        t = h.T.reshape(-1)             # transpose + reshape: breakers
+        return t * 3.0 - 1.0
+
+    rep = mxfuse.fusion_from_fn(f, aval)
+    assert len(rep.chains) == 2
+    for c in rep.chains:
+        assert not ({"transpose", "reshape"} & set(c.prims))
+
+
+def test_shared_buffer_counted_once():
+    """A chain reading the same external buffer through several eqns
+    bills it ONCE in the fused pass (the donated/in-place w of every
+    optimizer update)."""
+    n = 128 * 128
+    aval = jax.ShapeDtypeStruct((n,), jnp.float32)
+
+    def f(w):
+        a = w * 2.0
+        b = w + 1.0          # second read of w
+        return a * b
+
+    rep = mxfuse.fusion_from_fn(f, aval)
+    assert len(rep.chains) == 1
+    c = rep.chains[0]
+    assert c.external_in_bytes == n * 4          # w once, not twice
+    assert c.external_out_bytes == n * 4
+    # unfused: 3 eqns x (reads + writes); fused: w in, result out
+    assert c.fused_bytes == 2 * n * 4
+    assert c.bytes_saved == c.unfused_bytes - 2 * n * 4
+
+
+def test_normalization_chain_kind_with_reduction_epilogue():
+    aval = jax.ShapeDtypeStruct((32, 128), jnp.float32)
+    s = jax.ShapeDtypeStruct((128,), jnp.float32)
+
+    def ln(x, scale, bias):
+        mu = x.mean(axis=-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + 1e-5) * scale + bias
+
+    rep = mxfuse.fusion_from_fn(ln, aval, s, s)
+    assert len(rep.chains) == 1
+    c = rep.chains[0]
+    assert c.kind == "normalization"
+    assert any(p.startswith("reduce_") for p in c.prims)
+    assert c.bytes_saved > 0
+
+
+def test_scan_scale_uniform_within_chain():
+    aval = jax.ShapeDtypeStruct((64,), jnp.float32)
+
+    def f(x):
+        def body(c, _):
+            return c * 2.0 + 1.0, ()
+        out, _ = lax.scan(body, x, jnp.arange(4))
+        return out * 3.0 - 1.0
+
+    rep = mxfuse.fusion_from_jaxpr(jax.make_jaxpr(f)(aval))
+    # the scanned body chain (scale 4) never merges with the scale-1
+    # epilogue chain
+    scales = sorted(c.scale for c in rep.chains)
+    assert scales == [1, 4]
+
+
+# ---------------------------------------------------------------------------
+# fused kernels: numerics vs the unfused ops, bitwise rerun stability
+# ---------------------------------------------------------------------------
+def _rand(p, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(p).astype("f")),
+            jnp.asarray(rng.randn(p).astype("f")),
+            jnp.asarray(rng.randn(p).astype("f")),
+            jnp.asarray(np.abs(rng.randn(p)).astype("f")))
+
+
+@pytest.mark.parametrize("wd,clip", [(0.0, None), (1e-4, 0.5)])
+def test_fused_sgd_momentum_matches_unfused(wd, clip):
+    w, g, m, _ = _rand(5000)
+    lr = jnp.float32(0.05)
+    nw, nm = fo.fused_sgd_momentum(w, g, m, lr, momentum=0.9, wd=wd,
+                                   clip_gradient=clip, interpret=True)
+    rw, rm = oo.sgd_mom_update(w, g, m, lr=lr, momentum=0.9, wd=wd,
+                               clip_gradient=-1.0 if clip is None
+                               else clip)
+    assert float(jnp.max(jnp.abs(nw - rw))) <= FLOAT_TOL
+    assert float(jnp.max(jnp.abs(nm - rm))) <= FLOAT_TOL
+    nw2, nm2 = fo.fused_sgd_momentum(w, g, m, lr, momentum=0.9, wd=wd,
+                                     clip_gradient=clip, interpret=True)
+    assert (np.asarray(nw) == np.asarray(nw2)).all()
+    assert (np.asarray(nm) == np.asarray(nm2)).all()
+
+
+def test_fused_plain_sgd_matches_unfused():
+    w, g, _, _ = _rand(4096)
+    lr = jnp.float32(0.05)
+    nw = fo.fused_sgd(w, g, lr, wd=1e-4, interpret=True)
+    rw = oo.sgd_update(w, g, lr=lr, wd=1e-4)
+    assert float(jnp.max(jnp.abs(nw - rw))) <= FLOAT_TOL
+
+
+def test_fused_adam_matches_unfused():
+    w, g, m, v = _rand(5000, seed=2)
+    lr, t = jnp.float32(0.01), jnp.int32(3)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    lr_t = lr * ((1 - b2 ** t) ** 0.5) / (1 - b1 ** t)
+    nw, nm, nv = fo.fused_adam(w, g, m, v, lr_t, beta1=b1, beta2=b2,
+                               epsilon=eps, wd=1e-4, interpret=True)
+    rw, rm, rv = oo.adam_update(w, g, m, v, lr=lr_t, beta1=b1, beta2=b2,
+                                epsilon=eps, wd=1e-4)
+    for a, b in ((nw, rw), (nm, rm), (nv, rv)):
+        assert float(jnp.max(jnp.abs(a - b))) <= FLOAT_TOL
+
+
+def test_fused_update_zero_padding_tail_stays_zero():
+    """The resize-losslessness lemma survives the fused kernels: a zero
+    (w, g, state) tail maps to a zero tail (the flat space pads to
+    whole kernel tiles)."""
+    p = 5000                      # pads to 5120 inside the kernel
+    w = jnp.concatenate([jnp.ones((p - 100,)), jnp.zeros((100,))])
+    g = jnp.concatenate([jnp.ones((p - 100,)), jnp.zeros((100,))])
+    m = jnp.zeros((p,))
+    nw, nm = fo.fused_sgd_momentum(w, g, m, jnp.float32(0.1),
+                                   momentum=0.9, wd=1e-4,
+                                   interpret=True)
+    assert (np.asarray(nw)[-100:] == 0).all()
+    assert (np.asarray(nm)[-100:] == 0).all()
+
+
+def test_fused_layer_norm_matches_jnp_and_grads():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(6, 32).astype("f"))
+    s = jnp.asarray(rng.randn(32).astype("f"))
+    b = jnp.asarray(rng.randn(32).astype("f"))
+
+    def ref(x, s, b):
+        mu = x.mean(axis=-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + 1e-5) * s + b
+
+    got = fo.fused_layer_norm(x, s, b)
+    assert float(jnp.max(jnp.abs(got - ref(x, s, b)))) <= FLOAT_TOL
+    gr = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1, 2))(
+        x, s, b)
+    gf = jax.grad(lambda *a: (fo.fused_layer_norm(*a) ** 2).sum(),
+                  argnums=(0, 1, 2))(x, s, b)
+    for a, b2 in zip(gr, gf):
+        assert float(jnp.max(jnp.abs(a - b2))) <= 1e-4
+
+
+def test_transformer_layer_norm_routes_to_fused(monkeypatch):
+    from mxnet_tpu.transformer import layers as L
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(4, 64).astype("f"))
+    s = jnp.asarray(rng.randn(64).astype("f"))
+    b = jnp.asarray(rng.randn(64).astype("f"))
+    base = L.layer_norm(x, s, b)          # default host path: unfused
+    monkeypatch.setenv("MXTPU_FUSED_LAYERNORM", "1")
+    fused = L.layer_norm(x, s, b)
+    assert float(jnp.max(jnp.abs(base - fused))) <= FLOAT_TOL
+    # the fused spelling really is the Pallas kernel
+    closed = jax.make_jaxpr(lambda *a: L.layer_norm(*a))(x, s, b)
+    assert "pallas_call" in str(closed)
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: replicated fused-vs-unfused, ZeRO-1 composition
+# ---------------------------------------------------------------------------
+def _mlp_trainer(opt, params, zero=0, seed=3):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    return DataParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               opt, params, zero=zero)
+
+
+def _run_steps(trainer, n=4, seed=5):
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(n):
+        x = NDArray(jnp.asarray(rng.rand(8, 16).astype("f")))
+        y = NDArray(jnp.asarray(rng.randint(0, 10, 8)))
+        losses.append(float(trainer.step(x, y).asnumpy()))
+    trainer.flush()
+    params = [np.asarray(trainer._params_by_name[n_].data()._data)
+              for n_ in trainer._train_names]
+    return losses, params
+
+
+@pytest.mark.parametrize("opt,oparams", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_replicated_trainer_fused_matches_unfused(monkeypatch, opt,
+                                                  oparams):
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "0")
+    l0, p0 = _run_steps(_mlp_trainer(opt, oparams))
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    l1, p1 = _run_steps(_mlp_trainer(opt, oparams))
+    l2, p2 = _run_steps(_mlp_trainer(opt, oparams))
+    assert max(np.max(np.abs(a - b)) for a, b in zip(p0, p1)) <= FLOAT_TOL
+    assert max(abs(a - b) for a, b in zip(l0, l1)) <= FLOAT_TOL
+    # fused path is bitwise-deterministic across runs
+    assert all((a == b).all() for a, b in zip(p1, p2))
+    assert l1 == l2
+
+
+def test_fused_kernel_traced_in_replicated_step(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_OPTIMIZER", "1")
+    tr = _mlp_trainer("sgd", {"learning_rate": 0.1, "momentum": 0.9})
+    rep = tr.cost_report(data_shape=(8, 16), label_shape=(8,))
+    assert "pallas_call" in rep.per_primitive
+    assert rep.unpriced_kernels == []
+    # all four params fused into ONE flat bucket
+    assert len(tr._groups) == 1 and len(tr._groups[0]) == 4
+
+
+def test_zero1_fused_composition_subprocess(tmp_path):
+    """The ZeRO-1 composition (ISSUE 15): on a real 4-way data axis the
+    rs → FUSED-update → ag spelling matches the PR-13 unfused
+    build_runtime_fns params within float tolerance, and the fused run
+    repeats bitwise at equal steps (state still physically sharded)."""
+    script = tmp_path / "zero_fused.py"
+    script.write_text(textwrap.dedent("""\
+        import os, sys
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import numpy as np, jax, jax.numpy as jnp
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon
+        from mxnet_tpu.ndarray import NDArray
+        from mxnet_tpu.parallel.trainer import DataParallelTrainer
+
+        assert len(jax.devices()) == 4
+
+        def trainer(seed=3):
+            mx.random.seed(seed); np.random.seed(seed)
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Dense(32, activation="relu"))
+            net.add(gluon.nn.Dense(10))
+            net.initialize(mx.init.Xavier())
+            return DataParallelTrainer(
+                net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                {"learning_rate": 0.1, "momentum": 0.9}, zero=1)
+
+        def run(n=5):
+            t = trainer()
+            rng = np.random.RandomState(7)
+            for _ in range(n):
+                x = NDArray(jnp.asarray(rng.rand(8, 16).astype("f")))
+                y = NDArray(jnp.asarray(rng.randint(0, 10, 8)))
+                t.step(x, y)
+            t.flush()
+            state = t._states_raw[0]
+            leaves = jax.tree_util.tree_leaves(state)
+            # the optimizer state is PHYSICALLY sharded 4 ways
+            for leaf in leaves:
+                assert len(leaf.sharding.device_set) == 4
+            params = [np.asarray(t._params_by_name[n_].data()._data)
+                      for n_ in t._train_names]
+            return params
+
+        os.environ["MXTPU_FUSED_OPTIMIZER"] = "0"
+        p_unfused = run()
+        os.environ["MXTPU_FUSED_OPTIMIZER"] = "1"
+        p_fused = run()
+        p_fused2 = run()
+        err = max(np.max(np.abs(a - b))
+                  for a, b in zip(p_unfused, p_fused))
+        assert err <= 1e-5, "fused-vs-unfused zero1 err %g" % err
+        assert all((a == b).all() for a, b in zip(p_fused, p_fused2)), \\
+            "fused zero1 rerun not bitwise"
+        print("ZERO1_FUSED_OK err=%g" % err)
+        """))
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=_cpu_env(devices=4), timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ZERO1_FUSED_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the budget gate: FUS001 + the FUSED_OPTIMIZER mutation seam
+# ---------------------------------------------------------------------------
+def test_fused_budget_model_clean_and_pinned():
+    from mxnet_tpu.analysis.budget_models import (
+        build_model, fused_update_fusion_numbers)
+    rep, findings, shard = build_model("fused_optimizer_update")
+    assert findings == []
+    n = fused_update_fusion_numbers()
+    # declared-vs-tape parity is EXACT at the pinned geometry (sgd)
+    assert n["sgd"]["kernel_bytes"] == n["sgd"]["chain_fused_bytes"]
+    assert abs(n["adam"]["kernel_bytes"]
+               - n["adam"]["chain_fused_bytes"]) <= 256
+    assert n["sgd"]["saved_pct"] > 60 and n["adam"]["saved_pct"] > 70
+    assert rep.transfer_bytes == 0 and rep.collective_bytes == 0
+
+
+def test_fused_seam_kills_budget_gate(tmp_path):
+    """Acceptance: FUSED_OPTIMIZER=False fails the UNMODIFIED
+    STATIC_BUDGETS.json gate rc=2 naming FUS001 — from a subprocess."""
+    script = tmp_path / "mutate.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu.ops import fused_optimizer\n"
+        "fused_optimizer.FUSED_OPTIMIZER = False\n"
+        "from mxnet_tpu.analysis.__main__ import main\n"
+        "sys.exit(main(['--cost', '--budget', %r]))\n"
+        % os.path.join(REPO, "STATIC_BUDGETS.json"))
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=_cpu_env(), timeout=600)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "FUS001" in proc.stdout
+    assert "fused_optimizer_update" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# COST005: the declared-cost lint + unpriced kernels named on the tape
+# ---------------------------------------------------------------------------
+def test_shipped_kernels_all_declare_costs():
+    from mxnet_tpu.analysis import lint_kernel_costs
+    from mxnet_tpu.analysis.cost import KERNEL_COSTS
+    assert lint_kernel_costs() == []
+    kernels, dynamic = mxfuse.pallas_kernels_used()
+    assert dynamic == []
+    assert set(kernels) <= set(KERNEL_COSTS)
+    # the flash kernels are in the sweep (their annotation re-priced
+    # ring_attention_fwd honestly)
+    assert {"_fa_kernel", "_fa_dq_kernel", "_fa_dkv_kernel",
+            "_fused_sgd_mom_kernel", "_fused_adam_kernel"} <= \
+        set(kernels)
+
+
+def test_unannotated_kernel_named_by_lint(tmp_path):
+    opsdir = tmp_path / "ops"
+    opsdir.mkdir()
+    (opsdir / "rogue.py").write_text(textwrap.dedent("""\
+        import functools
+        from jax.experimental import pallas as pl
+
+        def _rogue_kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        def rogue(x):
+            kernel = functools.partial(_rogue_kernel)
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """))
+    findings = mxfuse.lint_kernel_costs(root=str(opsdir))
+    assert [f.rule_id for f in findings] == ["COST005"]
+    assert "_rogue_kernel" in findings[0].message
+
+
+def test_unpriced_kernel_named_on_tape():
+    from jax.experimental import pallas as pl
+
+    def _anon_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def f(x):
+        return pl.pallas_call(
+            _anon_kernel,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=True)(x)
+
+    tape = build_tape(jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((8, 128), jnp.float32)))
+    assert tape.unpriced_kernels == ["_anon_kernel"]
+    from mxnet_tpu.analysis.cost import analyze_tape, unpriced_findings
+    rep = analyze_tape(tape)
+    assert rep.unpriced_kernels == ["_anon_kernel"]
+    rules = [f.rule_id for f in unpriced_findings(rep)]
+    assert "COST005" in rules
+
+
+def test_flash_kernels_priced_by_declaration():
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+    q = jax.ShapeDtypeStruct((2, 128, 4, 32), jnp.float32)
+    closed = jax.make_jaxpr(
+        lambda q, k, v: flash_attention(q, k, v, causal=True))(q, q, q)
+    tape = build_tape(closed)
+    pall = [op for op in tape.ops if op.prim == "pallas_call"]
+    assert len(pall) == 1
+    assert pall[0].params["kernel"] == "_fa_kernel"
+    # declared flops: qk + pv dots = 4 * BH*T*Tk*D
+    assert pall[0].flops == 4 * 8 * 128 * 128 * 32
+    assert tape.unpriced_kernels == []
+
+
+# ---------------------------------------------------------------------------
+# report hooks: Symbol / trainer / CLI / schema
+# ---------------------------------------------------------------------------
+def test_symbol_fusion_report():
+    from mxnet_tpu import symbol as sym
+    data = sym.var("data")
+    h = sym.FullyConnected(data, num_hidden=8, name="fu_fc1")
+    a = sym.Activation(h, act_type="relu", name="fu_relu")
+    out = sym.FullyConnected(a, num_hidden=4, name="fu_fc2")
+    net = sym.SoftmaxOutput(out, name="fu_softmax")
+    rep = net.fusion_report(shapes={"data": (4, 16)})
+    assert rep is not None and rep.n_eqns > 0
+    assert rep.chains and rep.total_bytes_saved > 0
+
+
+def test_trainer_fusion_report_zero1():
+    tr = _mlp_trainer("sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                      zero=1)
+    rep = tr.fusion_report(data_shape=(8, 16), label_shape=(8,),
+                           declared_axis_size=8)
+    assert rep.chains
+    # the shard-local update chain is found in the runtime spelling
+    assert any(c.kind == "elementwise" for c in rep.chains)
+
+
+def test_trainer_fusion_report_mesh_tier():
+    from mxnet_tpu.analysis.budget_models import (TP_GEOMETRY,
+                                                  _tp_plan_and_program)
+    from mxnet_tpu.parallel.mesh import MeshPlan
+    g = TP_GEOMETRY
+    _, _, block = _tp_plan_and_program()
+    tr = DataParallelTrainer(
+        block, None, "sgd",
+        {"learning_rate": g["lr"], "momentum": g["momentum"]},
+        mesh_plan=MeshPlan(data=g["data"], model=g["model"],
+                           sequence=g["sequence"]))
+    rep = tr.fusion_report(data_shape=(g["batch"], g["seq_len"]))
+    assert rep.chains and rep.total_bytes_saved > 0
+
+
+def test_cli_fusion_json_schema4():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--cost",
+         "--fusion", "--json", "--model", "fused_optimizer_update"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["schema_version"] == 4
+    fus = payload["fusion"]["fused_optimizer_update"]
+    assert fus["n_chains"] >= 1 and fus["total_bytes_saved"] > 0
+    assert fus["chains"][0]["kind"] == "elementwise"
+    # without --fusion the section is absent (pre-4 consumers unaffected)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--cost", "--json",
+         "--model", "mlp_infer"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert "fusion" not in json.loads(proc.stdout)
+
+
+def test_parse_log_reads_fusion_rows():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    doc = {"version": 1, "schema_version": 4, "findings": [],
+           "fusion": {"m": {"total_bytes_saved": 9, "bytes_saved_pct":
+                            50.0, "top_chain_pct": 30.0, "n_chains": 2,
+                            "chains": []}}}
+    rows = dict(parse_log.parse_analysis_json(doc))
+    assert rows["fusion.m.total_bytes_saved"] == 9
+    assert rows["fusion.m.top_chain_pct"] == 30.0
+
+
+# ---------------------------------------------------------------------------
+# doctor follow-through: the fusable context hint
+# ---------------------------------------------------------------------------
+def test_fusion_report_sets_fusable_context(tmp_path):
+    from mxnet_tpu import telemetry
+    telemetry.enable(str(tmp_path), rank=0, role="worker")
+    try:
+        tr = _mlp_trainer("sgd", {"learning_rate": 0.1,
+                                  "momentum": 0.9})
+        rep = tr.fusion_report(data_shape=(64, 16), label_shape=(64,))
+        assert rep.top_chain_pct > mxfuse.FUSION_HINT_MIN_PCT
+        ctx = telemetry.attribution().snapshot()["context"]
+        assert ctx.get("dispatch") == "fusable"
+        assert ctx.get("collective_or_ps") == "fusable"
+    finally:
+        telemetry.disable()
+
+
+def test_doctor_names_fusion_knob(tmp_path):
+    """A rank whose metrics dump shows dispatch dominant with the
+    fusable context tag gets the fusion knob named in its hint."""
+    from mxnet_tpu.telemetry.attribution import doctor_report
+    dump = {
+        "schema_version": 1,
+        "attribution": {
+            "steps": 100, "wall_s": 10.0,
+            "phases_s": {"dispatch": 7.0, "input_wait": 1.0},
+            "unattributed_s": 2.0, "step_p50_s": 0.1, "anomalies": 0,
+            "context": {"dispatch": "fusable"},
+        },
+    }
+    with open(os.path.join(str(tmp_path), "metrics-worker0-1.json"),
+              "w") as f:
+        json.dump(dump, f)
+    report = doctor_report(str(tmp_path))
+    rec = report["ranks"]["worker0"]
+    assert rec["dominant_phase"] == "dispatch"
+    assert "fus" in rec["hint"]
+    assert "docs/fusion.md" in rec["hint"]
+
+
+# ---------------------------------------------------------------------------
+# bench stage + bench_compare gates
+# ---------------------------------------------------------------------------
+def test_fusion_bench_keys():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.fusion_bench"],
+        capture_output=True, text=True, cwd=REPO, env=_cpu_env(),
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["fusion_numerics_ok"] == 1.0
+    assert rec["fused_optimizer_speedup_host"] > 1.0
+    assert rec["modeled_fusion_bytes_saved_pct"] > 60

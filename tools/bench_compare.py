@@ -54,6 +54,15 @@ Gated metrics (direction, tolerance)::
     tp_numerics_ok                     higher, zero slack (mesh losses
                                        must equal the replicated
                                        baseline: 1.0 or regression)
+    fused_optimizer_speedup_host       higher, 10% relative (measured
+                                       unfused vs fused update on the
+                                       1-core host, >= 1.2x expected)
+    modeled_fusion_bytes_saved_pct     higher, 2% relative (modeled:
+                                       deterministic fusion win of the
+                                       optimizer chain)
+    fusion_numerics_ok                 higher, zero slack (fused must
+                                       equal unfused Optimizer.update:
+                                       1.0 or regression)
 
 A metric with fewer than two live occurrences has no prior bar and
 passes vacuously (the r01–r05 lineage: ``value`` is live in r01+r02,
@@ -127,6 +136,15 @@ GATES = {
     "tp_modeled_model_axis_bytes": ("lower_rel", 0.02),
     "seqpar_tokens_per_sec_host": ("higher", 0.10),
     "tp_numerics_ok": ("higher", 0.0),
+    # fusion stage (r06 onward): the measured fused-vs-unfused optimizer
+    # update speedup on the 1-core host (10% rel — wall time on a noisy
+    # host); the modeled bytes-saved of the optimizer chain is
+    # deterministic (2% covers intentional geometry retunes shipped
+    # with their PR); fused-vs-unfused numerics is a hard contract —
+    # any drop from 1.0 is a kernel regression, zero slack
+    "fused_optimizer_speedup_host": ("higher", 0.10),
+    "modeled_fusion_bytes_saved_pct": ("higher", 0.02),
+    "fusion_numerics_ok": ("higher", 0.0),
 }
 
 _RECORD_KEYS = ("n", "cmd", "rc", "parsed")

@@ -27,8 +27,9 @@ import sys
 # the newest metrics-JSON schema this parser understands
 METRICS_SCHEMA_VERSION = 1
 # the newest analysis-CLI (--json) schema this parser understands
-# (3 = the mxshard "shard" section; see docs/analysis.md)
-ANALYSIS_SCHEMA_VERSION = 3
+# (3 = the mxshard "shard" section, 4 = the mxfuse "fusion" section;
+# see docs/analysis.md)
+ANALYSIS_SCHEMA_VERSION = 4
 
 
 def parse(lines):
@@ -118,6 +119,12 @@ def parse_analysis_json(doc):
         for k, v in sorted(rep.get("extras", {}).items()):
             if isinstance(v, (int, float)):
                 rows.append(("shard.%s.%s" % (model, k), v))
+    for model, rep in sorted(doc.get("fusion", {}).items()):
+        for metric in ("total_bytes_saved", "bytes_saved_pct",
+                       "top_chain_pct", "n_chains"):
+            if metric in rep:
+                rows.append(("fusion.%s.%s" % (model, metric),
+                             rep[metric]))
     return rows
 
 
