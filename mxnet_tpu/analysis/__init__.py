@@ -44,6 +44,9 @@ from .fusion import (FusionReport, FusionChain, analyze_tape_fusion,
                      fusion_for_symbol, lint_kernel_costs,
                      FUSION_HINT_MIN_PCT)
 from .dist_lint import lint_dist_step, lint_trainer, dist_summary
+from .race_lint import (lint_race_source, lint_race_file,
+                        lint_threaded_sources, lock_order_findings,
+                        parse_hierarchy, race_summary, threaded_targets)
 from .shard_prop import (MeshSpec, ShardSpec, ShardReport, propagate,
                          collective_schedule, lint_sharded_step,
                          lint_ring_schedule, lint_global_sharding,
@@ -77,6 +80,9 @@ __all__ = [
     "fusion_from_jaxpr", "fusion_from_fn", "fusion_for_symbol",
     "lint_kernel_costs", "FUSION_HINT_MIN_PCT", "KERNEL_COSTS",
     "declare_kernel_cost",
+    "lint_race_source", "lint_race_file", "lint_threaded_sources",
+    "lock_order_findings", "parse_hierarchy", "race_summary",
+    "threaded_targets",
 ]
 
 
@@ -89,7 +95,8 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 
 def self_check(disable=(), with_coverage=True, with_cost=True,
                with_examples=True, with_workers=True, with_serving=True,
-               with_telemetry=True, with_shard=True, with_mlops=True):
+               with_telemetry=True, with_shard=True, with_mlops=True,
+               with_race=True):
     """Registry lint over the live registry, the rule-table docs sync
     check, the cost-pass determinism check, the SRC004 sweep over the
     shipped training loops, the SRC005 sweep over the shipped worker
@@ -102,7 +109,11 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
     (``shard_self_check``) and the shipped ring/Ulysses attention paths
     must pass the mixed-axis DST rules (``lint_parallel_sources``) —
     and the declared-cost sweep over the shipped Pallas kernels
-    (``lint_kernel_costs``, COST005) — what CI runs.
+    (``lint_kernel_costs``, COST005) — plus the mxrace concurrency
+    sweep over every threaded host module (``lint_threaded_sources``:
+    RACE001-RACE005, the lock-order/hierarchy sync against
+    ``docs/concurrency.md``, and race-report determinism) — what CI
+    runs.
 
     Returns the findings list; clean means the shipped registry is sound
     (every severity counts: ``--self-check`` exits non-zero on warnings).
@@ -127,6 +138,8 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
     if with_shard:
         findings += shard_self_check(disable=disable)
         findings += lint_parallel_sources(disable=disable)
+    if with_race:
+        findings += lint_threaded_sources(disable=disable)
     if with_cost:
         # the declared-cost sweep (COST005): every shipped pallas_call
         # must price itself — an un-annotated kernel fails CI here

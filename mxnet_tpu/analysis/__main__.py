@@ -10,6 +10,11 @@ Targets:
                           models, or — with ``--budget FILE`` — the
                           STATIC_BUDGETS.json CI gate (COST001/COST002)
                           including each trainer model's DST lint
+  ``--race``              mxrace concurrency lint (docs/concurrency.md):
+                          over a ``script.py`` target, or — bare — the
+                          whole-repo sweep of the threaded host tiers
+                          plus the lock-order/hierarchy sync; adds the
+                          ``race`` section to ``--json`` (schema 5)
   ``script.py``           AST source lint for trace-time traps
   ``symbol.json``         graph lint a saved Symbol (``Symbol.save``)
 
@@ -113,6 +118,14 @@ def main(argv=None):
                         "bytes-saved-if-fused over the budget models' "
                         "unfused spellings (docs/fusion.md); adds the "
                         "'fusion' section to --json (schema_version 4)")
+    p.add_argument("--race", action="store_true",
+                   help="mxrace concurrency lint: of a .py target, or "
+                        "(bare) the whole-repo sweep over the threaded "
+                        "host tiers — lock-guard inference, lock-order/"
+                        "hierarchy sync, blocking-under-lock, thread "
+                        "lifecycle, callback discipline "
+                        "(docs/concurrency.md); adds the 'race' section "
+                        "to --json (schema_version 5)")
     p.add_argument("--hbm-cap", type=int, default=0, dest="hbm_cap",
                    help="with --serving: flag buckets whose modeled peak "
                         "HBM exceeds this many bytes (SRV003)")
@@ -137,6 +150,28 @@ def main(argv=None):
               else render_text(findings, title="mxlint --self-check"))
         # the shipped registry must be clean: warnings fail too
         return exit_code(findings, strict=True)
+
+    if args.race:
+        from .race_lint import (lint_race_file, lint_threaded_sources,
+                                race_summary)
+        if args.target:
+            findings = lint_race_file(args.target, disable=disable)
+            title = "mxrace %s" % args.target
+            print(render_json(findings) if args.as_json
+                  else render_text(findings, title=title))
+            return exit_code(findings, strict=args.strict)
+        findings = lint_threaded_sources(disable=disable)
+        if args.as_json:
+            print(render_json(findings, race=race_summary()))
+        else:
+            print(render_text(findings, title="mxrace sweep"))
+            summary = race_summary()
+            print("mxrace: %d files, %d locks, %d guarded attrs, "
+                  "%d lock-order edges (%d pinned)"
+                  % (summary["n_files"], len(summary["locks"]),
+                     len(summary["guards"]), len(summary["edges"]),
+                     len(summary["hierarchy"])))
+        return exit_code(findings, strict=args.strict)
 
     if args.cost and not (args.target and args.target.endswith(".json")):
         return _run_cost(args, disable)

@@ -180,6 +180,28 @@ RULES = {
                        "body walk (wrong in both directions) behind a "
                        "zero-cost connector — register a "
                        "declare_kernel_cost model"),
+    # race pass (mxnet_tpu/analysis/race_lint.py, "mxrace")
+    "RACE001": (ERROR, "lock-guard violation: an attribute mutated under "
+                       "a lock in one method is read/iterated/written "
+                       "bare elsewhere (the PR-6 _key_owner bug class) — "
+                       "concurrent mutation can corrupt the bare access"),
+    "RACE002": (ERROR, "lock-order hazard: an acquired-while-holding "
+                       "cycle (potential deadlock), or the observed "
+                       "edge set drifted from the pinned "
+                       "docs/concurrency.md lock-hierarchy table in "
+                       "either direction"),
+    "RACE003": (ERROR, "blocking call under a held lock: socket/RPC "
+                       "I/O, unbounded queue get/join, sleep, "
+                       "subprocess, or a chaos.maybe_inject site (which "
+                       "can delay or raise) inside a lock region stalls "
+                       "every contending thread"),
+    "RACE004": (ERROR, "Thread started with neither daemon=True nor a "
+                       "registered join/shutdown path — it outlives "
+                       "shutdown and hangs interpreter exit"),
+    "RACE005": (ERROR, "user/foreign callback invoked while holding the "
+                       "owner's lock (the PR-6 watchdog class): the "
+                       "callback can call back in (deadlock) or block "
+                       "the owner unboundedly"),
     # fusion pass (mxnet_tpu/analysis/fusion.py)
     "FUS001": (ERROR, "fused-kernel byte contract broken: the fused "
                       "spelling's modeled HBM bytes do not realize the "

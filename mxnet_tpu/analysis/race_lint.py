@@ -1,0 +1,1024 @@
+"""Concurrency lint ("mxrace"): AST analysis of the threaded host tiers.
+
+The reference stack's core is a threaded dependency engine
+(``src/engine/threaded_engine.h``): push threads, worker pools and the
+engine share mutable state behind mutexes.  Our TPU rebuild keeps that
+concurrency in its *host* tiers — the PS server's serve threads, the
+heartbeat watchdog, the serving batcher/fleet, the pipeline supervisor
+— and hand review has already caught real shipped races there (PR 6:
+the unlocked ``_key_owner`` iteration inside the watchdog callback).
+mxrace turns that bug class into a hardware-free static gate, in the
+mxlint house style: parse, infer, emit Findings.
+
+Five rules:
+
+- **RACE001 lock-guard inference** — per class, every ``self.X``
+  access is classified by the set of ``with self._lock:`` regions held
+  at that point.  An attribute *written* under a lock anywhere is
+  inferred guarded by it; any access holding none of the guard set
+  (outside ``__init__``) is a race candidate — the exact
+  ``_key_owner`` class of bug.
+- **RACE002 lock-order** — ``with B:`` inside ``with A:`` is an
+  acquired-while-holding edge ``A -> B``.  Cycles across the swept
+  modules are potential deadlocks; ``docs/concurrency.md`` pins the
+  sanctioned acquisition order and the sweep checks the table both
+  ways (an observed edge missing from the table, or a pinned row no
+  longer observed, fails — the DOC001/TEL001 sync pattern).
+- **RACE003 blocking-under-lock** — socket/RPC I/O, unbounded
+  ``queue.get``/``join``, ``sleep``, subprocess calls and
+  ``chaos.maybe_inject`` sites (which can delay or raise by design)
+  inside a held region serialize every sibling of that lock behind
+  I/O — and turn a chaos delay into a server-wide stall.
+- **RACE004 thread lifecycle** — a ``Thread(...)`` started with
+  neither ``daemon=True`` nor a join/shutdown path outlives shutdown
+  and hangs interpreter exit.
+- **RACE005 callback-under-lock** — invoking a user/foreign callback
+  while holding the owner's lock (the PR-6 watchdog class): the
+  callback can call back in (deadlock) or block the owner for an
+  unbounded time.
+
+The analysis is intra-class with one interprocedural refinement:
+private helpers (``self._foo()``) inherit the lock set their callers
+*always* hold (the ``*_locked`` helper convention), computed to a
+fixpoint.  The rules are heuristic (python is dynamic); deliberate
+exceptions carry a trailing ``# mxlint: disable=RACEnnn`` with a
+justification comment — policy in docs/concurrency.md.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding, filter_findings
+
+__all__ = ["lint_race_source", "lint_race_file", "lint_threaded_sources",
+           "lock_order_findings", "parse_hierarchy", "race_summary",
+           "threaded_targets"]
+
+# threading.X() / X() calls that create a mutual-exclusion region
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+# thread-safe (or thread-handle) objects: excluded from guard inference
+_SAFE_FACTORIES = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+                   "PriorityQueue", "Semaphore", "BoundedSemaphore",
+                   "Barrier", "Thread", "local"}
+# method calls that mutate their receiver in place
+_MUTATORS = {"append", "extend", "insert", "add", "remove", "discard",
+             "pop", "popitem", "clear", "update", "setdefault",
+             "appendleft", "popleft", "sort", "reverse"}
+# attribute calls that block on I/O regardless of arguments
+_BLOCKING_IO = {"recv", "recv_into", "recvfrom", "accept", "connect",
+                "sendall", "makefile", "communicate", "check_output",
+                "check_call"}
+# attribute calls that block only in their zero-positional-arg /
+# unbounded spelling (queue.get(), thread.join(); dict.get(k) and
+# " ".join(xs) take positionals)
+_BLOCKING_NOARG = {"get", "join", "put"}
+# names that look like user-provided callbacks when called
+_CALLBACK_NAME = re.compile(
+    r"(callback|_cb$|^cb$|cbs$|hook|listener|handler|^on_[a-z_]+$)")
+
+
+def _is_factory(node, names):
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
+    return name in names
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "method", "lineno", "held")
+
+    def __init__(self, attr, kind, method, lineno, held):
+        self.attr, self.kind = attr, kind
+        self.method, self.lineno = method, lineno
+        self.held = frozenset(held)
+
+
+class _Owner:
+    """One lock scope: a class (``self.X`` locks) or the module itself
+    (module-global locks used by module-level functions)."""
+
+    def __init__(self, name, is_module=False):
+        self.name = name
+        self.is_module = is_module
+        self.locks = set()         # lock attrs/globals
+        self.lock_dicts = set()    # dicts filled with per-key locks
+        self.lock_methods = set()  # methods returning a lock
+        self.safe = set()          # Event/Queue/Thread attrs (skip RACE001)
+        self.methods = {}          # name -> FunctionDef (class mode)
+        self.foreign = set()       # attrs assigned straight from a parameter
+        self.globals = set()       # module-level names (module mode)
+        self.accesses = []         # _Access records (final pass)
+        self.call_sites = {}       # method -> [frozenset(held), ...]
+        self.entry = {}            # method -> frozenset(held at entry)
+        self.callers = {}          # method -> {caller qualnames}
+        self.init_only = set()     # private methods reachable only
+        #                            from __init__ (pre-thread setup)
+
+    def prefix(self, lock):
+        return "%s.%s" % (self.name, lock)
+
+    def attr_of(self, node, shadow=()):
+        """The tracked name a node refers to: ``self.X`` in class mode,
+        an unshadowed module global in module mode."""
+        if self.is_module:
+            if isinstance(node, ast.Name) and node.id not in shadow and \
+                    (node.id in self.locks or node.id in self.globals):
+                return node.id
+            return None
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        return None
+
+    def lock_of(self, node, aliases, shadow=()):
+        """Canonical (unprefixed) lock name for an acquisition
+        expression, or None: ``self._lock`` / ``self._locks[k]`` /
+        ``self._key_lock(k)`` / a local alias of one."""
+        a = self.attr_of(node, shadow)
+        if a is not None:
+            return a if a in self.locks else None
+        if isinstance(node, ast.Call):
+            fa = self.attr_of(node.func, shadow)
+            if fa is not None and fa in self.lock_methods:
+                return fa + "()"
+        if isinstance(node, ast.Subscript):
+            va = self.attr_of(node.value, shadow)
+            if va is not None and va in self.lock_dicts:
+                return va + "[]"
+        if isinstance(node, ast.Name):
+            al = aliases.get(node.id)
+            if al is not None and al[0] == "lock":
+                return al[1]
+        return None
+
+
+def _root_attr(owner, node, shadow=()):
+    """Innermost tracked attr under subscript/attribute chains:
+    ``self.X[k]`` -> X, ``self.X.y[k]`` -> X, alias-free."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        a = owner.attr_of(node, shadow)
+        if a is not None:
+            return a
+        node = node.value
+    return owner.attr_of(node, shadow)
+
+
+def _inferred_guard(owner, accs):
+    """-> (guard lock set, runtime locked writes) for one attribute's
+    accesses.  ``__init__`` and init-only setup methods are excluded
+    from inference: their writes run before any sibling thread exists,
+    so they neither establish nor violate a guard."""
+    locked_writes = [a for a in accs
+                     if a.kind == "w" and a.held
+                     and a.method != "__init__"
+                     and a.method not in owner.init_only]
+    if not locked_writes:
+        return set(), []
+    guard = set(locked_writes[0].held)
+    for a in locked_writes[1:]:
+        guard &= a.held
+    return guard, locked_writes
+
+
+class _ThreadSite:
+    __slots__ = ("lineno", "daemon", "binding", "func")
+
+    def __init__(self, lineno, daemon, binding, func):
+        self.lineno, self.daemon = lineno, daemon
+        self.binding, self.func = binding, func
+
+
+class _Analyzer:
+    """Whole-module analysis: builds owners, runs the entry-lock
+    fixpoint, then a collecting walk that records accesses, edges and
+    direct findings."""
+
+    def __init__(self, tree, filename, suppressed):
+        self.tree = tree
+        self.filename = filename
+        self.suppressed = suppressed
+        self.findings = []
+        self.edges = []            # (outer, inner, "file:line") prefixed
+        self.thread_sites = []
+        self.joined = set()        # ("attr"/"name", name) / ("func", qual)
+        self.daemon_set = set()    # same keys as joined
+        self._seen_threads = set()
+        self._emitted = set()
+        self.owners = []
+
+    # -- collection -------------------------------------------------------
+    def build(self):
+        mod = _Owner(os.path.splitext(os.path.basename(self.filename))[0],
+                     is_module=True)
+        for st in self.tree.body:
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                if _is_factory(st.value, _LOCK_FACTORIES):
+                    mod.locks.add(name)
+                elif name != "__all__":
+                    mod.globals.add(name)
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.methods[st.name] = st
+        self.owners.append(mod)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self.owners.append(self._build_class(node))
+
+    def _build_class(self, cls):
+        owner = _Owner(cls.name)
+        for st in cls.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                owner.methods[st.name] = st
+        for fn in owner.methods.values():
+            params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
+                      if a.arg != "self"}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    val = node.value
+                    for t in node.targets:
+                        a = owner.attr_of(t)
+                        if a is None:
+                            # self.X[k] = threading.Lock(): per-key dict
+                            if isinstance(t, ast.Subscript):
+                                va = owner.attr_of(t.value)
+                                if va and _is_factory(val, _LOCK_FACTORIES):
+                                    owner.lock_dicts.add(va)
+                            continue
+                        if _is_factory(val, _LOCK_FACTORIES):
+                            owner.locks.add(a)
+                        elif _is_factory(val, _SAFE_FACTORIES):
+                            owner.safe.add(a)
+                        elif isinstance(val, ast.Name) and val.id in params:
+                            owner.foreign.add(a)
+                elif isinstance(node, ast.Call):
+                    f = node.func
+                    # self.X.setdefault(k, threading.Lock())
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr == "setdefault" and len(node.args) > 1 \
+                            and _is_factory(node.args[1], _LOCK_FACTORIES):
+                        va = owner.attr_of(f.value)
+                        if va:
+                            owner.lock_dicts.add(va)
+        for name, fn in owner.methods.items():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    if any(_is_factory(sub, _LOCK_FACTORIES)
+                           for sub in ast.walk(node.value)):
+                        owner.lock_methods.add(name)
+                        break
+        return owner
+
+    # -- walking ----------------------------------------------------------
+    def run(self):
+        self.build()
+        # fixpoint: private helpers inherit the intersection of their
+        # observed callers' held sets (the *_locked convention)
+        for _ in range(4):
+            for owner in self.owners:
+                owner.call_sites = {}
+            self._walk_all(collect=False)
+            changed = False
+            for owner in self.owners:
+                new = {}
+                for m in owner.methods:
+                    if not m.startswith("_") or m.startswith("__"):
+                        continue
+                    sites = owner.call_sites.get(m)
+                    if sites:
+                        new[m] = frozenset.intersection(*sites)
+                if new != owner.entry:
+                    owner.entry = new
+                    changed = True
+            if not changed:
+                break
+        for owner in self.owners:
+            owner.call_sites = {}
+            owner.callers = {}
+            owner.accesses = []
+        self._walk_all(collect=True)
+        for owner in self.owners:
+            owner.init_only = self._init_only(owner)
+            self._guard_findings(owner)
+        self._thread_findings()
+
+    @staticmethod
+    def _init_only(owner):
+        """Private methods whose every observed caller is ``__init__``
+        or another init-only method: pre-thread setup (e.g. a WAL
+        ``_recover`` that runs before the socket binds) shares the
+        ``__init__`` exemption.  Closure quals (``x.<locals>.y``) never
+        qualify as callers — a closure defined in ``__init__`` may be a
+        thread target that runs much later."""
+        out = set()
+        changed = True
+        while changed:
+            changed = False
+            for m in owner.methods:
+                if m in out or not m.startswith("_") or m.startswith("__"):
+                    continue
+                callers = owner.callers.get(m)
+                if callers and all(c == "__init__" or c in out
+                                   for c in callers):
+                    out.add(m)
+                    changed = True
+        return out
+
+    def _walk_all(self, collect):
+        for owner in self.owners:
+            for name, fn in sorted(owner.methods.items()):
+                entry = sorted(owner.entry.get(name, ()))
+                _Walker(self, owner, name, entry, collect, fn).walk()
+
+    # -- emission ---------------------------------------------------------
+    def emit(self, rule, lineno, msg):
+        if rule in self.suppressed.get(lineno, ()):
+            return
+        key = (rule, lineno, msg)
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.findings.append(
+            Finding(rule, "%s:%d" % (self.filename, lineno), msg))
+
+    def add_edge(self, outer, inner, lineno):
+        if "RACE002" in self.suppressed.get(lineno, ()):
+            return
+        self.edges.append((outer, inner,
+                           "%s:%d" % (self.filename, lineno)))
+
+    # -- RACE001 ----------------------------------------------------------
+    def _guard_findings(self, owner):
+        by_attr = {}
+        for acc in owner.accesses:
+            by_attr.setdefault(acc.attr, []).append(acc)
+        for attr in sorted(by_attr):
+            accs = by_attr[attr]
+            guard, locked_writes = _inferred_guard(owner, accs)
+            if not locked_writes:
+                continue
+            rep = min(locked_writes, key=lambda a: (a.lineno, a.method))
+            if not guard:
+                self.emit("RACE001", rep.lineno,
+                          "attribute '%s' of %s is written under "
+                          "inconsistent lock sets across methods — no "
+                          "single lock guards it; pick one lock and hold "
+                          "it at every mutation" % (attr, owner.name))
+                continue
+            pretty = " or ".join(sorted(owner.prefix(g) for g in guard))
+            seen = set()
+            for a in sorted(accs, key=lambda a: (a.lineno, a.kind)):
+                if a.method == "__init__" or a.method in owner.init_only \
+                        or (a.held & guard):
+                    continue
+                if a.lineno in seen:
+                    continue
+                seen.add(a.lineno)
+                self.emit("RACE001", a.lineno,
+                          "attribute '%s' of %s is %s here without %s, "
+                          "but %s() mutates it under that lock (line %d) "
+                          "— a concurrent mutation can corrupt or resize "
+                          "it mid-access (the PR-6 _key_owner bug class)"
+                          % (attr, owner.name,
+                             "written" if a.kind == "w" else "read",
+                             pretty, rep.method, rep.lineno))
+
+    # -- RACE004 ----------------------------------------------------------
+    def note_thread(self, call, binding, func):
+        if id(call) in self._seen_threads:
+            return
+        self._seen_threads.add(id(call))
+        daemon = any(k.arg == "daemon" and
+                     isinstance(k.value, ast.Constant) and
+                     k.value.value is True for k in call.keywords)
+        self.thread_sites.append(
+            _ThreadSite(call.lineno, daemon, binding, func))
+
+    def _thread_findings(self):
+        for site in sorted(self.thread_sites, key=lambda s: s.lineno):
+            if site.daemon:
+                continue
+            if site.binding and (site.binding in self.joined or
+                                 site.binding in self.daemon_set):
+                continue
+            if ("func", site.func) in self.joined:
+                continue
+            self.emit("RACE004", site.lineno,
+                      "Thread started with neither daemon=True nor a "
+                      "join/shutdown path (no .join() or .daemon=True "
+                      "found for it) — a non-daemon thread with no "
+                      "registered join outlives shutdown and hangs "
+                      "interpreter exit")
+
+
+class _Walker:
+    """Statement walker for one method/function: tracks the held-lock
+    stack through ``with`` regions and explicit acquire/release."""
+
+    def __init__(self, an, owner, qual, entry_held, collect, fn,
+                 shadow=None):
+        self.an, self.owner, self.qual = an, owner, qual
+        self.held = list(entry_held)
+        self.collect = collect
+        self.fn = fn
+        self.aliases = {}     # local name -> ("lock", l)|("attr", a)|("cb",)
+        if shadow is not None:
+            self.shadow = shadow
+        elif owner.is_module:
+            self.shadow = self._shadowed(fn)
+        else:
+            self.shadow = frozenset()
+
+    @staticmethod
+    def _shadowed(fn):
+        """Names local to fn (params + assigned without ``global``)."""
+        hidden, globs = set(), set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globs.update(node.names)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                hidden.add(node.id)
+        for a in fn.args.args + fn.args.kwonlyargs:
+            hidden.add(a.arg)
+        return frozenset(hidden - globs)
+
+    def walk(self):
+        self.block(self.fn.body)
+
+    # -- statements -------------------------------------------------------
+    def block(self, stmts):
+        for st in stmts:
+            self.stmt(st)
+
+    def stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure runs later (often as a thread target): fresh
+            # held set, accesses still belong to this owner
+            _Walker(self.an, self.owner,
+                    "%s.<locals>.%s" % (self.qual, st.name), (),
+                    self.collect, st, shadow=self.shadow).walk()
+        elif isinstance(st, ast.ClassDef):
+            pass   # nested classes get their own owner via build()
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            self._with(st)
+        elif isinstance(st, ast.Assign):
+            self._assign(st)
+        elif isinstance(st, ast.AugAssign):
+            self._write_target(st.target, also_read=True)
+            self.expr(st.value)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._write_target(st.target)
+                self.expr(st.value)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._write_target(t)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._for(st)
+        else:
+            for _field, value in ast.iter_fields(st):
+                if isinstance(value, list):
+                    if value and isinstance(value[0], ast.stmt):
+                        self.block(value)
+                    else:
+                        for v in value:
+                            if isinstance(v, ast.expr):
+                                self.expr(v)
+                            elif isinstance(v, ast.stmt):
+                                self.stmt(v)
+                            elif isinstance(v, (ast.excepthandler,)):
+                                self.block(v.body)
+                elif isinstance(value, ast.expr):
+                    self.expr(value)
+
+    def _with(self, st):
+        acquired = []
+        for item in st.items:
+            ln = self.owner.lock_of(item.context_expr, self.aliases,
+                                    self.shadow)
+            if ln is not None:
+                # record the helper call site before entering the region
+                if isinstance(item.context_expr, ast.Call) and \
+                        ln.endswith("()"):
+                    self.owner.call_sites.setdefault(ln[:-2], []).append(
+                        frozenset(self.held))
+                    self.owner.callers.setdefault(
+                        ln[:-2], set()).add(self.qual)
+                    for a in item.context_expr.args:
+                        self.expr(a)
+                if self._acquire(ln, item.context_expr.lineno):
+                    acquired.append(ln)
+            else:
+                self.expr(item.context_expr)
+            if item.optional_vars is not None:
+                self._write_target(item.optional_vars)
+        self.block(st.body)
+        for ln in reversed(acquired):
+            self.held.remove(ln)
+
+    def _acquire(self, ln, lineno):
+        if self.held and ln not in self.held and self.collect:
+            self.an.add_edge(self.owner.prefix(self.held[-1]),
+                             self.owner.prefix(ln), lineno)
+        if ln not in self.held:
+            self.held.append(ln)
+            return True
+        return False
+
+    def _assign(self, st):
+        val = st.value
+        if len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
+            tname = st.targets[0].id
+            ln = self.owner.lock_of(val, self.aliases, self.shadow)
+            a = self.owner.attr_of(val, self.shadow)
+            if ln is not None:
+                self.aliases[tname] = ("lock", ln)
+            elif a is not None:
+                self.aliases[tname] = ("attr", a)
+            else:
+                self.aliases.pop(tname, None)
+        # thread creation bindings: self.X = Thread(...) / t = Thread(...)
+        # / threads = [Thread(...) for ...]
+        tcalls = [n for n in ast.walk(val)
+                  if isinstance(n, ast.Call) and _is_factory(n, {"Thread"})]
+        if tcalls and st.targets:
+            binding = self._binding_key(st.targets[0])
+            for c in tcalls:
+                self.an.note_thread(c, binding, self.qual)
+        # t.daemon = True / self.X.daemon = True
+        for t in st.targets:
+            if isinstance(t, ast.Attribute) and t.attr == "daemon" and \
+                    isinstance(val, ast.Constant) and val.value is True:
+                key = self._binding_key(t.value)
+                if key:
+                    self.an.daemon_set.add(key)
+        for t in st.targets:
+            self._write_target(t)
+        self.expr(val)
+
+    def _binding_key(self, node):
+        a = None
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self":
+            a = ("attr", node.attr)
+        elif isinstance(node, ast.Name):
+            a = ("name", node.id)
+        return a
+
+    def _for(self, st):
+        self.expr(st.iter)
+        # `for cb in self._callbacks:` — mark the loop var callback-ish
+        it_attr = _root_attr(self.owner, st.iter, self.shadow)
+        if it_attr and _CALLBACK_NAME.search(it_attr):
+            for n in ast.walk(st.target):
+                if isinstance(n, ast.Name):
+                    self.aliases[n.id] = ("cb",)
+        else:
+            self._write_target(st.target, record=False)
+        self.block(st.body)
+        self.block(st.orelse)
+
+    def _write_target(self, t, also_read=False, record=True):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._write_target(t=e, also_read=also_read, record=record)
+            return
+        if isinstance(t, ast.Starred):
+            self._write_target(t.value, also_read=also_read, record=record)
+            return
+        if not record:
+            return
+        attr = None
+        a = self.owner.attr_of(t, self.shadow)
+        if a is not None:
+            attr = a
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            attr = _root_attr(self.owner, t, self.shadow)
+            if attr is None and isinstance(t.value, ast.Name):
+                al = self.aliases.get(t.value.id)
+                if al is not None and al[0] == "attr":
+                    attr = al[1]   # e = self._entries[k]; e.field = v
+            # the subscript/index expressions are reads
+            for _field, value in ast.iter_fields(t):
+                if isinstance(value, ast.expr) and value is not t.value:
+                    self.expr(value)
+            if isinstance(t.value, (ast.Subscript, ast.Attribute)):
+                self.expr(t.value)
+        if attr is not None:
+            self._record(attr, "w", t.lineno)
+            if also_read:
+                self._record(attr, "r", t.lineno)
+
+    # -- expressions ------------------------------------------------------
+    def _record(self, attr, kind, lineno):
+        if not self.collect:
+            return
+        o = self.owner
+        if attr in o.locks or attr in o.lock_dicts or attr in o.safe or \
+                attr in o.methods or attr in o.lock_methods:
+            return
+        o.accesses.append(_Access(attr, kind, self.qual, lineno, self.held))
+
+    def expr(self, e):
+        if e is None:
+            return
+        if isinstance(e, ast.Call):
+            self._call(e)
+            return
+        if isinstance(e, ast.Lambda):
+            # lambdas in this codebase are synchronous predicates/keys
+            # (cv.wait_for re-acquires before evaluating; sort keys run
+            # inline) — unlike def closures (thread targets), they
+            # inherit the current held set
+            w = _Walker(self.an, self.owner,
+                        "%s.<locals>.<lambda>" % self.qual,
+                        tuple(self.held), self.collect, e,
+                        shadow=self.shadow)
+            w.expr(e.body)
+            return
+        a = self.owner.attr_of(e, self.shadow)
+        if a is not None:
+            if isinstance(getattr(e, "ctx", None), (ast.Store, ast.Del)):
+                self._record(a, "w", e.lineno)
+            else:
+                self._record(a, "r", e.lineno)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, (ast.expr, ast.comprehension)):
+                if isinstance(child, ast.comprehension):
+                    self.expr(child.iter)
+                    for c in child.ifs:
+                        self.expr(c)
+                else:
+                    self.expr(child)
+
+    def _call(self, e):
+        f = e.func
+        fattr = f.attr if isinstance(f, ast.Attribute) else None
+        fname = f.id if isinstance(f, ast.Name) else None
+        recv_attr = self.owner.attr_of(f.value, self.shadow) \
+            if isinstance(f, ast.Attribute) else None
+
+        # mutator: self.X.append(...) / self.X[k].update(...)
+        if fattr in _MUTATORS and isinstance(f, ast.Attribute):
+            root = _root_attr(self.owner, f.value, self.shadow)
+            if root is None and isinstance(f.value, ast.Name):
+                al = self.aliases.get(f.value.id)
+                if al is not None and al[0] == "attr":
+                    root = al[1]
+            if root is not None:
+                self._record(root, "w", e.lineno)
+
+        # explicit acquire/release on a known lock
+        if fattr == "acquire":
+            ln = self.owner.lock_of(f.value, self.aliases, self.shadow)
+            if ln is not None:
+                self._acquire(ln, e.lineno)
+        elif fattr == "release":
+            ln = self.owner.lock_of(f.value, self.aliases, self.shadow)
+            if ln is not None and ln in self.held:
+                self.held.remove(ln)
+
+        # thread creation not bound by an Assign (e.g. Thread(...).start())
+        if _is_factory(e, {"Thread"}):
+            self.an.note_thread(e, None, self.qual)
+
+        # join/shutdown bookkeeping for RACE004
+        if fattr == "join" and not e.args and isinstance(f, ast.Attribute):
+            key = self._binding_key(f.value)
+            if key:
+                self.an.joined.add(key)
+            self.an.joined.add(("func", self.qual))
+
+        # interprocedural: self._helper(...) call sites
+        if recv_attr is None and isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" and \
+                f.attr in self.owner.methods:
+            self.owner.call_sites.setdefault(f.attr, []).append(
+                frozenset(self.held))
+            self.owner.callers.setdefault(f.attr, set()).add(self.qual)
+
+        if self.held and self.collect:
+            self._blocking(e, f, fattr, fname, recv_attr)
+            self._callback(e, f, fattr, fname, recv_attr)
+
+        for a in e.args:
+            self.expr(a)
+        for k in e.keywords:
+            self.expr(k.value)
+        if isinstance(f, ast.Attribute):
+            self.expr(f.value)
+        elif not isinstance(f, ast.Name):
+            self.expr(f)
+
+    def _blocking(self, e, f, fattr, fname, recv_attr):
+        held = " while holding %s" % ", ".join(
+            self.owner.prefix(h) for h in self.held)
+        reason = None
+        if fattr == "sleep" or fname == "sleep":
+            reason = "sleep()"
+        elif fattr == "maybe_inject" or fname == "maybe_inject":
+            reason = "chaos.maybe_inject() — a chaos fault can delay " \
+                     "or raise here"
+        elif fattr in _BLOCKING_IO:
+            reason = "blocking I/O .%s()" % fattr
+        elif fattr == "wait":
+            # cv.wait() releases the cv it waits on: only the *sole*
+            # held lock being the waited condition is safe
+            if not (recv_attr is not None and self.held and
+                    recv_attr == self.held[-1] and len(self.held) == 1):
+                reason = ".wait() that does not release the held lock"
+        elif fattr in _BLOCKING_NOARG and not e.args and \
+                not any(k.arg in ("timeout", "block") for k in e.keywords):
+            reason = "unbounded .%s()" % fattr
+        elif fattr in ("run", "call", "Popen") and \
+                isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "subprocess":
+            reason = "subprocess.%s()" % fattr
+        if reason is not None:
+            self.an.emit("RACE003", e.lineno,
+                         "%s%s: every thread contending for that lock "
+                         "stalls behind this call (and a chaos "
+                         "delay/raise under a lock becomes a "
+                         "server-wide stall)" % (reason, held))
+
+    def _callback(self, e, f, fattr, fname, recv_attr):
+        cb = None
+        if recv_attr is not None and fattr is None:
+            pass
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            a = f.attr
+            if a not in self.owner.methods and \
+                    (a in self.owner.foreign or _CALLBACK_NAME.search(a)):
+                cb = "self.%s" % a
+        elif isinstance(f, ast.Name):
+            al = self.aliases.get(f.id)
+            if al is not None and al[0] == "cb":
+                cb = f.id
+            elif al is not None and al[0] == "attr" and \
+                    al[1] not in self.owner.methods and \
+                    (al[1] in self.owner.foreign or
+                     _CALLBACK_NAME.search(al[1])):
+                cb = f.id
+            elif _CALLBACK_NAME.search(f.id):
+                cb = f.id
+        if cb is not None:
+            self.an.emit("RACE005", e.lineno,
+                         "callback %s(...) invoked while holding %s: a "
+                         "user/foreign callable under the owner's lock "
+                         "can call back in (deadlock) or block the owner "
+                         "unboundedly — copy state under the lock, call "
+                         "outside (the heartbeat-watchdog fix)"
+                         % (cb, ", ".join(self.owner.prefix(h)
+                                          for h in self.held)))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _line_suppressions(source):
+    from .source_lint import _line_suppressions as impl
+    return impl(source)
+
+
+def _analyze_source(source, filename):
+    """-> (findings, edges, owners) for one module."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        raise ValueError("cannot parse %s: %s" % (filename, e))
+    an = _Analyzer(tree, filename, _line_suppressions(source))
+    an.run()
+    return an.findings, an.edges, an.owners
+
+
+def lint_race_source(source, filename="<string>", disable=()):
+    """Race-lint one module's source text: the per-module rules
+    (RACE001/003/004/005) plus cycle detection over the module's own
+    lock-order edges.  The cross-module hierarchy sync runs in
+    :func:`lint_threaded_sources`."""
+    findings, edges, _ = _analyze_source(source, filename)
+    findings = findings + lock_order_findings(edges)
+    return filter_findings(findings, disable)
+
+
+def lint_race_file(path, disable=()):
+    with open(path) as f:
+        return lint_race_source(f.read(), filename=path, disable=disable)
+
+
+def _dedup_edges(edges):
+    """(outer, inner) -> first site, deterministically."""
+    out = {}
+    for outer, inner, site in sorted(edges):
+        out.setdefault((outer, inner), site)
+    return out
+
+
+def parse_hierarchy(path):
+    """The pinned lock-order rows of docs/concurrency.md: markdown
+    table rows whose 2nd and 3rd columns are backticked lock names
+    (``| n | `Outer.lock` | `Inner.lock` | where |``)."""
+    with open(path) as f:
+        text = f.read()
+    return [(m.group(1), m.group(2)) for m in re.finditer(
+        r"^\|[^|`]*\|\s*`([A-Za-z_][\w.()\[\]]*)`\s*\|"
+        r"\s*`([A-Za-z_][\w.()\[\]]*)`\s*\|", text, re.M)]
+
+
+def _sccs(nodes, adj):
+    """Tarjan SCCs, deterministic order."""
+    index, low, on, stack, out = {}, {}, set(), [], []
+    counter = [0]
+
+    def strong(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in adj.get(v, ()):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            scc = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                scc.append(w)
+                if w == v:
+                    break
+            out.append(sorted(scc))
+
+    for v in sorted(nodes):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def lock_order_findings(edges, hierarchy_path=None, disable=()):
+    """RACE002 over acquired-while-holding edges: cycles are potential
+    deadlocks; when ``hierarchy_path`` is given, the docs table must
+    match the observed edge set both ways."""
+    findings = []
+    dedup = _dedup_edges(edges)
+    nodes = set()
+    adj = {}
+    for (outer, inner), _site in dedup.items():
+        nodes.add(outer)
+        nodes.add(inner)
+        adj.setdefault(outer, []).append(inner)
+    for k in adj:
+        adj[k].sort()
+    for scc in _sccs(nodes, adj):
+        cyclic = len(scc) > 1 or (scc[0] in adj.get(scc[0], ()))
+        if not cyclic:
+            continue
+        sites = sorted(site for (o, i), site in dedup.items()
+                       if o in scc and i in scc)
+        findings.append(Finding(
+            "RACE002", sites[0] if sites else "lock-order",
+            "potential deadlock: lock-order cycle through %s (acquire "
+            "sites: %s) — two threads entering the cycle from different "
+            "ends block each other forever; pick one order and pin it "
+            "in docs/concurrency.md" % (" -> ".join(scc),
+                                        ", ".join(sites))))
+    if hierarchy_path is not None and os.path.isfile(hierarchy_path):
+        pinned = set(parse_hierarchy(hierarchy_path))
+        observed = set(dedup)
+        for outer, inner in sorted(observed - pinned):
+            findings.append(Finding(
+                "RACE002", dedup[(outer, inner)],
+                "acquired-while-holding edge %s -> %s is not pinned in "
+                "the docs/concurrency.md lock-hierarchy table — add the "
+                "row (same PR) or fix the nesting" % (outer, inner)))
+        for outer, inner in sorted(pinned - observed):
+            findings.append(Finding(
+                "RACE002", "docs/concurrency.md",
+                "pinned lock-order row %s -> %s is no longer observed "
+                "in the swept sources — drop the stale row so the table "
+                "stays the single source of truth" % (outer, inner)))
+    return filter_findings(findings, disable)
+
+
+def _repo_root():
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(pkg)            # mxnet_tpu/
+    repo = os.path.dirname(root)
+    if not os.path.isfile(os.path.join(root, "kvstore_ps.py")):
+        return None
+    return repo
+
+
+def threaded_targets():
+    """The swept modules, repo-relative and sorted: every threaded host
+    tier (ISSUE 16) — PS server/client, serving, resilience, io,
+    telemetry, mlops, the engine, and the tools/ CLIs."""
+    repo = _repo_root()
+    if repo is None:
+        return []
+    rels = ["mxnet_tpu/engine.py", "mxnet_tpu/kvstore_ps.py",
+            "mxnet_tpu/kvstore_server.py"]
+    for pkg in ("io", "mlops", "resilience", "serving", "telemetry"):
+        d = os.path.join(repo, "mxnet_tpu", pkg)
+        if os.path.isdir(d):
+            rels += ["mxnet_tpu/%s/%s" % (pkg, f)
+                     for f in os.listdir(d) if f.endswith(".py")]
+    tools = os.path.join(repo, "tools")
+    if os.path.isdir(tools):
+        rels += ["tools/%s" % f for f in os.listdir(tools)
+                 if f.endswith(".py")]
+    return sorted(r for r in rels
+                  if os.path.isfile(os.path.join(repo, r)))
+
+
+def _sweep_once():
+    """-> (per-file findings, edges, owners-by-file), repo-relative."""
+    repo = _repo_root()
+    findings, edges, owners = [], [], []
+    if repo is None:
+        return findings, edges, owners
+    for rel in threaded_targets():
+        with open(os.path.join(repo, rel)) as f:
+            source = f.read()
+        try:
+            found, es, own = _analyze_source(source, rel)
+        except ValueError:
+            continue
+        findings += found
+        edges += es
+        owners.append((rel, own))
+    return findings, edges, owners
+
+
+def lint_threaded_sources(disable=(), hierarchy=None):
+    """The mxrace sweep ``--self-check`` runs: every threaded host
+    module race-linted, the lock-order graph checked for cycles and
+    synced against the docs/concurrency.md hierarchy table both ways,
+    and the whole report checked for determinism (two analyses of the
+    same sources must agree — the COST003 contract)."""
+    repo = _repo_root()
+    if repo is None:
+        return []
+    findings, edges, _owners = _sweep_once()
+    if hierarchy is None:
+        hierarchy = os.path.join(repo, "docs", "concurrency.md")
+    findings = findings + lock_order_findings(edges, hierarchy)
+    f2, e2, _ = _sweep_once()
+    f2 = f2 + lock_order_findings(e2, hierarchy)
+    if [str(f) for f in findings] != [str(f) for f in f2]:
+        findings.append(Finding(
+            "COST003", "race_self_check",
+            "two runs of the race pass over the same sources produced "
+            "different reports — the race gate would flap in CI"))
+    return filter_findings(findings, disable)
+
+
+def race_summary(hierarchy=None):
+    """The ``--json`` ``race`` section (schema_version 5): the sweep's
+    lock inventory, the inferred guard map, the deduplicated
+    acquired-while-holding edges and the pinned hierarchy —
+    deterministically ordered throughout."""
+    repo = _repo_root()
+    if repo is None:
+        return {"n_files": 0, "locks": [], "guards": {}, "edges": [],
+                "hierarchy": []}
+    _findings, edges, owners = _sweep_once()
+    locks, guards = set(), {}
+    for _rel, owns in owners:
+        for o in owns:
+            for l in o.locks:
+                locks.add(o.prefix(l))
+            for m in o.lock_methods:
+                locks.add(o.prefix(m + "()"))
+            by_attr = {}
+            for acc in o.accesses:
+                by_attr.setdefault(acc.attr, []).append(acc)
+            for attr in sorted(by_attr):
+                guard, lw = _inferred_guard(o, by_attr[attr])
+                if not lw:
+                    continue
+                if guard:
+                    guards[o.prefix(attr)] = sorted(
+                        o.prefix(g) for g in guard)
+    dedup = _dedup_edges(edges)
+    if hierarchy is None:
+        hierarchy = os.path.join(repo, "docs", "concurrency.md")
+    pinned = parse_hierarchy(hierarchy) \
+        if os.path.isfile(hierarchy) else []
+    return {
+        "n_files": len(owners),
+        "locks": sorted(locks),
+        "guards": {k: guards[k] for k in sorted(guards)},
+        "edges": [{"outer": o, "inner": i, "site": s}
+                  for (o, i), s in sorted(dedup.items())],
+        "hierarchy": [[o, i] for o, i in sorted(set(pinned))],
+    }

@@ -25,8 +25,9 @@ __all__ = ["render_text", "render_json", "worst_severity", "exit_code",
 # bumped in PR 4 (cost/dist sections + the field itself); 3 adds the
 # shard section (mxshard collective schedules) and the
 # unpriced_collectives row inside each cost report; 4 adds the fusion
-# section (mxfuse chain rankings) and the unpriced_kernels row
-SCHEMA_VERSION = 4
+# section (mxfuse chain rankings) and the unpriced_kernels row; 5 adds
+# the race section (mxrace lock inventory/guards/edges/hierarchy)
+SCHEMA_VERSION = 5
 
 
 def _sorted(findings):
@@ -46,11 +47,13 @@ def render_text(findings, title="mxlint"):
     return "\n".join(lines)
 
 
-def render_json(findings, cost=None, dist=None, shard=None, fusion=None):
+def render_json(findings, cost=None, dist=None, shard=None, fusion=None,
+                race=None):
     """``cost``: {target_name: CostReport-or-dict}; ``dist``: the
     dist_summary dict; ``shard``: the shard_summary dict; ``fusion``:
-    {target_name: FusionReport-or-dict} (schema 4).  Sections appear
-    only when provided."""
+    {target_name: FusionReport-or-dict} (schema 4); ``race``: the
+    race_summary dict (schema 5).  Sections appear only when
+    provided."""
     counts = Counter(f.severity for f in findings)
     payload = {
         "version": 1,
@@ -70,6 +73,8 @@ def render_json(findings, cost=None, dist=None, shard=None, fusion=None):
         payload["fusion"] = {
             name: (rep.as_dict() if hasattr(rep, "as_dict") else rep)
             for name, rep in sorted(fusion.items())}
+    if race is not None:
+        payload["race"] = race
     return json.dumps(payload, indent=2)
 
 

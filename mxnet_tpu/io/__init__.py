@@ -499,7 +499,8 @@ class DeviceFeedIter(DataIter):
     def live_slots_max(self):
         """Most prefetched batches simultaneously device-resident so far
         (must never exceed ``depth`` — the HBM bound the tests assert)."""
-        return self._live_max
+        with self._live_lock:
+            return self._live_max
 
     @property
     def provide_data(self):

@@ -289,12 +289,17 @@ class PSServer:
         self._thread.start()
 
     def _metrics_samples(self):
+        # the scrape thread must not read the WAL counters mid-append:
+        # snapshot both under the lock that guards their mutation
+        with self._state_lock:
+            wal_seq = self._wal_seq
+            pushes_since_snap = self._pushes_since_snap
         samples = [
-            ("mxtpu_ps_wal_seq", {}, self._wal_seq),
+            ("mxtpu_ps_wal_seq", {}, wal_seq),
             ("mxtpu_ps_generation", {}, self.generation),
             ("mxtpu_ps_recovered_wal_records", {},
              self.recovered_wal_records),
-            ("mxtpu_ps_pushes_since_snapshot", {}, self._pushes_since_snap),
+            ("mxtpu_ps_pushes_since_snapshot", {}, pushes_since_snap),
             ("mxtpu_ps_fleet_max_step", {}, self.monitor.max_step()),
         ]
         for rank, lag in self.monitor.lag_s().items():
@@ -543,8 +548,11 @@ class PSServer:
                     last = self._applied.get(rank, {}).get(key)
                     if last is not None and int(step) <= last:
                         return ("ok",)
-                _chaos.maybe_inject("kvstore.server_apply",
-                                    ctx=(rank, step, key))
+                # chaos site is deliberately INSIDE the apply critical
+                # section: the faults it schedules must land in the
+                # window the WAL/snapshot machinery protects
+                _chaos.maybe_inject(  # mxlint: disable=RACE003
+                    "kvstore.server_apply", ctx=(rank, step, key))
                 self._apply_push(key, grad)
                 if _tele._ENABLED and not self._replaying:
                     # flight-record the apply (with the worker's trace
@@ -609,7 +617,9 @@ class PSServer:
         (numpy conversion, pickling, fsync, rename) runs OFF the apply
         path on the captured refs.  Only the live optimizer object must
         be pickled here: its update counters mutate in place."""
-        _chaos.maybe_inject("kvstore.snapshot")
+        # deliberately inside the snapshot critical section: a chaos
+        # crash here must be able to kill a half-taken snapshot
+        _chaos.maybe_inject("kvstore.snapshot")  # mxlint: disable=RACE003
         with self._live_lock:
             owner = dict(self._key_owner)
         if self._updater is not None:
@@ -702,10 +712,13 @@ class PSServer:
                 rank, step,
                 t_ns=msg[4] if len(msg) > 4 else None,
                 phase=msg[3] if len(msg) > 3 else None)
+            # read the monitor's view first: its dead() takes the
+            # monitor's own lock, which must never nest inside ours
+            monitor_dead = self.monitor.dead()
             with self._live_lock:
                 self._dead_ranks.discard(rank)
-            return ("ok", self.monitor.max_step(),
-                    len(self.monitor.dead() | self._dead_ranks))
+                n_dead = len(monitor_dead | self._dead_ranks)
+            return ("ok", self.monitor.max_step(), n_dead)
         if cmd == "key_owner":
             return ("ok", self.key_owner(msg[1]))
         if cmd == "init_meta":
@@ -1073,8 +1086,12 @@ class PSClient:
     def _transfer_epoch(self):
         """Per-connection + per-server-life epoch: chunked transfers
         restart wholesale when EITHER moves (both invalidate the
-        server-side staged prefix / pull snapshot)."""
-        return (self.reconnects, self.failovers)
+        server-side staged prefix / pull snapshot).  Snapshotted under
+        ``_lock`` — ``_reconnect`` bumps ``reconnects`` under it, and a
+        torn pair here would miss exactly the restart it exists to
+        detect."""
+        with self._lock:
+            return (self.reconnects, self.failovers)
 
     def _chunk_error_is_restart(self, epoch):
         """A chunk RPC failed server-side: restart or genuine error?
@@ -1263,7 +1280,11 @@ class PSClient:
                     if msg[0] not in self._RETRY_SAFE or \
                             attempt >= self._retry.max_retries:
                         raise
-                    time.sleep(self._retry.delay(attempt))
+                    # deliberate: the backoff holds _lock so sibling
+                    # callers queue behind ONE reconnect instead of
+                    # dogpiling the recovering server
+                    time.sleep(  # mxlint: disable=RACE003
+                        self._retry.delay(attempt))
                     attempt += 1
                     try:
                         self._reconnect()
@@ -1284,6 +1305,9 @@ class PSClient:
             self._hb.stop()
             self._hb = None
         try:
-            self._sock.close()
+            # deliberately lock-free: closing the socket out from under
+            # a _request wedged in recv() is how close() unblocks it —
+            # taking _lock here would wait for the wedge instead
+            self._sock.close()  # mxlint: disable=RACE001
         except OSError:
             pass

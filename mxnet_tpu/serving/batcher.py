@@ -238,7 +238,11 @@ class Batcher:
     # -- client side -------------------------------------------------------
     @property
     def queue_depth(self):
-        return len(self._heap)
+        # len() of a heap mid-sift on another thread can be torn on
+        # pypy-likes and is racy in spirit everywhere: read it under
+        # the same condition lock submit/sweep mutate it under
+        with self._cond:
+            return len(self._heap)
 
     @property
     def draining(self):
@@ -389,11 +393,17 @@ class Batcher:
             # feeds the fleet's circuit breaker
             _chaos.maybe_inject("serving.batch", ctx=batch)
             n = len(batch)
-            bucket = self.runner.bucket_for(n)
+            bucket = 0   # refined under the runner lock below; a
+            #              failure before then reports the 0 bucket
             try:
                 x = _np.stack([r.example for r in batch])
                 with self._runner_lock:
+                    # bucket choice and forward must see the SAME
+                    # runner: a hot swap between a bare bucket_for and
+                    # the locked forward would pad for the old model
+                    # and execute on the new one
                     runner = self.runner
+                    bucket = runner.bucket_for(n)
                     out = runner.forward_batch(x)
             except Exception as e:  # propagate per-request, keep serving
                 for r in batch:
@@ -460,17 +470,21 @@ class Batcher:
         the old one's ``example_shape`` (queued pixels must stay valid).
         Returns the previous runner; raises ``TimeoutError`` when the
         in-flight batch does not finish in ``timeout``."""
-        if tuple(runner.example_shape) != tuple(self.runner.example_shape):
-            raise MXNetError(
-                "swap refused: example_shape %r != %r — queued requests "
-                "would be fed to an incompatible model"
-                % (tuple(runner.example_shape),
-                   tuple(self.runner.example_shape)))
         if not self._runner_lock.acquire(timeout=float(timeout)):
             raise TimeoutError(
                 "in-flight batch did not complete within %ss; swap aborted"
                 % timeout)
         try:
+            # compat check INSIDE the lock region: checked against the
+            # runner actually being replaced, not one a concurrent swap
+            # may itself be replacing
+            if tuple(runner.example_shape) != \
+                    tuple(self.runner.example_shape):
+                raise MXNetError(
+                    "swap refused: example_shape %r != %r — queued "
+                    "requests would be fed to an incompatible model"
+                    % (tuple(runner.example_shape),
+                       tuple(self.runner.example_shape)))
             old, self.runner = self.runner, runner
             with self._cond:
                 self.max_batch = min(self._max_batch_req or runner.max_batch,

@@ -100,15 +100,18 @@ class CanarySplit:
 
     @property
     def stage(self):
-        return self._stage
+        with self._lock:
+            return self._stage
 
     @property
     def fraction(self):
-        return self.schedule[self._stage]
+        with self._lock:
+            return self.schedule[self._stage]
 
     @property
     def final_stage(self):
-        return self._stage == len(self.schedule) - 1
+        with self._lock:
+            return self._stage == len(self.schedule) - 1
 
     def advance(self):
         """Step the pinned ramp (controller decision); returns the new
@@ -149,8 +152,13 @@ class CanarySplit:
             }
 
     def __repr__(self):
+        # one acquisition, raw fields: the fraction property takes the
+        # same non-reentrant lock
+        with self._lock:
+            stage = self._stage
+            fraction = self.schedule[stage]
         return "<CanarySplit ->%s %.3g stage=%d/%d>" % (
-            self.canary, self.fraction, self._stage, len(self.schedule))
+            self.canary, fraction, stage, len(self.schedule))
 
 
 class BreakerOpen(MXNetError):
@@ -252,7 +260,11 @@ class CircuitBreaker:
             self._open_until = 0.0
 
     def __repr__(self):
-        return "<CircuitBreaker %s trips=%d>" % (self.state, self._trips)
+        # one acquisition, raw state: the state property takes the same
+        # non-reentrant lock
+        with self._lock:
+            return "<CircuitBreaker %s trips=%d>" % (
+                self._state_locked(), self._trips)
 
 
 class _Entry:
@@ -376,7 +388,8 @@ class ModelFleet:
 
     @property
     def default_model(self):
-        return self._default
+        with self._lock:
+            return self._default
 
     def entry(self, name=None):
         with self._lock:
@@ -718,6 +731,7 @@ class ModelFleet:
         with self._lock:
             entries = list(self._entries.values())
             cap = self.hbm_cap_bytes
+            default = self._default
         models = {}
         for e in entries:
             d = e.batcher.stats.as_dict()
@@ -742,7 +756,7 @@ class ModelFleet:
             models[e.name] = d
         return {
             "models": models,
-            "default_model": self._default,
+            "default_model": default,
             "hbm_cap_bytes": cap,
             "modeled_hbm_total_bytes": self.modeled_hbm_total(),
             "unready": self.unready(),
@@ -775,5 +789,6 @@ class ModelFleet:
         return sum(e.batcher.force_drain() for e in entries)
 
     def __repr__(self):
-        return "<ModelFleet %s default=%r>" % (self.models(),
-                                               self._default)
+        with self._lock:
+            names, default = list(self._entries), self._default
+        return "<ModelFleet %s default=%r>" % (names, default)

@@ -254,8 +254,12 @@ class StepAttribution:
                              % (name, PHASES))
         if seconds <= 0.0:
             return
-        cur = self._cur
-        cur[name] = cur.get(name, 0.0) + seconds
+        # deliberately lock-free (this is the per-phase hot path): the
+        # ref load and dict add are each GIL-atomic, and an add racing
+        # a window close lands in whichever dict it loaded — a window
+        # boundary is the documented semantics, not corruption
+        cur = self._cur  # mxlint: disable=RACE001
+        cur[name] = cur.get(name, 0.0) + seconds  # mxlint: disable=RACE001
 
     def on_step(self, step):
         """Mark the step-``step`` dispatch: closes the previous window
@@ -268,17 +272,21 @@ class StepAttribution:
         ring = _RING
         if ring is not None:
             ring.set_cursor(step, int(now * 1e9))
-        prev_t = self._open_t
-        self._open_t = now
-        if prev_t is None:
+        # the window bookkeeping shares _lock with flush_window: a
+        # metrics dump on the scrape thread closing the open window
+        # mid-append here would double-count or drop it
+        with self._lock:
+            prev_t = self._open_t
+            self._open_t = now
+            if prev_t is None:
+                self._open_step = int(step)
+                self._cur = {}
+                return
+            self._pending.append((self._open_step, now - prev_t,
+                                  self._cur))
             self._open_step = int(step)
             self._cur = {}
-            return
-        self._pending.append((self._open_step, now - prev_t, self._cur))
-        self._open_step = int(step)
-        self._cur = {}
-        if len(self._pending) >= self._defer:
-            with self._lock:
+            if len(self._pending) >= self._defer:
                 self._drain_locked()
 
     def flush_window(self):
@@ -522,7 +530,9 @@ def attribution():
     instrumented sites reach it only behind the telemetry-enabled
     check)."""
     global _ATTR
-    a = _ATTR
+    # double-checked locking: the bare fast-path read is GIL-atomic and
+    # either sees the fully-constructed singleton or falls to the lock
+    a = _ATTR  # mxlint: disable=RACE001
     if a is None:
         with _ATTR_LOCK:
             a = _ATTR
@@ -543,9 +553,12 @@ def dominant_phase_or_none():
     """The dominant phase when telemetry is armed, else None — the
     worker-side ``phase_fn`` heartbeats report (kvstore.py)."""
     from . import enabled as _enabled
-    if not _enabled() or _ATTR is None:
+    # one GIL-atomic read of the singleton ref (the heartbeat hot
+    # path); a concurrent reset simply means this beat reports None
+    a = _ATTR  # mxlint: disable=RACE001
+    if not _enabled() or a is None:
         return None
-    return _ATTR.dominant_phase()
+    return a.dominant_phase()
 
 
 class StragglerDetector:

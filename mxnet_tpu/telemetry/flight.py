@@ -117,15 +117,17 @@ class FlightRecorder:
     def set_cursor(self, step, ts_ns=None):
         """The per-step fast path: store the progress cursor into the
         fixed header field — one struct pack + mmap store, no JSON, no
-        slot.  Single-writer (the training loop); torn reads are
-        impossible for a post-SIGKILL reader because the process is
-        dead when the ring is read."""
-        if self._closed:
-            return
-        self._mm[_CURSOR_OFFSET:_CURSOR_OFFSET + _CURSOR.size] = \
-            _CURSOR.pack(int(step),
-                         time.perf_counter_ns() if ts_ns is None
-                         else int(ts_ns))
+        slot.  Torn reads are impossible for a post-SIGKILL reader
+        because the process is dead when the ring is read; the lock is
+        against ``close()`` invalidating the mmap mid-store (an
+        uncontended acquire is noise next to the pack+store)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._mm[_CURSOR_OFFSET:_CURSOR_OFFSET + _CURSOR.size] = \
+                _CURSOR.pack(int(step),
+                             time.perf_counter_ns() if ts_ns is None
+                             else int(ts_ns))
 
     def record(self, kind, **fields):
         """Append one event; returns its sequence number.  Oversized

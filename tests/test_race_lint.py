@@ -1,0 +1,838 @@
+"""mxrace: the static concurrency gate over the threaded host tiers
+(mxnet_tpu/analysis/race_lint.py; docs/concurrency.md).
+
+Covers the five RACE rules each with a broken-fixture subprocess test
+exiting rc=2 through the real CLI (the mutation-seam discipline), the
+PR-6 historical ``_key_owner`` fixture (the analyzer must catch the
+repo's own shipped bug), the lock-order/hierarchy sync both ways, the
+interprocedural refinements (``*_locked`` helpers, lambdas, init-only
+setup methods), the whole-repo sweep staying clean, race-report
+byte-determinism, the schema-5 ``race`` section through
+``tools/parse_log.py``, and a pre-fix fixture for every real
+concurrency finding this gate surfaced in shipped code.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.analysis
+
+from mxnet_tpu.analysis import race_lint as rl
+from mxnet_tpu.analysis.findings import RULES, ERROR
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HIERARCHY = os.path.join(REPO, "docs", "concurrency.md")
+
+
+def rules(findings):
+    return sorted({f.rule_id for f in findings})
+
+
+def _lint(body):
+    return rl.lint_race_source(textwrap.dedent(body), filename="fix.py")
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, "-m", "mxnet_tpu.analysis"]
+                          + list(args), capture_output=True, text=True,
+                          cwd=REPO, env=env, timeout=300)
+
+
+def _cli_fixture(tmp_path, body):
+    """Race-lint a broken fixture through the real CLI."""
+    script = tmp_path / "fixture.py"
+    script.write_text(textwrap.dedent(body))
+    return _run_cli("--race", str(script))
+
+
+# ---------------------------------------------------------------------------
+# rule registration
+# ---------------------------------------------------------------------------
+def test_race_rules_registered_as_errors():
+    for rule in ("RACE001", "RACE002", "RACE003", "RACE004", "RACE005"):
+        assert rule in RULES
+        assert RULES[rule][0] == ERROR
+
+
+# ---------------------------------------------------------------------------
+# RACE001: lock-guard inference
+# ---------------------------------------------------------------------------
+PR6_KEY_OWNER = """\
+    import threading
+
+    class PSServerFixture:
+        def __init__(self):
+            self._live_lock = threading.Lock()
+            self._key_owner = {}
+
+        def assign(self, key, rank):
+            with self._live_lock:
+                self._key_owner[key] = rank
+
+        def on_rank_dead(self, dead_rank, live):
+            # the PR-6 shipped bug: iterating the ownership dict BARE
+            # while assign() mutates it under the lock
+            moved = []
+            for key, rank in self._key_owner.items():
+                if rank == dead_rank:
+                    moved.append(key)
+            return moved
+"""
+
+
+def test_race001_flags_the_pr6_key_owner_bug():
+    findings = _lint(PR6_KEY_OWNER)
+    assert "RACE001" in rules(findings)
+    hit = [f for f in findings if f.rule_id == "RACE001"]
+    assert any("_key_owner" in f.message for f in hit)
+    assert any("PSServerFixture" in f.message for f in hit)
+
+
+def test_race001_pr6_fixture_exits_2_through_cli(tmp_path):
+    proc = _cli_fixture(tmp_path, PR6_KEY_OWNER)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "RACE001" in proc.stdout and "_key_owner" in proc.stdout
+
+
+def test_race001_clean_when_every_access_is_locked():
+    findings = _lint("""\
+        import threading
+
+        class Guarded:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def snapshot(self):
+                with self._lock:
+                    return list(self._items)
+    """)
+    assert findings == []
+
+
+def test_race001_inconsistent_lock_sets():
+    findings = _lint("""\
+        import threading
+
+        class TwoLocks:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._n = 0
+
+            def inc_a(self):
+                with self._a:
+                    self._n += 1
+
+            def inc_b(self):
+                with self._b:
+                    self._n += 1
+    """)
+    hit = [f for f in findings if f.rule_id == "RACE001"]
+    assert len(hit) == 1 and "inconsistent lock sets" in hit[0].message
+
+
+def test_race001_locked_helper_inherits_callers_held_set():
+    # the *_locked convention: the private helper is only ever called
+    # under the lock, so its bare-looking writes are guarded
+    findings = _lint("""\
+        import threading
+
+        class Conv:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+
+            def apply(self, k, v):
+                with self._lock:
+                    self._apply_locked(k, v)
+
+            def _apply_locked(self, k, v):
+                self._state[k] = v
+
+            def get(self, k):
+                with self._lock:
+                    return self._state.get(k)
+    """)
+    assert findings == []
+
+
+def test_race001_lambda_inherits_held_set():
+    # cv.wait_for predicates run holding the condition — no finding
+    findings = _lint("""\
+        import threading
+
+        class Pending:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._pending = set()
+
+            def claim(self, key):
+                with self._cv:
+                    self._pending.add(key)
+
+            def await_done(self, key):
+                with self._cv:
+                    self._cv.wait_for(lambda: key not in self._pending)
+    """)
+    assert findings == []
+
+
+def test_race001_closure_does_not_inherit_held_set():
+    # a def closure is a thread target: bare accesses inside it are
+    # NOT blessed by the spawning method's held locks
+    findings = _lint("""\
+        import threading
+
+        class Spawner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def start(self):
+                def run():
+                    while self._n < 10:
+                        pass
+                t = threading.Thread(target=run, daemon=True)
+                t.start()
+    """)
+    assert "RACE001" in rules(findings)
+
+
+def test_race001_init_only_helper_shares_init_exemption():
+    # _recover runs before any thread exists (only __init__ calls it):
+    # its bare writes neither violate nor weaken the runtime guard
+    findings = _lint("""\
+        import threading
+
+        class Server:
+            def __init__(self, path):
+                self._lock = threading.Lock()
+                self._store = {}
+                self._recover(path)
+
+            def _recover(self, path):
+                self._store["seed"] = path
+
+            def apply(self, k, v):
+                with self._lock:
+                    self._store[k] = v
+
+            def pull(self, k):
+                with self._lock:
+                    return self._store[k]
+    """)
+    assert findings == []
+
+
+def test_race001_disable_comment_suppresses():
+    findings = _lint("""\
+        import threading
+
+        class Deliberate:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                return self._n  # mxlint: disable=RACE001
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# RACE002: lock-order cycles + the pinned hierarchy
+# ---------------------------------------------------------------------------
+RACE002_CYCLE = """\
+    import threading
+
+    class ABBA:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_race002_flags_lock_order_cycle():
+    findings = _lint(RACE002_CYCLE)
+    hit = [f for f in findings if f.rule_id == "RACE002"]
+    assert hit and "deadlock" in hit[0].message
+
+
+def test_race002_cycle_fixture_exits_2_through_cli(tmp_path):
+    proc = _cli_fixture(tmp_path, RACE002_CYCLE)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "RACE002" in proc.stdout
+
+
+def test_race002_hierarchy_sync_both_ways(tmp_path):
+    doc = tmp_path / "concurrency.md"
+    doc.write_text(textwrap.dedent("""\
+        | # | outer | inner | why |
+        |---|-------|-------|-----|
+        | 1 | `A._x` | `A._y` | pinned |
+        | 2 | `A._stale` | `A._gone` | no longer observed |
+    """))
+    edges = [("A._x", "A._y", "m.py:3"),
+             ("A._y", "A._z", "m.py:9")]
+    findings = rl.lock_order_findings(edges, hierarchy_path=str(doc))
+    msgs = [f.message for f in findings if f.rule_id == "RACE002"]
+    assert len(msgs) == 2
+    assert any("A._y -> A._z" in m and "not pinned" in m for m in msgs)
+    assert any("A._stale -> A._gone" in m and "no longer observed" in m
+               for m in msgs)
+
+
+def test_pinned_hierarchy_matches_observed_edges_exactly():
+    """The checked-in docs/concurrency.md table IS the observed edge
+    set — the sync that RACE002 enforces, asserted directly."""
+    pinned = set(rl.parse_hierarchy(HIERARCHY))
+    summary = rl.race_summary()
+    observed = {(e["outer"], e["inner"]) for e in summary["edges"]}
+    assert pinned == observed
+    assert len(pinned) >= 5
+
+
+# ---------------------------------------------------------------------------
+# RACE003: blocking under a held lock
+# ---------------------------------------------------------------------------
+RACE003_BLOCKING = """\
+    import queue
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+
+        def take(self):
+            with self._lock:
+                return self._q.get()
+"""
+
+
+def test_race003_flags_unbounded_get_under_lock():
+    findings = _lint(RACE003_BLOCKING)
+    hit = [f for f in findings if f.rule_id == "RACE003"]
+    assert hit and ".get()" in hit[0].message
+
+
+def test_race003_fixture_exits_2_through_cli(tmp_path):
+    proc = _cli_fixture(tmp_path, RACE003_BLOCKING)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "RACE003" in proc.stdout
+
+
+def test_race003_timeout_and_wait_on_own_cv_are_clean():
+    findings = _lint("""\
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._q = queue.Queue()
+
+            def take(self):
+                with self._cv:
+                    return self._q.get(timeout=0.2)
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait()
+    """)
+    assert findings == []
+
+
+def test_race003_wait_on_foreign_cv_is_flagged():
+    findings = _lint("""\
+        import threading
+
+        class Cross:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def park(self):
+                with self._lock:
+                    with self._cv:
+                        self._cv.wait()
+    """)
+    # _cv.wait() releases _cv but NOT the outer _lock
+    hit = [f for f in findings if f.rule_id == "RACE003"]
+    assert hit and ".wait()" in hit[0].message
+
+
+def test_race003_flags_sleep_and_maybe_inject_under_lock():
+    findings = _lint("""\
+        import threading
+        import time
+        from mxnet_tpu.resilience import chaos
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    chaos.maybe_inject("site")
+                    time.sleep(0.1)
+    """)
+    hit = [f.message for f in findings if f.rule_id == "RACE003"]
+    assert len(hit) == 2
+    assert any("maybe_inject" in m for m in hit)
+    assert any("sleep" in m for m in hit)
+
+
+# ---------------------------------------------------------------------------
+# RACE004: thread lifecycle
+# ---------------------------------------------------------------------------
+RACE004_LEAK = """\
+    import threading
+
+    def start_worker(fn):
+        t = threading.Thread(target=fn)
+        t.start()
+        return t
+"""
+
+
+def test_race004_flags_non_daemon_never_joined_thread():
+    findings = _lint(RACE004_LEAK)
+    assert rules(findings) == ["RACE004"]
+
+
+def test_race004_fixture_exits_2_through_cli(tmp_path):
+    proc = _cli_fixture(tmp_path, RACE004_LEAK)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "RACE004" in proc.stdout
+
+
+def test_race004_daemon_or_joined_is_clean():
+    findings = _lint("""\
+        import threading
+
+        class Owner:
+            def __init__(self, fn):
+                self._t = threading.Thread(target=fn, daemon=True)
+                self._t.start()
+                self._u = threading.Thread(target=fn)
+                self._u.start()
+
+            def stop(self):
+                self._u.join()
+    """)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# RACE005: callbacks under the owner's lock
+# ---------------------------------------------------------------------------
+RACE005_WATCHDOG = """\
+    import threading
+
+    class Watchdog:
+        def __init__(self, on_dead):
+            self._lock = threading.Lock()
+            self._on_dead = on_dead
+            self._dead = set()
+
+        def check(self, rank):
+            with self._lock:
+                self._dead.add(rank)
+                self._on_dead(rank)
+"""
+
+
+def test_race005_flags_callback_invoked_under_lock():
+    findings = _lint(RACE005_WATCHDOG)
+    hit = [f for f in findings if f.rule_id == "RACE005"]
+    assert hit and "_on_dead" in hit[0].message
+
+
+def test_race005_fixture_exits_2_through_cli(tmp_path):
+    proc = _cli_fixture(tmp_path, RACE005_WATCHDOG)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "RACE005" in proc.stdout
+
+
+def test_race005_copy_then_call_outside_is_clean():
+    # the PR-6 watchdog FIX: snapshot under the lock, call outside
+    findings = _lint("""\
+        import threading
+
+        class Watchdog:
+            def __init__(self, on_dead):
+                self._lock = threading.Lock()
+                self._on_dead = on_dead
+                self._dead = set()
+
+            def check(self, rank):
+                with self._lock:
+                    self._dead.add(rank)
+                self._on_dead(rank)
+    """)
+    assert findings == []
+
+
+def test_race005_loop_over_callback_collection_under_lock():
+    findings = _lint("""\
+        import threading
+
+        class Bus:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._callbacks = []
+
+            def subscribe(self, cb):
+                with self._lock:
+                    self._callbacks.append(cb)
+
+            def publish(self, evt):
+                with self._lock:
+                    for cb in self._callbacks:
+                        cb(evt)
+    """)
+    assert "RACE005" in rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# pre-fix fixtures: the real findings this gate surfaced in shipped code
+# ---------------------------------------------------------------------------
+PREFIX_FIXTURES = {
+    # serving/batcher.py queue_depth read len(self._heap) bare while
+    # submit() mutates the heap under _cond
+    "batcher_queue_depth": ("_heap", """\
+        import threading
+
+        class Batcher:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._heap = []
+
+            def submit(self, r):
+                with self._cond:
+                    self._heap.append(r)
+
+            def queue_depth(self):
+                return len(self._heap)
+    """),
+    # serving/batcher.py _run_batch picked the bucket from a BARE
+    # self.runner read before taking the runner lock for the forward
+    "batcher_runner_swap": ("runner", """\
+        import threading
+
+        class Batcher:
+            def __init__(self, runner):
+                self._runner_lock = threading.Lock()
+                self.runner = runner
+
+            def run_batch(self, n, x):
+                bucket = self.runner.bucket_for(n)
+                with self._runner_lock:
+                    return self.runner.forward_batch(x), bucket
+
+            def swap_runner(self, runner):
+                with self._runner_lock:
+                    old, self.runner = self.runner, runner
+                return old
+    """),
+    # kvstore_ps.py _metrics_samples read the WAL counters bare while
+    # the apply path mutates them under _state_lock
+    "ps_metrics_counters": ("_wal_seq", """\
+        import threading
+
+        class PSServer:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+                self._wal_seq = 0
+
+            def wal_append(self, rec):
+                with self._state_lock:
+                    self._wal_seq += 1
+
+            def metrics_samples(self):
+                return [("mxtpu_ps_wal_seq", {}, self._wal_seq)]
+    """),
+    # kvstore_ps.py heartbeat reply computed the dead-set union AFTER
+    # releasing _live_lock
+    "ps_dead_ranks_union": ("_dead_ranks", """\
+        import threading
+
+        class PSServer:
+            def __init__(self):
+                self._live_lock = threading.Lock()
+                self._dead_ranks = set()
+
+            def mark_dead(self, rank):
+                with self._live_lock:
+                    self._dead_ranks.add(rank)
+
+            def beat(self, rank, monitor_dead):
+                with self._live_lock:
+                    self._dead_ranks.discard(rank)
+                return len(monitor_dead | self._dead_ranks)
+    """),
+    # kvstore_ps.py PSClient._transfer_epoch read (reconnects,
+    # failovers) bare while _reconnect bumps them under _lock
+    "ps_client_epoch": ("reconnects", """\
+        import threading
+
+        class PSClient:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.reconnects = 0
+
+            def _reconnect(self):
+                with self._lock:
+                    self.reconnects += 1
+
+            def transfer_epoch(self):
+                return self.reconnects
+    """),
+    # serving/fleet.py CanarySplit/ModelFleet properties + __repr__
+    # read ramp/default state bare while advance()/register() mutate
+    # it under _lock
+    "fleet_bare_properties": ("_stage", """\
+        import threading
+
+        class CanarySplit:
+            def __init__(self, schedule):
+                self._lock = threading.Lock()
+                self.schedule = schedule
+                self._stage = 0
+
+            def advance(self):
+                with self._lock:
+                    self._stage += 1
+                    return self.schedule[self._stage]
+
+            def fraction(self):
+                return self.schedule[self._stage]
+    """),
+    # io DeviceFeedIter.live_slots_max read the high-water mark bare
+    # while the worker updates it under _live_lock
+    "io_live_slots_max": ("_live_max", """\
+        import threading
+
+        class DeviceFeedIter:
+            def __init__(self):
+                self._live_lock = threading.Lock()
+                self._live = 0
+                self._live_max = 0
+
+            def on_batch(self):
+                with self._live_lock:
+                    self._live += 1
+                    self._live_max = max(self._live_max, self._live)
+
+            def live_slots_max(self):
+                return self._live_max
+    """),
+    # telemetry/flight.py set_cursor stored through self._mm bare —
+    # close() can invalidate the mmap mid-store
+    "flight_set_cursor": ("_mm", """\
+        import threading
+
+        class FlightRecorder:
+            def __init__(self, mm):
+                self._lock = threading.Lock()
+                self._mm = mm
+                self._closed = False
+
+            def set_cursor(self, step):
+                self._mm[0:8] = step
+
+            def close(self):
+                with self._lock:
+                    self._closed = True
+                    self._mm.close()
+                    self._mm = None
+    """),
+    # telemetry/attribution.py on_step appended the closed window bare
+    # while flush_window drains under _lock on the scrape thread
+    "attribution_on_step": ("_pending", """\
+        import threading
+
+        class StepAttribution:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+
+            def on_step(self, step, dt, cur):
+                self._pending.append((step, dt, cur))
+
+            def flush_window(self):
+                with self._lock:
+                    drained, self._pending = self._pending, []
+                return drained
+    """),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PREFIX_FIXTURES))
+def test_prefix_fixture_is_flagged_race001(name):
+    attr, body = PREFIX_FIXTURES[name]
+    findings = _lint(body)
+    hit = [f for f in findings if f.rule_id == "RACE001"]
+    assert hit, "pre-fix pattern %r no longer flagged" % name
+    assert any("'%s'" % attr in f.message for f in hit), \
+        "expected %r named in %s" % (attr, [str(f) for f in hit])
+
+
+# ---------------------------------------------------------------------------
+# the whole-repo sweep
+# ---------------------------------------------------------------------------
+def test_threaded_targets_cover_the_host_tiers():
+    targets = rl.threaded_targets()
+    assert "mxnet_tpu/kvstore_ps.py" in targets
+    assert "mxnet_tpu/engine.py" in targets
+    assert any(t.startswith("mxnet_tpu/serving/") for t in targets)
+    assert any(t.startswith("mxnet_tpu/resilience/") for t in targets)
+    assert any(t.startswith("mxnet_tpu/io/") for t in targets)
+    assert any(t.startswith("mxnet_tpu/telemetry/") for t in targets)
+    assert any(t.startswith("mxnet_tpu/mlops/") for t in targets)
+    assert any(t.startswith("tools/") for t in targets)
+    assert targets == sorted(targets)
+
+
+def test_sweep_is_clean_and_deterministic():
+    """The shipped threaded tiers race-lint clean (fixes landed,
+    deliberate exceptions disabled inline), and two sweeps agree."""
+    findings = rl.lint_threaded_sources()
+    assert findings == [], "\n".join(str(f) for f in findings)
+    assert rl.race_summary() == rl.race_summary()
+
+
+def test_race_sweep_report_byte_identical_across_cli_runs():
+    a = _run_cli("--race", "--json")
+    b = _run_cli("--race", "--json")
+    assert a.returncode == 0, a.stdout + a.stderr
+    assert a.stdout == b.stdout
+
+
+def test_race_summary_shape():
+    s = rl.race_summary()
+    assert s["n_files"] >= 40
+    assert "PSServer._state_lock" in s["locks"]
+    assert "PSServer._key_lock()" in s["locks"]
+    assert s["guards"]["Batcher._heap"] == ["Batcher._cond"]
+    assert s["guards"]["PSServer._key_owner"] == ["PSServer._live_lock"]
+    for edge in s["edges"]:
+        assert set(edge) == {"outer", "inner", "site"}
+    assert s["locks"] == sorted(s["locks"])
+
+
+# ---------------------------------------------------------------------------
+# CLI / schema / tooling wiring
+# ---------------------------------------------------------------------------
+def test_race_cli_json_section_schema5():
+    proc = _run_cli("--race", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["schema_version"] == 5
+    race = payload["race"]
+    assert race["n_files"] >= 40
+    assert race["hierarchy"] == sorted(race["hierarchy"])
+    assert len(race["hierarchy"]) == len(race["edges"])
+    # the race section appears only with --race
+    proc = _run_cli("--cost", "--json", "--model", "mlp_infer")
+    assert "race" not in json.loads(proc.stdout)
+
+
+def test_parse_log_reads_race_section_and_refuses_newer(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    doc = {"version": 1, "schema_version": 5, "findings": [],
+           "race": {"n_files": 3,
+                    "locks": ["A._lock", "B._lock"],
+                    "guards": {"A._heap": ["A._lock"]},
+                    "edges": [{"outer": "A._lock", "inner": "B._lock",
+                               "site": "a.py:7"}],
+                    "hierarchy": [["A._lock", "B._lock"]]}}
+    rows = dict(parse_log.parse_analysis_json(doc))
+    assert rows["race.n_files"] == 3
+    assert rows["race.n_locks"] == 2
+    assert rows["race.n_guarded_attrs"] == 1
+    assert rows["race.n_edges"] == 1
+    assert rows["race.n_pinned"] == 1
+    assert rows['race.guard{attr="A._heap"}'] == "A._lock"
+    assert rows['race.edge{outer="A._lock",inner="B._lock"}'] == "a.py:7"
+    with pytest.raises(ValueError, match="newer"):
+        parse_log.parse_analysis_json(dict(doc, schema_version=6))
+    # end to end: a schema-6 document is refused through the CLI
+    newer = tmp_path / "newer.json"
+    newer.write_text(json.dumps(dict(doc, schema_version=6)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         str(newer)], capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "newer" in (proc.stderr + proc.stdout)
+
+
+def test_self_check_runs_race_sweep():
+    """Mutating one threaded module with a guard violation must fail
+    self_check through lint_threaded_sources — proves the sweep is
+    armed (without actually breaking the shipped tree: we assert the
+    wiring by flag instead)."""
+    from mxnet_tpu.analysis import self_check
+    clean = self_check(with_coverage=False, with_cost=False,
+                       with_examples=False, with_workers=False,
+                       with_serving=False, with_telemetry=False,
+                       with_shard=False, with_mlops=False, with_race=True)
+    assert [f for f in clean if f.rule_id.startswith("RACE")] == []
+    # and the race pass is genuinely what ran: disabling it is the only
+    # difference between these two calls
+    no_race = self_check(with_coverage=False, with_cost=False,
+                         with_examples=False, with_workers=False,
+                         with_serving=False, with_telemetry=False,
+                         with_shard=False, with_mlops=False,
+                         with_race=False)
+    assert no_race == []
+
+
+def test_hierarchy_drift_fails_the_sweep(tmp_path):
+    """Pin a stale row / omit a real edge: lint_threaded_sources must
+    flag both directions against the alternate table."""
+    doc = tmp_path / "concurrency.md"
+    real = rl.parse_hierarchy(HIERARCHY)
+    kept = real[1:]   # drop one observed edge from the pinned table
+    rows = ["| # | outer | inner | why |", "|---|---|---|---|"]
+    rows += ["| %d | `%s` | `%s` | kept |" % (i, o, inn)
+             for i, (o, inn) in enumerate(kept, 1)]
+    rows.append("| 99 | `Ghost._a` | `Ghost._b` | stale |")
+    doc.write_text("\n".join(rows) + "\n")
+    findings = rl.lint_threaded_sources(hierarchy=str(doc))
+    msgs = [f.message for f in findings if f.rule_id == "RACE002"]
+    assert any("not pinned" in m for m in msgs)
+    assert any("Ghost._a -> Ghost._b" in m for m in msgs)

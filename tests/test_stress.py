@@ -1,0 +1,54 @@
+"""Schedule-amplified reruns of the key threaded suites
+(docs/concurrency.md).
+
+``sys.setswitchinterval(1e-5)`` forces the interpreter to consider a
+thread switch every ~10us instead of every 5ms — interleavings that a
+default schedule hits once in a thousand runs become routine, so the
+lock-discipline bugs mxrace reasons about statically also get dynamic
+exercise. Opt-in with ``pytest -m stress``; the tests are also marked
+``slow`` so the tier-1 ``-m 'not slow'`` run keeps the default
+schedules (these are reruns, not new coverage).
+"""
+import sys
+
+import pytest
+
+import test_resilience
+import test_serving
+
+pytestmark = [pytest.mark.stress, pytest.mark.slow]
+
+
+@pytest.fixture(autouse=True)
+def _amplified_schedule():
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(prev)
+
+
+@pytest.mark.parametrize("kind", ["module", "gluon"])
+def test_stress_serving_concurrent_load(kind):
+    test_serving.test_zero_recompiles_under_200_request_concurrent_load(kind)
+
+
+def test_stress_serving_queue_overflow():
+    test_serving.test_queue_overflow_rejects_not_stalls()
+
+
+def test_stress_serving_graceful_drain():
+    test_serving.test_graceful_drain_completes_inflight()
+
+
+def test_stress_heartbeat_monitor():
+    test_resilience.test_heartbeat_monitor_detects_silence_and_rejoin()
+
+
+def test_stress_ps_watchdog_reassign():
+    test_resilience.test_ps_watchdog_reassigns_dead_worker_keys()
+
+
+def test_stress_watchdog_dead_callback():
+    test_resilience.test_watchdog_survives_on_dead_callback_error()

@@ -27,9 +27,9 @@ import sys
 # the newest metrics-JSON schema this parser understands
 METRICS_SCHEMA_VERSION = 1
 # the newest analysis-CLI (--json) schema this parser understands
-# (3 = the mxshard "shard" section, 4 = the mxfuse "fusion" section;
-# see docs/analysis.md)
-ANALYSIS_SCHEMA_VERSION = 4
+# (3 = the mxshard "shard" section, 4 = the mxfuse "fusion" section,
+# 5 = the mxrace "race" section; see docs/analysis.md)
+ANALYSIS_SCHEMA_VERSION = 5
 
 
 def parse(lines):
@@ -125,6 +125,20 @@ def parse_analysis_json(doc):
             if metric in rep:
                 rows.append(("fusion.%s.%s" % (model, metric),
                              rep[metric]))
+    race = doc.get("race", {})
+    if race:
+        rows.append(("race.n_files", race.get("n_files", 0)))
+        rows.append(("race.n_locks", len(race.get("locks", []))))
+        rows.append(("race.n_guarded_attrs", len(race.get("guards", {}))))
+        rows.append(("race.n_edges", len(race.get("edges", []))))
+        rows.append(("race.n_pinned", len(race.get("hierarchy", []))))
+        for attr, locks in sorted(race.get("guards", {}).items()):
+            rows.append(("race.guard{attr=\"%s\"}" % attr,
+                         "+".join(locks)))
+        for edge in race.get("edges", []):
+            rows.append(("race.edge{outer=\"%s\",inner=\"%s\"}"
+                         % (edge.get("outer"), edge.get("inner")),
+                         edge.get("site", "")))
     return rows
 
 
