@@ -401,6 +401,18 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_FUSION", "1") == "1":
         rec.stage("fusion", 150, _fusion_bench)
 
+    # -- decode-tier micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): decode_tokens_per_sec_host (continuous
+    # batching through the DecodeRunner→DecodeBatcher path under a
+    # seeded concurrent mixed-length burst), decode_p99_per_token_ms
+    # (the SLO unit of the tokens-remaining shed arithmetic),
+    # decode_numerics_ok (paged-cache greedy decode == the no-cache
+    # full-forward reference, exactly) and decode_recompiles (zero
+    # steady-state jit-cache growth over the prefill-bucket × decode-
+    # slot surface) stay live when the TPU is down — docs/serving.md
+    if os.environ.get("MXTPU_BENCH_DECODE", "1") == "1":
+        rec.stage("decode", 150, _decode_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -775,6 +787,30 @@ def _fusion_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("fusion bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _decode_bench():
+    """decode_tokens_per_sec_host + per-token latency percentiles +
+    decode_numerics_ok + decode_recompiles through the autoregressive
+    serving harness (mxnet_tpu/serving/decode_bench.py): a seeded
+    concurrent mixed-length burst continuous-batched through the
+    DecodeRunner→DecodeBatcher path over the paged KV cache, with the
+    cached-vs-full-forward numerics contract and the zero-recompile
+    contract gated by the child's rc.  JAX_PLATFORMS=cpu subprocess —
+    same isolation contract as the other host stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual test mesh in the child
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.serving.decode_bench"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("decode bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

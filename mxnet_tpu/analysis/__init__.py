@@ -57,6 +57,7 @@ __all__ = [
     "lint_registry", "lint_graph", "lint_source", "lint_file",
     "lint_symbol", "lint_serving", "lint_fleet_hbm",
     "lint_deadline_propagation", "lint_serving_sources",
+    "lint_decode_sources", "lint_decode_trace_constants",
     "lint_wallclock_reads", "lint_promotion_sources",
     "lint_supervisor_sources",
     "lint_rule_docs", "self_check",
@@ -129,6 +130,7 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += lint_worker_loops(disable=disable)
     if with_serving:
         findings += lint_serving_sources(disable=disable)
+        findings += lint_decode_sources(disable=disable)
     if with_mlops:
         findings += lint_promotion_sources(disable=disable)
         findings += lint_supervisor_sources(disable=disable)
@@ -171,6 +173,34 @@ def lint_serving_sources(disable=()):
     for path in targets:
         try:
             findings += lint_deadline_propagation(os.path.normpath(path))
+        except OSError:
+            continue
+    return filter_findings(findings, disable)
+
+
+def lint_decode_sources(disable=()):
+    """SRV006 over the shipped decode tier: the serving package (the
+    DecodeRunner/DecodeBatcher host paths) plus the traced phase
+    spellings in ``mxnet_tpu/transformer/decode.py``.  A decode path
+    that bakes sequence length or batch position into a trace constant
+    recompiles per request geometry — the exact contract the
+    prefill/decode split exists to keep.  Skipped silently outside a
+    repo checkout."""
+    import glob
+    import os
+
+    from .serving_lint import lint_decode_trace_constants
+
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(pkg)          # mxnet_tpu/
+    targets = sorted(glob.glob(os.path.join(root, "serving", "*.py")))
+    tdec = os.path.join(root, "transformer", "decode.py")
+    if os.path.isfile(tdec):
+        targets.append(tdec)
+    findings = []
+    for path in targets:
+        try:
+            findings += lint_decode_trace_constants(os.path.normpath(path))
         except OSError:
             continue
     return filter_findings(findings, disable)

@@ -882,6 +882,195 @@ def fused_optimizer_update():
     return report, findings
 
 
+# the pinned decode_step geometry: the TP_GEOMETRY transformer served
+# over a declared model=2 axis — one token step for a fixed batch of
+# 4 sequence slots against a 33-page KV pool (1 scratch + 4 full
+# sequences), page_size 8.  Small enough to trace in seconds on the
+# 1-core CI host but with the whole serving story present: the paged
+# gather/scatter, the position<=length mask, and the vocab all-gather
+# over `model`
+DECODE_GEOMETRY = {
+    "vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 2,
+    "d_ff": 64, "seq_len": 64,
+    "page_size": 8, "slots": 4, "model": 2,
+}
+
+
+def _decode_program(model_axis):
+    from ..parallel.mesh import MeshPlan
+    from ..transformer import TransformerLMConfig
+    from ..transformer.decode import DecodeProgram
+
+    g = DECODE_GEOMETRY
+    cfg = TransformerLMConfig(
+        vocab_size=g["vocab_size"], d_model=g["d_model"],
+        n_heads=g["n_heads"], n_layers=g["n_layers"], d_ff=g["d_ff"],
+        seq_len=g["seq_len"])
+    return DecodeProgram(cfg, plan=MeshPlan(data=1, model=model_axis),
+                         page_size=g["page_size"])
+
+
+def decode_step():
+    """The serving tier's KV-cached token step (docs/serving.md) as a
+    static proof: ``DecodeProgram.decode_replica`` — the SAME bound
+    method ``DecodeRunner`` jits — traced hardware-free over the
+    declared ``model=2`` axis at the pinned ``DECODE_GEOMETRY``.  The
+    budget row pins its metrics (a widened cache gather or a vocab
+    projection that grew past the all-gather shows up as COST001 with
+    no accelerator attached); the builder statically proves the traced
+    step WRITES the cache (2 scatters per layer — flipping the
+    ``DECODE_WRITE_KV`` seam deletes them and fails the gate rc=2) and
+    runs ``decode_runtime_checks``: a real short greedy decode through
+    the paged cache against the full-forward reference, so the same
+    seam flip also fails as a *numeric* stale-KV divergence."""
+    import jax
+
+    from . import shard_prop as sp
+    from .cost import analyze_jaxpr, unpriced_findings
+    from .findings import Finding
+
+    g = DECODE_GEOMETRY
+    prog = _decode_program(g["model"])
+    plan = prog.plan
+    n_pages = 1 + g["slots"] * prog.pages_per_seq
+    avals = prog.decode_avals(n_pages, g["slots"])
+    closed = jax.make_jaxpr(prog.decode_replica,
+                            axis_env=plan.axis_env())(*avals)
+
+    n = len(prog.program.param_names)
+    # flat invars: params, cache_k, cache_v, page_table, lengths, tokens
+    host = [n + 2, n + 3, n + 4]
+    report = analyze_jaxpr(closed, axis_sizes=plan.axis_sizes(),
+                           donated_invars=[n, n + 1],
+                           host_invars=host,
+                           fetched_outvars=[0])
+    findings = unpriced_findings(report, subject="decode_step")
+
+    # the static half of the DECODE_WRITE_KV seam: every layer scatters
+    # its new token's K and V into the paged cache — a traced step with
+    # fewer than 2 scatters per layer serves stale KV
+    scatters = sum(1 for eqn in closed.jaxpr.eqns
+                   if "scatter" in eqn.primitive.name)
+    want = 2 * prog.cfg.n_layers
+    if scatters < want:
+        findings.append(Finding(
+            "COST001", "decode_step.cache_write",
+            "the traced decode step carries %d cache scatter(s), want "
+            ">= %d (K and V per layer): the KV write is gone "
+            "(DECODE_WRITE_KV seam, or a broken .at[].set spelling) — "
+            "every decode step would attend over a cache missing its "
+            "own tokens" % (scatters, want)))
+
+    shard = sp.collective_schedule(closed, sp.MeshSpec(plan.axis_sizes()),
+                                   subject="decode_step")
+    shard.extras.update({
+        "decode_geometry": dict(DECODE_GEOMETRY),
+        "n_pages": int(n_pages),
+        "bytes_per_page": int(prog.bytes_per_page()),
+        "pages_per_seq": int(prog.pages_per_seq),
+        "cache_scatters": int(scatters),
+        "modeled_model_axis_bytes": int(
+            shard.collective_bytes_per_axis.get("model", 0)),
+    })
+    # the RUNTIME half: the real DecodeRunner must reproduce the
+    # full-forward reference through the paged cache
+    rt_findings, rt_extras = decode_runtime_checks()
+    findings += rt_findings
+    shard.extras.update(rt_extras)
+    return report, findings, shard
+
+
+def decode_runtime_checks(max_new=6, tolerance=5e-4):
+    """Gate the REAL serving decode path: a ``DecodeRunner`` (collapsed
+    plan, 1 CPU device) greedy-decodes a short prompt through the paged
+    KV cache and must match the no-cache full-forward reference —
+    per-step logits within ``tolerance`` and argmax tokens EXACTLY.
+    The classic failure this pins down is stale KV (the
+    ``DECODE_WRITE_KV`` seam: cache writes skipped, every step attends
+    over zeros), which no static metric can see.  Also asserts the
+    recompile-free contract: the whole ladder compiles at warmup and
+    the decode loop adds zero jit-cache keys."""
+    import numpy as _onp
+
+    from ..serving.decode import DecodeRunner
+    from .findings import Finding
+
+    findings = []
+    try:
+        prog = _decode_program(1)
+        params = prog.program.init_params(0)
+        runner = DecodeRunner(prog, params, slots=2,
+                              prefill_buckets=(8, 16), warmup=True)
+    except Exception as e:
+        findings.append(Finding(
+            "COST001", "decode_step.runtime",
+            "the serving DecodeRunner no longer builds at the pinned "
+            "geometry: %s: %s" % (type(e).__name__, str(e)[:200])))
+        return findings, {}
+
+    prompt = (_onp.arange(1, 6, dtype=_onp.int32)
+              % prog.cfg.vocab_size)
+    with runner._lock:
+        pages = runner.pool.alloc(
+            runner.pool.pages_for(prompt.size + max_new))
+    try:
+        row = _onp.zeros(runner.pages_per_seq, _onp.int32)
+        row[:len(pages)] = pages
+        seq = list(prompt)
+        pt = _onp.zeros((runner.slots, runner.pages_per_seq),
+                        _onp.int32)
+        lengths = _onp.zeros(runner.slots, _onp.int32)
+        toks = _onp.zeros(runner.slots, _onp.int32)
+        pt[0] = row
+        max_diff, mismatch_at = 0.0, None
+        cached_logits = runner.prefill(prompt, pages)
+        for step in range(max_new):
+            # full-forward oracle over the sequence so far (scratch
+            # pages only — never touches the live allocation)
+            ref_logits = runner.prefill(
+                _onp.asarray(seq, _onp.int32), _onp.zeros(0, _onp.int32))
+            diff = float(_onp.max(_onp.abs(cached_logits - ref_logits)))
+            max_diff = max(max_diff, diff)
+            if (mismatch_at is None
+                    and (diff > tolerance
+                         or int(cached_logits.argmax())
+                         != int(ref_logits.argmax()))):
+                mismatch_at = step
+            nxt = int(ref_logits.argmax())
+            seq.append(nxt)
+            lengths[0] = len(seq) - 1
+            toks[0] = nxt
+            cached_logits = runner.decode_step(pt, lengths, toks)[0]
+        if mismatch_at is not None:
+            findings.append(Finding(
+                "COST001", "decode_step.runtime.numerics",
+                "cached decode diverged from the full-forward reference "
+                "at generated token %d (max |logit| diff %.3e, tolerance "
+                "%.0e): the paged KV cache does not reproduce the model "
+                "— stale KV (the DECODE_WRITE_KV seam), a wrong page "
+                "mapping, or a broken position mask"
+                % (mismatch_at, max_diff, tolerance)))
+        recompiles = runner.recompiles_since_warmup()
+        if recompiles:
+            findings.append(Finding(
+                "COST001", "decode_step.runtime.recompiles",
+                "the decode loop added %d jit-cache key(s) after warmup "
+                "— the prefill bucket ladder or the fixed slot batch "
+                "leaked a new trace signature; steady-state serving "
+                "would recompile per request" % recompiles))
+        extras = {
+            "runtime_max_logit_diff": max_diff,
+            "runtime_tokens_checked": int(max_new),
+            "runtime_recompiles": int(recompiles),
+            "runtime_admission_hbm_bytes": int(
+                runner.admission_hbm_bytes()),
+        }
+        return findings, extras
+    finally:
+        with runner._lock:
+            runner.pool.free(pages)
+
+
 BUDGET_MODELS = {
     "mlp_train_step": mlp_train_step,
     "mlp_infer": mlp_infer,
@@ -892,6 +1081,7 @@ BUDGET_MODELS = {
     "ulysses_attention": ulysses_attention,
     "tp_transformer_train_step": tp_transformer_train_step,
     "fused_optimizer_update": fused_optimizer_update,
+    "decode_step": decode_step,
 }
 
 

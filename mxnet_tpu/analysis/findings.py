@@ -123,6 +123,13 @@ RULES = {
                       "from registry metrics and pinned schedules, or "
                       "reruns stop being byte-identical and the audit "
                       "trail stops being replayable"),
+    "SRV006": (ERROR, "a decode/prefill path puts sequence geometry "
+                      "(length/position/offset) into Python control flow "
+                      "or slice bounds: the traced program bakes the "
+                      "value as a compile-time constant, so serving "
+                      "recompiles per request geometry (or silently "
+                      "reuses the wrong program) — keep geometry in "
+                      "traced ops (masks, jnp.where, take_along_axis)"),
     # distributed-step pass (mxnet_tpu/analysis/dist_lint.py)
     "DST001": (ERROR, "a trainable parameter's gradient is never "
                       "psum/pmean-reduced over the data axis: replicas "

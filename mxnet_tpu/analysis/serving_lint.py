@@ -33,6 +33,20 @@ The fleet rules (SRV004, error) keep multi-model admission control a
   mode the SLO tiers exist to prevent.  ``--self-check`` sweeps it over
   every shipped serving source (``mxnet_tpu/serving/``,
   ``tools/serve.py``, ``examples/serving/``).
+
+The decode rule (SRV006, error — the decode twin of SRV001/SRV002)
+keeps the autoregressive tier recompile-free:
+
+- **trace-constant geometry** (:func:`lint_decode_trace_constants`): in
+  a decode/prefill function that touches jax, Python ``if``/``while``/
+  ``for range(...)`` control flow — or slice bounds — over
+  sequence-geometry names (``length``/``position``/``offset``/...)
+  bakes that value into the compiled program as a constant: one program
+  per length (a recompile per request geometry) or a silently-wrong
+  reuse.  Geometry must stay in traced ops — masks, ``jnp.where``,
+  ``take_along_axis`` — which is exactly how
+  ``transformer/decode.py`` spells both phases.  ``--self-check``
+  sweeps ``mxnet_tpu/serving/`` + ``mxnet_tpu/transformer/decode.py``.
 """
 from __future__ import annotations
 
@@ -40,7 +54,8 @@ import ast
 
 from .findings import Finding, filter_findings
 
-__all__ = ["lint_serving", "lint_fleet_hbm", "lint_deadline_propagation"]
+__all__ = ["lint_serving", "lint_fleet_hbm", "lint_deadline_propagation",
+           "lint_decode_trace_constants"]
 
 # mirrors graph_lint._RESHAPE_OPS; serving cares about the batch axis
 _RESHAPE_OPS = frozenset({"Reshape", "reshape"})
@@ -223,6 +238,111 @@ def lint_deadline_propagation(path=None, source=None):
                 "admission control can never shed it and it rots in "
                 "the queue under overload"
                 % (fn.name, call.func.attr)))
+    return out
+
+
+import re as _re
+
+# functions the decode rule inspects: anything that names itself a
+# prefill/decode path AND touches jax (host-side helpers that never
+# trace are exempt by the jax-reference requirement)
+_SRV006_FN = _re.compile(r"(prefill|decode)", _re.I)
+_SRV006_JAX = frozenset({"jax", "jnp", "lax"})
+# sequence-geometry identifier segments: an identifier counts when any
+# "_"-separated segment is one of these (so `lengths`, `q_pos` match;
+# `n_layers`, `page_size`, `Tb`, bare `len(...)` do not) — plus the
+# joined compound spellings below (`seq_len`, `cached_len`, ...)
+_SRV006_GEOM = frozenset({
+    "length", "lengths", "seqlen", "pos", "position", "positions",
+    "offset", "offsets", "ntokens", "promptlen"})
+_SRV006_GEOM_JOINED = frozenset({
+    "seqlen", "ntokens", "promptlen", "cachedlen", "tokenpos"})
+
+
+def _srv006_geometry(name):
+    segs = name.lower().split("_")
+    if any(s in _SRV006_GEOM for s in segs):
+        return True
+    return name.lower().replace("_", "") in _SRV006_GEOM_JOINED
+
+
+def _srv006_names(node):
+    """Geometry identifiers referenced anywhere under ``node`` —
+    bare names and terminal attribute names (``self.cached_len``)."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and _srv006_geometry(n.id):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute) and _srv006_geometry(n.attr):
+            out.append(n.attr)
+    return out
+
+
+def lint_decode_trace_constants(path=None, source=None):
+    """SRV006: flag decode/prefill functions that put sequence geometry
+    into Python control flow or slice bounds (module docstring).  Pure
+    AST; ``# mxlint: disable=SRV006`` on the offending line waives a
+    deliberate host-side exception."""
+    from .source_lint import _line_suppressions
+    if source is None:
+        with open(path, "r") as f:
+            source = f.read()
+    try:
+        tree = ast.parse(source, filename=path or "<string>")
+    except SyntaxError as e:
+        return [Finding("SRV006", path or "<string>",
+                        "source does not parse: %s" % e)]
+    suppressed = _line_suppressions(source)
+    subject = path or "<string>"
+    out = []
+
+    def emit(fn, node, what, names):
+        if "SRV006" in suppressed.get(node.lineno, ()):
+            return
+        out.append(Finding(
+            "SRV006", "%s:%d" % (subject, node.lineno),
+            "%s() bakes sequence geometry into the trace: %s over %s — "
+            "the compiled program pins that value as a constant, so "
+            "serving recompiles per request geometry (or reuses the "
+            "wrong program); move it into traced ops (a position mask, "
+            "jnp.where, take_along_axis)"
+            % (fn.name, what, ", ".join(sorted(set(names))))))
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _SRV006_FN.search(fn.name):
+            continue
+        refs = {n.id for n in ast.walk(fn) if isinstance(n, ast.Name)}
+        refs |= {n.value.id for n in ast.walk(fn)
+                 if isinstance(n, ast.Attribute)
+                 and isinstance(n.value, ast.Name)}
+        if not (refs & _SRV006_JAX):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                names = _srv006_names(node.test)
+                if names:
+                    emit(fn, node,
+                         "`%s` branching" % type(node).__name__.lower(),
+                         names)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                it = node.iter
+                if isinstance(it, ast.Call) and \
+                        isinstance(it.func, ast.Name) and \
+                        it.func.id == "range":
+                    names = [x for a in it.args
+                             for x in _srv006_names(a)]
+                    if names:
+                        emit(fn, node, "`for range(...)` iteration",
+                             names)
+            elif isinstance(node, ast.Slice):
+                names = [x for part in
+                         (node.lower, node.upper, node.step)
+                         if part is not None
+                         for x in _srv006_names(part)]
+                if names:
+                    emit(fn, node, "slice bounds", names)
     return out
 
 

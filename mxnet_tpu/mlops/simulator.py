@@ -38,7 +38,8 @@ from ..serving.batcher import tier_name, tier_rank
 
 __all__ = ["SimConfig", "FleetSimulator", "SimReport",
            "diurnal_trace", "burst_trace", "trace_for_dau",
-           "service_ms_from_modeled_cost", "required_replicas",
+           "service_ms_from_modeled_cost", "token_ms_from_decode_step",
+           "decode_service_model", "required_replicas",
            "percentile"]
 
 # pinned reference throughput constants for converting the PR-4 modeled
@@ -73,6 +74,37 @@ def service_ms_from_modeled_cost(cost_row, flops_per_s=DEFAULT_FLOPS_PER_S,
                   + cost_row.get("bytes_written", 0))
     return max(flops / flops_per_s, moved / bytes_per_s) * 1e3 \
         + float(overhead_ms)
+
+
+def token_ms_from_decode_step(cost_row, flops_per_s=DEFAULT_FLOPS_PER_S,
+                              bytes_per_s=DEFAULT_BYTES_PER_S,
+                              overhead_ms=DEFAULT_OVERHEAD_MS):
+    """Modeled per-token step time for the decode tier from the
+    ``decode_step`` budget row (STATIC_BUDGETS.json): one decode step
+    advances EVERY slot by one token, so the roofline step time IS the
+    per-token latency each active sequence observes — the unit the
+    DecodeBatcher's tokens-remaining shed arithmetic prices in."""
+    return service_ms_from_modeled_cost(cost_row, flops_per_s=flops_per_s,
+                                        bytes_per_s=bytes_per_s,
+                                        overhead_ms=overhead_ms)
+
+
+def decode_service_model(token_ms, max_new_tokens, prefill_ms=0.0):
+    """Token-level service model for an autoregressive tier: a
+    ``bucket -> ms`` callable for :class:`SimConfig`.
+
+    Under continuous batching a coalesced batch holds its slots for
+    ``prefill + max_new_tokens x token_ms`` — the batch *fill* changes
+    how many tokens are delivered, not the wall time (slots decode in
+    lockstep, idle slots compute scratch) — which is exactly why token
+    capacity questions need token-level service times instead of the
+    fixed-shape per-bucket table: a request costs its token budget, not
+    one forward."""
+    svc = float(prefill_ms) + float(max_new_tokens) * float(token_ms)
+
+    def service(bucket):
+        return svc
+    return service
 
 
 # ---------------------------------------------------------------------------

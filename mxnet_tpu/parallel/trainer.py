@@ -947,6 +947,19 @@ class DataParallelTrainer:
         shard._fusion_ctx = (closed, dict(plan.axis_sizes()))
         return report, findings, shard
 
+    def mesh_params(self):
+        """The trained GLOBAL parameter arrays, name -> float32 ndarray
+        in ``MeshProgram.param_names`` order — exactly the layout
+        ``init_params`` produces and the serving tier's ``DecodeRunner``
+        consumes.  Sharded device values gather to their global shape
+        here; only meaningful on the mesh tier after the first step."""
+        if getattr(self, "_mesh_params", None) is None:
+            raise RuntimeError(
+                "mesh_params() needs the mesh tier set up (train at "
+                "least one step with mesh_plan=...)")
+        return {name: np.asarray(self._mesh_params[name])
+                for name in self._mesh_param_names}
+
     # -- mesh-tier checkpointing -------------------------------------------
     def _save_mesh(self, directory, epoch=None, nbatch=None, keep=3):
         """Monolithic snapshot of the mesh tier (program param names are

@@ -29,7 +29,15 @@ symbolic executor with packaged inference).  Four layers:
 - :class:`~mxnet_tpu.serving.server.Server` — a stdlib-HTTP front end
   with ``/predict`` (model/tier/deadline routing), per-model
   ``/readyz`` vs process ``/livez``, ``/healthz``, ``/stats``, bounded
-  request bodies (413) and graceful drain.
+  request bodies (413) and graceful drain;
+- :mod:`~mxnet_tpu.serving.decode` — the autoregressive tier: a paged
+  KV-cache allocator (:class:`~mxnet_tpu.serving.decode.PagePool`), the
+  prefill/decode split behind the same recompile-free contract
+  (:class:`~mxnet_tpu.serving.decode.DecodeRunner`), and continuous
+  batching with the SLO arithmetic generalized to tokens-remaining
+  (:class:`~mxnet_tpu.serving.decode.DecodeBatcher`) — the fleet serves
+  the transformer the repo trains (``ModelFleet.register_decode`` /
+  ``.decode``).
 
 See ``docs/serving.md``, ``tools/serve.py`` (CLI) and
 ``examples/serving/`` (end-to-end demo).
@@ -43,10 +51,13 @@ from .fleet import (ModelFleet, CircuitBreaker, BreakerOpen, UnknownModel,
                     CanarySplit, DEFAULT_CANARY_SCHEDULE)
 from .server import Server
 from .stats import ServingStats, percentile
+from .decode import (PagePool, NoPagesFree, DecodeRunner, DecodeBatcher,
+                     DecodeStats)
 
 __all__ = ["ModelRunner", "DEFAULT_BUCKETS", "Batcher", "ServerBusy",
            "Draining", "RequestShed", "TIERS", "DEFAULT_TIER",
            "tier_rank", "tier_name", "ModelFleet", "CircuitBreaker",
            "BreakerOpen", "UnknownModel", "CanarySplit",
            "DEFAULT_CANARY_SCHEDULE", "Server", "ServingStats",
-           "percentile"]
+           "percentile", "PagePool", "NoPagesFree", "DecodeRunner",
+           "DecodeBatcher", "DecodeStats"]

@@ -1,0 +1,137 @@
+"""Host-runnable decode micro-benchmark.
+
+Measures the autoregressive serving tier's headline numbers through the
+full DecodeRunner→DecodeBatcher path — ``decode_tokens_per_sec_host``
+(continuous-batching throughput under a seeded mixed-length concurrent
+burst), ``decode_p50/p99_per_token_ms`` (per generated token, the SLO
+unit the tokens-remaining shed arithmetic prices in) — plus the two
+hard contracts as 0/1 keys the compare gate holds at zero slack:
+``decode_numerics_ok`` (a paged-cache greedy decode must match the
+no-cache full-forward reference EXACTLY) and ``decode_recompiles``
+(zero steady-state jit-cache growth after the AOT warmup ladder, the
+``ModelRunner`` contract extended to the prefill-bucket × decode-slot
+surface).  Deliberately TPU-independent (the r5 failure mode: every
+key starved behind backend acquisition); ``bench.py`` runs this module
+as a ``JAX_PLATFORMS=cpu`` subprocess, and it can be run directly:
+
+    JAX_PLATFORMS=cpu python -m mxnet_tpu.serving.decode_bench
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as _np
+
+__all__ = ["decode_bench"]
+
+
+def _build_runner(slots=4):
+    from ..parallel.mesh import MeshPlan
+    from ..transformer import TransformerLMConfig
+    from ..transformer.decode import DecodeProgram
+    from .decode import DecodeRunner
+
+    cfg = TransformerLMConfig(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, seq_len=64)
+    prog = DecodeProgram(cfg, plan=MeshPlan(data=1), page_size=8)
+    params = prog.program.init_params(0)
+    return DecodeRunner(prog, params, slots=slots,
+                        prefill_buckets=(8, 16, 32), warmup=True)
+
+
+def decode_bench(n_requests=None, concurrency=None, slots=4):
+    """Fire ``n_requests`` mixed-length, mixed-tier prompts from
+    ``concurrency`` client threads through a DecodeBatcher; returns the
+    stable bench keys."""
+    from .batcher import RequestShed, ServerBusy
+    from .decode import DecodeBatcher
+    from .stats import percentile
+
+    n_requests = n_requests or int(os.environ.get(
+        "MXTPU_DECODE_BENCH_N", "48"))
+    concurrency = concurrency or int(os.environ.get(
+        "MXTPU_SERVING_BENCH_CONCURRENCY", "8"))
+    runner = _build_runner(slots=slots)
+
+    # the numerics contract BEFORE the batcher exists (the page pool has
+    # one owner): cached greedy decode == no-cache full-forward reference
+    rng = _np.random.RandomState(0)
+    numerics_ok = 1
+    for trial in range(3):
+        prompt = rng.randint(1, 64, size=rng.randint(3, 12)
+                             ).astype(_np.int32)
+        cached = runner.generate(prompt, 8)
+        ref = runner.reference_decode(prompt, 8)
+        if not _np.array_equal(cached, ref):
+            numerics_ok = 0
+            break
+
+    batcher = DecodeBatcher(runner, max_queue=max(64, n_requests),
+                            model="bench")
+    lengths = [3, 5, 8, 11, 16, 24]       # mixed prefill buckets
+    tiers = ["gold", "silver", "bronze"]
+    tokens_done = []
+    lock = threading.Lock()
+    shed = [0]
+    per_thread = n_requests // concurrency
+
+    def client(tid):
+        got, drop = 0, 0
+        r = _np.random.RandomState(100 + tid)
+        for i in range(per_thread):
+            n = lengths[(tid + i) % len(lengths)]
+            prompt = r.randint(1, 64, size=n).astype(_np.int32)
+            try:
+                out = batcher.decode(prompt, max_new_tokens=8,
+                                     tier=tiers[(tid + i) % len(tiers)],
+                                     timeout=120)
+                got += len(out)
+            except (RequestShed, ServerBusy):
+                drop += 1
+        with lock:
+            tokens_done.append(got)
+            shed[0] += drop
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    batcher.drain()
+
+    st = batcher.stats
+    p50, p99 = st.token_latency_ms()
+    total_tokens = sum(tokens_done)
+    return {
+        "decode_tokens_per_sec_host": round(total_tokens / wall, 2)
+        if wall else 0.0,
+        "decode_p50_per_token_ms": round(p50, 3),
+        "decode_p99_per_token_ms": round(p99, 3),
+        "decode_numerics_ok": numerics_ok,
+        "decode_recompiles": runner.recompiles_since_warmup(),
+        "decode_tokens_total": total_tokens,
+        "decode_requests_shed": shed[0],
+        "decode_pages_leaked": runner.pool.pages_in_use,
+        "decode_concurrency": concurrency,
+    }
+
+
+def main():
+    out = decode_bench()
+    print(json.dumps(out), flush=True)
+    # the contract bench.py's stage relies on: exact numerics through
+    # the paged cache, zero steady-state recompiles, zero leaked pages
+    return 0 if (out["decode_numerics_ok"] == 1
+                 and out["decode_recompiles"] == 0
+                 and out["decode_pages_leaked"] == 0) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

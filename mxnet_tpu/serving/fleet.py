@@ -379,6 +379,27 @@ class ModelFleet:
                 tl = dict(labels, tier=tier)
                 samples.append(("mxtpu_serving_tier_p50_ms", tl, tp50))
                 samples.append(("mxtpu_serving_tier_p99_ms", tl, tp99))
+            # decode entries: the per-token surface (PR-9 registry) —
+            # token latency percentiles, token/step totals, page-pool
+            # occupancy against the pages-based admission bound
+            if hasattr(st, "token_latency_ms"):
+                kp50, kp99 = st.token_latency_ms()
+                samples.append(("mxtpu_decode_token_p50_ms", labels,
+                                kp50))
+                samples.append(("mxtpu_decode_token_p99_ms", labels,
+                                kp99))
+                samples.append(("mxtpu_decode_tokens_total", labels,
+                                st.tokens_total))
+                samples.append(("mxtpu_decode_steps_total", labels,
+                                st.steps_total))
+                samples.append(("mxtpu_decode_sequences_done_total",
+                                labels, st.sequences_done_total))
+                pool = getattr(e.runner, "pool", None)
+                if pool is not None:
+                    samples.append(("mxtpu_decode_pages_in_use", labels,
+                                    pool.pages_in_use))
+                    samples.append(("mxtpu_decode_pages_free", labels,
+                                    pool.available))
         return samples
 
     # -- registration: admission control as a static problem ---------------
@@ -409,8 +430,16 @@ class ModelFleet:
 
     @staticmethod
     def _modeled_hbm(runner, hbm_bytes=None):
+        # prefer the runner's own admission bound when it declares one:
+        # fixed-shape runners price the max-over-buckets worst case,
+        # decode runners price weights + KV page pool + one step's
+        # working set — page-granular admission instead of assuming
+        # every slot holds a full-context forward
         if hbm_bytes is not None:
             return int(hbm_bytes)
+        admission = getattr(runner, "admission_hbm_bytes", None)
+        if admission is not None:
+            return admission()
         return runner.modeled_peak_hbm()
 
     def register(self, name, runner, fallback=None, hbm_bytes=None,
@@ -463,6 +492,92 @@ class ModelFleet:
             if self._default is None:
                 self._default = name
         return entry
+
+    def register_decode(self, name, runner, max_queue=None,
+                        token_time_hint_ms=None, breaker=None,
+                        tier_slos=None, hbm_bytes=None, eos_token=None):
+        """Host a :class:`~mxnet_tpu.serving.decode.DecodeRunner` as
+        ``name`` behind a continuous-batching
+        :class:`~mxnet_tpu.serving.decode.DecodeBatcher`.
+
+        Admission against the SRV004 cap uses the runner's pages-based
+        ``admission_hbm_bytes()`` — weights + the KV page pool + one
+        decode step's working set — so a decode model packs at page
+        granularity next to fixed-shape models priced at their
+        max-over-buckets worst case.  Requests route through
+        :meth:`decode` / :meth:`decode_submit`; the fixed-shape
+        :meth:`submit` path refuses decode entries.  Decode entries
+        never hot-swap (live page tables index one runner's cache
+        pool) — drain and re-register instead.
+        """
+        from .decode import DecodeBatcher, DecodeStats
+        name = str(name)
+        candidate = self._modeled_hbm(runner, hbm_bytes)
+        with self._lock:
+            if name in self._entries:
+                raise MXNetError("model %r already registered; decode "
+                                 "models drain and re-register" % name)
+            if self.hbm_cap_bytes:
+                from ..analysis.serving_lint import lint_fleet_hbm
+                packing = {e.name: e.hbm_bytes
+                           for e in self._entries.values()}
+                packing[name] = candidate
+                findings = lint_fleet_hbm(packing, self.hbm_cap_bytes)
+                if findings:
+                    from ..analysis import render_text
+                    raise MXNetError(
+                        "fleet registration refused — modeled HBM over "
+                        "cap:\n%s" % render_text(findings))
+            breaker = breaker if breaker is not None else CircuitBreaker()
+            batcher = DecodeBatcher(
+                runner,
+                max_queue=self.max_queue if max_queue is None
+                else max_queue,
+                token_time_hint_ms=token_time_hint_ms,
+                stats=DecodeStats(runner.buckets),
+                on_step_success=breaker.record_success,
+                on_step_error=lambda exc: breaker.record_failure(),
+                model=name, eos_token=eos_token)
+            entry = _Entry(name, batcher, breaker, candidate, None,
+                           tier_slos)
+            self._entries[name] = entry
+            if self._default is None:
+                self._default = name
+        return entry
+
+    @staticmethod
+    def _is_decode(entry):
+        return hasattr(entry.batcher, "schedule_events")
+
+    def decode_submit(self, prompt, model=None, max_new_tokens=16,
+                      tier=DEFAULT_TIER, deadline_ms=None, on_token=None):
+        """Route one prompt to a decode model; returns a future-like
+        whose ``result()`` is the generated token array.  Same refusal
+        surface as :meth:`submit` (:class:`BreakerOpen` /
+        :class:`RequestShed` / :class:`ServerBusy` / :class:`Draining`);
+        no fallback rerouting — decode models declare none."""
+        entry = self.entry(model)
+        if not self._is_decode(entry):
+            raise MXNetError(
+                "model %r is a fixed-shape model; use fleet.submit()"
+                % entry.name)
+        if not entry.breaker.allow():
+            raise BreakerOpen(
+                "model %r breaker is open; failing fast" % entry.name,
+                model=entry.name,
+                retry_after_s=entry.breaker.retry_after_s())
+        return entry.batcher.submit(
+            prompt, max_new_tokens=max_new_tokens, tier=tier,
+            deadline_ms=deadline_ms, on_token=on_token)
+
+    def decode(self, prompt, model=None, max_new_tokens=16, timeout=60.0,
+               tier=DEFAULT_TIER, deadline_ms=None, on_token=None):
+        """Blocking decode: submit + wait for the generated tokens."""
+        fut = self.decode_submit(prompt, model=model,
+                                 max_new_tokens=max_new_tokens,
+                                 tier=tier, deadline_ms=deadline_ms,
+                                 on_token=on_token)
+        return fut.result(timeout)
 
     def provenance_digests(self):
         """{model: checkpoint digest or None} — the hello-path summary
@@ -561,6 +676,10 @@ class ModelFleet:
         """
         from ..resilience import chaos as _chaos
         entry = self.entry(model)
+        if self._is_decode(entry):
+            raise MXNetError(
+                "model %r serves autoregressive decode; use "
+                "fleet.decode()/decode_submit()" % entry.name)
         with self._lock:
             self._route_seq += 1
             seq = self._route_seq
@@ -743,6 +862,8 @@ class ModelFleet:
             d["modeled_wait_ms"] = round(e.batcher.modeled_wait_ms(), 3)
             d["recompiles"] = e.runner.recompiles_since_warmup()
             d["buckets_configured"] = list(e.runner.buckets)
+            if self._is_decode(e):
+                d["page_pool"] = e.runner.pool.describe()
             # checkpoint provenance: which exact bytes this entry serves
             # (digest + epoch/step/train_run_id, or None for untracked
             # runners) — what promotion audit records cross-reference

@@ -151,6 +151,16 @@ class ModelRunner:
             return None
         return max(row["peak_hbm_bytes"] for row in cost.values())
 
+    def admission_hbm_bytes(self):
+        """The bound fleet packing charges this runner against the
+        SRV004 cap.  For a fixed-shape runner every admitted request
+        really can ride the largest bucket's forward, so the
+        max-over-buckets worst case IS the right admission figure; the
+        decode tier overrides this with its pages-based bound (weights +
+        KV page pool + one step) — pricing a decode model by a
+        full-context forward per slot was the over-commit bug."""
+        return self.modeled_peak_hbm()
+
     # -- bucket arithmetic -------------------------------------------------
     @property
     def max_batch(self):

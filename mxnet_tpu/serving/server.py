@@ -12,6 +12,11 @@ wrapped as a one-model fleet named ``default``.  Contract:
   deadline).  ``data`` is one example when the shape matches the routed
   model's ``example_shape``, else a batch of examples (each coalesced
   independently).  200 → ``{"outputs": ..., "model": name}``.
+- ``POST /decode``  body ``{"prompt": [token ids], "model": <name>,
+  "max_new_tokens": <int>, "tier": ..., "deadline_ms": ...}`` against a
+  registered :class:`~mxnet_tpu.serving.decode.DecodeRunner` — 200 →
+  ``{"tokens": [...], "model": name}``; 400 when the routed model is
+  fixed-shape.  Refusal codes match ``/predict``.
 - ``429`` + ``Retry-After`` when the admission queue is full
   (backpressure), ``503`` + ``Retry-After`` when admission control sheds
   the request (modeled queue wait past its deadline, eviction by a
@@ -132,10 +137,14 @@ class _Handler(BaseHTTPRequestHandler):
             stats["buckets_configured"] = list(default.runner.buckets)
             # static per-bucket cost model (mxcost): modeled, not
             # measured — lets dashboards show expected flops/HBM next
-            # to the measured p50/p99 without a profiling run
-            stats["modeled_cost"] = {
-                str(b): row
-                for b, row in sorted(default.runner.modeled_cost().items())}
+            # to the measured p50/p99 without a profiling run.  Decode
+            # runners price admission by pages, not per-bucket cost
+            # rows, so the key is absent when the default model decodes.
+            if hasattr(default.runner, "modeled_cost"):
+                stats["modeled_cost"] = {
+                    str(b): row
+                    for b, row in
+                    sorted(default.runner.modeled_cost().items())}
             stats.update(fleet_stats)
             self._reply(200, stats)
         elif self.path == "/metrics":
@@ -150,7 +159,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"error": "unknown path %s" % self.path})
 
     def do_POST(self):
-        if self.path != "/predict":
+        if self.path not in ("/predict", "/decode"):
             self._reply(404, {"error": "unknown path %s" % self.path})
             return
         srv = self._srv
@@ -171,6 +180,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             payload = json.loads(self.rfile.read(n) or b"{}")
+        except ValueError as e:
+            self._reply(400, {"error": "bad request: %s" % e})
+            return
+        if self.path == "/decode":
+            self._do_decode(payload)
+            return
+        try:
             data = _np.asarray(payload["data"], dtype=_np.float64)
             model = payload.get("model")
             tier = payload.get("tier", "gold")
@@ -189,6 +205,13 @@ class _Handler(BaseHTTPRequestHandler):
             entry = srv.fleet.entry(model)
         except UnknownModel as e:
             self._reply(404, {"error": str(e)})
+            return
+        if getattr(entry.runner, "example_shape", None) is None:
+            # decode runners take variable-length token prompts, not
+            # fixed-shape examples — route them to /decode
+            self._reply(400, {
+                "error": "model %r is an autoregressive decode model; "
+                         "POST /decode" % entry.name})
             return
         example_shape = tuple(entry.runner.example_shape)
         single = data.shape == example_shape
@@ -223,6 +246,63 @@ class _Handler(BaseHTTPRequestHandler):
             return
         out = _np.stack(outs)
         self._reply(200, {"outputs": (out[0] if single else out).tolist(),
+                          "model": entry.name})
+
+    def _do_decode(self, payload):
+        """``POST /decode`` — the autoregressive route: ``{"prompt":
+        [token ids], "model": <name>, "max_new_tokens": <int>, "tier":
+        ..., "deadline_ms": ...}`` → 200 ``{"tokens": [...], "model":
+        name}``.  Same refusal surface as ``/predict`` (429 queue-full,
+        503 shed/breaker/draining, 404 unknown model) plus 400 when the
+        routed model is a fixed-shape one — decode requests only make
+        sense against a registered DecodeRunner."""
+        srv = self._srv
+        try:
+            prompt = _np.asarray(payload["prompt"], dtype=_np.int32)
+            if prompt.ndim != 1 or prompt.size < 1:
+                raise ValueError("prompt must be a non-empty 1-D "
+                                 "token-id list")
+            model = payload.get("model")
+            tier = payload.get("tier", "gold")
+            max_new = int(payload.get("max_new_tokens", 16))
+            deadline_ms = payload.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            tier_rank(tier)
+        except (ValueError, KeyError, TypeError) as e:
+            self._reply(400, {"error": "bad request: %s" % e})
+            return
+        try:
+            entry = srv.fleet.entry(model)
+        except UnknownModel as e:
+            self._reply(404, {"error": str(e)})
+            return
+        try:
+            out = srv.fleet.decode(prompt, model=entry.name,
+                                   max_new_tokens=max_new,
+                                   timeout=srv.request_timeout_s,
+                                   tier=tier, deadline_ms=deadline_ms)
+        except ServerBusy as e:
+            self._reply(429, {"error": str(e)},
+                        headers=[("Retry-After", "1")])
+            return
+        except (RequestShed, BreakerOpen) as e:
+            retry = max(1, int(math.ceil(getattr(e, "retry_after_s", 1.0))))
+            self._reply(503, {"error": str(e),
+                              "tier": getattr(e, "tier", tier)},
+                        headers=[("Retry-After", str(retry))])
+            return
+        except Draining as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except MXNetError as e:
+            # a fixed-shape model on the decode route (or vice versa)
+            self._reply(400, {"error": str(e)})
+            return
+        except Exception as e:  # model error / timeout
+            self._reply(500, {"error": str(e)[:500]})
+            return
+        self._reply(200, {"tokens": _np.asarray(out).tolist(),
                           "model": entry.name})
 
 

@@ -1,0 +1,303 @@
+"""KV-cached autoregressive decode over the PR-14 MeshProgram.
+
+The serve-side twin of :mod:`mxnet_tpu.transformer.model`: the SAME
+parameter layout, initializer and per-layer math as
+``MeshProgram.loss_replica``, refactored into the two phases an
+autoregressive server actually runs —
+
+- :meth:`DecodeProgram.prefill_replica`: one full causal forward over a
+  length-bucketed prompt, writing every position's K/V into the paged
+  cache and returning the last real position's next-token logits.
+  Causality makes bucket padding *exact*: a padded key at position
+  ``>= length`` is only visible to queries at positions ``>= length``,
+  so real-position logits are bitwise independent of the bucket chosen
+  (the padding-equivalence test in tests/test_decode.py).
+- :meth:`DecodeProgram.decode_replica`: one token step for a fixed
+  batch of sequence slots — embed the last token, write its K/V at
+  ``page_table[b, length // page_size], length % page_size``, attend
+  over the gathered per-sequence pages with a ``position <= length``
+  mask, and emit full-vocab logits (the model-axis shards all-gathered;
+  the vocab is tiny next to the cache).
+
+**Paged cache layout** (docs/serving.md has the full picture): one pool
+per model rank, ``(n_layers, n_pages, page_size, heads_local,
+head_dim)`` for K and V each — a *page* holds ``page_size`` tokens of
+K+V across ALL layers, so the host allocator hands out whole-sequence
+page lists and admission control counts pages, not worst-case
+sequences.  Page 0 is the reserved scratch page: idle batch slots carry
+all-zero page tables and a sequence that overruns its allocation writes
+(and reads) scratch — corruption of live sequences is impossible by
+construction, the host side merely must not *trust* tokens past the
+allocation (DecodeBatcher stops at ``max_new_tokens``).
+
+Both phases are spelled ONCE (the ``parallel/zero.py`` discipline):
+:meth:`build_runtime_fns` jits them (under ``shard_map`` when the plan
+keeps a model axis), and the same bound methods feed
+``jax.make_jaxpr(axis_env=plan.axis_env())`` in the ``decode_step``
+budget model — the executed decode and the proven decode can never
+drift.
+
+``DECODE_WRITE_KV`` is the tier's **mutation seam** (the ``TP_ROW_PSUM``
+discipline): flipping it False skips the cache write — the classic
+stale-KV bug where every decode step attends over a cache missing its
+own token — and the ``decode_step`` budget gate must fail rc=2 with the
+cached-vs-full-forward mismatch named (tests/test_decode.py,
+subprocess).  Production code never touches it.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+
+from ..parallel.mesh import MeshPlan
+from .model import MeshProgram, TransformerLMConfig
+
+__all__ = ["DecodeProgram", "DECODE_WRITE_KV"]
+
+# runtime+analysis mutation seam (module docstring) — tests only
+DECODE_WRITE_KV = True
+
+_NEG_INF = -1e30
+
+
+def _full_logits(logits_local, plan):
+    """All-gather vocab-sharded ``(B, V/Km)`` logits over ``model`` into
+    the replicated ``(B, V)`` row every rank can argmax.  The one place
+    decode pays a vocab-sized collective — cheap by design (the vocab is
+    tiny next to the KV pages) and absent when the axis collapses."""
+    from jax import lax
+    if plan.present("model"):
+        return lax.all_gather(logits_local, "model", axis=1, tiled=True)
+    return logits_local
+
+
+class DecodeProgram:
+    """One ``(config, plan)`` pair's concrete KV-cached decode program.
+
+    ``plan`` may keep only the ``model`` axis: batch is a host concern
+    (continuous batching joins/leaves slots per step) and the sequence
+    dimension lives in the cache, so ``data``/``sequence`` must be
+    collapsed.  ``page_size`` fixes the token-block granularity; the
+    per-sequence page-table width is ``seq_len / page_size`` (a full
+    sequence's worth of slots, unallocated tails pointing at scratch).
+    """
+
+    def __init__(self, cfg, plan=None, page_size=8):
+        if not isinstance(cfg, TransformerLMConfig):
+            cfg = TransformerLMConfig(**cfg)
+        plan = MeshPlan.coerce(plan) or MeshPlan(data=1)
+        plan = plan.resolve(1) if plan.data is None else plan
+        if plan.size("data") != 1 or plan.size("sequence") != 1:
+            raise ValueError(
+                "DecodeProgram serves over the model axis only (batch is "
+                "the host's continuous-batching concern, sequence lives "
+                "in the cache); got %r" % (plan,))
+        if cfg.seq_len % int(page_size):
+            raise ValueError(
+                "page_size %d must divide seq_len %d"
+                % (page_size, cfg.seq_len))
+        self.cfg = cfg
+        self.plan = plan
+        self.program = MeshProgram(cfg, plan)
+        self.page_size = int(page_size)
+        self.pages_per_seq = cfg.seq_len // self.page_size
+        self.heads_local = cfg.n_heads // plan.size("model")
+
+    # -- geometry ----------------------------------------------------------
+    def cache_shape(self, n_pages):
+        """LOCAL (per model rank) K or V pool shape."""
+        return (self.cfg.n_layers, int(n_pages), self.page_size,
+                self.heads_local, self.cfg.head_dim)
+
+    def global_cache_shape(self, n_pages):
+        return (self.cfg.n_layers, int(n_pages), self.page_size,
+                self.cfg.n_heads, self.cfg.head_dim)
+
+    def bytes_per_page(self):
+        """GLOBAL f32 bytes one page pins across all model ranks: K+V for
+        ``page_size`` tokens through every layer — the unit the page
+        allocator and pages-based fleet admission count in."""
+        cfg = self.cfg
+        return (2 * cfg.n_layers * self.page_size * cfg.n_heads
+                * cfg.head_dim * 4)
+
+    def pages_for(self, n_tokens):
+        """Pages a sequence of ``n_tokens`` total (prompt + generation
+        budget) pins, capped nowhere — callers check against the pool."""
+        return -(-int(n_tokens) // self.page_size)
+
+    # -- the per-replica phases (spelled ONCE) ------------------------------
+    def prefill_replica(self, train_vals, cache_k, cache_v, page_table,
+                        tokens, lengths):
+        """Full causal forward over a ``(B, Tb)`` padded prompt bucket:
+        returns ``(logits, cache_k, cache_v)`` with the last *real*
+        position's full-vocab next-token logits and every position's K/V
+        scattered into ``page_table``'s pages (page-table tails of 0
+        land in scratch — see the module docstring).  ``Tb`` must be a
+        page multiple (the bucket ladder is built that way)."""
+        import jax.numpy as jnp
+
+        from . import layers as L
+
+        cfg, plan = self.cfg, self.plan
+        p = dict(zip(self.program.param_names, train_vals))
+        B, Tb = tokens.shape
+        ps = self.page_size
+        h = L.vocab_parallel_embedding(p["embed"], tokens, plan)
+        h = h + p["pos_embed"][:Tb][None]
+        ks, vs = [], []
+        for i in range(cfg.n_layers):
+            pre = "l%d_" % i
+            a = L.layer_norm(h, p[pre + "ln1_scale"], p[pre + "ln1_bias"])
+            a = L.copy_to_model(a, plan)
+            q = jnp.einsum("btd,dhe->bthe", a, p[pre + "wq"])
+            k = jnp.einsum("btd,dhe->bthe", a, p[pre + "wk"])
+            v = jnp.einsum("btd,dhe->bthe", a, p[pre + "wv"])
+            ks.append(k)
+            vs.append(v)
+            o = self._causal_attention(q, k, v)
+            o = jnp.einsum("bthe,hed->btd", o, p[pre + "wo"])
+            h = h + L.row_parallel_out(o, plan)
+            m = L.layer_norm(h, p[pre + "ln2_scale"], p[pre + "ln2_bias"])
+            m = L.copy_to_model(m, plan)
+            f = L.column_parallel_dense(m, p[pre + "w1"], p[pre + "b1"])
+            f = jax.nn.gelu(f)
+            f = f @ p[pre + "w2"]
+            h = h + L.row_parallel_out(f, plan, bias=p[pre + "b2"])
+        # next-token logits of the last real position only: slice the
+        # hidden state BEFORE the vocab projection so the bucket tail
+        # never pays the matmul
+        last = jnp.take_along_axis(
+            h, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1)
+        hf = L.layer_norm(last, p["lnf_scale"], p["lnf_bias"])
+        hf = L.copy_to_model(hf, plan)
+        logits = _full_logits((hf @ p["w_out"])[:, 0], plan)
+        # scatter the prompt K/V into pages: bucket position t lands at
+        # (page_table[b, t // ps], t % ps); unallocated table tails are
+        # 0 and land in scratch
+        npg = Tb // ps
+        pages = page_table[:, :npg]
+        kp = jnp.stack(ks).reshape(
+            cfg.n_layers, B, npg, ps, self.heads_local, cfg.head_dim)
+        vp = jnp.stack(vs).reshape(
+            cfg.n_layers, B, npg, ps, self.heads_local, cfg.head_dim)
+        if DECODE_WRITE_KV:
+            cache_k = cache_k.at[:, pages].set(kp)
+            cache_v = cache_v.at[:, pages].set(vp)
+        return logits, cache_k, cache_v
+
+    def decode_replica(self, train_vals, cache_k, cache_v, page_table,
+                       lengths, tokens):
+        """One token step for every batch slot: ``tokens (B,)`` are the
+        slots' last tokens, ``lengths (B,)`` the cached token counts (=
+        the new token's position).  Writes the new K/V at
+        ``(page_table[b, length // ps], length % ps)``, attends over the
+        gathered pages under a ``position <= length`` mask, and returns
+        ``(logits, cache_k, cache_v)`` — full-vocab next-token logits
+        per slot.  Idle slots (zero table, length 0) compute scratch
+        garbage the host ignores."""
+        import jax.numpy as jnp
+
+        from . import layers as L
+
+        cfg, plan = self.cfg, self.plan
+        p = dict(zip(self.program.param_names, train_vals))
+        ps = self.page_size
+        B = tokens.shape[0]
+        h = L.vocab_parallel_embedding(p["embed"], tokens[:, None], plan)
+        h = h + jnp.take(p["pos_embed"], lengths, axis=0)[:, None]
+        page_ids = jnp.take_along_axis(
+            page_table, (lengths // ps)[:, None], axis=1)[:, 0]
+        offs = lengths % ps
+        kpos = jnp.arange(self.pages_per_seq * ps)
+        seen = kpos[None, :] <= lengths[:, None]          # (B, T_max)
+        scale = cfg.head_dim ** -0.5
+        for i in range(cfg.n_layers):
+            pre = "l%d_" % i
+            a = L.layer_norm(h, p[pre + "ln1_scale"], p[pre + "ln1_bias"])
+            a = L.copy_to_model(a, plan)
+            q = jnp.einsum("btd,dhe->bthe", a, p[pre + "wq"])
+            k = jnp.einsum("btd,dhe->bthe", a, p[pre + "wk"])
+            v = jnp.einsum("btd,dhe->bthe", a, p[pre + "wv"])
+            if DECODE_WRITE_KV:
+                cache_k = cache_k.at[i, page_ids, offs].set(k[:, 0])
+                cache_v = cache_v.at[i, page_ids, offs].set(v[:, 0])
+            kseq = cache_k[i][page_table].reshape(
+                B, -1, self.heads_local, cfg.head_dim)
+            vseq = cache_v[i][page_table].reshape(
+                B, -1, self.heads_local, cfg.head_dim)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kseq) * scale
+            s = jnp.where(seen[:, None, None, :], s, _NEG_INF)
+            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1),
+                           vseq)
+            o = jnp.einsum("bthe,hed->btd", o, p[pre + "wo"])
+            h = h + L.row_parallel_out(o, plan)
+            m = L.layer_norm(h, p[pre + "ln2_scale"], p[pre + "ln2_bias"])
+            m = L.copy_to_model(m, plan)
+            f = L.column_parallel_dense(m, p[pre + "w1"], p[pre + "b1"])
+            f = jax.nn.gelu(f)
+            f = f @ p[pre + "w2"]
+            h = h + L.row_parallel_out(f, plan, bias=p[pre + "b2"])
+        hf = L.layer_norm(h, p["lnf_scale"], p["lnf_bias"])
+        hf = L.copy_to_model(hf, plan)
+        logits = _full_logits((hf @ p["w_out"])[:, 0], plan)
+        return logits, cache_k, cache_v
+
+    def _causal_attention(self, q, k, v):
+        from ..parallel.ring_attention import local_attention
+        return local_attention(q, k, v, causal=True)
+
+    # -- runtime ------------------------------------------------------------
+    def build_runtime_fns(self, mesh=None):
+        """``(prefill_fn, decode_fn)`` — the jitted programs the
+        DecodeRunner dispatches.  With a collapsed plan they are plain
+        jits; with a model axis they are ``shard_map`` programs over
+        ``mesh`` (params ride their partition specs, the cache pools
+        shard their head dim, tokens/lengths/page tables and the
+        all-gathered logits are replicated).  Both donate the cache
+        pools so the update happens in place in HBM."""
+        from jax.sharding import PartitionSpec as P
+
+        if not self.plan.present("model"):
+            prefill = jax.jit(self.prefill_replica,
+                              donate_argnums=(1, 2))
+            decode = jax.jit(self.decode_replica, donate_argnums=(1, 2))
+            return prefill, decode
+        if mesh is None:
+            mesh = self.plan.build_mesh()
+        from ..parallel.ring_attention import _shard_map
+        param_specs = tuple(self.program.partition_spec(n)
+                            for n in self.program.param_names)
+        cache = P(None, None, None, "model", None)
+        prefill = jax.jit(_shard_map(
+            self.prefill_replica, mesh,
+            in_specs=(param_specs, cache, cache, P(), P(), P()),
+            out_specs=(P(), cache, cache)), donate_argnums=(1, 2))
+        decode = jax.jit(_shard_map(
+            self.decode_replica, mesh,
+            in_specs=(param_specs, cache, cache, P(), P(), P()),
+            out_specs=(P(), cache, cache)), donate_argnums=(1, 2))
+        return prefill, decode
+
+    # -- analysis -----------------------------------------------------------
+    def decode_avals(self, n_pages, slots):
+        """Local abstract values of one decode step, in
+        ``decode_replica`` argument order — what the ``decode_step``
+        budget model traces with ``make_jaxpr(axis_env=...)``."""
+        from jax import ShapeDtypeStruct as S
+        import jax.numpy as jnp
+        params = tuple(
+            S(self.program.local_shape(n), jnp.float32)
+            for n in self.program.param_names)
+        cache = S(self.cache_shape(n_pages), jnp.float32)
+        return (params, cache, cache,
+                S((slots, self.pages_per_seq), jnp.int32),
+                S((slots,), jnp.int32), S((slots,), jnp.int32))
+
+    def describe(self):
+        return {"config": self.cfg.describe(),
+                "plan": self.plan.describe(),
+                "page_size": self.page_size,
+                "pages_per_seq": self.pages_per_seq,
+                "bytes_per_page": self.bytes_per_page()}

@@ -67,6 +67,7 @@ class TestLibSVMIter:
                                num_parts=2, part_index=1)
         assert len(it0._rows) == 4 and len(it1._rows) == 4
 
+    @pytest.mark.slow
     def test_trains_sparse_linear(self, tmp_path):
         """The sparse linear example path: LibSVM input end-to-end."""
         import importlib.util

@@ -45,8 +45,11 @@ def test_forward(name):
 
 # one per family: the SURVEY §5 race-detection analogue at model level —
 # the compiled (hybridize→jit) and op-by-op executions must agree
-HYBRID_MODELS = ["resnet18_v1", "resnet18_v2", "vgg11_bn", "alexnet",
-                 "densenet121", "squeezenet1.1", "mobilenet0.25",
+HYBRID_MODELS = ["resnet18_v1", "resnet18_v2",
+                 pytest.param("vgg11_bn", marks=pytest.mark.slow),
+                 "alexnet",
+                 pytest.param("densenet121", marks=pytest.mark.slow),
+                 "squeezenet1.1", "mobilenet0.25",
                  "mobilenetv2_0.25"]
 
 
@@ -61,6 +64,7 @@ def test_hybridize_consistency(name):
     np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_thumbnail_resnet_train_smoke():
     from mxnet_tpu import autograd, gluon
     net = get_model("resnet18_v1", classes=10, thumbnail=True)

@@ -127,6 +127,7 @@ def test_quantize_model_rewrites_conv_and_pooling():
     assert agree >= 0.9, agree
 
 
+@pytest.mark.slow
 def test_resnet18_int8_prediction_agreement():
     """Symbolic resnet-18 (thumbnail): int8 argmax agreement with fp32 —
     the VERDICT's 'accuracy within 1%' check, done as prediction agreement
@@ -270,6 +271,7 @@ class TestBNFolding:
         assert "BatchNorm" in [n.op for n in fsym._nodes()]
 
 
+@pytest.mark.slow
 def test_quantize_model_entropy_nhwc_resnet():
     """End to end: NHWC resnet-18, entropy calibration, BN folding — the
     round-3 int8 path (quantize_v2 ranges come from KL thresholds)."""

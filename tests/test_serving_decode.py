@@ -1,0 +1,431 @@
+"""mxnet_tpu.serving.decode: the paged KV pool, the continuous-batching
+DecodeBatcher, and the fleet's decode surface (ISSUE 17).
+
+The host-side contracts:
+
+- PagePool determinism: ascending allocation, scratch page 0 reserved,
+  LIFO recycling, double-free refused — the page-table arithmetic the
+  batching schedule's byte-identical reruns lean on;
+- continuous batching is DETERMINISTIC: a paused batcher fed a seeded
+  burst (pinned ``token_time_hint_ms`` so the tokens-remaining shed
+  arithmetic has no wall-clock in it) replays to byte-identical
+  ``schedule_events()`` and token-exact results, with deadline sheds
+  confined to the admission path and the bronze tier;
+- chaos at ``serving.batch`` fails the active sequences WITHOUT leaking
+  a single KV page, and the worker keeps serving;
+- fleet admission (the satellite bugfix): fixed-shape runners price the
+  max-over-buckets worst case, decode runners their pages-based
+  ``admission_hbm_bytes()`` override — both flow through SRV004;
+- the SRV006 trace-constant lint and the ``tools/capacity.py --tokens``
+  sizing mode ride the same decode_step budget row the gate pins;
+- headline: a TRAINED TransformerLM served through the fleet under a
+  seeded concurrent mixed-length burst — token-exact vs the sequential
+  no-batching reference, gold p99-per-token inside its declared SLO,
+  sheds confined to bronze, zero steady-state recompiles, zero leaked
+  pages after drain.
+"""
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.resilience import chaos
+from mxnet_tpu.resilience.chaos import ChaosError
+from mxnet_tpu.serving.batcher import RequestShed
+from mxnet_tpu.serving.decode import (DecodeBatcher, DecodeRunner,
+                                      NoPagesFree, PagePool)
+from mxnet_tpu.serving.fleet import ModelFleet
+from mxnet_tpu.transformer import TransformerLMConfig
+from mxnet_tpu.transformer.decode import DecodeProgram
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+CFG = dict(vocab_size=32, d_model=16, n_heads=2, n_layers=2, d_ff=32,
+           seq_len=32)
+
+
+def _runner(slots=2, warmup=True):
+    prog = DecodeProgram(TransformerLMConfig(**CFG), page_size=8)
+    return DecodeRunner(prog, prog.program.init_params(0), slots=slots,
+                        prefill_buckets=(8, 16, 32), warmup=warmup)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return _runner()
+
+
+def _fresh_pool(runner):
+    """Swap in a pristine pool: the determinism reruns must start from
+    identical free-list state, and stale cache content is provably
+    harmless (attention never reads past ``length``)."""
+    runner.pool = PagePool(1 + runner.slots * runner.pages_per_seq,
+                           runner.page_size, runner.pool.bytes_per_page)
+
+
+# -- PagePool ---------------------------------------------------------------
+def test_page_pool_ascending_alloc_and_scratch_reserved():
+    pool = PagePool(9, 8, 1024)
+    assert pool.available == 8          # page 0 never handed out
+    a = pool.alloc(3)
+    b = pool.alloc(2)
+    assert a == [1, 2, 3] and b == [4, 5]
+    assert 0 not in a + b
+    assert pool.pages_in_use == 5
+    d = pool.describe()
+    assert d["n_pages"] == 9 and d["available"] == 3
+    assert d["pages_in_use"] == 5 and d["bytes_per_page"] == 1024
+
+
+def test_page_pool_lifo_recycle_is_deterministic():
+    pool = PagePool(9, 8, 1024)
+    a = pool.alloc(3)
+    pool.free(a)
+    assert pool.alloc(3) == a           # freed pages come back first,
+    assert pool.pages_for(1) == 1       # in the same order
+    assert pool.pages_for(8) == 1 and pool.pages_for(9) == 2
+
+
+def test_page_pool_double_free_raises():
+    pool = PagePool(5, 8, 64)
+    pages = pool.alloc(2)
+    pool.free(pages)
+    with pytest.raises(MXNetError):
+        pool.free(pages)                # already on the free list
+    with pytest.raises(MXNetError):
+        pool.free([0])                  # the scratch page, never leased
+    assert pool.pages_in_use == 0
+
+
+def test_page_pool_exhaustion_raises_no_pages_free():
+    pool = PagePool(4, 8, 64)
+    pool.alloc(3)
+    with pytest.raises(NoPagesFree):
+        pool.alloc(1)
+    assert pool.available == 0 and pool.pages_in_use == 3
+
+
+# -- continuous-batching determinism ----------------------------------------
+# (prompt_len, max_new, tier, deadline_ms): two bronze requests carry a
+# 1ms deadline — with the pinned 5ms/token hint their modeled completion
+# (>= max_new * 5ms) always exceeds it, so they shed AT ADMISSION on
+# every run; deadline never touches the wall-clock sweep path.
+_BURST = [(5, 6, "gold", None), (11, 6, "silver", None),
+          (3, 6, "bronze", 1), (8, 6, "gold", 60000),
+          (16, 6, "bronze", 1), (24, 6, "silver", None),
+          (7, 6, "bronze", None)]
+
+
+def _burst_prompts():
+    rng = np.random.RandomState(7)
+    return [rng.randint(1, CFG["vocab_size"], size=n).astype(np.int32)
+            for n, _, _, _ in _BURST]
+
+
+def _run_burst(runner, prompts):
+    _fresh_pool(runner)
+    batcher = DecodeBatcher(runner, max_queue=32,
+                            token_time_hint_ms=5.0, paused=True)
+    futs, shed = {}, []
+    for i, ((_, max_new, tier, deadline), prompt) in enumerate(
+            zip(_BURST, prompts)):
+        try:
+            futs[i] = batcher.submit(prompt, max_new_tokens=max_new,
+                                     tier=tier, deadline_ms=deadline)
+        except RequestShed as e:
+            assert e.shed_at == "admit"
+            shed.append(i)
+    batcher.release()
+    outs = {i: np.asarray(f.result(120.0), np.int32)
+            for i, f in futs.items()}
+    batcher.drain(timeout=60.0)
+    return outs, tuple(shed), batcher.schedule_events(), batcher.stats
+
+
+def test_continuous_batching_schedule_is_byte_identical(runner):
+    prompts = _burst_prompts()
+    refs = {i: runner.reference_decode(p, _BURST[i][1])
+            for i, p in enumerate(prompts)}           # idle runner
+
+    out1, shed1, ev1, st1 = _run_burst(runner, prompts)
+    out2, shed2, ev2, st2 = _run_burst(runner, prompts)
+
+    assert ev1 == ev2, "schedule diverged across identical reruns"
+    assert shed1 == shed2 == (2, 4)                   # the bronze pair
+    assert set(out1) == set(out2) == {0, 1, 3, 5, 6}
+    for i in out1:
+        assert np.array_equal(out1[i], out2[i])
+        assert np.array_equal(out1[i], refs[i]), \
+            "request %d diverged from the sequential reference" % i
+    # every join/leave/shed is on the tape, sheds confined to admission
+    events = {e for e, _, _ in ev1}
+    assert events == {"join", "leave", "shed-admit"}
+    assert sum(1 for e, _, _ in ev1 if e == "join") == 5
+    for st in (st1, st2):
+        assert st._shed_by_tier == {"bronze": 2}
+        assert st.swept_total == 0
+        assert st.sequences_done_total == 5
+    assert runner.pool.pages_in_use == 0
+    assert runner.recompiles_since_warmup() == 0
+
+
+def test_chaos_step_fault_reclaims_every_page(runner):
+    """A raise mid-decode-step fails every ACTIVE sequence, frees their
+    pages, and the worker keeps serving the queue — then a post-chaos
+    decode works on the same batcher."""
+    prompts = _burst_prompts()[:4]
+    refs = [runner.reference_decode(p, 6) for p in prompts]
+    _fresh_pool(runner)
+    batcher = DecodeBatcher(runner, max_queue=32,
+                            token_time_hint_ms=5.0, paused=True)
+    chaos.install([chaos.Fault("serving.batch", 2, "raise")])
+    try:
+        futs = [batcher.submit(p, max_new_tokens=6) for p in prompts]
+        batcher.release()
+        failed, served = [], []
+        for i, f in enumerate(futs):
+            try:
+                out = np.asarray(f.result(120.0), np.int32)
+            except ChaosError:
+                failed.append(i)
+            else:
+                served.append(i)
+                assert np.array_equal(out, refs[i])
+        # slots=2: requests 0+1 were active at step 2 when the fault
+        # fired; 2+3 joined after and decoded clean
+        assert failed == [0, 1] and served == [2, 3]
+        assert len(chaos.triggered()) == 1
+        # the worker is still alive: decode again through the chaos'd
+        # batcher, token-exact
+        out = np.asarray(batcher.decode(prompts[0], max_new_tokens=6,
+                                        timeout=120.0), np.int32)
+        assert np.array_equal(out, refs[0])
+    finally:
+        chaos.uninstall()
+    batcher.drain(timeout=60.0)
+    assert runner.pool.pages_in_use == 0, \
+        "%d KV pages leaked across the fault" % runner.pool.pages_in_use
+
+
+# -- fleet admission (the satellite bugfix) ----------------------------------
+def _module_runner():
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import ModelRunner
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=3, name="fc2"),
+        name="softmax")
+    mod = mx.mod.Module(sym)
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))], for_training=False)
+    mod.init_params(mx.init.Xavier())
+    return ModelRunner(mod, buckets=(1, 4, 8))
+
+
+def test_fixed_runner_admission_is_max_over_buckets():
+    r = _module_runner()
+    cost = r.modeled_cost()
+    assert set(cost) == {1, 4, 8}
+    worst = max(row["peak_hbm_bytes"] for row in cost.values())
+    assert r.modeled_peak_hbm() == worst
+    # the regression: admission charges the worst bucket, not bucket[0]
+    assert r.admission_hbm_bytes() == worst
+    assert worst >= cost[1]["peak_hbm_bytes"]
+
+
+def test_fleet_prefers_decode_pages_bound_and_enforces_cap():
+    r = _runner(warmup=False)
+    adm = r.admission_hbm_bytes()
+    # pages-based: weights + the KV pool + one step's working set
+    assert adm > r.pool.n_pages * r.pool.bytes_per_page
+    # over-cap registration is refused statically — before any batcher
+    # (or page-table owner) exists
+    tight = ModelFleet(hbm_cap_bytes=adm - 1)
+    with pytest.raises(MXNetError, match="over cap"):
+        tight.register_decode("lm", r)
+    fleet = ModelFleet(hbm_cap_bytes=adm + 1)
+    entry = fleet.register_decode("lm", r)
+    assert entry.hbm_bytes == adm
+    assert fleet.modeled_hbm_total() == adm
+    with pytest.raises(MXNetError, match="already registered"):
+        fleet.register_decode("lm", r)
+    entry.batcher.force_drain()
+
+
+# -- capacity --tokens (the PR-12 simulator rides the budget row) ------------
+def test_capacity_cli_tokens_mode(capsys):
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import capacity
+    base = ["--dau", "20000", "--slo-ms", "2000", "--tokens",
+            "--max-new-tokens", "8", "--slots", "4", "--json"]
+    assert capacity.main(base) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["replicas"] >= 1
+    # derived deterministically from the gated decode_step budget row
+    from mxnet_tpu.mlops.simulator import token_ms_from_decode_step
+    with open(os.path.join(REPO, "STATIC_BUDGETS.json")) as f:
+        row = json.load(f)["models"]["decode_step"]
+    want = token_ms_from_decode_step(
+        {"flops": row["flops"], "bytes_read": row["peak_hbm_bytes"],
+         "bytes_written": 0})
+    assert out["token_ms"] == pytest.approx(want)
+    # a pinned --token-ms overrides the derivation verbatim
+    assert capacity.main(base + ["--token-ms", "2.0"]) == 0
+    out2 = json.loads(capsys.readouterr().out)
+    assert out2["token_ms"] == pytest.approx(2.0)
+
+
+# -- the gated bench contract ------------------------------------------------
+@pytest.mark.slow
+def test_decode_bench_contract_keys():
+    from mxnet_tpu.serving.decode_bench import decode_bench
+    r = decode_bench(n_requests=8, concurrency=2, slots=2)
+    assert r["decode_numerics_ok"] == 1
+    assert r["decode_recompiles"] == 0
+    assert r["decode_pages_leaked"] == 0
+    assert r["decode_tokens_total"] > 0
+    assert r["decode_tokens_per_sec_host"] > 0
+    assert r["decode_p99_per_token_ms"] >= r["decode_p50_per_token_ms"]
+
+
+# -- SRV006 ------------------------------------------------------------------
+_BAD_DECODE = """
+import jax.numpy as jnp
+
+def decode_step(cache, length):
+    if length > 4:%s
+        return jnp.zeros(())
+    return jnp.ones(())
+
+def prefill_tokens(x, pos):
+    y = jnp.asarray(x)
+    return y[:pos]
+"""
+
+
+def test_srv006_flags_trace_constant_geometry():
+    from mxnet_tpu.analysis.serving_lint import lint_decode_trace_constants
+    findings = lint_decode_trace_constants(source=_BAD_DECODE % "")
+    assert len(findings) == 2
+    assert all(f.rule_id == "SRV006" for f in findings)
+    msgs = " ".join(f.message for f in findings)
+    assert "branching" in msgs and "slice bounds" in msgs
+    # the disable comment waives the branch, the slice still fires
+    waived = lint_decode_trace_constants(
+        source=_BAD_DECODE % "  # mxlint: disable=SRV006")
+    assert len(waived) == 1 and "slice bounds" in waived[0].message
+
+
+def test_srv006_shipped_decode_sources_are_clean():
+    from mxnet_tpu.analysis import lint_decode_sources
+    assert lint_decode_sources() == []
+
+
+# -- headline: the trained LM through the fleet -------------------------------
+def _train_tiny_lm(cfg, steps=10, batch=4, seed=0):
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import DataParallelTrainer, MeshPlan
+    from mxnet_tpu.transformer import TransformerLM
+
+    mx.random.seed(seed)
+    trainer = DataParallelTrainer(
+        TransformerLM(cfg), None, "sgd",
+        {"learning_rate": 0.5, "momentum": 0.9},
+        mesh_plan=MeshPlan(data=1))
+    # seeded near-deterministic bigram stream: learnable structure so
+    # the loss provably drops in a handful of steps
+    rng = np.random.RandomState(seed + 7)
+    corpus = np.zeros(2048, np.int64)
+    for i in range(1, len(corpus)):
+        corpus[i] = (5 * corpus[i - 1] + 1
+                     + (7 if rng.rand() < 0.1 else 0)) % cfg.vocab_size
+    losses = []
+    for s in range(steps):
+        starts = rng.randint(0, len(corpus) - cfg.seq_len - 1,
+                             size=batch)
+        x = np.stack([corpus[i:i + cfg.seq_len] for i in starts])
+        y = np.stack([corpus[i + 1:i + 1 + cfg.seq_len] for i in starts])
+        loss = trainer.step(NDArray(jnp.asarray(x)),
+                            NDArray(jnp.asarray(y)))
+        losses.append(float(loss.asnumpy()))
+    trainer.flush()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), \
+        "tiny LM did not train: %r" % losses
+    return trainer.mesh_params()
+
+
+def test_e2e_trained_lm_served_through_fleet_under_burst():
+    cfg = TransformerLMConfig(**CFG)
+    params = _train_tiny_lm(cfg)
+    prog = DecodeProgram(cfg, page_size=8)
+    runner = DecodeRunner(prog, params, slots=2,
+                          prefill_buckets=(8, 16, 32))
+
+    rng = np.random.RandomState(11)
+    lengths = [3, 5, 8, 11, 16, 24, 7, 12]
+    prompts = [rng.randint(1, cfg.vocab_size, size=n).astype(np.int32)
+               for n in lengths]
+    refs = [runner.reference_decode(p, 6) for p in prompts]
+    warm = runner.jit_cache_keys()
+
+    fleet = ModelFleet()
+    fleet.register_decode("lm", runner, max_queue=32,
+                          token_time_hint_ms=5.0,
+                          tier_slos={"gold": 250.0})
+    # 8 concurrent clients over 2 slots: gold/silver served, two bronze
+    # requests carry an unmeetable 1ms deadline (modeled completion
+    # >= 6 tokens x 5ms hint) — shed at admission, every run
+    tiers = ["gold", "silver", "gold", "silver", "bronze", "bronze",
+             "gold", "silver"]
+    results, sheds, errors = {}, [], []
+
+    def client(k):
+        try:
+            deadline = 1 if tiers[k] == "bronze" else None
+            results[k] = np.asarray(
+                fleet.decode(prompts[k], model="lm", max_new_tokens=6,
+                             timeout=120.0, tier=tiers[k],
+                             deadline_ms=deadline), np.int32)
+        except RequestShed:
+            sheds.append(k)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    # sheds confined to bronze; everything else served token-exact
+    assert sorted(sheds) == [4, 5]
+    assert sorted(results) == [0, 1, 2, 3, 6, 7]
+    for k, out in results.items():
+        assert np.array_equal(out, refs[k]), \
+            "request %d diverged from the sequential reference" % k
+
+    st = fleet.entry("lm").batcher.stats
+    assert set(st._shed_by_tier) == {"bronze"}
+    assert st._shed_by_tier["bronze"] == 2
+    # the declared gold SLO holds on the measured per-token latency
+    p50, p99 = st.token_latency_ms("gold")
+    assert 0.0 < p50 <= p99 < 250.0, (p50, p99)
+
+    # zero steady-state recompiles, zero leaked pages
+    assert runner.jit_cache_keys() == warm
+    assert runner.recompiles_since_warmup() == 0
+    fleet.entry("lm").batcher.drain(timeout=60.0)
+    assert runner.pool.pages_in_use == 0

@@ -80,6 +80,7 @@ def _conv_sym(nclass, layout="NHWC", dtype=None):
     return mx.sym.SoftmaxOutput(fc, name="softmax")
 
 
+@pytest.mark.slow
 def test_conv_convergence():
     """Small conv net trains to >=95% (reference: train/test_conv.py)."""
     X, y = _blob_images(512, 4)
@@ -141,6 +142,7 @@ def test_bf16_training_matches_fp32():
     assert acc16 >= acc32 - 0.02, (acc32, acc16)
 
 
+@pytest.mark.slow
 def test_bucketing_lm_convergence():
     """Bucketing char-LM trains until perplexity clearly drops
     (reference: train/test_bucketing.py's perplexity bound)."""

@@ -63,6 +63,18 @@ Gated metrics (direction, tolerance)::
     fusion_numerics_ok                 higher, zero slack (fused must
                                        equal unfused Optimizer.update:
                                        1.0 or regression)
+    decode_tokens_per_sec_host         higher, 10% relative (continuous
+                                       batching through the paged KV
+                                       cache on the 1-core host)
+    decode_numerics_ok                 higher, zero slack (cached decode
+                                       must equal the no-cache full-
+                                       forward reference: 1.0 or
+                                       regression)
+    decode_recompiles                  lower, zero slack (steady-state
+                                       decode traffic must never grow
+                                       the jit cache)
+    decode_pages_leaked                lower, zero slack (every retired
+                                       sequence returns its KV pages)
 
 A metric with fewer than two live occurrences has no prior bar and
 passes vacuously (the r01–r05 lineage: ``value`` is live in r01+r02,
@@ -145,6 +157,15 @@ GATES = {
     "fused_optimizer_speedup_host": ("higher", 0.10),
     "modeled_fusion_bytes_saved_pct": ("higher", 0.02),
     "fusion_numerics_ok": ("higher", 0.0),
+    # decode stage (r07 onward): continuous-batching token throughput is
+    # wall time on the noisy 1-core host (10% rel); the cached-vs-full-
+    # forward numerics contract and the zero-recompile/zero-page-leak
+    # contracts are hard — any drop from 1.0 / rise from 0 is a serving
+    # regression, zero slack
+    "decode_tokens_per_sec_host": ("higher", 0.10),
+    "decode_numerics_ok": ("higher", 0.0),
+    "decode_recompiles": ("lower_abs", 0.0),
+    "decode_pages_leaked": ("lower_abs", 0.0),
 }
 
 _RECORD_KEYS = ("n", "cmd", "rc", "parsed")

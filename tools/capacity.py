@@ -5,6 +5,16 @@ deterministically by the mlops fleet simulator.
     python tools/capacity.py --dau 1000000 --slo-ms 250
     python tools/capacity.py --dau 5000000 --slo-ms 100 \
         --service-ms 1=8,4=18,8=32 --window-s 60 --json
+    python tools/capacity.py --dau 200000 --slo-ms 2000 --tokens \
+        --max-new-tokens 16 --slots 4
+
+``--tokens`` switches to the autoregressive decode tier's token-level
+service model (``decode_service_model``): a request costs its token
+budget (``prefill + max_new x token_ms``), not one fixed-shape forward,
+with the per-token step time pinned by ``--token-ms`` or derived
+deterministically from the ``decode_step`` row of STATIC_BUDGETS.json
+(``token_ms_from_decode_step`` — the same modeled roofline the budget
+gate pins, so the capacity answer moves only when the budget row does).
 
 The traffic model is the seeded diurnal generator scaled to ``--dau``
 (mean rate = dau x requests/user/day / 86400, judged on a window at the
@@ -78,19 +88,68 @@ def parse_args(argv=None):
     p.add_argument("--batch-timeout-ms", type=float, default=2.0)
     p.add_argument("--max-queue", type=int, default=128)
     p.add_argument("--max-replicas", type=int, default=4096)
+    p.add_argument("--tokens", action="store_true",
+                   help="size the autoregressive decode tier: token-"
+                        "level service times (a request holds a slot "
+                        "for prefill + max_new x token_ms) instead of "
+                        "the per-bucket batch table")
+    p.add_argument("--token-ms", type=float, default=None,
+                   help="pinned per-token decode step time; default: "
+                        "derived from the decode_step row of "
+                        "STATIC_BUDGETS.json")
+    p.add_argument("--max-new-tokens", type=int, default=16,
+                   help="token budget each decode request holds pages "
+                        "and a slot for (--tokens)")
+    p.add_argument("--prefill-ms", type=float, default=2.0,
+                   help="modeled prompt prefill time per request "
+                        "(--tokens)")
+    p.add_argument("--slots", type=int, default=4,
+                   help="decode slot width per replica — the coalescing "
+                        "bound under --tokens")
     p.add_argument("--json", action="store_true", dest="as_json")
     return p.parse_args(argv)
 
 
+def resolve_token_ms(args):
+    """The pinned per-token step time: ``--token-ms`` verbatim, else
+    derived from the gated ``decode_step`` budget row so the capacity
+    answer is byte-identical on any host and moves only when the budget
+    moves."""
+    if args.token_ms is not None:
+        return float(args.token_ms)
+    from mxnet_tpu.mlops.simulator import token_ms_from_decode_step
+    with open(os.path.join(_ROOT, "STATIC_BUDGETS.json")) as f:
+        row = json.load(f)["models"]["decode_step"]
+    # decode is memory-bound: the step streams its resident working set
+    # (the budget row's peak HBM) roughly once per token
+    return token_ms_from_decode_step(
+        {"flops": row["flops"], "bytes_read": row["peak_hbm_bytes"],
+         "bytes_written": 0})
+
+
 def answer(args):
-    from mxnet_tpu.mlops.simulator import (SimConfig, required_replicas,
+    from mxnet_tpu.mlops.simulator import (SimConfig,
+                                           decode_service_model,
+                                           required_replicas,
                                            trace_for_dau)
 
-    table = parse_service_ms(args.service_ms)
-    buckets = tuple(sorted(table))
-    cfg = SimConfig(service_ms=lambda b: table[b], buckets=buckets,
-                    batch_timeout_ms=args.batch_timeout_ms,
-                    max_queue=args.max_queue)
+    if args.tokens:
+        token_ms = resolve_token_ms(args)
+        slots = max(1, int(args.slots))
+        buckets = tuple(sorted({1, max(1, slots // 2), slots}))
+        cfg = SimConfig(
+            service_ms=decode_service_model(token_ms,
+                                            args.max_new_tokens,
+                                            prefill_ms=args.prefill_ms),
+            buckets=buckets, max_batch=slots,
+            batch_timeout_ms=args.batch_timeout_ms,
+            max_queue=args.max_queue)
+    else:
+        table = parse_service_ms(args.service_ms)
+        buckets = tuple(sorted(table))
+        cfg = SimConfig(service_ms=lambda b: table[b], buckets=buckets,
+                        batch_timeout_ms=args.batch_timeout_ms,
+                        max_queue=args.max_queue)
     deadlines = {"gold": 500.0, "silver": 400.0, "bronze": 150.0}
     deadlines[args.slo_tier] = float(args.slo_ms)
     trace = trace_for_dau(
@@ -114,16 +173,26 @@ def main(argv=None):
         print("UNSATISFIABLE: %s" % e)
         return 3
     if args.as_json:
-        print(json.dumps({"replicas": replicas, "dau": args.dau,
-                          "slo_tier": args.slo_tier,
-                          "slo_p99_ms": args.slo_ms,
-                          "arrivals": len(trace),
-                          "report": report}, indent=1, sort_keys=True,
-                         default=str))
+        out = {"replicas": replicas, "dau": args.dau,
+               "slo_tier": args.slo_tier,
+               "slo_p99_ms": args.slo_ms,
+               "arrivals": len(trace),
+               "report": report}
+        if args.tokens:
+            out["token_ms"] = resolve_token_ms(args)
+            out["max_new_tokens"] = args.max_new_tokens
+            out["slots"] = args.slots
+        print(json.dumps(out, indent=1, sort_keys=True, default=str))
     else:
         mean_rps = args.dau * args.requests_per_user_per_day / 86400.0
         print("%.0f DAU -> %.1f reqs/s mean, ~%.1f at the diurnal crest"
               % (args.dau, mean_rps, mean_rps * args.peak_factor))
+        if args.tokens:
+            token_ms = resolve_token_ms(args)
+            print("decode tier: %.3fms/token x %d tokens + %.1fms "
+                  "prefill per request, %d slots/replica"
+                  % (token_ms, args.max_new_tokens, args.prefill_ms,
+                     args.slots))
         print("replicas needed for %s p99 <= %.0fms: %d"
               % (args.slo_tier, args.slo_ms, replicas))
         print(report.render())

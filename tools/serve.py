@@ -21,9 +21,17 @@ SIGTERM/SIGINT.  See docs/serving.md.
         --model resnet_int8=ckpt/resnet@3:int8 \
         --fallback resnet=resnet_int8 --hbm-cap $((8 << 30))
 
+    # an autoregressive decode model (paged KV cache, continuous
+    # batching) from a resilience checkpoint directory, beside the
+    # fixed-shape fleet
+    python tools/serve.py --decode lm=ckpt/lm_decode@200 --port 8080
+
     curl -s -X POST localhost:8080/predict \
         -d '{"data": [[0.1, ...]], "model": "resnet", "tier": "silver",
              "deadline_ms": 50}'
+    curl -s -X POST localhost:8080/decode \
+        -d '{"prompt": [5, 12, 3], "model": "lm", "max_new_tokens": 16,
+             "tier": "gold"}'
     curl -s localhost:8080/readyz; curl -s localhost:8080/stats
 """
 from __future__ import annotations
@@ -54,6 +62,17 @@ def parse_args(argv=None):
                         ":int8 suffix quantizes it at load (naive "
                         "calibration over synthetic data — the cheap "
                         "degraded-mode variant).  Repeatable.")
+    p.add_argument("--decode", action="append", default=[],
+                   metavar="NAME=DIR[@STEP]",
+                   help="register an autoregressive decode model from a "
+                        "resilience checkpoint directory (payload: "
+                        "transformer-LM config + MeshProgram params, the "
+                        "format examples/serving/decode_demo.py saves); "
+                        "@STEP picks a step, default the newest loadable "
+                        "one.  Served on POST /decode.  Repeatable.")
+    p.add_argument("--decode-slots", type=int, default=4,
+                   help="decode batch width per --decode model — the "
+                        "continuous-batching bound (one compile)")
     p.add_argument("--fallback", action="append", default=[],
                    metavar="NAME=VARIANT",
                    help="degraded mode: overflow NAME sheds (or refuses "
@@ -130,6 +149,59 @@ def parse_model_spec(spec):
     return name, prefix, epoch, int8
 
 
+def parse_decode_spec(spec):
+    """``NAME=DIR[@STEP]`` -> (name, directory, step or None)."""
+    name, sep, rest = str(spec).partition("=")
+    if not sep or not name or not rest:
+        raise SystemExit("bad --decode spec %r (want NAME=DIR[@STEP])"
+                         % (spec,))
+    directory, sep, st = rest.partition("@")
+    try:
+        step = int(st) if sep else None
+    except ValueError:
+        raise SystemExit("bad step in --decode spec %r" % (spec,))
+    if not directory:
+        raise SystemExit("empty checkpoint dir in --decode spec %r"
+                         % (spec,))
+    return name, directory, step
+
+
+def _load_decode_runner(directory, step, slots, warmup=True):
+    """Build a :class:`DecodeRunner` from a resilience checkpoint whose
+    payload carries ``{"kind": "transformer_lm_decode", "config":
+    cfg.describe(), "params": {name: array}, "page_size": N}`` — the
+    format ``examples/serving/decode_demo.py`` saves.  Provenance (the
+    digest /healthz surfaces) rides along from the checkpoint record."""
+    from mxnet_tpu.resilience.checkpoint import (list_checkpoints,
+                                                 load_checkpoint,
+                                                 provenance)
+    from mxnet_tpu.serving.decode import DecodeRunner
+    from mxnet_tpu.transformer import TransformerLMConfig
+    from mxnet_tpu.transformer.decode import DecodeProgram
+
+    entries = dict(list_checkpoints(directory))
+    if not entries:
+        raise SystemExit("no checkpoints under %r" % (directory,))
+    if step is None:
+        step = max(entries)
+    if step not in entries:
+        raise SystemExit("no step-%d checkpoint under %r (have %s)"
+                         % (step, directory, sorted(entries)))
+    rec = load_checkpoint(entries[step])
+    payload = rec["payload"]
+    if not isinstance(payload, dict) or \
+            payload.get("kind") != "transformer_lm_decode":
+        raise SystemExit(
+            "checkpoint %r is not a transformer_lm_decode payload "
+            "(got kind=%r)" % (entries[step],
+                               payload.get("kind")
+                               if isinstance(payload, dict) else None))
+    cfg = TransformerLMConfig(**payload["config"])
+    prog = DecodeProgram(cfg, page_size=int(payload.get("page_size", 8)))
+    return DecodeRunner(prog, payload["params"], slots=slots,
+                        warmup=warmup, provenance=provenance(rec))
+
+
 def _load_module(prefix, epoch, data_name, example_shape, buckets,
                  int8=False):
     """Load a Module checkpoint bound for bucketed inference; with
@@ -201,9 +273,9 @@ def build_fleet(args):
     (SRV004) before any traffic arrives."""
     from mxnet_tpu.serving import ModelFleet, ModelRunner
 
-    if not args.data_shape:
+    if args.model and not args.data_shape:
         raise SystemExit("--data-shape is required with --model")
-    example_shape = _shape(args.data_shape)
+    example_shape = _shape(args.data_shape) if args.data_shape else None
     buckets = _shape(args.buckets)
     fallbacks = {}
     for spec in args.fallback:
@@ -248,17 +320,31 @@ def build_fleet(args):
         fleet.set_canary(name, canary_name,
                          schedule=(args.canary_fraction,),
                          seed=args.canary_seed)
+    # decode models: the autoregressive tier beside the fixed-shape
+    # ones — same SRV004 packing ledger (priced by pages), routed on
+    # POST /decode, never a fallback target (live page tables pin one
+    # runner's cache pool)
+    for spec in args.decode:
+        name, directory, step = parse_decode_spec(spec)
+        if name in names:
+            raise SystemExit("--decode name %r collides with a --model "
+                             "registration" % name)
+        runner = _load_decode_runner(directory, step, args.decode_slots,
+                                     warmup=not args.no_warmup)
+        fleet.register_decode(name, runner, max_queue=args.max_queue)
+        names.append(name)
     return fleet
 
 
 def main(argv=None):
     args = parse_args(argv)
-    if not args.demo and not args.prefix and not args.model:
-        raise SystemExit("give --model specs (a fleet), --prefix "
-                         "(a checkpoint) or --demo")
+    if not args.demo and not args.prefix and not args.model \
+            and not args.decode:
+        raise SystemExit("give --model/--decode specs (a fleet), "
+                         "--prefix (a checkpoint) or --demo")
 
     from mxnet_tpu.serving import Server
-    if args.model:
+    if args.model or args.decode:
         target = build_fleet(args)
         summary = "fleet %s" % target.models()
     else:
