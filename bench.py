@@ -413,6 +413,19 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_DECODE", "1") == "1":
         rec.stage("decode", 150, _decode_bench)
 
+    # -- mixed-precision micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): fused_loss_scaled_speedup_host (the
+    # unscale+clip+update+select-skip chain vs the one-pass fused
+    # kernel), bf16_modeled_hbm_ratio (deterministic, from the
+    # bf16_zero1_train_step budget builder), bf16_convergence_delta
+    # (bf16 vs f32 loss trajectories, same seed) and
+    # int8_kv_decode_tokens_per_sec_host (+ token agreement with the
+    # f32 cache) stay live when the TPU is down — docs/precision.md.
+    # NOTE: MXTPU_BENCH_PRECISION (no _STAGE) is the matmul-precision
+    # knob below; the stage toggle is deliberately distinct.
+    if os.environ.get("MXTPU_BENCH_PRECISION_STAGE", "1") == "1":
+        rec.stage("precision", 150, _precision_bench)
+
     # default 256/chip: the reference's headline number is bs=32-per-GPU,
     # but modern chips need larger batches to fill the MXU — measured on
     # one chip (bf16): bs=128 → ~2000, bs=256 → ~2300, bs=512 → ~2250
@@ -787,6 +800,27 @@ def _fusion_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("fusion bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _precision_bench():
+    """fused_loss_scaled_speedup_host + bf16_modeled_hbm_ratio +
+    bf16_convergence_delta + int8_kv_decode_tokens_per_sec_host +
+    precision_numerics_ok through the mixed-precision harness
+    (mxnet_tpu/precision_bench.py).  JAX_PLATFORMS=cpu subprocess —
+    same isolation contract as the other host stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual test mesh in the child
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.precision_bench"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("precision bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

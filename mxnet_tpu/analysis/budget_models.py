@@ -205,10 +205,11 @@ def zero1_mlp_train_step():
     return report, findings, shard
 
 
-def _zero1_geometry_trainer(zero):
+def _zero1_geometry_trainer(zero, dtype="float32"):
     """A real ``DataParallelTrainer`` at the pinned ``ZERO1_GEOMETRY``
     (the fixture's 3-layer MLP), on the 1-cpu-device mesh — hardware-
-    free analysis subject for the runtime half of the ZeRO-1 proof."""
+    free analysis subject for the runtime half of the ZeRO-1 proof
+    (and, with ``dtype="bf16"``, of the mixed-precision one)."""
     import jax
 
     from .. import init as mx_init
@@ -226,7 +227,7 @@ def _zero1_geometry_trainer(zero):
     return DataParallelTrainer(
         net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": g["lr"], "momentum": g["momentum"]},
-        mesh=_cpu_mesh(), zero=zero)
+        mesh=_cpu_mesh(), zero=zero, dtype=dtype)
 
 
 def zero1_runtime_checks(fixture_report, tolerance_pct=10.0):
@@ -369,6 +370,98 @@ def zero1_runtime_checks(fixture_report, tolerance_pct=10.0):
         "runtime_inferred_psum_bytes": int(inferred),
     }
     return findings, extras
+
+
+# Pinned ceilings for the mixed-precision ZeRO-1 proof: measured at
+# ZERO1_GEOMETRY, the bf16 trainer models peak HBM at 0.660x its f32
+# twin (the 34% drop docs/precision.md claims: bf16 params, activations
+# and all-gather, f32 masters only as the 1/K shard) and collective
+# bytes at 0.750x (the all-gather halves; the gradient reduce-scatter
+# deliberately stays f32 — the tightened DST004 contract).  The
+# ceilings sit above the measured ratios with margin but BELOW the
+# broken spellings: re-deriving masters from a full flat f32 vector
+# per rank (the PRECISION_MASTER_F32 seam) models 0.769x and fails.
+BF16_PEAK_HBM_RATIO_CEILING = 0.70
+BF16_COLLECTIVE_RATIO_CEILING = 0.78
+
+
+def bf16_zero1_train_step():
+    """Mixed-precision ZeRO-1 (docs/precision.md) as a static proof:
+    the real ``DataParallelTrainer(dtype="bf16", zero=1)`` step tape at
+    the pinned ``ZERO1_GEOMETRY``, gated three ways —
+
+    - the runtime DST/mixed-axis lint: the gradient reduce-scatter must
+      run f32 (``PRECISION_F32_GRAD_REDUCE`` flipped = a bf16 ring
+      reduction = the tightened DST004, rc 2);
+    - modeled peak HBM at most ``BF16_PEAK_HBM_RATIO_CEILING`` x the
+      f32 twin's: holds only while the f32 masters exist solely as the
+      1/K shard — ``PRECISION_MASTER_F32`` flipped re-derives them from
+      a full per-rank flat f32 vector and busts the ceiling (rc 2);
+    - modeled collective bytes at most
+      ``BF16_COLLECTIVE_RATIO_CEILING`` x the twin's: the param
+      all-gather must move bf16 on the wire.
+
+    The budget row pins the bf16 tape's absolute metrics; the ratios
+    ride the shard extras."""
+    import jax
+
+    from . import shard_fixtures as sf
+    from .findings import Finding
+
+    k = DECLARED_AXIS
+    g = sf.ZERO1_GEOMETRY
+    data_shape = (g["batch"] * k, g["in_dim"])
+    label_shape = (g["batch"] * k,)
+
+    trainer = _zero1_geometry_trainer(zero=1, dtype="bf16")
+    report, findings, shard = trainer.zero_report(
+        data_shape=data_shape, label_shape=label_shape,
+        label_dtype="int32", declared_axis_size=k)
+
+    # the f32 twin: same geometry, same ZeRO-1 spelling, full precision
+    # (its own gate lives in zero1_mlp_train_step — only the ratio is
+    # this row's business)
+    twin = _zero1_geometry_trainer(zero=1, dtype="float32")
+    twin_report, _, _ = twin.zero_report(
+        data_shape=data_shape, label_shape=label_shape,
+        label_dtype="int32", declared_axis_size=k)
+
+    peak_ratio = report.peak_hbm_bytes / max(twin_report.peak_hbm_bytes,
+                                             1)
+    coll_ratio = report.collective_bytes / max(
+        twin_report.collective_bytes, 1)
+    if peak_ratio > BF16_PEAK_HBM_RATIO_CEILING:
+        findings.append(Finding(
+            "COST001", "bf16_zero1_train_step.peak_hbm_bytes",
+            "mixed-precision proof violated: the bf16 ZeRO-1 step "
+            "models peak HBM at %.3fx its f32 twin (%d vs %d bytes), "
+            "over the %.2f ceiling — the f32 masters are no longer "
+            "confined to the 1/%d shard (or the params/activations "
+            "stopped being bf16)"
+            % (peak_ratio, report.peak_hbm_bytes,
+               twin_report.peak_hbm_bytes,
+               BF16_PEAK_HBM_RATIO_CEILING, k)))
+    if coll_ratio > BF16_COLLECTIVE_RATIO_CEILING:
+        findings.append(Finding(
+            "COST001", "bf16_zero1_train_step.collective_bytes",
+            "mixed-precision proof violated: the bf16 ZeRO-1 step "
+            "models collective bytes at %.3fx its f32 twin (%d vs %d), "
+            "over the %.2f ceiling — the param all-gather is no longer "
+            "moving bf16 on the wire"
+            % (coll_ratio, report.collective_bytes,
+               twin_report.collective_bytes,
+               BF16_COLLECTIVE_RATIO_CEILING)))
+
+    shard.extras.update({
+        "bf16_peak_hbm_bytes": int(report.peak_hbm_bytes),
+        "f32_twin_peak_hbm_bytes": int(twin_report.peak_hbm_bytes),
+        "bf16_peak_hbm_ratio": round(peak_ratio, 4),
+        "bf16_collective_bytes": int(report.collective_bytes),
+        "f32_twin_collective_bytes": int(twin_report.collective_bytes),
+        "bf16_collective_ratio": round(coll_ratio, 4),
+        "bf16_modeled_hbm_drop_pct": round(100.0 * (1 - peak_ratio), 2),
+    })
+    return report, findings, shard
 
 
 def ring_attention_fwd():
@@ -1077,6 +1170,7 @@ BUDGET_MODELS = {
     "convnet_infer": convnet_infer,
     "resnet50_train_step": resnet50_train_step,
     "zero1_mlp_train_step": zero1_mlp_train_step,
+    "bf16_zero1_train_step": bf16_zero1_train_step,
     "ring_attention_fwd": ring_attention_fwd,
     "ulysses_attention": ulysses_attention,
     "tp_transformer_train_step": tp_transformer_train_step,

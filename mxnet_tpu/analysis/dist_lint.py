@@ -27,9 +27,14 @@ over the data axis makes its output invariant.  Then:
   helpers and the step inputs — a parameter PartitionSpec that uses the
   data axis, names an axis the mesh lacks, or outranks the parameter;
   a batch axis the mesh cannot split evenly.
-- **DST004** (warning): collective dtype promotion — the reduced
-  operand was widened (e.g. bf16 grads converted to f32) right before
-  the collective: 2× the wire bytes the math needs.
+- **DST004**: collective reduction dtype.  A sub-f32 float (bf16/f16)
+  reduced over the data axis is an **error** — a ring reduction
+  accumulates one rounding per hop, so gradients must be cast to f32
+  BEFORE the collective (the mixed-precision contract,
+  docs/precision.md; ``precision.PRECISION_F32_GRAD_REDUCE`` is the
+  seam proving this gate bites).  An operand already ≥f32 that was
+  *widened* right before the collective (f32→f64) stays a warning:
+  wider wire bytes than the math needs.
 - **DST005** (warning): a Python value was baked into the step program
   as a closure constant.  A step program should be constant-free
   (everything iteration-dependent enters as an argument); a baked value
@@ -41,7 +46,7 @@ from __future__ import annotations
 import numpy as _np
 
 from .cost import build_tape, _aval_bytes
-from .findings import Finding, filter_findings
+from .findings import ERROR, Finding, filter_findings
 
 __all__ = ["lint_dist_step", "lint_trainer", "dist_summary"]
 
@@ -60,6 +65,48 @@ def _is_float(dtype):
         return bool(jnp.issubdtype(jnp.dtype(dtype), jnp.floating))
     except TypeError:
         return False
+
+
+def _dtype_findings(op, tape, producer, data_axis, subject):
+    """DST004 over one reducing collective's operands (module
+    docstring): sub-f32 float on the wire is an ERROR, a ≥f32 operand
+    widened immediately before the collective stays a WARNING."""
+    out = []
+    for i in op.in_ids:
+        dt = tape.avals[i].dtype
+        if not _is_float(dt):
+            continue
+        if _np.dtype(dt).itemsize < 4:
+            out.append(Finding(
+                "DST004", subject,
+                "%s over axis %r reduces %s on the wire: a ring "
+                "reduction accumulates one rounding per hop, so "
+                "gradients must be cast to float32 BEFORE the "
+                "collective and only narrowed after (the "
+                "mixed-precision contract, docs/precision.md)"
+                % (op.prim, data_axis, _np.dtype(dt).name),
+                severity=ERROR))
+            continue
+        src = producer.get(i)
+        if src is not None and src.prim == "convert_element_type":
+            in_dt = tape.avals[src.in_ids[0]].dtype \
+                if src.in_ids else dt
+            if (_is_float(in_dt)
+                    and 4 <= _np.dtype(in_dt).itemsize
+                    < _np.dtype(dt).itemsize):
+                out.append(Finding(
+                    "DST004", subject,
+                    "%s over axis %r reduces a value widened "
+                    "%s->%s immediately before the collective: "
+                    "%.2f MiB on the wire where %.2f would do — "
+                    "reduce in %s and widen after (or make the "
+                    "promotion explicit)"
+                    % (op.prim, data_axis, _np.dtype(in_dt).name,
+                       _np.dtype(dt).name,
+                       _aval_bytes(tape.avals[i]) / (1 << 20),
+                       _aval_bytes(tape.avals[src.in_ids[0]])
+                       / (1 << 20), _np.dtype(in_dt).name)))
+    return out
 
 
 def lint_dist_step(closed_jaxpr, data_axis, varying_invars,
@@ -97,30 +144,15 @@ def lint_dist_step(closed_jaxpr, data_axis, varying_invars,
                     % (op.prim, data_axis)))
             # reduced over the data axis: output identical on every
             # replica regardless of operand variance
-            # DST004: was the reduced operand widened just before?
-            for i in op.in_ids:
-                src = producer.get(i)
-                if src is not None and src.prim == "convert_element_type":
-                    out_dt = tape.avals[i].dtype
-                    in_dt = tape.avals[src.in_ids[0]].dtype \
-                        if src.in_ids else out_dt
-                    if (_is_float(out_dt) and _is_float(in_dt)
-                            and _np.dtype(out_dt).itemsize
-                            > _np.dtype(in_dt).itemsize):
-                        findings.append(Finding(
-                            "DST004", subject,
-                            "%s over axis %r reduces a value widened "
-                            "%s->%s immediately before the collective: "
-                            "%.2f MiB on the wire where %.2f would do — "
-                            "reduce in %s and widen after (or make the "
-                            "promotion explicit)"
-                            % (op.prim, data_axis, _np.dtype(in_dt).name,
-                               _np.dtype(out_dt).name,
-                               _aval_bytes(tape.avals[i]) / (1 << 20),
-                               _aval_bytes(tape.avals[src.in_ids[0]])
-                               / (1 << 20), _np.dtype(in_dt).name)))
+            findings.extend(_dtype_findings(op, tape, producer,
+                                            data_axis, subject))
             continue
         if op.prim in _NON_REDUCING and touches_axis:
+            if op.prim == "reduce_scatter":
+                # a reduce_scatter sums over the wire exactly like psum
+                # (only the result layout differs): same dtype contract
+                findings.extend(_dtype_findings(op, tape, producer,
+                                                data_axis, subject))
             # value still differs per replica (gathered/permuted layout)
             if any_varying:
                 varying.update(op.out_ids)

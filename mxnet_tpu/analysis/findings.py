@@ -141,9 +141,14 @@ RULES = {
                       "and the step inputs (param spec uses the data "
                       "axis, names a missing axis, outranks the param, "
                       "or the batch does not divide the axis)"),
-    "DST004": (WARNING, "collective operand widened (e.g. bf16->f32) "
-                        "immediately before the reduction: the wire "
-                        "carries wider bytes than the math needs"),
+    "DST004": (WARNING, "collective reduction dtype wrong for the wire: "
+                        "a sub-f32 float (bf16/f16) reduced over the "
+                        "data axis is an ERROR (ring reductions "
+                        "accumulate rounding per hop — cast the grads "
+                        "to f32 BEFORE the collective, "
+                        "docs/precision.md), and an f32+ operand "
+                        "widened immediately before the reduction is a "
+                        "WARNING (wider wire bytes than the math needs)"),
     "DST005": (WARNING, "step program closes over a baked Python "
                         "constant: iteration-dependent values captured "
                         "at trace time diverge across hosts"),

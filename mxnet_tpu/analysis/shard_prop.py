@@ -52,6 +52,38 @@ __all__ = ["MeshSpec", "ShardSpec", "CollectiveEvent", "ReshardEvent",
 _REDUCING = frozenset({"psum", "pmax", "pmin"})
 
 
+def _reduce_dtype_findings(op, tape, subject):
+    """Tightened DST004 over one wire-reducing collective (psum or
+    reduce_scatter): sub-f32 float operands are an ERROR — shared with
+    ``dist_lint``'s replicated-spelling pass."""
+    import numpy as _np
+
+    from .findings import ERROR as _ERR
+    out = []
+    for i in op.in_ids:
+        aval = tape.avals.get(i)
+        dt = getattr(aval, "dtype", None)
+        if dt is None:
+            continue
+        try:
+            import jax.numpy as jnp
+            if not jnp.issubdtype(jnp.dtype(dt), jnp.floating):
+                continue
+        except TypeError:
+            continue
+        if _np.dtype(dt).itemsize < 4:
+            out.append(Finding(
+                "DST004", subject,
+                "%s over %r reduces %s on the wire: a ring reduction "
+                "accumulates one rounding per hop, so gradients must "
+                "be cast to float32 BEFORE the collective and only "
+                "narrowed after (the mixed-precision contract, "
+                "docs/precision.md)"
+                % (op.prim, sorted(op.axes), _np.dtype(dt).name),
+                severity=_ERR))
+    return out
+
+
 class MeshSpec:
     """A mesh as pure declaration: ordered ``{axis_name: size}``.
 
@@ -594,6 +626,13 @@ def lint_sharded_step(closed_jaxpr, mesh, data_axes=("data",),
     findings = []
 
     def on_reduce(t, op, state, axes):
+        # DST004 (tightened, docs/precision.md): a gradient reduction
+        # over the data axes must run f32 on the wire — a sub-f32 float
+        # accumulates one rounding per ring hop.  Scoped to the data
+        # axes: a bf16 row-parallel activation psum over a model axis
+        # is legitimate mixed-precision practice.
+        if axes & data_axes:
+            findings.extend(_reduce_dtype_findings(op, tape, subject))
         for a in sorted(axes):
             if a in state.partial:
                 continue            # completes a partial sum: legit

@@ -78,13 +78,27 @@ def service_ms_from_modeled_cost(cost_row, flops_per_s=DEFAULT_FLOPS_PER_S,
 
 def token_ms_from_decode_step(cost_row, flops_per_s=DEFAULT_FLOPS_PER_S,
                               bytes_per_s=DEFAULT_BYTES_PER_S,
-                              overhead_ms=DEFAULT_OVERHEAD_MS):
+                              overhead_ms=DEFAULT_OVERHEAD_MS,
+                              kv_pool_bytes_f32=None,
+                              kv_pool_bytes=None):
     """Modeled per-token step time for the decode tier from the
     ``decode_step`` budget row (STATIC_BUDGETS.json): one decode step
     advances EVERY slot by one token, so the roofline step time IS the
     per-token latency each active sequence observes — the unit the
-    DecodeBatcher's tokens-remaining shed arithmetic prices in."""
-    return service_ms_from_modeled_cost(cost_row, flops_per_s=flops_per_s,
+    DecodeBatcher's tokens-remaining shed arithmetic prices in.
+
+    The budget row models the f32 cache; a quantized KV pool changes
+    the bytes the step streams, so callers sizing an int8 tier pass
+    BOTH pool sizes (``kv_pool_bytes_f32`` as modeled in the row,
+    ``kv_pool_bytes`` as deployed — codes + per-page scales) and the
+    difference is swapped out of the moved-byte total before the
+    roofline (docs/precision.md)."""
+    row = dict(cost_row)
+    if kv_pool_bytes is not None and kv_pool_bytes_f32:
+        moved = float(row.get("bytes_read", 0))
+        row["bytes_read"] = max(
+            0.0, moved - float(kv_pool_bytes_f32) + float(kv_pool_bytes))
+    return service_ms_from_modeled_cost(row, flops_per_s=flops_per_s,
                                         bytes_per_s=bytes_per_s,
                                         overhead_ms=overhead_ms)
 

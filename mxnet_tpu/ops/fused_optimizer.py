@@ -121,54 +121,79 @@ def _block_rows(p):
 
 # ---------------------------------------------------------------------------
 # the kernels: exact unfused-op expressions, one HBM pass
+#
+# Every kernel reads THREE scalars from SMEM — ``[lr, inv_scale, ok]``
+# (``s_ref``, shape (1, 3) f32).  ``inv_scale`` is the mixed-precision
+# loss-scale reciprocal applied to the gradient BEFORE clip (unscale +
+# clip + update stays one kernel pass, docs/precision.md); ``ok`` is the
+# grads-finite select-skip flag: when 0 the kernel writes the OLD
+# weights and state back, so a loss-scale-skipped step is a true no-op
+# in the same single HBM pass.  The f32 path passes (inv_scale=1, ok=1)
+# — same spelling, so analysis and runtime can never drift.
 # ---------------------------------------------------------------------------
-def _prep_g(g, rescale_grad, clip_gradient):
-    g = rescale_grad * g
+def _prep_g(g, inv_scale, rescale_grad, clip_gradient):
+    g = (rescale_grad * inv_scale) * g
     if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     return g
 
 
-def _fused_sgd_kernel(lr_ref, w_ref, g_ref, ow_ref, *, wd, rescale_grad,
+def _fused_sgd_kernel(s_ref, w_ref, g_ref, ow_ref, *, wd, rescale_grad,
                       clip_gradient):
     # ops/optimizer_ops.py sgd_update: w' = (1 - lr*wd)*w - lr*clip(r*g)
-    lr = lr_ref[0, 0]
-    g = _prep_g(g_ref[...], rescale_grad, clip_gradient)
-    ow_ref[...] = (1.0 - lr * wd) * w_ref[...] - lr * g
+    lr = s_ref[0, 0]
+    ok = s_ref[0, 2]
+    w = w_ref[...]
+    g = _prep_g(g_ref[...], s_ref[0, 1], rescale_grad, clip_gradient)
+    ow_ref[...] = jnp.where(ok > 0.0, (1.0 - lr * wd) * w - lr * g, w)
 
 
-def _fused_sgd_mom_kernel(lr_ref, w_ref, g_ref, m_ref, ow_ref, om_ref, *,
+def _fused_sgd_mom_kernel(s_ref, w_ref, g_ref, m_ref, ow_ref, om_ref, *,
                           momentum, wd, rescale_grad, clip_gradient):
     # ops/optimizer_ops.py sgd_mom_update:
     #   m' = momentum*m - lr*wd*w - lr*clip(r*g); w' = w + m'
-    lr = lr_ref[0, 0]
+    lr = s_ref[0, 0]
+    ok = s_ref[0, 2]
     w = w_ref[...]
-    g = _prep_g(g_ref[...], rescale_grad, clip_gradient)
-    new_m = momentum * m_ref[...] - lr * wd * w - lr * g
-    ow_ref[...] = w + new_m
-    om_ref[...] = new_m
+    m = m_ref[...]
+    g = _prep_g(g_ref[...], s_ref[0, 1], rescale_grad, clip_gradient)
+    new_m = momentum * m - lr * wd * w - lr * g
+    ow_ref[...] = jnp.where(ok > 0.0, w + new_m, w)
+    om_ref[...] = jnp.where(ok > 0.0, new_m, m)
 
 
-def _fused_adam_kernel(lr_ref, w_ref, g_ref, m_ref, v_ref, ow_ref,
+def _fused_adam_kernel(s_ref, w_ref, g_ref, m_ref, v_ref, ow_ref,
                        om_ref, ov_ref, *, beta1, beta2, epsilon, wd,
                        rescale_grad, clip_gradient):
-    # ops/optimizer_ops.py adam_update (lr_ref carries the
+    # ops/optimizer_ops.py adam_update (s_ref[0, 0] carries the
     # bias-corrected lr_t, computed outside exactly as Adam.update does):
     #   g = clip(r*g + wd*w); m' = b1*m + (1-b1)*g;
     #   v' = b2*v + (1-b2)*g²; w' = w - lr_t*m'/(sqrt(v') + eps)
-    lr_t = lr_ref[0, 0]
+    lr_t = s_ref[0, 0]
+    ok = s_ref[0, 2]
     w = w_ref[...]
-    g = rescale_grad * g_ref[...] + wd * w
+    m = m_ref[...]
+    v = v_ref[...]
+    g = (rescale_grad * s_ref[0, 1]) * g_ref[...] + wd * w
     if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    new_m = beta1 * m_ref[...] + (1.0 - beta1) * g
-    new_v = beta2 * v_ref[...] + (1.0 - beta2) * jnp.square(g)
-    ow_ref[...] = w - lr_t * new_m / (jnp.sqrt(new_v) + epsilon)
-    om_ref[...] = new_m
-    ov_ref[...] = new_v
+    new_m = beta1 * m + (1.0 - beta1) * g
+    new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+    ow_ref[...] = jnp.where(
+        ok > 0.0, w - lr_t * new_m / (jnp.sqrt(new_v) + epsilon), w)
+    om_ref[...] = jnp.where(ok > 0.0, new_m, m)
+    ov_ref[...] = jnp.where(ok > 0.0, new_v, v)
 
 
-def _flat_call(kernel, lr, arrays, n_out, aliases, interpret):
+def _scalars(lr, inv_scale, ok):
+    """The (1, 3) f32 SMEM operand ``[lr, inv_scale, ok]`` — each entry
+    may be a python float or a traced scalar."""
+    parts = [jnp.asarray(s, jnp.float32).reshape(1)
+             for s in (lr, inv_scale, ok)]
+    return jnp.concatenate(parts).reshape(1, 3)
+
+
+def _flat_call(kernel, scalars, arrays, n_out, aliases, interpret):
     """Run one fused flat kernel over the padded (rows, 128) space."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -182,7 +207,6 @@ def _flat_call(kernel, lr, arrays, n_out, aliases, interpret):
     br = max(-(-p // 128), 1) if interpret else _block_rows(p)
     tiles = [_pad_rows(a.astype(jnp.float32), br)[0] for a in arrays]
     rows = int(tiles[0].shape[0])
-    lr2 = jnp.asarray(lr, jnp.float32).reshape(1, 1)
     blk = pl.BlockSpec((br, 128), lambda i: (i, 0))
     outs = pl.pallas_call(
         kernel,
@@ -195,52 +219,57 @@ def _flat_call(kernel, lr, arrays, n_out, aliases, interpret):
         else _sds((rows, 128), jnp.float32, arrays[0]),
         input_output_aliases=dict(aliases),
         interpret=interpret,
-    )(lr2, *tiles)
+    )(scalars, *tiles)
     if n_out == 1:
         outs = (outs,)
     return tuple(o.reshape(-1)[:p] for o in outs)
 
 
 def fused_sgd(w, g, lr, *, wd=0.0, rescale_grad=1.0, clip_gradient=None,
-              interpret=None):
+              inv_scale=1.0, ok=1.0, interpret=None):
     """Plain SGD over the flat f32 space as one fused pass."""
     kernel = functools.partial(
         _fused_sgd_kernel, wd=float(wd),
         rescale_grad=float(rescale_grad), clip_gradient=clip_gradient)
-    (nw,) = _flat_call(kernel, lr, (w, g), 1, {1: 0}, interpret)
+    (nw,) = _flat_call(kernel, _scalars(lr, inv_scale, ok), (w, g), 1,
+                       {1: 0}, interpret)
     return nw
 
 
 def fused_sgd_momentum(w, g, m, lr, *, momentum, wd=0.0,
                        rescale_grad=1.0, clip_gradient=None,
-                       interpret=None):
+                       inv_scale=1.0, ok=1.0, interpret=None):
     """SGD+momentum over the flat f32 space as one fused pass:
     ``(new_w, new_m)``, matching ``nd.sgd_mom_update`` elementwise."""
     kernel = functools.partial(
         _fused_sgd_mom_kernel, momentum=float(momentum), wd=float(wd),
         rescale_grad=float(rescale_grad), clip_gradient=clip_gradient)
-    return _flat_call(kernel, lr, (w, g, m), 2, {1: 0, 3: 1}, interpret)
+    return _flat_call(kernel, _scalars(lr, inv_scale, ok), (w, g, m), 2,
+                      {1: 0, 3: 1}, interpret)
 
 
 def fused_adam(w, g, m, v, lr_t, *, beta1, beta2, epsilon, wd=0.0,
-               rescale_grad=1.0, clip_gradient=None, interpret=None):
+               rescale_grad=1.0, clip_gradient=None, inv_scale=1.0,
+               ok=1.0, interpret=None):
     """Adam over the flat f32 space as one fused pass:
     ``(new_w, new_m, new_v)``; ``lr_t`` is the bias-corrected rate."""
     kernel = functools.partial(
         _fused_adam_kernel, beta1=float(beta1), beta2=float(beta2),
         epsilon=float(epsilon), wd=float(wd),
         rescale_grad=float(rescale_grad), clip_gradient=clip_gradient)
-    return _flat_call(kernel, lr_t, (w, g, m, v), 3,
-                      {1: 0, 3: 1, 4: 2}, interpret)
+    return _flat_call(kernel, _scalars(lr_t, inv_scale, ok),
+                      (w, g, m, v), 3, {1: 0, 3: 1, 4: 2}, interpret)
 
 
 def fused_optimizer_update(opt, index, w_flat, g_flat, state_raw, lr, t,
-                           interpret=None):
+                           inv_scale=1.0, ok=1.0, interpret=None):
     """Fused twin of ``parallel.functional.functional_optimizer_update``
     for the flat f32 space: same ``(new_w, new_state_raw)`` contract,
     same lr/wd-mult resolution (static mults, traced base lr), same
     update expressions — one kernel pass instead of the eqn chain.
-    ``supports(opt)`` must be truthy."""
+    ``inv_scale``/``ok`` are the mixed-precision loss-scale reciprocal
+    and grads-finite select-skip flag (both default to the f32 path's
+    no-op values).  ``supports(opt)`` must be truthy."""
     kind = supports(opt)
     if kind is None:
         raise ValueError("fused update supports SGD/Adam exactly; got %s"
@@ -260,12 +289,14 @@ def fused_optimizer_update(opt, index, w_flat, g_flat, state_raw, lr, t,
             nw = fused_sgd(w_flat, g_flat, lr, wd=wd,
                            rescale_grad=opt.rescale_grad,
                            clip_gradient=opt.clip_gradient,
+                           inv_scale=inv_scale, ok=ok,
                            interpret=interpret)
             return nw, None
         nw, nm = fused_sgd_momentum(
             w_flat, g_flat, state_raw, lr, momentum=opt.momentum, wd=wd,
             rescale_grad=opt.rescale_grad,
-            clip_gradient=opt.clip_gradient, interpret=interpret)
+            clip_gradient=opt.clip_gradient, inv_scale=inv_scale,
+            ok=ok, interpret=interpret)
         return nw, nm
     m, v = state_raw
     # the exact bias-corrected rate Adam.update computes
@@ -273,7 +304,8 @@ def fused_optimizer_update(opt, index, w_flat, g_flat, state_raw, lr, t,
     nw, nm, nv = fused_adam(
         w_flat, g_flat, m, v, lr_t, beta1=opt.beta1, beta2=opt.beta2,
         epsilon=opt.epsilon, wd=wd, rescale_grad=opt.rescale_grad,
-        clip_gradient=opt.clip_gradient, interpret=interpret)
+        clip_gradient=opt.clip_gradient, inv_scale=inv_scale, ok=ok,
+        interpret=interpret)
     return nw, (nm, nv)
 
 
@@ -395,18 +427,21 @@ def _elementwise_cost(eqn, flops_per_elem, trans_per_elem=0):
 
 @declare_kernel_cost("_fused_sgd_kernel")
 def _cost_fused_sgd(eqn):
-    return _elementwise_cost(eqn, 4)
+    # per element: (r*inv)*g, clip?, (1-lr*wd)*w, lr*g, sub, select-skip
+    return _elementwise_cost(eqn, 6)
 
 
 @declare_kernel_cost("_fused_sgd_mom_kernel")
 def _cost_fused_sgd_mom(eqn):
-    # per element: r*g, clip?, momentum*m, lr*wd*w, lr*g, 2 subs, 1 add
-    return _elementwise_cost(eqn, 7)
+    # per element: (r*inv)*g, clip?, momentum*m, lr*wd*w, lr*g, 2 subs,
+    # 1 add, 2 select-skips
+    return _elementwise_cost(eqn, 10)
 
 
 @declare_kernel_cost("_fused_adam_kernel")
 def _cost_fused_adam(eqn):
-    cost = _elementwise_cost(eqn, 12)
+    # the 12-op Adam chain + the unscale multiply and 3 select-skips
+    cost = _elementwise_cost(eqn, 16)
     n = 1
     for d in eqn.outvars[0].aval.shape:
         n *= int(d)

@@ -270,6 +270,55 @@ def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
     return out, min_data.reshape(1), max_data.reshape(1)
 
 
+def _qfcpc_optional(params):
+    if params.get("no_bias", False):
+        return ("bias",)
+    return ()
+
+
+@register("_contrib_quantized_fc_pc",
+          arg_names=["data", "weight", "w_scale", "bias"],
+          differentiable=False, aliases=("quantized_fc_pc",),
+          optional_args=_qfcpc_optional)
+def quantized_fc_pc(data, weight, w_scale, bias=None, num_hidden=0,
+                    in_amax=1.0, relu=False, no_bias=False, flatten=True):
+    """Per-channel int8 FC with the dequant epilogue fused — the
+    ``qmm_requant`` kernel lineage (ops/pallas_kernels.py) applied to the
+    PTQ pipeline (serving/quantize.py, docs/precision.md).
+
+    ``weight`` is int8 codes quantized per OUTPUT CHANNEL:
+    ``w_real[c] = codes[c] * w_scale[c]`` with ``w_scale`` an ``(O,)``
+    f32 vector — one outlier row no longer poisons every channel's
+    resolution the way the reference's per-tensor (min, max) pair does.
+    The f32 activation quantizes on entry against the CALIBRATED
+    ``in_amax`` (a trace-time constant from the calibration set), the
+    s8×s8→s32 dot rides the MXU, and the epilogue
+    ``acc * (in_scale * w_scale[c]) + bias → [relu]`` lands back on the
+    float rail in the same fusion — the int32 accumulator never touches
+    HBM.  Output stays float (the measured-faster split-graph
+    discipline: contrib/quantization.py keeps requantize chains out of
+    XLA's way)."""
+    in_scale = float(in_amax) / _INT8_MAX
+    if in_scale <= 0.0:
+        in_scale = 1.0 / _INT8_MAX
+    x = data
+    if flatten:
+        x = x.reshape(x.shape[0], -1)
+    codes = jnp.clip(jnp.round(x.astype(jnp.float32) / in_scale),
+                     -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        codes, weight.astype(jnp.int8).T,
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) \
+        * (in_scale * w_scale.astype(jnp.float32))[None, :]
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32)[None, :]
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    fdt = jnp.dtype(_int8_float_env())
+    return out.astype(fdt)
+
+
 def calib_minmax(arrays):
     """Min/max calibration over representative activations
     (reference: contrib/quantization.py _collect_layer_output_min_max)."""

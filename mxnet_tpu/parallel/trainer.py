@@ -102,9 +102,17 @@ class DataParallelTrainer:
                  mesh=None, param_spec_fn=None, data_axis="data",
                  kvstore=None, input_transform=None, run_id=None,
                  zero=0, mesh_plan=None, model_parallel=None,
-                 sequence_parallel=None):
+                 sequence_parallel=None, dtype=None):
         from .. import kvstore as kvs
         from .. import optimizer as opt_mod
+        from .. import precision as _precision
+        # mixed precision (docs/precision.md): dtype="bf16" trains with
+        # bf16 params/activations, f32 master weights (inside the
+        # ZeRO-1 shard under zero=1), f32 gradient reduction and
+        # dynamic loss scaling.  dtype=None/"float32" is the historical
+        # f32 path, byte-identical to before the knob existed.
+        self._dtype = _precision.resolve_dtype(dtype)
+        self._reduced = _precision.is_reduced(self._dtype)
         self._block = block
         self._loss = loss
         self._input_transform = input_transform
@@ -168,6 +176,13 @@ class DataParallelTrainer:
             kvstore = kvs.create(kvstore)
         self._kv = kvstore if (kvstore is not None
                                and kvstore.num_workers > 1) else None
+        if self._reduced and self._kv is not None:
+            raise ValueError(
+                "dtype='bf16' is not supported with a multi-process "
+                "kvstore: the flat-key push/pull path reduces gradients "
+                "in f32 on the PS without the loss-scale/finite "
+                "bookkeeping (train bf16 in-process, or f32 with the "
+                "kvstore)")
         if self._kv is not None:
             # the split-step protocol needs replace-with-sum push semantics:
             # dist_async applies pushes per-arrival on the PS (no
@@ -395,7 +410,19 @@ class DataParallelTrainer:
                                                             jnp.float32)))
             self._flat_out = NDArray(jnp.zeros((total,), jnp.float32))
             self._validate_flat_key(total)
+        if self._reduced:
+            self._init_loss_scale_state()
         self._ready = True
+
+    def _init_loss_scale_state(self):
+        """Device-resident loss-scale machine state (docs/precision.md):
+        scale, consecutive-good-step counter, skipped-step total.  Held
+        as lazy device scalars so the step never syncs; ``flush()``
+        publishes them through the telemetry registry."""
+        from .. import precision as _precision
+        self._ls_scale, self._ls_good = _precision.init_loss_scale()
+        self._ls_skipped = jnp.zeros((), jnp.int32)
+        self._ls_reported_skipped = 0
 
     def _validate_flat_key(self, total):
         """Detect cross-rank trainer desync before any gradient mixes.
@@ -441,6 +468,16 @@ class DataParallelTrainer:
         sizes = dict(zip(self._mesh.axis_names, self._mesh.devices.shape))
         return int(sizes.get(self._data_axis, 1))
 
+    def _zero_param_dtypes(self):
+        """Per-param dtype strings for the flat plan.  Mixed precision
+        runs the LIVE params (the all_gather reassembly targets) in the
+        compute dtype; the f32 masters live outside the plan, as the
+        explicit ``(shard,)`` master argument."""
+        if self._reduced:
+            return [str(jnp.dtype(self._dtype))] * len(self._train_names)
+        return [str(np.dtype(self._params_by_name[n].dtype or "float32"))
+                for n in self._train_names]
+
     def _setup_zero_states(self):
         """Build the flat ZeRO-1 plan and the sharded optimizer state:
         one ``(padded,)`` f32 array per state leaf, ``P(data)``-sharded
@@ -463,11 +500,29 @@ class DataParallelTrainer:
         plan = _zero.Zero1Plan(
             self._train_names,
             [self._params_by_name[n].shape for n in self._train_names],
-            [str(np.dtype(self._params_by_name[n].dtype
-                          or "float32")) for n in self._train_names],
+            self._zero_param_dtypes(),
             self._data_axis, k)
         self._zero_plan = plan
         state_sh = NamedSharding(mesh, PartitionSpec(self._data_axis))
+        if self._reduced:
+            # f32 MASTER weights, stored ONLY as the P(data)-sharded
+            # flat vector (arxiv 2004.13336's layout): seeded from the
+            # still-f32 initial params, then the live bf16 params are
+            # cast FROM them.  After this point no unsharded f32 copy
+            # of the weights exists anywhere (docs/precision.md;
+            # addressable_shards-asserted in tests/test_precision.py).
+            from . import zero as _zmod
+            master = _zmod._flatten_pad(
+                [self._params_by_name[n].data()._data
+                 for n in self._train_names], plan, jnp)
+            self._zero_master = jax.device_put(master, state_sh)
+            for n in self._train_names:
+                p = self._params_by_name[n]
+                p._data._set_data(jax.device_put(
+                    p.data()._data.astype(self._dtype),
+                    self._param_shardings[n]))
+        else:
+            self._zero_master = None
         flat_w = jnp.zeros((plan.padded,), jnp.float32)
         state = self._opt.create_state_multi_precision(0, NDArray(flat_w))
         raw = tree_raw(state)
@@ -501,19 +556,35 @@ class DataParallelTrainer:
         from . import zero as _zero
         if self._zero_grad_fn is None:
             self._zero_grad_fn, self._zero_update_fn = \
-                _zero.build_runtime_fns(self._fwd, self._opt,
-                                        self._zero_plan,
-                                        self._zero_treedef, self._mesh)
+                _zero.build_runtime_fns(
+                    self._fwd, self._opt, self._zero_plan,
+                    self._zero_treedef, self._mesh,
+                    compute_dtype=self._dtype if self._reduced else None)
             if tele_on:
                 attr.set_context("collective_or_ps", "zero1")
-        g_sh, loss_val, muts = self._zero_grad_fn(
-            train_vals, aux_vals, x, y, rng)
-        if tele_on:
-            t2 = time.perf_counter()
-            attr.add_phase("dispatch", t2 - t1)
-        new_vals, new_leaves = self._zero_update_fn(
-            train_vals, self._zero_leaves(), g_sh,
-            jnp.float32(lr_host), jnp.int32(self._step_count))
+        if self._reduced:
+            g_sh, loss_val, muts, fin = self._zero_grad_fn(
+                train_vals, aux_vals, x, y, rng, self._ls_scale)
+            if tele_on:
+                t2 = time.perf_counter()
+                attr.add_phase("dispatch", t2 - t1)
+            (new_vals, new_master, new_leaves, new_scale, new_good,
+             new_skipped) = self._zero_update_fn(
+                train_vals, self._zero_master, self._zero_leaves(),
+                g_sh, jnp.float32(lr_host), jnp.int32(self._step_count),
+                self._ls_scale, self._ls_good, self._ls_skipped, fin)
+            self._zero_master = new_master
+            self._ls_scale, self._ls_good = new_scale, new_good
+            self._ls_skipped = new_skipped
+        else:
+            g_sh, loss_val, muts = self._zero_grad_fn(
+                train_vals, aux_vals, x, y, rng)
+            if tele_on:
+                t2 = time.perf_counter()
+                attr.add_phase("dispatch", t2 - t1)
+            new_vals, new_leaves = self._zero_update_fn(
+                train_vals, self._zero_leaves(), g_sh,
+                jnp.float32(lr_host), jnp.int32(self._step_count))
         self._states_raw = [jax.tree_util.tree_unflatten(
             self._zero_treedef, list(new_leaves))]
         if tele_on:
@@ -532,11 +603,11 @@ class DataParallelTrainer:
         plan = _zero.Zero1Plan(
             self._train_names,
             [self._params_by_name[n].shape for n in self._train_names],
-            [str(np.dtype(self._params_by_name[n].dtype
-                          or "float32")) for n in self._train_names],
+            self._zero_param_dtypes(),
             self._data_axis, k)
         return _zero.build_replica_step(
-            self._fwd, self._opt, plan, self._zero_treedef), plan
+            self._fwd, self._opt, plan, self._zero_treedef,
+            compute_dtype=self._dtype if self._reduced else None), plan
 
     def zero_report(self, data_shape=None, label_shape=None,
                     data_dtype="float32", label_dtype="int32",
@@ -572,11 +643,11 @@ class DataParallelTrainer:
         k = int(declared_axis_size or self._zero_axis_size())
         step, plan = self._build_zero_replica_step(k)
         shard_local = max(data_shape[0] // max(k, 1), 1)
+        dtypes = self._zero_param_dtypes()
         train_avals = tuple(
             jax.ShapeDtypeStruct(
-                tuple(self._params_by_name[n].shape),
-                _onp.dtype(self._params_by_name[n].dtype or "float32"))
-            for n in self._train_names)
+                tuple(self._params_by_name[n].shape), _onp.dtype(dt))
+            for n, dt in zip(self._train_names, dtypes))
         n_leaves = len(self._zero_leaves())
         state_avals = tuple(
             jax.ShapeDtypeStruct((plan.shard,), _onp.float32)
@@ -591,14 +662,34 @@ class DataParallelTrainer:
         ys = jax.ShapeDtypeStruct((shard_local,) + label_shape[1:],
                                   _onp.dtype(label_dtype))
         key = jax.ShapeDtypeStruct((2,), _onp.uint32)
-        closed = jax.make_jaxpr(
-            step, axis_env=[(self._data_axis, k)])(
-            train_avals, state_avals, aux_avals, xs, ys, key,
-            jnp.float32(0.01), jnp.int32(1))
         n_train = len(train_avals)
-        host = [n_train + n_leaves + len(aux_avals),
-                n_train + n_leaves + len(aux_avals) + 1]
-        donated = list(range(n_train + n_leaves))
+        if self._reduced:
+            # reduced spelling adds the (shard,) f32 master invar after
+            # the params and the three loss-scale scalars at the tail
+            master_aval = jax.ShapeDtypeStruct((plan.shard,),
+                                               _onp.float32)
+            closed = jax.make_jaxpr(
+                step, axis_env=[(self._data_axis, k)])(
+                train_avals, master_aval, state_avals, aux_avals,
+                xs, ys, key, jnp.float32(0.01), jnp.int32(1),
+                jnp.float32(2.0 ** 15), jnp.int32(0), jnp.int32(0))
+            n_sharded = n_train + 1 + n_leaves
+            host = [n_sharded + len(aux_avals),
+                    n_sharded + len(aux_avals) + 1]
+            shard_dims = {n_train: {0: (self._data_axis,)}}
+            shard_dims.update({n_train + 1 + li: {0: (self._data_axis,)}
+                               for li in range(n_leaves)})
+        else:
+            closed = jax.make_jaxpr(
+                step, axis_env=[(self._data_axis, k)])(
+                train_avals, state_avals, aux_avals, xs, ys, key,
+                jnp.float32(0.01), jnp.int32(1))
+            n_sharded = n_train + n_leaves
+            host = [n_sharded + len(aux_avals),
+                    n_sharded + len(aux_avals) + 1]
+            shard_dims = {n_train + li: {0: (self._data_axis,)}
+                          for li in range(n_leaves)}
+        donated = list(range(n_sharded))
         report = _cost.analyze_jaxpr(
             closed, axis_sizes={self._data_axis: k},
             donated_invars=donated, host_invars=host)
@@ -607,8 +698,7 @@ class DataParallelTrainer:
         findings = _sp.lint_sharded_step(
             closed, mesh, data_axes=(self._data_axis,),
             varying_invars=host,
-            shard_dims={n_train + li: {0: (self._data_axis,)}
-                        for li in range(n_leaves)},
+            shard_dims=shard_dims,
             param_outvars=list(range(1, 1 + n_train)),
             param_names=list(self._train_names),
             subject="DataParallelTrainer(zero=1)")
@@ -739,7 +829,8 @@ class DataParallelTrainer:
             _tstep.build_runtime_fns(
                 program, apply_update, self._mesh_leaf_counts, mesh,
                 self._mesh_state_specs, zero=self._zero,
-                zero_plan=self._mesh_zero_plan)
+                zero_plan=self._mesh_zero_plan,
+                compute_dtype=self._dtype if self._reduced else None)
         if _tele._ENABLED:
             _tele.attribution().set_context("collective_or_ps",
                                             self._mesh_context_tag())
@@ -883,7 +974,8 @@ class DataParallelTrainer:
 
         step = _tstep.build_replica_step(
             program, self._mesh_apply_update(treedefs), leaf_counts,
-            zero=self._zero, zero_plan=zp)
+            zero=self._zero, zero_plan=zp,
+            compute_dtype=self._dtype if self._reduced else None)
         train_avals = tuple(
             jax.ShapeDtypeStruct(program.local_shape(n), _onp.float32)
             for n in program.param_names)
@@ -1029,29 +1121,48 @@ class DataParallelTrainer:
         return dict(payload["cursor"], step=self._step_count)
 
     # -- the compiled step -------------------------------------------------
-    def _apply_groups(self, train_vals, states, grads, lr, t):
+    def _apply_groups(self, train_vals, states, grads, lr, t,
+                      inv_scale=None, ok=None):
         """Optimizer update for every group — traced inside the step jit
         (single-process) or the update jit (dist split-step).  With the
         fused Pallas update enabled (docs/fusion.md) a group's update
         runs as ONE kernel pass over its flat f32 space instead of the
         unfused elementwise eqn chain; numerics mirror
-        ``Optimizer.update`` exactly."""
+        ``Optimizer.update`` exactly.  Mixed precision threads the
+        loss-scale reciprocal and the finite flag through (``inv_scale``
+        / ``ok`` f32 scalars): the fused kernel unscales + select-skips
+        in the same pass, the unfused fallback spells the same algebra
+        around ``functional_optimizer_update``."""
         from ..ops import fused_optimizer as _fused
 
         opt, groups = self._opt, self._groups
         fused_on = (_fused.fused_update_enabled()
                     and _fused.supports(opt) is not None)
+        scaled = inv_scale is not None
         name_to_idx = {n: i for i, n in enumerate(self._train_names)}
         new_vals = [None] * len(train_vals)
         new_states = []
 
         def _fused_flat(gi, wf, gf):
             sf = jax.tree_util.tree_map(jnp.ravel, states[gi])
+            kw = ({"inv_scale": inv_scale, "ok": ok} if scaled else {})
             nwf, nsf = _fused.fused_optimizer_update(
-                opt, gi, wf.ravel(), gf.ravel(), sf, lr, t)
+                opt, gi, wf.ravel(), gf.ravel(), sf, lr, t, **kw)
             ns = jax.tree_util.tree_map(
                 lambda n, o: n.reshape(o.shape), nsf, states[gi])
             return nwf, ns
+
+        def _unfused(gi, wf, gf):
+            if not scaled:
+                return functional_optimizer_update(
+                    opt, gi, wf, gf, states[gi], lr, t)
+            nw, ns = functional_optimizer_update(
+                opt, gi, wf, gf * inv_scale, states[gi], lr, t)
+            okb = ok > 0.0
+            nw = jnp.where(okb, nw, wf)
+            ns = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(okb, n, o), ns, states[gi])
+            return nw, ns
 
         for gi, names in enumerate(groups):
             idxs = [name_to_idx[n] for n in names]
@@ -1061,9 +1172,7 @@ class DataParallelTrainer:
                     nwf, ns = _fused_flat(gi, train_vals[i], grads[i])
                     nw = nwf.reshape(train_vals[i].shape)
                 else:
-                    nw, ns = functional_optimizer_update(
-                        opt, gi, train_vals[i], grads[i], states[gi],
-                        lr, t)
+                    nw, ns = _unfused(gi, train_vals[i], grads[i])
                 new_vals[i] = nw
             else:
                 # fused bucket: one flat update for the whole group
@@ -1075,8 +1184,7 @@ class DataParallelTrainer:
                 if fused_on and wf.dtype == jnp.float32:
                     nwf, ns = _fused_flat(gi, wf, gf)
                 else:
-                    nwf, ns = functional_optimizer_update(
-                        opt, gi, wf, gf, states[gi], lr, t)
+                    nwf, ns = _unfused(gi, wf, gf)
                 off = 0
                 for i in idxs:
                     sz = train_vals[i].size
@@ -1088,6 +1196,9 @@ class DataParallelTrainer:
 
     def _build_step(self):
         fwd = self._fwd
+        if self._reduced:
+            return jax.jit(self._reduced_pure_step(),
+                           donate_argnums=(0, 1))
 
         def pure_step(train_vals, states, aux_vals, x, y, key, lr, t):
             def loss_of(tv):
@@ -1101,6 +1212,49 @@ class DataParallelTrainer:
             return loss_val, new_vals, new_states, muts
 
         return jax.jit(pure_step, donate_argnums=(0, 1))
+
+    def _reduced_pure_step(self):
+        """Mixed-precision replicated spelling: the f32 ``train_vals``
+        ARE the masters; they cast to the compute dtype at the forward
+        boundary (so grads come back f32 through the cast transpose),
+        the scaled loss drives the backward, and the optimizer update
+        unscales + select-skips on the global finite flag — one kernel
+        pass when fused (docs/precision.md)."""
+        from .. import precision as _precision
+        fwd, dtype = self._fwd, self._dtype
+
+        def _to_compute(v):
+            if hasattr(v, "dtype") and jnp.issubdtype(v.dtype,
+                                                      jnp.floating):
+                return v.astype(dtype)
+            return v
+
+        def pure_step(train_vals, states, aux_vals, x, y, key, lr, t,
+                      scale, good, skipped):
+            x_c = _to_compute(x)
+            aux_c = tuple(_to_compute(a) for a in aux_vals)
+
+            def loss_of(tv):
+                tv_c = tuple(_to_compute(w) for w in tv)
+                outs, muts = fwd(tv_c, aux_c, (x_c, y), key)
+                raw = outs[0].astype(jnp.float32)
+                return raw * scale, (raw, muts)
+
+            (_, (loss_val, muts)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            fin = _precision.all_finite(grads)
+            inv = (1.0 / scale).astype(jnp.float32)
+            new_vals, new_states = self._apply_groups(
+                train_vals, states, grads, lr, t,
+                inv_scale=inv, ok=fin.astype(jnp.float32))
+            new_scale, new_good = _precision.loss_scale_update(
+                scale, good, fin)
+            new_skipped = skipped + (1 - fin.astype(jnp.int32))
+            muts = tuple(m.astype(jnp.float32) for m in muts)
+            return (loss_val, new_vals, new_states, muts,
+                    new_scale, new_good, new_skipped)
+
+        return pure_step
 
     def _reduce_grads(self, grads):
         """Cross-replica gradient mean over the data axis.
@@ -1125,6 +1279,49 @@ class DataParallelTrainer:
         ``python -m mxnet_tpu.analysis --cost`` budget models."""
         fwd = self._fwd
         axis = self._data_axis
+        if self._reduced:
+            from .. import precision as _precision
+            dtype = self._dtype
+
+            def _to_compute(v):
+                if hasattr(v, "dtype") and jnp.issubdtype(
+                        v.dtype, jnp.floating):
+                    return v.astype(dtype)
+                return v
+
+            def replica_step(train_vals, states, aux_vals, x, y, key,
+                             lr, t):
+                # analysis twin of the reduced jitted step, seeded with
+                # the neutral loss-scale constants (scale=1 keeps the
+                # traced algebra identical; the live scale only changes
+                # a scalar multiply).  8-arg so lint_trainer/cost_report
+                # keep their one calling convention.
+                scale = jnp.float32(1.0)
+                x_c = _to_compute(x)
+                aux_c = tuple(_to_compute(a) for a in aux_vals)
+
+                def loss_of(tv):
+                    tv_c = tuple(_to_compute(w) for w in tv)
+                    outs, muts = fwd(tv_c, aux_c, (x_c, y), key)
+                    raw = outs[0].astype(jnp.float32)
+                    return raw * scale, (raw, muts)
+
+                (_, (loss_val, muts)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_vals)
+                # grads are f32 through the cast transpose — the
+                # collective reduces f32 (tightened DST004 contract)
+                grads = self._reduce_grads(grads)
+                loss_val = jax.lax.pmean(loss_val, axis)
+                muts = tuple(jax.lax.pmean(m.astype(jnp.float32), axis)
+                             for m in muts)
+                fin = _precision.all_finite(grads)
+                inv = (1.0 / scale).astype(jnp.float32)
+                new_vals, new_states = self._apply_groups(
+                    train_vals, states, grads, lr, t,
+                    inv_scale=inv, ok=fin.astype(jnp.float32))
+                return loss_val, new_vals, new_states, muts
+
+            return replica_step
 
         def replica_step(train_vals, states, aux_vals, x, y, key, lr, t):
             def loss_of(tv):
@@ -1539,6 +1736,16 @@ class DataParallelTrainer:
             self.dispatch_stats.on_backpressure(waited)
             if _tele._ENABLED:
                 _tele.attribution().add_phase("runahead_stall", waited)
+        if self._reduced and self._ready:
+            # everything dispatched has retired, so the loss-scale
+            # scalars are cheap to read: publish the live scale and any
+            # newly-skipped steps (docs/observability.md)
+            from .. import precision as _precision
+            skipped = int(self._ls_skipped)
+            _precision.record_loss_scale(
+                float(self._ls_scale),
+                skipped - self._ls_reported_skipped)
+            self._ls_reported_skipped = skipped
 
     def step(self, data, label):
         """Run one training step; returns the (scalar) loss NDArray.
@@ -1604,9 +1811,18 @@ class DataParallelTrainer:
             # jax.jit itself retraces and caches per input shape/dtype
             if self._step_fn is None:
                 self._step_fn = self._build_step()
-            loss_val, new_vals, new_states, muts = self._step_fn(
-                train_vals, tuple(self._states_raw), aux_vals, x, y, rng,
-                jnp.float32(lr_host), jnp.int32(self._step_count))
+            if self._reduced:
+                (loss_val, new_vals, new_states, muts, self._ls_scale,
+                 self._ls_good, self._ls_skipped) = self._step_fn(
+                    train_vals, tuple(self._states_raw), aux_vals, x, y,
+                    rng, jnp.float32(lr_host),
+                    jnp.int32(self._step_count), self._ls_scale,
+                    self._ls_good, self._ls_skipped)
+            else:
+                loss_val, new_vals, new_states, muts = self._step_fn(
+                    train_vals, tuple(self._states_raw), aux_vals, x, y,
+                    rng, jnp.float32(lr_host),
+                    jnp.int32(self._step_count))
             self._states_raw = list(new_states)
             if tele_on:
                 # "dispatch" spans from the batch being device-ready to
@@ -1670,6 +1886,12 @@ class DataParallelTrainer:
             "setup_desc": self._setup_desc,
             "groups": [list(g) for g in self._groups],
         }
+        if self._reduced:
+            payload["loss_scale"] = {
+                "scale": float(self._ls_scale),
+                "good_steps": int(self._ls_good),
+                "skipped": int(self._ls_skipped),
+            }
         # provenance digest over NAME-CANONICALIZED content: gluon
         # gensyms shift per process (dense0 vs dense12 for the same
         # architecture — the positional-mapping case restore_checkpoint
@@ -1714,11 +1936,26 @@ class DataParallelTrainer:
             "zero_plan": plan.describe(),
             "state_leaf_count": len(leaves),
         }
+        master = None
+        if self._reduced:
+            # the f32 masters shard exactly like the state leaves; the
+            # loss-scale machine state is three host scalars.  Both must
+            # survive resize-on-resume BITWISE (docs/precision.md).
+            master = np.asarray(self._zero_master)
+            payload["has_master"] = True
+            payload["loss_scale"] = {
+                "scale": float(self._ls_scale),
+                "good_steps": int(self._ls_good),
+                "skipped": int(self._ls_skipped),
+            }
         shards = []
         for r in range(plan.k):
             sl = slice(r * plan.shard, (r + 1) * plan.shard)
-            shards.append({"states": [_ckpt.encode_array(leaf[sl])
-                                      for leaf in leaves]})
+            rec = {"states": [_ckpt.encode_array(leaf[sl])
+                              for leaf in leaves]}
+            if master is not None:
+                rec["master"] = _ckpt.encode_array(master[sl])
+            shards.append(rec)
         # provenance digest over NAME-CANONICALIZED content (the
         # monolithic discipline): gensym-shifted reruns name the same
         # bytes, and the digest covers the FULL state — independent of
@@ -1732,6 +1969,9 @@ class DataParallelTrainer:
         canon.pop("state_leaf_count", None)
         canon["full_state"] = [
             _ckpt.encode_array(leaf[:plan.total]) for leaf in leaves]
+        if master is not None:
+            canon["full_master"] = _ckpt.encode_array(
+                master[:plan.total])
         for key in ("k", "padded", "shard"):
             canon["zero_plan"].pop(key, None)
         return _ckpt.save_sharded_checkpoint(
@@ -1778,6 +2018,12 @@ class DataParallelTrainer:
             raise RuntimeError(
                 "optimizer state leaf count mismatch (%d vs %d): "
                 "different optimizer?" % (n_leaves, len(cur_leaves)))
+        if bool(payload.get("has_master")) != bool(self._reduced):
+            raise RuntimeError(
+                "mixed-precision mismatch: checkpoint %s f32 masters "
+                "but this trainer was constructed with dtype=%r"
+                % ("has" if payload.get("has_master") else "has no",
+                   str(jnp.dtype(self._dtype))))
         from . import zero as _zero
         state_sh = self._group_shardings[0]
         new_leaves = []
@@ -1790,6 +2036,29 @@ class DataParallelTrainer:
             new_leaves.append(jax.device_put(jnp.asarray(arr), state_sh))
         self._states_raw = [jax.tree_util.tree_unflatten(
             self._zero_treedef, new_leaves)]
+        if self._reduced:
+            # masters restore BITWISE through the same reassemble/re-pad
+            # path as the state leaves; live params are then re-derived
+            # by exact cast so the param == cast(master) invariant holds
+            # across any save-K -> restore-K' resize
+            full_m = _zero.reassemble_state(
+                [_ckpt.decode_array(sh["master"])
+                 for sh in rec["shards"]], plan.total)
+            arr = np.zeros((plan.padded,), np.float32)
+            arr[:plan.total] = full_m
+            self._zero_master = jax.device_put(jnp.asarray(arr),
+                                               state_sh)
+            vals = _zero._unflatten(jnp.asarray(
+                arr.astype(np.float32)), plan, jnp)
+            for name, val in zip(self._train_names, vals):
+                self._params_by_name[name]._data._set_data(
+                    jax.device_put(val.astype(self._dtype),
+                                   self._param_shardings[name]))
+            ls = payload["loss_scale"]
+            self._ls_scale = jnp.asarray(ls["scale"], jnp.float32)
+            self._ls_good = jnp.asarray(ls["good_steps"], jnp.int32)
+            self._ls_skipped = jnp.asarray(ls["skipped"], jnp.int32)
+            self._ls_reported_skipped = int(ls["skipped"])
         self._step_count = int(payload["step_count"])
         self._opt.num_update = self._step_count
         _rng.set_state(payload["rng"])
@@ -1900,6 +2169,12 @@ class DataParallelTrainer:
                     for e in encs]
             new_states.append(jax.tree_util.tree_unflatten(treedef, vals))
         self._states_raw = new_states
+        if self._reduced and "loss_scale" in payload:
+            ls = payload["loss_scale"]
+            self._ls_scale = jnp.asarray(ls["scale"], jnp.float32)
+            self._ls_good = jnp.asarray(ls["good_steps"], jnp.int32)
+            self._ls_skipped = jnp.asarray(ls["skipped"], jnp.int32)
+            self._ls_reported_skipped = int(ls["skipped"])
         self._step_count = int(payload["step_count"])
         self._opt.num_update = self._step_count
         _rng.set_state(payload["rng"])

@@ -86,11 +86,12 @@ class Zero1Plan:
                 "shard": self.shard}
 
 
-def _flatten_pad(vals, plan, jnp):
-    parts = [v.ravel().astype(jnp.float32) for v in vals]
+def _flatten_pad(vals, plan, jnp, dtype=None):
+    dtype = jnp.float32 if dtype is None else dtype
+    parts = [v.ravel().astype(dtype) for v in vals]
     pad = plan.padded - plan.total
     if pad:
-        parts.append(jnp.zeros((pad,), jnp.float32))
+        parts.append(jnp.zeros((pad,), dtype))
     return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
 
@@ -103,7 +104,7 @@ def _unflatten(flat, plan, jnp):
     return tuple(out)
 
 
-def build_parts(fwd, opt, plan, state_treedef):
+def build_parts(fwd, opt, plan, state_treedef, compute_dtype=None):
     """``(grads_part, update_part)`` — the per-replica halves of the
     ZeRO-1 step.  Both are pure jax functions over LOCAL shards (the
     ``shard_map`` / ``axis_env`` view):
@@ -118,6 +119,23 @@ def build_parts(fwd, opt, plan, state_treedef):
       sliced out, the SAME ``Optimizer.update`` code as the eager path
       applied shard-locally, the new params all-gathered back whole
       (the DST007 pair).
+
+    With ``compute_dtype=bfloat16`` (``mxnet_tpu.precision``,
+    docs/precision.md) the halves grow the mixed-precision signature
+    instead: params/activations are bf16, the f32 MASTER weights live
+    only as the ``(shard,)`` slice each rank owns (they never
+    materialize unsharded — the arxiv 2004.13336 layout), gradients are
+    cast f32 BEFORE the reduce-scatter (the tightened DST004 subject),
+    the loss-scale grow/backoff tick and the inf/nan select-skip ride
+    the update, and the all-gather reassembles the params ALREADY cast
+    bf16 — half the wire and param-HBM bytes:
+
+    - ``grads_part(train_vals, aux_vals, x, y, key, scale) ->
+      (g_shard_f32, loss, muts, grads_finite)``
+    - ``update_part(train_vals, master_shard, state_leaves, g_shard,
+      lr, t, scale, good_steps, skipped, grads_finite) ->
+      (new_vals_bf16, new_master_shard, new_state_leaves, new_scale,
+      new_good_steps, new_skipped)``
     """
     import jax
     import jax.numpy as jnp
@@ -126,6 +144,11 @@ def build_parts(fwd, opt, plan, state_treedef):
     from .functional import functional_optimizer_update
 
     axis, k, shard = plan.axis, plan.k, plan.shard
+
+    if compute_dtype is not None and \
+            jnp.dtype(compute_dtype) != jnp.float32:
+        return _build_parts_reduced(fwd, opt, plan, state_treedef,
+                                    jnp.dtype(compute_dtype))
 
     def grads_part(train_vals, aux_vals, x, y, key):
         def loss_of(tv):
@@ -176,12 +199,149 @@ def build_parts(fwd, opt, plan, state_treedef):
     return grads_part, update_part
 
 
-def build_replica_step(fwd, opt, plan, state_treedef):
+def _build_parts_reduced(fwd, opt, plan, state_treedef, compute_dtype):
+    """The mixed-precision halves (see :func:`build_parts` docstring):
+    bf16 compute, f32 masters-in-the-shard, f32 gradient reduction,
+    loss scaling with select-skip.  Split out so the f32 spelling's
+    traced program stays byte-identical."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .. import precision as _prec
+    from .functional import functional_optimizer_update
+
+    axis, k, shard = plan.axis, plan.k, plan.shard
+
+    def _to_compute(v):
+        # only floating leaves move to bf16 — integer labels/ids stay put
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(compute_dtype)
+        return v
+
+    def grads_part(train_vals, aux_vals, x, y, key, scale):
+        # the batch and any floating aux enter the forward in the
+        # compute dtype too, else f32 inputs silently promote the
+        # activations back to f32 and the bytes win evaporates
+        x_c = _to_compute(x)
+        aux_c = tuple(_to_compute(a) for a in aux_vals)
+
+        def loss_of(tv):
+            outs, muts = fwd(tv, aux_c, (x_c, y), key)
+            raw = outs[0].astype(jnp.float32)
+            # the SCALED loss drives the backward so bf16 grads don't
+            # flush; the raw loss rides aux for reporting
+            return raw * scale, (raw, muts)
+
+        (_, (loss_val, muts)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(train_vals)
+        if _prec.PRECISION_F32_GRAD_REDUCE:
+            # cast BEFORE the collective: the ring reduction must run
+            # f32 (the tightened DST004 contract, docs/precision.md)
+            flat_g = _flatten_pad(grads, plan, jnp)
+            g_sh = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
+                                    tiled=True) / k
+        else:
+            # the seam's broken spelling (tests only): reduce in bf16
+            # and widen after — exactly what DST004 must catch
+            flat_g = _flatten_pad(grads, plan, jnp, compute_dtype)
+            g_sh = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
+                                    tiled=True).astype(jnp.float32) / k
+        # global inf/nan verdict: every rank checks its owned shard,
+        # pmin ANDs the flags (1.0 = every gradient element finite)
+        fin = lax.pmin(
+            jnp.isfinite(g_sh).all().astype(jnp.float32), axis)
+        loss_val = lax.pmean(loss_val, axis)
+        muts = tuple(lax.pmean(m.astype(jnp.float32), axis)
+                     for m in muts)
+        return g_sh, loss_val, muts, fin
+
+    def update_part(train_vals, master_sh, state_leaves, g_sh, lr, t,
+                    scale, good, skipped, fin):
+        from ..ops import fused_optimizer as _fused
+
+        if _prec.PRECISION_MASTER_F32:
+            # the masters ARE the shard: each rank updates the f32
+            # slice it owns; no flat f32 weight vector ever exists
+            w_sh = master_sh
+        else:
+            # the seam's broken spelling (tests only): "masters"
+            # re-derived from the bf16 params — the full flat f32
+            # space materializes per rank and the master precision is
+            # lost, which the bf16_zero1_train_step peak-HBM/precision
+            # proof must catch (COST001 rc=2)
+            flat_w = _flatten_pad(train_vals, plan, jnp)
+            idx = lax.axis_index(axis)
+            w_sh = lax.dynamic_slice(flat_w, (idx * shard,), (shard,))
+        inv = (1.0 / scale).astype(jnp.float32)
+        state = jax.tree_util.tree_unflatten(state_treedef,
+                                             list(state_leaves))
+        if _fused.fused_update_enabled() and _fused.supports(opt):
+            # unscale + clip + update + select-skip as ONE kernel pass:
+            # the loss-scale reciprocal and the finite flag ride the
+            # SMEM scalar block (docs/fusion.md, docs/precision.md)
+            new_w_sh, new_state = _fused.fused_optimizer_update(
+                opt, 0, w_sh, g_sh, state, lr, t, inv_scale=inv,
+                ok=fin)
+        else:
+            nw, ns = functional_optimizer_update(
+                opt, 0, w_sh, g_sh * inv, state, lr, t)
+            okb = fin > 0.0
+            new_w_sh = jnp.where(okb, nw, w_sh)
+            new_state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(okb, n, o), ns, state)
+        new_scale, new_good = _prec.loss_scale_update(scale, good,
+                                                      fin > 0.0)
+        new_skipped = skipped + (1 - fin.astype(jnp.int32))
+        # cast BEFORE the gather: the reassembled params are bf16, so
+        # the all-gather moves half the wire bytes and the gathered
+        # param copy holds half the HBM of the f32 twin
+        new_w_c = new_w_sh.astype(compute_dtype)
+        if ZERO1_RUNTIME_ALL_GATHER:
+            new_flat = lax.all_gather(new_w_c, axis, tiled=True)
+        else:
+            # the classic broken spelling (tests only; see build_parts)
+            new_flat = jnp.concatenate([new_w_c] * k) if k > 1 \
+                else new_w_c
+        new_vals = _unflatten(new_flat, plan, jnp)
+        return (new_vals, new_w_sh,
+                tuple(jax.tree_util.tree_leaves(new_state)),
+                new_scale, new_good, new_skipped)
+
+    return grads_part, update_part
+
+
+def build_replica_step(fwd, opt, plan, state_treedef,
+                       compute_dtype=None):
     """One per-replica function composing both halves — the analysis
     spelling.  ``step(train_vals, state_leaves, aux_vals, x, y, key,
     lr, t) -> (loss, new_vals, new_state_leaves, muts)``; trace with
-    ``jax.make_jaxpr(axis_env=[(plan.axis, plan.k)])``."""
-    grads_part, update_part = build_parts(fwd, opt, plan, state_treedef)
+    ``jax.make_jaxpr(axis_env=[(plan.axis, plan.k)])``.
+
+    Under a reduced ``compute_dtype`` the spelling grows the
+    mixed-precision arguments instead (the :func:`build_parts`
+    docstring): ``step(train_vals, master_sh, state_leaves, aux_vals,
+    x, y, key, lr, t, scale, good, skipped) -> (loss, new_vals,
+    new_master_sh, new_state_leaves, muts, new_scale, new_good,
+    new_skipped)``."""
+    import jax.numpy as jnp
+
+    grads_part, update_part = build_parts(fwd, opt, plan, state_treedef,
+                                          compute_dtype=compute_dtype)
+    if compute_dtype is not None and \
+            jnp.dtype(compute_dtype) != jnp.float32:
+        def replica_step(train_vals, master_sh, state_leaves, aux_vals,
+                         x, y, key, lr, t, scale, good, skipped):
+            g_sh, loss_val, muts, fin = grads_part(
+                train_vals, aux_vals, x, y, key, scale)
+            (new_vals, new_master, new_states, new_scale, new_good,
+             new_skipped) = update_part(
+                train_vals, master_sh, state_leaves, g_sh, lr, t,
+                scale, good, skipped, fin)
+            return (loss_val, new_vals, new_master, new_states, muts,
+                    new_scale, new_good, new_skipped)
+
+        return replica_step
 
     def replica_step(train_vals, state_leaves, aux_vals, x, y, key,
                      lr, t):
@@ -194,21 +354,42 @@ def build_replica_step(fwd, opt, plan, state_treedef):
     return replica_step
 
 
-def build_runtime_fns(fwd, opt, plan, state_treedef, mesh):
+def build_runtime_fns(fwd, opt, plan, state_treedef, mesh,
+                      compute_dtype=None):
     """``(grad_fn, update_fn)`` — the jitted ``shard_map`` programs the
     trainer dispatches each step.  ``grad_fn``'s flat-gradient output
     and the optimizer-state leaves are GLOBAL ``(padded,)`` arrays
     sharded ``P(axis)`` (each device holds its ``shard``-sized slice);
     params/aux/loss stay replicated; the batch shards over ``axis``.
     ``update_fn`` donates params, states and the gradient shard, so the
-    update happens in place in HBM exactly like the fused step."""
+    update happens in place in HBM exactly like the fused step.
+
+    Under a reduced ``compute_dtype`` the f32 master shard is an extra
+    GLOBAL ``(padded,)`` ``P(axis)`` array threaded through ``update_fn``
+    (donated in, returned out) and the loss-scale scalars ride
+    replicated — the :func:`build_parts` mixed-precision signature."""
     import jax
+    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from .ring_attention import _shard_map
 
-    grads_part, update_part = build_parts(fwd, opt, plan, state_treedef)
+    grads_part, update_part = build_parts(fwd, opt, plan, state_treedef,
+                                          compute_dtype=compute_dtype)
     axis = plan.axis
+    if compute_dtype is not None and \
+            jnp.dtype(compute_dtype) != jnp.float32:
+        grad_fn = jax.jit(_shard_map(
+            grads_part, mesh,
+            in_specs=(P(), P(), P(axis), P(axis), P(), P()),
+            out_specs=(P(axis), P(), P(), P())))
+        update_fn = jax.jit(_shard_map(
+            update_part, mesh,
+            in_specs=(P(), P(axis), P(axis), P(axis), P(), P(), P(),
+                      P(), P(), P()),
+            out_specs=(P(), P(axis), P(axis), P(), P(), P())),
+            donate_argnums=(0, 1, 2, 3))
+        return grad_fn, update_fn
     grad_fn = jax.jit(_shard_map(
         grads_part, mesh,
         in_specs=(P(), P(), P(axis), P(axis), P()),

@@ -1,0 +1,215 @@
+"""Host-only mixed-precision bench (the r05 subprocess pattern).
+
+Run as ``python -m mxnet_tpu.precision_bench`` under
+``JAX_PLATFORMS=cpu`` (bench.py's ``precision`` stage does, BEFORE
+backend acquisition, so the keys stay live when the TPU is down).
+Emits one JSON line:
+
+- ``fused_loss_scaled_speedup_host``: REAL measured wall-time ratio of
+  the unfused unscale+clip+update chain (per-parameter eqns, the
+  ``jnp.where`` select-skip outside) vs the shipped fused kernel with
+  the loss-scale reciprocal and finite flag riding the SMEM scalar
+  block (``ops/fused_optimizer.py`` — unscale+clip+update+select-skip
+  as ONE pass).  Gated ``higher`` in tools/bench_compare.py.
+- ``bf16_modeled_hbm_ratio``: deterministic modeled peak-HBM ratio of
+  the bf16 ZeRO-1 trainer vs its f32 twin from the
+  ``bf16_zero1_train_step`` budget builder (0.66x measured = the 34%
+  drop docs/precision.md claims).  Gated ``lower_abs``.
+- ``bf16_convergence_delta``: max |loss_bf16 - loss_f32| over
+  ``CONV_STEPS`` real trainer steps on the same data/seed — the
+  mixed-precision trajectory must track full precision.  Gated
+  ``lower_abs``.
+- ``int8_kv_decode_tokens_per_sec_host``: greedy-decode throughput
+  through a DecodeRunner over the int8 KV cache (quantized codes +
+  per-page scales, dequant fused into the attention read).  Gated
+  ``higher``.
+- ``precision_numerics_ok``: 1.0 iff the fused loss-scaled update
+  matches the unfused spelling within FLOAT_TOL, the skip path leaves
+  params bitwise-untouched on an inf gradient, AND int8-KV greedy
+  tokens agree with the f32-cache reference on >= 90% of generated
+  tokens — gated at zero slack.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+FLOAT_TOL = 1e-5
+BENCH_REPS = 40
+NPAR, PSIZE = 96, 4096
+CONV_STEPS = 20
+DECODE_PROMPTS = 6
+DECODE_NEW = 8
+
+
+def _bench(fn, args, reps=BENCH_REPS):
+    import jax
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _loss_scaled_update_bench(out):
+    """Unfused unscale+clip+update chain vs the fused kernel with
+    inv_scale/ok in the SMEM scalar block."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.ops import fused_optimizer as fo
+    from mxnet_tpu.parallel.functional import functional_optimizer_update
+
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4)
+    rng = np.random.RandomState(7)
+    ws = [jnp.asarray(rng.randn(PSIZE).astype("f")) for _ in range(NPAR)]
+    gs = [jnp.asarray(rng.randn(PSIZE).astype("f")) for _ in range(NPAR)]
+    ms = [jnp.asarray(rng.randn(PSIZE).astype("f")) for _ in range(NPAR)]
+    wf, gf, mf = map(jnp.concatenate, (ws, gs, ms))
+    lr = jnp.float32(0.1)
+    inv = jnp.float32(1.0 / 1024.0)
+    ok = jnp.float32(1.0)
+
+    @jax.jit
+    def unfused(ws, gs, ms, lr, inv, ok):
+        outs = []
+        for w, g, m in zip(ws, gs, ms):
+            nw, nm = functional_optimizer_update(opt, 0, w, g * inv, m,
+                                                 lr, 1)
+            okb = ok > 0.0
+            outs.append((jnp.where(okb, nw, w), jnp.where(okb, nm, m)))
+        return [o[0] for o in outs], [o[1] for o in outs]
+
+    @jax.jit
+    def fused(wf, gf, mf, lr, inv, ok):
+        return fo.fused_optimizer_update(opt, 0, wf, gf, mf, lr, 1,
+                                         inv_scale=inv, ok=ok,
+                                         interpret=True)
+
+    nw_u, nm_u = unfused(ws, gs, ms, lr, inv, ok)
+    jax.block_until_ready((nw_u, nm_u))
+    nw_f, nm_f = fused(wf, gf, mf, lr, inv, ok)
+    jax.block_until_ready((nw_f, nm_f))
+
+    t_u = _bench(unfused, (ws, gs, ms, lr, inv, ok))
+    t_f = _bench(fused, (wf, gf, mf, lr, inv, ok))
+    out["fused_loss_scaled_unfused_ms"] = round(t_u * 1e3, 4)
+    out["fused_loss_scaled_fused_ms"] = round(t_f * 1e3, 4)
+    out["fused_loss_scaled_speedup_host"] = round(t_u / t_f, 3)
+
+    err = max(float(jnp.max(jnp.abs(jnp.concatenate(nw_u) - nw_f))),
+              float(jnp.max(jnp.abs(jnp.concatenate(nm_u) - nm_f))))
+    # the skip contract: an inf gradient must leave w/m bitwise alone
+    gbad = gf.at[0].set(np.inf)
+    sw, sm = fused(wf, gbad, mf, lr, inv, jnp.float32(0.0))
+    skipped_ok = bool((np.asarray(sw) == np.asarray(wf)).all()
+                      and (np.asarray(sm) == np.asarray(mf)).all())
+    return err, skipped_ok
+
+
+def _convergence_bench(out):
+    """bf16 vs f32 trainer loss trajectories, same seed/data."""
+    from mxnet_tpu import init as mx_init
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.trainer import DataParallelTrainer
+
+    rng = np.random.RandomState(11)
+    x = rng.randn(32, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=32).astype(np.int32)
+
+    def losses(dtype):
+        from mxnet_tpu import random as mx_random
+        mx_random.seed(3)    # identical init for both arms
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(4))
+        net.initialize(mx_init.Xavier(rnd_type="gaussian",
+                                      magnitude=2.0))
+        tr = DataParallelTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                                 "sgd", {"learning_rate": 0.1},
+                                 dtype=dtype)
+        return [float(tr.step(nd.array(x), nd.array(y)))
+                for _ in range(CONV_STEPS)]
+
+    l32 = losses("float32")
+    l16 = losses("bf16")
+    delta = max(abs(a - b) for a, b in zip(l32, l16))
+    out["bf16_convergence_delta"] = round(delta, 5)
+    out["bf16_final_loss"] = round(l16[-1], 5)
+    return l16[-1] < l16[0]    # it must actually be learning
+
+
+def _int8_decode_bench(out):
+    """Greedy decode through the int8 KV cache: tokens/sec + agreement
+    with the f32-cache reference."""
+    from mxnet_tpu.parallel.mesh import MeshPlan
+    from mxnet_tpu.serving.decode import DecodeRunner
+    from mxnet_tpu.transformer import TransformerLMConfig
+    from mxnet_tpu.transformer.decode import DecodeProgram
+
+    cfg = TransformerLMConfig(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, seq_len=64)
+
+    def runner(kv_dtype):
+        prog = DecodeProgram(cfg, plan=MeshPlan(data=1), page_size=8,
+                             kv_dtype=kv_dtype)
+        params = prog.program.init_params(0)
+        return DecodeRunner(prog, params, slots=2,
+                            prefill_buckets=(8, 16), warmup=True)
+
+    r8 = runner("int8")
+    r32 = runner(None)
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 64, size=rng.randint(3, 12)
+                           ).astype(np.int32)
+               for _ in range(DECODE_PROMPTS)]
+    agree = total = 0
+    for p in prompts:
+        a = r8.generate(p, DECODE_NEW)
+        b = r32.generate(p, DECODE_NEW)
+        agree += int((np.asarray(a) == np.asarray(b)).sum())
+        total += len(a)
+    t0 = time.perf_counter()
+    done = 0
+    for p in prompts:
+        done += len(r8.generate(p, DECODE_NEW))
+    dt = time.perf_counter() - t0
+    out["int8_kv_decode_tokens_per_sec_host"] = round(done / dt, 2)
+    out["int8_kv_token_agreement"] = round(agree / total, 4)
+    out["int8_kv_page_bytes"] = int(r8.program.bytes_per_page())
+    return agree / total >= 0.9
+
+
+def main():
+    from mxnet_tpu.analysis.budget_models import bf16_zero1_train_step
+
+    out = {}
+
+    err, skipped_ok = _loss_scaled_update_bench(out)
+    out["precision_numerics_max_err"] = float(err)
+
+    # deterministic modeled ratio straight from the budget builder —
+    # the same number the rc=2 gate pins
+    _, _, shard = bf16_zero1_train_step()
+    out["bf16_modeled_hbm_ratio"] = shard.extras["bf16_peak_hbm_ratio"]
+    out["bf16_modeled_hbm_drop_pct"] = shard.extras[
+        "bf16_modeled_hbm_drop_pct"]
+
+    learning = _convergence_bench(out)
+    int8_ok = _int8_decode_bench(out)
+
+    out["precision_numerics_ok"] = 1.0 if (
+        err <= FLOAT_TOL and skipped_ok and learning and int8_ok) else 0.0
+    print(json.dumps(out))
+    return 0 if out["precision_numerics_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -202,9 +202,18 @@ class DecodeRunner:
         # serializes device dispatch — the ModelRunner._lock discipline
         self._lock = threading.Lock()
         self._prefill_fn, self._decode_fn = program.build_runtime_fns(mesh)
+        cache_dtype = jnp.int8 if program.kv_quantized else jnp.float32
         self._ck = jnp.zeros(program.global_cache_shape(n_pages),
-                             jnp.float32)
+                             cache_dtype)
         self._cv = jnp.zeros_like(self._ck)
+        if program.kv_quantized:
+            # int8 pools carry per-row f32 scale pools beside the codes
+            # (docs/precision.md) — threaded through every device call
+            self._sk = jnp.ones(program.global_scale_shape(n_pages),
+                                jnp.float32)
+            self._sv = jnp.ones_like(self._sk)
+        else:
+            self._sk = self._sv = None
         self._warm_keys = frozenset()
         self.warmed_up = False
         if warmup:
@@ -271,10 +280,17 @@ class DecodeRunner:
         row = _np.zeros(self.pages_per_seq, _np.int32)
         row[:pr.size] = pr
         with self._lock:
-            logits, self._ck, self._cv = self._prefill_fn(
-                self._vals, self._ck, self._cv, jnp.asarray(row[None]),
-                jnp.asarray(toks[None]),
-                jnp.asarray([length], _np.int32))
+            if self.program.kv_quantized:
+                (logits, self._ck, self._cv, self._sk,
+                 self._sv) = self._prefill_fn(
+                    self._vals, self._ck, self._cv, self._sk, self._sv,
+                    jnp.asarray(row[None]), jnp.asarray(toks[None]),
+                    jnp.asarray([length], _np.int32))
+            else:
+                logits, self._ck, self._cv = self._prefill_fn(
+                    self._vals, self._ck, self._cv,
+                    jnp.asarray(row[None]), jnp.asarray(toks[None]),
+                    jnp.asarray([length], _np.int32))
             return _np.asarray(logits[0])
 
     def decode_step(self, page_tables, lengths, tokens):
@@ -284,11 +300,19 @@ class DecodeRunner:
         logits ``(slots, V)`` as numpy."""
         import jax.numpy as jnp
         with self._lock:
-            logits, self._ck, self._cv = self._decode_fn(
-                self._vals, self._ck, self._cv,
-                jnp.asarray(page_tables, _np.int32),
-                jnp.asarray(lengths, _np.int32),
-                jnp.asarray(tokens, _np.int32))
+            if self.program.kv_quantized:
+                (logits, self._ck, self._cv, self._sk,
+                 self._sv) = self._decode_fn(
+                    self._vals, self._ck, self._cv, self._sk, self._sv,
+                    jnp.asarray(page_tables, _np.int32),
+                    jnp.asarray(lengths, _np.int32),
+                    jnp.asarray(tokens, _np.int32))
+            else:
+                logits, self._ck, self._cv = self._decode_fn(
+                    self._vals, self._ck, self._cv,
+                    jnp.asarray(page_tables, _np.int32),
+                    jnp.asarray(lengths, _np.int32),
+                    jnp.asarray(tokens, _np.int32))
             return _np.asarray(logits)
 
     # -- convenience decodes -----------------------------------------------
@@ -378,8 +402,9 @@ class DecodeRunner:
 
     def __repr__(self):
         return ("<DecodeRunner slots=%d prefill_buckets=%s pages=%d "
-                "page_size=%d>" % (self.slots, list(self.buckets),
-                                   self.pool.n_pages, self.page_size))
+                "page_size=%d kv_dtype=%s>"
+                % (self.slots, list(self.buckets), self.pool.n_pages,
+                   self.page_size, self.program.kv_dtype))
 
 
 class DecodeStats(ServingStats):

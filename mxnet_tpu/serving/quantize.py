@@ -1,0 +1,318 @@
+"""Real post-training quantization (PTQ) for the serving fleet.
+
+Replaces the naive quantize-at-load orphan (``tools/serve.py`` used to
+call ``contrib.quantization.quantize_model`` over SYNTHETIC calibration
+data) with a pipeline whose every number is accountable
+(docs/precision.md):
+
+- **Per-channel weight scales**: each output channel quantizes against
+  its own ``amax/127`` — one outlier row no longer poisons the whole
+  tensor's resolution the way a per-tensor (min, max) pair does.
+- **Calibration from a real set**: activation ranges come from forward
+  passes over caller-provided calibration batches, never synthetic
+  noise.
+- **int8 matmul via the ``qmm_requant`` lineage**: the quantized layers
+  lower to ``_contrib_quantized_fc_pc`` (ops/quantization.py) — s8×s8
+  →s32 on the MXU with the per-channel dequant + bias + relu epilogue
+  fused, int32 accumulator never touching HBM.
+- **Scales carry provenance**: :func:`ptq_digest` hashes every code
+  tensor, scale vector and calibrated range into one sha256 that rides
+  the runner's ``provenance`` dict — the digest the fleet ``/stats``
+  and promotion audit records name.  Two quantizations of the same
+  checkpoint over the same calibration set digest identically; a
+  scrambled scale does not.
+
+The quantized model registers as an ordinary fleet variant, so its
+golden-set parity is judged by the PR-12
+:class:`~mxnet_tpu.mlops.promote.PromotionController` exactly like any
+canary: a bad quant (scrambled scales, wrong calibration) drops
+``golden_parity`` below the threshold and auto-rolls-back with the
+audit record naming the metric (tests/test_precision.py).
+
+Scope: the gluon path quantizes Dense chains (the fleet's MLP serving
+models) per-channel; Module/symbol checkpoints route through
+:func:`ptq_quantize_module` — the contrib graph rewrite driven by REAL
+calibration data with the scales digested — because per-channel scale
+plumbing through the reference's (data, min, max) triple ABI would fork
+that contract.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["PTQLayer", "PTQModel", "ptq_quantize_net", "ptq_digest",
+           "build_quantized_net", "QuantizedDense",
+           "quantized_runner_from_checkpoint", "ptq_quantize_module",
+           "per_channel_scales"]
+
+
+def per_channel_scales(w):
+    """Symmetric per-output-channel int8 scales of a ``(O, I)`` weight:
+    ``scales[c] = amax(|w[c, :]|) / 127`` (floored so an all-zero
+    channel quantizes to code 0, not NaN).  Returns ``(codes int8,
+    scales f32 (O,))``."""
+    w = _np.asarray(w, _np.float32)
+    flat = w.reshape(w.shape[0], -1)
+    scales = _np.abs(flat).max(axis=1) / 127.0
+    scales = _np.maximum(scales, 1e-12).astype(_np.float32)
+    codes = _np.clip(_np.round(flat / scales[:, None]), -127, 127) \
+        .astype(_np.int8)
+    return codes.reshape(w.shape), scales
+
+
+class PTQLayer:
+    """One quantized Dense layer: int8 codes, per-channel scales, the
+    f32 bias, the CALIBRATED input amax and the activation to fuse."""
+
+    __slots__ = ("name", "codes", "scales", "bias", "in_amax",
+                 "activation", "units")
+
+    def __init__(self, name, codes, scales, bias, in_amax,
+                 activation=None):
+        self.name = str(name)
+        self.codes = _np.asarray(codes, _np.int8)
+        self.scales = _np.asarray(scales, _np.float32)
+        self.bias = None if bias is None \
+            else _np.asarray(bias, _np.float32)
+        self.in_amax = float(in_amax)
+        self.activation = activation
+        self.units = int(self.codes.shape[0])
+
+
+class PTQModel:
+    """The pipeline's output: the ordered quantized layers plus the
+    calibration summary.  ``digest`` is memoized content identity over
+    every scale/code/range byte (:func:`ptq_digest`)."""
+
+    def __init__(self, layers, calib_examples):
+        self.layers = list(layers)
+        self.calib_examples = int(calib_examples)
+        self._digest = None
+
+    @property
+    def digest(self):
+        if self._digest is None:
+            self._digest = ptq_digest(self)
+        return self._digest
+
+    def describe(self):
+        return {
+            "layers": [{"name": l.name,
+                        "units": l.units,
+                        "in_amax": round(l.in_amax, 6),
+                        "scale_min": float(l.scales.min()),
+                        "scale_max": float(l.scales.max())}
+                       for l in self.layers],
+            "calib_examples": self.calib_examples,
+            "digest": self.digest,
+        }
+
+
+def ptq_digest(model):
+    """sha256 over every quantized artifact — codes, per-channel
+    scales, biases and calibrated ranges in layer order.  The
+    provenance identity of a quantization: same checkpoint + same
+    calibration set → same digest; a scrambled scale changes it."""
+    h = hashlib.sha256()
+    for layer in model.layers:
+        h.update(layer.name.encode())
+        h.update(_np.ascontiguousarray(layer.codes).tobytes())
+        h.update(_np.ascontiguousarray(layer.scales).tobytes())
+        if layer.bias is not None:
+            h.update(_np.ascontiguousarray(layer.bias).tobytes())
+        h.update(_np.float32(layer.in_amax).tobytes())
+        h.update(str(layer.activation).encode())
+    return h.hexdigest()
+
+
+def _dense_layers(net):
+    """Flatten a gluon net into its ordered Dense children; anything
+    else (activations live INSIDE Dense here) is a scope error — the
+    pipeline quantizes what it can prove it understands."""
+    from ..gluon import nn
+
+    out = []
+
+    def walk(block):
+        if isinstance(block, nn.Dense):
+            out.append(block)
+            return
+        kids = list(getattr(block, "_children", {}).values())
+        if not kids:
+            raise MXNetError(
+                "ptq_quantize_net only quantizes Dense chains; found "
+                "%r with no Dense children" % type(block).__name__)
+        for child in kids:
+            walk(child)
+
+    walk(net)
+    if not out:
+        raise MXNetError("no Dense layers found to quantize")
+    return out
+
+
+def ptq_quantize_net(net, calib):
+    """Quantize a trained Dense-chain gluon net from a REAL calibration
+    set: per-channel weight scales, per-layer input amax measured by
+    running ``calib`` through the f32 layers in order.  Returns a
+    :class:`PTQModel`."""
+    from .. import ndarray as nd
+
+    calib = _np.asarray(calib, _np.float32)
+    if calib.ndim < 2 or calib.shape[0] < 1:
+        raise MXNetError("calibration set must be (n,) + example_shape "
+                         "with n >= 1, got %r" % (calib.shape,))
+    layers = []
+    x = nd.array(calib)
+    for dense in _dense_layers(net):
+        w = dense.weight.data().asnumpy()
+        bias = dense.bias.data().asnumpy() if dense.bias is not None \
+            else None
+        codes, scales = per_channel_scales(w)
+        in_amax = max(float(_np.abs(x.asnumpy()).max()), 1e-12)
+        act = dense.act._act_type if dense.act is not None else None
+        layers.append(PTQLayer(dense.name, codes, scales, bias, in_amax,
+                               activation=act))
+        x = dense(x)    # f32 forward feeds the NEXT layer's calibration
+    return PTQModel(layers, calib.shape[0])
+
+
+_QDENSE_CLS = None
+
+
+def _quantized_dense_cls():
+    """Lazily define (and cache) QuantizedDense — serving.quantize must
+    import without dragging the gluon tier in at module load."""
+    global _QDENSE_CLS
+    if _QDENSE_CLS is not None:
+        return _QDENSE_CLS
+    from ..gluon.block import HybridBlock
+
+    class QuantizedDense(HybridBlock):
+        """One PTQ'd Dense layer: int8 codes + per-channel scales as
+        gluon Constants, lowered through ``_contrib_quantized_fc_pc``
+        (the qmm_requant-lineage fused epilogue).  relu fuses into the
+        epilogue; other activations apply on the float rail after."""
+
+        def __init__(self, layer, prefix=None, params=None):
+            super().__init__(prefix=prefix, params=params)
+            from .. import ndarray as nd
+            self._units = layer.units
+            self._in_amax = layer.in_amax
+            self._activation = layer.activation
+            with self.name_scope():
+                self.wq = self.params.get_constant(
+                    "wq", nd.array(layer.codes, dtype=_np.int8))
+                self.wscale = self.params.get_constant(
+                    "wscale", nd.array(layer.scales, dtype=_np.float32))
+                self.bias = None if layer.bias is None else \
+                    self.params.get_constant(
+                        "bias", nd.array(layer.bias, dtype=_np.float32))
+
+        def hybrid_forward(self, F, x, wq, wscale, bias=None):
+            out = F.contrib.quantized_fc_pc(
+                x, wq, wscale, bias, num_hidden=self._units,
+                in_amax=self._in_amax, relu=self._activation == "relu",
+                no_bias=bias is None)
+            if self._activation not in (None, "relu"):
+                out = F.Activation(out, act_type=self._activation)
+            return out
+
+    _QDENSE_CLS = QuantizedDense
+    return QuantizedDense
+
+
+def __getattr__(name):
+    if name == "QuantizedDense":
+        return _quantized_dense_cls()
+    raise AttributeError(name)
+
+
+def build_quantized_net(model):
+    """A hybridized gluon net serving a :class:`PTQModel` — what a
+    :class:`~mxnet_tpu.serving.runner.ModelRunner` wraps."""
+    from ..gluon import nn
+
+    cls = _quantized_dense_cls()
+    net = nn.HybridSequential()
+    for layer in model.layers:
+        net.add(cls(layer))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def quantized_runner_from_checkpoint(path_or_record, net_builder,
+                                     example_shape, calib,
+                                     buckets=(1, 4, 16), **runner_kwargs):
+    """The PTQ twin of
+    :func:`~mxnet_tpu.mlops.promote.runner_from_trainer_checkpoint`:
+    rebuild the f32 net from a trainer ``.mxckpt`` snapshot, quantize
+    it over the REAL calibration set, and wrap the quantized net in a
+    :class:`~mxnet_tpu.serving.runner.ModelRunner` whose provenance
+    carries BOTH the checkpoint digest and the quantization digest —
+    the promotion controller judges the variant like any canary.
+
+    Returns ``(runner, provenance, ptq_model)`` — the PTQModel rides
+    along so callers (and tests) can inspect or deliberately break the
+    scales and rebuild via :func:`build_quantized_net`."""
+    from ..mlops.promote import runner_from_trainer_checkpoint
+    from ..resilience import checkpoint as _ckpt
+    from .runner import ModelRunner
+
+    if isinstance(path_or_record, dict):
+        rec = path_or_record
+    else:
+        rec = _ckpt.load_checkpoint(path_or_record)
+    # reuse the positional param-mapping discipline (shape checks and
+    # all) by building the f32 runner, then quantizing its net
+    f32_runner, prov = runner_from_trainer_checkpoint(
+        rec, net_builder, example_shape=example_shape, buckets=buckets)
+    model = ptq_quantize_net(f32_runner._model, calib)
+    qnet = build_quantized_net(model)
+    prov = dict(prov or {})
+    prov["quant_digest"] = model.digest
+    prov["quant"] = {"kind": "ptq_per_channel",
+                     "calib_examples": model.calib_examples}
+    runner = ModelRunner(qnet, buckets=buckets,
+                         example_shape=tuple(example_shape),
+                         provenance=prov, **runner_kwargs)
+    return runner, prov, model
+
+
+def ptq_quantize_module(sym, arg_params, aux_params, calib_data,
+                        data_names=("data",), num_calib_examples=None,
+                        calib_mode="naive", excluded_sym_names=None):
+    """PTQ for Module/symbol checkpoints (the ``tools/serve.py :int8``
+    route): the contrib graph rewrite driven by a REAL calibration
+    iterator — never synthetic — with every weight scale and calibrated
+    range digested for provenance.  Per-tensor scales here (the
+    reference triple ABI); the per-channel story is the gluon path
+    above.  Returns ``(qsym, qarg, aux, report)`` where ``report`` has
+    the sha256 ``digest`` the serving provenance carries."""
+    from ..contrib.quantization import quantize_model
+
+    if calib_data is None:
+        raise MXNetError(
+            "ptq_quantize_module needs a real calibration iterator — "
+            "the synthetic-data shortcut is exactly the naive-at-load "
+            "path this pipeline retires (pass tools/serve.py --calib)")
+    qsym, qarg, aux = quantize_model(
+        sym, arg_params, aux_params, data_names=tuple(data_names),
+        calib_mode=calib_mode, calib_data=calib_data,
+        num_calib_examples=num_calib_examples,
+        excluded_sym_names=excluded_sym_names)
+    h = hashlib.sha256()
+    for name in sorted(qarg):
+        if name.endswith(("_quantized", "_min", "_max")):
+            h.update(name.encode())
+            h.update(_np.ascontiguousarray(
+                qarg[name].asnumpy()).tobytes())
+    report = {"digest": h.hexdigest(),
+              "calib_mode": str(calib_mode),
+              "kind": "ptq_per_tensor_module"}
+    return qsym, qarg, aux, report

@@ -43,6 +43,17 @@ stale-KV bug where every decode step attends over a cache missing its
 own token — and the ``decode_step`` budget gate must fail rc=2 with the
 cached-vs-full-forward mismatch named (tests/test_decode.py,
 subprocess).  Production code never touches it.
+
+**int8 KV-cache** (``kv_dtype="int8"``, docs/precision.md): the pools
+hold int8 codes quantized per (layer, page, token, head) row — scale =
+``amax(|kv_row|)/127`` over ``head_dim``, stored f32 in a scale pool of
+the same page layout beside the codes — and the dequant
+(``codes * scale``) is fused into the attention read, so a page costs
+~1/4 the f32 bytes (codes) plus a ``head_dim``-th of scales:
+``bytes_per_page()`` is dtype-aware and everything that counts pages
+(SRV004 admission, the capacity simulator, ``tools/capacity.py``)
+inherits the drop.  The write path quantizes the freshly-computed K/V
+row in the same kernel pass as the cache scatter.
 """
 from __future__ import annotations
 
@@ -59,6 +70,24 @@ __all__ = ["DecodeProgram", "DECODE_WRITE_KV"]
 DECODE_WRITE_KV = True
 
 _NEG_INF = -1e30
+
+_KV_DTYPES = {None: "float32", "f32": "float32", "float32": "float32",
+              "int8": "int8"}
+
+
+def _kv_quant(x, jnp):
+    """Quantize one K/V row-block along ``head_dim``: symmetric
+    per-row amax/127 scales (f32), int8 codes.  ``x`` is ``(...,
+    head_dim)``; returns ``(codes int8, scales f32 (..., 1))``."""
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12).astype(jnp.float32)
+    codes = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def _kv_dequant(codes, scale, jnp):
+    """The fused-into-attention read: ``codes * scale`` back to f32."""
+    return codes.astype(jnp.float32) * scale
 
 
 def _full_logits(logits_local, plan):
@@ -83,7 +112,7 @@ class DecodeProgram:
     sequence's worth of slots, unallocated tails pointing at scratch).
     """
 
-    def __init__(self, cfg, plan=None, page_size=8):
+    def __init__(self, cfg, plan=None, page_size=8, kv_dtype=None):
         if not isinstance(cfg, TransformerLMConfig):
             cfg = TransformerLMConfig(**cfg)
         plan = MeshPlan.coerce(plan) or MeshPlan(data=1)
@@ -97,12 +126,19 @@ class DecodeProgram:
             raise ValueError(
                 "page_size %d must divide seq_len %d"
                 % (page_size, cfg.seq_len))
+        key = kv_dtype if kv_dtype is None else str(kv_dtype)
+        if key not in _KV_DTYPES:
+            raise ValueError("kv_dtype must be one of %s, got %r"
+                             % (sorted(k for k in _KV_DTYPES if k),
+                                kv_dtype))
         self.cfg = cfg
         self.plan = plan
         self.program = MeshProgram(cfg, plan)
         self.page_size = int(page_size)
         self.pages_per_seq = cfg.seq_len // self.page_size
         self.heads_local = cfg.n_heads // plan.size("model")
+        self.kv_dtype = _KV_DTYPES[key]
+        self.kv_quantized = self.kv_dtype == "int8"
 
     # -- geometry ----------------------------------------------------------
     def cache_shape(self, n_pages):
@@ -110,17 +146,37 @@ class DecodeProgram:
         return (self.cfg.n_layers, int(n_pages), self.page_size,
                 self.heads_local, self.cfg.head_dim)
 
+    def scale_shape(self, n_pages):
+        """LOCAL per-row scale pool shape (int8 KV only): one f32 scale
+        per (layer, page, token, head) row, trailing 1 so the dequant
+        broadcasts straight over ``head_dim``."""
+        return (self.cfg.n_layers, int(n_pages), self.page_size,
+                self.heads_local, 1)
+
     def global_cache_shape(self, n_pages):
         return (self.cfg.n_layers, int(n_pages), self.page_size,
                 self.cfg.n_heads, self.cfg.head_dim)
 
+    def global_scale_shape(self, n_pages):
+        return (self.cfg.n_layers, int(n_pages), self.page_size,
+                self.cfg.n_heads, 1)
+
+    def cache_np_dtype(self):
+        """numpy dtype of the cache pools (the scale pools are always
+        f32)."""
+        return _np.int8 if self.kv_quantized else _np.float32
+
     def bytes_per_page(self):
-        """GLOBAL f32 bytes one page pins across all model ranks: K+V for
+        """GLOBAL bytes one page pins across all model ranks: K+V for
         ``page_size`` tokens through every layer — the unit the page
-        allocator and pages-based fleet admission count in."""
+        allocator and pages-based fleet admission count in.  Dtype-
+        aware: int8 pages carry 1-byte codes plus one f32 scale per
+        (layer, token, head) row — well under half the f32 page."""
         cfg = self.cfg
-        return (2 * cfg.n_layers * self.page_size * cfg.n_heads
-                * cfg.head_dim * 4)
+        rows = 2 * cfg.n_layers * self.page_size * cfg.n_heads
+        if self.kv_quantized:
+            return rows * cfg.head_dim * 1 + rows * 4
+        return rows * cfg.head_dim * 4
 
     def pages_for(self, n_tokens):
         """Pages a sequence of ``n_tokens`` total (prompt + generation
@@ -129,13 +185,16 @@ class DecodeProgram:
 
     # -- the per-replica phases (spelled ONCE) ------------------------------
     def prefill_replica(self, train_vals, cache_k, cache_v, page_table,
-                        tokens, lengths):
+                        tokens, lengths, scale_k=None, scale_v=None):
         """Full causal forward over a ``(B, Tb)`` padded prompt bucket:
         returns ``(logits, cache_k, cache_v)`` with the last *real*
         position's full-vocab next-token logits and every position's K/V
         scattered into ``page_table``'s pages (page-table tails of 0
         land in scratch — see the module docstring).  ``Tb`` must be a
-        page multiple (the bucket ladder is built that way)."""
+        page multiple (the bucket ladder is built that way).  Under
+        ``kv_dtype="int8"`` the per-row scale pools ride along and the
+        return grows to ``(logits, cache_k, cache_v, scale_k,
+        scale_v)``."""
         import jax.numpy as jnp
 
         from . import layers as L
@@ -182,13 +241,22 @@ class DecodeProgram:
             cfg.n_layers, B, npg, ps, self.heads_local, cfg.head_dim)
         vp = jnp.stack(vs).reshape(
             cfg.n_layers, B, npg, ps, self.heads_local, cfg.head_dim)
+        if self.kv_quantized:
+            kp, ksc = _kv_quant(kp, jnp)
+            vp, vsc = _kv_quant(vp, jnp)
+            if DECODE_WRITE_KV:
+                cache_k = cache_k.at[:, pages].set(kp)
+                cache_v = cache_v.at[:, pages].set(vp)
+                scale_k = scale_k.at[:, pages].set(ksc)
+                scale_v = scale_v.at[:, pages].set(vsc)
+            return logits, cache_k, cache_v, scale_k, scale_v
         if DECODE_WRITE_KV:
             cache_k = cache_k.at[:, pages].set(kp)
             cache_v = cache_v.at[:, pages].set(vp)
         return logits, cache_k, cache_v
 
     def decode_replica(self, train_vals, cache_k, cache_v, page_table,
-                       lengths, tokens):
+                       lengths, tokens, scale_k=None, scale_v=None):
         """One token step for every batch slot: ``tokens (B,)`` are the
         slots' last tokens, ``lengths (B,)`` the cached token counts (=
         the new token's position).  Writes the new K/V at
@@ -196,7 +264,10 @@ class DecodeProgram:
         gathered pages under a ``position <= length`` mask, and returns
         ``(logits, cache_k, cache_v)`` — full-vocab next-token logits
         per slot.  Idle slots (zero table, length 0) compute scratch
-        garbage the host ignores."""
+        garbage the host ignores.  Under ``kv_dtype="int8"`` the scale
+        pools ride along (quantize on write, dequant fused into the
+        attention read) and the return grows to ``(logits, cache_k,
+        cache_v, scale_k, scale_v)``."""
         import jax.numpy as jnp
 
         from . import layers as L
@@ -220,13 +291,30 @@ class DecodeProgram:
             q = jnp.einsum("btd,dhe->bthe", a, p[pre + "wq"])
             k = jnp.einsum("btd,dhe->bthe", a, p[pre + "wk"])
             v = jnp.einsum("btd,dhe->bthe", a, p[pre + "wv"])
-            if DECODE_WRITE_KV:
-                cache_k = cache_k.at[i, page_ids, offs].set(k[:, 0])
-                cache_v = cache_v.at[i, page_ids, offs].set(v[:, 0])
-            kseq = cache_k[i][page_table].reshape(
-                B, -1, self.heads_local, cfg.head_dim)
-            vseq = cache_v[i][page_table].reshape(
-                B, -1, self.heads_local, cfg.head_dim)
+            if self.kv_quantized:
+                kc, ksc = _kv_quant(k[:, 0], jnp)
+                vc, vsc = _kv_quant(v[:, 0], jnp)
+                if DECODE_WRITE_KV:
+                    cache_k = cache_k.at[i, page_ids, offs].set(kc)
+                    cache_v = cache_v.at[i, page_ids, offs].set(vc)
+                    scale_k = scale_k.at[i, page_ids, offs].set(ksc)
+                    scale_v = scale_v.at[i, page_ids, offs].set(vsc)
+                kseq = _kv_dequant(
+                    cache_k[i][page_table],
+                    scale_k[i][page_table], jnp).reshape(
+                    B, -1, self.heads_local, cfg.head_dim)
+                vseq = _kv_dequant(
+                    cache_v[i][page_table],
+                    scale_v[i][page_table], jnp).reshape(
+                    B, -1, self.heads_local, cfg.head_dim)
+            else:
+                if DECODE_WRITE_KV:
+                    cache_k = cache_k.at[i, page_ids, offs].set(k[:, 0])
+                    cache_v = cache_v.at[i, page_ids, offs].set(v[:, 0])
+                kseq = cache_k[i][page_table].reshape(
+                    B, -1, self.heads_local, cfg.head_dim)
+                vseq = cache_v[i][page_table].reshape(
+                    B, -1, self.heads_local, cfg.head_dim)
             s = jnp.einsum("bqhd,bkhd->bhqk", q, kseq) * scale
             s = jnp.where(seen[:, None, None, :], s, _NEG_INF)
             o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1),
@@ -242,6 +330,8 @@ class DecodeProgram:
         hf = L.layer_norm(h, p["lnf_scale"], p["lnf_bias"])
         hf = L.copy_to_model(hf, plan)
         logits = _full_logits((hf @ p["w_out"])[:, 0], plan)
+        if self.kv_quantized:
+            return logits, cache_k, cache_v, scale_k, scale_v
         return logits, cache_k, cache_v
 
     def _causal_attention(self, q, k, v):
@@ -256,48 +346,84 @@ class DecodeProgram:
         ``mesh`` (params ride their partition specs, the cache pools
         shard their head dim, tokens/lengths/page tables and the
         all-gathered logits are replicated).  Both donate the cache
-        pools so the update happens in place in HBM."""
+        pools so the update happens in place in HBM.  Under
+        ``kv_dtype="int8"`` both fns take the scale pools positionally
+        right after the code pools — ``(train_vals, cache_k, cache_v,
+        scale_k, scale_v, ...)`` — donate them too, and return the
+        5-tuple."""
         from jax.sharding import PartitionSpec as P
 
+        if self.kv_quantized:
+            def prefill_part(train_vals, cache_k, cache_v, scale_k,
+                             scale_v, page_table, tokens, lengths):
+                return self.prefill_replica(
+                    train_vals, cache_k, cache_v, page_table, tokens,
+                    lengths, scale_k=scale_k, scale_v=scale_v)
+
+            def decode_part(train_vals, cache_k, cache_v, scale_k,
+                            scale_v, page_table, lengths, tokens):
+                return self.decode_replica(
+                    train_vals, cache_k, cache_v, page_table, lengths,
+                    tokens, scale_k=scale_k, scale_v=scale_v)
+
+            donate = (1, 2, 3, 4)
+        else:
+            prefill_part = self.prefill_replica
+            decode_part = self.decode_replica
+            donate = (1, 2)
         if not self.plan.present("model"):
-            prefill = jax.jit(self.prefill_replica,
-                              donate_argnums=(1, 2))
-            decode = jax.jit(self.decode_replica, donate_argnums=(1, 2))
+            prefill = jax.jit(prefill_part, donate_argnums=donate)
+            decode = jax.jit(decode_part, donate_argnums=donate)
             return prefill, decode
         if mesh is None:
             mesh = self.plan.build_mesh()
         from ..parallel.ring_attention import _shard_map
         param_specs = tuple(self.program.partition_spec(n)
                             for n in self.program.param_names)
+        # the scale pool keeps the cache pool's rank (trailing 1 in
+        # place of head_dim) so the code-pool spec shards both
         cache = P(None, None, None, "model", None)
+        if self.kv_quantized:
+            in_specs = (param_specs, cache, cache, cache, cache,
+                        P(), P(), P())
+            out_specs = (P(), cache, cache, cache, cache)
+        else:
+            in_specs = (param_specs, cache, cache, P(), P(), P())
+            out_specs = (P(), cache, cache)
         prefill = jax.jit(_shard_map(
-            self.prefill_replica, mesh,
-            in_specs=(param_specs, cache, cache, P(), P(), P()),
-            out_specs=(P(), cache, cache)), donate_argnums=(1, 2))
+            prefill_part, mesh, in_specs=in_specs,
+            out_specs=out_specs), donate_argnums=donate)
         decode = jax.jit(_shard_map(
-            self.decode_replica, mesh,
-            in_specs=(param_specs, cache, cache, P(), P(), P()),
-            out_specs=(P(), cache, cache)), donate_argnums=(1, 2))
+            decode_part, mesh, in_specs=in_specs,
+            out_specs=out_specs), donate_argnums=donate)
         return prefill, decode
 
     # -- analysis -----------------------------------------------------------
     def decode_avals(self, n_pages, slots):
-        """Local abstract values of one decode step, in
-        ``decode_replica`` argument order — what the ``decode_step``
-        budget model traces with ``make_jaxpr(axis_env=...)``."""
+        """Local abstract values of one decode step, in the runtime
+        decode fn's argument order — what the ``decode_step`` budget
+        model traces with ``make_jaxpr(axis_env=...)``.  Under
+        ``kv_dtype="int8"`` the pools are int8 and the f32 scale pools
+        follow them (the ``build_runtime_fns`` wrapper order)."""
         from jax import ShapeDtypeStruct as S
         import jax.numpy as jnp
         params = tuple(
             S(self.program.local_shape(n), jnp.float32)
             for n in self.program.param_names)
+        table = S((slots, self.pages_per_seq), jnp.int32)
+        ints = S((slots,), jnp.int32)
+        if self.kv_quantized:
+            cache = S(self.cache_shape(n_pages), jnp.int8)
+            scales = S(self.scale_shape(n_pages), jnp.float32)
+            return (params, cache, cache, scales, scales,
+                    table, ints, ints)
         cache = S(self.cache_shape(n_pages), jnp.float32)
-        return (params, cache, cache,
-                S((slots, self.pages_per_seq), jnp.int32),
-                S((slots,), jnp.int32), S((slots,), jnp.int32))
+        return (params, cache, cache, table, ints, ints)
 
     def describe(self):
         return {"config": self.cfg.describe(),
                 "plan": self.plan.describe(),
                 "page_size": self.page_size,
                 "pages_per_seq": self.pages_per_seq,
+                "kv_dtype": self.kv_dtype,
                 "bytes_per_page": self.bytes_per_page()}

@@ -90,12 +90,20 @@ def _unflatten(flat, plan):
 
 
 def build_parts(program, apply_update, state_leaf_counts, zero=0,
-                zero_plan=None):
+                zero_plan=None, compute_dtype=None):
     """``(grads_part, update_part)`` over LOCAL shards (the ``shard_map``
     / ``axis_env`` view).  ``state_leaf_counts[i]`` is parameter ``i``'s
     optimizer-state leaf count (flat leaves concatenated across params in
     order); under ``zero=1`` every leaf is instead one flat
-    ``(shard,)``-sized slice of the :class:`TPZeroPlan` space."""
+    ``(shard,)``-sized slice of the :class:`TPZeroPlan` space.
+
+    ``compute_dtype`` (mixed precision, docs/precision.md): the mesh
+    tier keeps its params f32 — they ARE the masters — and casts params
+    + batch to the compute dtype at the loss boundary, so activations
+    run bf16 while gradients come back f32 through the cast transpose
+    and every collective reduces f32 (the tightened DST004 contract).
+    No loss scaling here: bf16 carries f32's 8-bit exponent, so grads
+    cannot flush to zero the way f16's 5-bit exponent loses them."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -104,10 +112,31 @@ def build_parts(program, apply_update, state_leaf_counts, zero=0,
     batch_axes = plan.batch_axes()
     if zero and zero_plan is None:
         raise ValueError("zero=1 needs a TPZeroPlan")
+    reduced = (compute_dtype is not None
+               and jnp.dtype(compute_dtype) != jnp.float32)
+
+    def _to_compute(v):
+        if reduced and hasattr(v, "dtype") \
+                and jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(compute_dtype)
+        return v
 
     def grads_part(train_vals, x, y, key):
-        loss, grads = jax.value_and_grad(program.loss_replica)(
-            tuple(train_vals), x, y, key)
+        if reduced:
+            x_c = _to_compute(x)
+
+            def loss_of(tv):
+                return program.loss_replica(
+                    tuple(_to_compute(w) for w in tv), x_c, y, key)
+
+            loss, grads = jax.value_and_grad(loss_of)(tuple(train_vals))
+            loss = loss.astype(jnp.float32)
+            # f32 already via the cast transpose — spelled out so the
+            # wire contract survives a program whose loss math changes
+            grads = tuple(g.astype(jnp.float32) for g in grads)
+        else:
+            loss, grads = jax.value_and_grad(program.loss_replica)(
+                tuple(train_vals), x, y, key)
         if batch_axes:
             loss = lax.pmean(loss, batch_axes)
         if zero:
@@ -158,14 +187,14 @@ def build_parts(program, apply_update, state_leaf_counts, zero=0,
 
 
 def build_replica_step(program, apply_update, state_leaf_counts, zero=0,
-                       zero_plan=None):
+                       zero_plan=None, compute_dtype=None):
     """Both halves composed into one per-replica function — the analysis
     spelling.  ``step(train_vals, state_leaves, x, y, key, lr, t) ->
     (loss, new_vals, new_state_leaves)``; trace with
     ``jax.make_jaxpr(axis_env=program.plan.axis_env())``."""
     grads_part, update_part = build_parts(
         program, apply_update, state_leaf_counts, zero=zero,
-        zero_plan=zero_plan)
+        zero_plan=zero_plan, compute_dtype=compute_dtype)
 
     def replica_step(train_vals, state_leaves, x, y, key, lr, t):
         grads, loss = grads_part(train_vals, x, y, key)
@@ -177,7 +206,8 @@ def build_replica_step(program, apply_update, state_leaf_counts, zero=0,
 
 
 def build_runtime_fns(program, apply_update, state_leaf_counts, mesh,
-                      state_specs, zero=0, zero_plan=None):
+                      state_specs, zero=0, zero_plan=None,
+                      compute_dtype=None):
     """``(grad_fn, update_fn)`` — the jitted ``shard_map`` programs the
     trainer dispatches each step.  Params ride their
     ``program.partition_spec``; the batch rides ``plan.batch_spec()``;
@@ -193,7 +223,7 @@ def build_runtime_fns(program, apply_update, state_leaf_counts, mesh,
     plan = program.plan
     grads_part, update_part = build_parts(
         program, apply_update, state_leaf_counts, zero=zero,
-        zero_plan=zero_plan)
+        zero_plan=zero_plan, compute_dtype=compute_dtype)
     param_specs = tuple(program.partition_spec(n)
                         for n in program.param_names)
     batch_spec = plan.batch_spec()

@@ -741,22 +741,54 @@ def test_dst002_duplicate_reduction():
     assert rules(findings) == {"DST002"}
 
 
-def test_dst004_widened_collective():
-    def widened(g):
-        return lax.psum(g.astype(jnp.float32), "data")
-
-    closed = _step_jaxpr(widened, jnp.zeros((1024,), jnp.bfloat16))
+def test_dst004_subf32_collective_is_error():
+    """Tightened DST004 (docs/precision.md): reducing bf16 over the
+    data axis is an ERROR — cast-to-f32-then-reduce is the CORRECT
+    mixed-precision spelling and traces clean."""
+    # the broken spelling: bf16 on the wire
+    closed = _step_jaxpr(lambda g: lax.psum(g, "data"),
+                         jnp.zeros((1024,), jnp.bfloat16))
     findings = dist_lint.lint_dist_step(
         closed, "data", varying_invars=[0], param_outvars=[],
         axis_size=8)
     assert rules(findings) == {"DST004"}
-    assert "bfloat16->float32" in findings[0].message
-    # reducing in the native dtype is clean
-    closed2 = _step_jaxpr(lambda g: lax.psum(g, "data"),
+    assert findings[0].severity == "error"
+    assert "bfloat16" in findings[0].message
+
+    # reduce-in-bf16-widen-after is the SAME wire bug
+    closed_rs = _step_jaxpr(
+        lambda g: lax.psum_scatter(g, "data", scatter_dimension=0,
+                                   tiled=True).astype(jnp.float32),
+        jnp.zeros((1024,), jnp.bfloat16))
+    findings_rs = dist_lint.lint_dist_step(
+        closed_rs, "data", varying_invars=[0], param_outvars=[],
+        axis_size=8)
+    assert "DST004" in rules(findings_rs)
+    assert any(f.severity == "error" for f in findings_rs
+               if f.rule_id == "DST004")
+
+    # the correct spelling: widen BEFORE the collective — clean
+    closed2 = _step_jaxpr(lambda g: lax.psum(g.astype(jnp.float32),
+                                             "data"),
                           jnp.zeros((1024,), jnp.bfloat16))
     assert dist_lint.lint_dist_step(
         closed2, "data", varying_invars=[0], param_outvars=[],
         axis_size=8) == []
+
+    # the retained widen flavor: an ALREADY-f32 operand widened to f64
+    # right before the wire stays a warning (x64 scoped: jax silently
+    # maps float64 to float32 otherwise)
+    from jax.experimental import enable_x64
+    with enable_x64():
+        closed3 = _step_jaxpr(lambda g: lax.psum(g.astype(jnp.float64),
+                                                 "data"),
+                              jnp.zeros((1024,), jnp.float32))
+    findings3 = dist_lint.lint_dist_step(
+        closed3, "data", varying_invars=[0], param_outvars=[],
+        axis_size=8)
+    assert rules(findings3) == {"DST004"}
+    assert findings3[0].severity == "warning"
+    assert "float32->float64" in findings3[0].message
 
 
 def test_dst005_baked_step_constant():

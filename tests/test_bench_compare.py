@@ -132,6 +132,54 @@ def test_fusion_keys_gated(tmp_path):
     assert report["regressions"] == ["fusion_numerics_ok"]
 
 
+def test_precision_keys_gated(tmp_path):
+    """The r08 precision-stage keys gate like any other: a slower
+    fused loss-scaled update, a fatter modeled bf16/f32 HBM ratio, a
+    widening bf16 convergence gap, slower int8-KV decode, or a
+    numerics drop all regress — the abs-slack gates bite past their
+    documented slack, the zero-slack one on ANY drop from 1.0."""
+    def rec(n, parsed):
+        return {"n": n, "cmd": "bench", "rc": 0, "tail": "",
+                "parsed": parsed}
+    a = tmp_path / "BENCH_r08.json"
+    b = tmp_path / "BENCH_r09.json"
+    base = {"fused_loss_scaled_speedup_host": 2.5,
+            "bf16_modeled_hbm_ratio": 0.66,
+            "bf16_convergence_delta": 0.006,
+            "int8_kv_decode_tokens_per_sec_host": 2200.0,
+            "precision_numerics_ok": 1.0}
+    a.write_text(json.dumps(rec(8, base)))
+    b.write_text(json.dumps(rec(9, dict(base))))
+    report = bc.compare([str(a), str(b)])
+    assert report["regressions"] == []
+    # fused loss-scaled speedup collapse past 10% regresses
+    b.write_text(json.dumps(rec(
+        9, dict(base, fused_loss_scaled_speedup_host=1.8))))
+    assert bc.compare([str(a), str(b)])["regressions"] == [
+        "fused_loss_scaled_speedup_host"]
+    # modeled HBM ratio creeping up past the 0.02 abs slack regresses
+    # (the f32 masters leaking out of the shard looks exactly like this)
+    b.write_text(json.dumps(rec(
+        9, dict(base, bf16_modeled_hbm_ratio=0.75))))
+    assert bc.compare([str(a), str(b)])["regressions"] == [
+        "bf16_modeled_hbm_ratio"]
+    # a widening bf16-vs-f32 trajectory gap past +0.005 regresses
+    b.write_text(json.dumps(rec(
+        9, dict(base, bf16_convergence_delta=0.05))))
+    assert bc.compare([str(a), str(b)])["regressions"] == [
+        "bf16_convergence_delta"]
+    # int8-KV decode throughput collapse past 10% regresses
+    b.write_text(json.dumps(rec(
+        9, dict(base, int8_kv_decode_tokens_per_sec_host=1500.0))))
+    assert bc.compare([str(a), str(b)])["regressions"] == [
+        "int8_kv_decode_tokens_per_sec_host"]
+    # numerics: zero slack — any drop from 1.0 regresses
+    b.write_text(json.dumps(rec(
+        9, dict(base, precision_numerics_ok=0.0))))
+    assert bc.compare([str(a), str(b)])["regressions"] == [
+        "precision_numerics_ok"]
+
+
 def test_gate_math_directions(tmp_path):
     """lower_abs gates (overhead pcts near zero) use absolute slack;
     higher gates use relative tolerance."""

@@ -420,8 +420,12 @@ def test_fused_budget_model_clean_and_pinned():
     rep, findings, shard = build_model("fused_optimizer_update")
     assert findings == []
     n = fused_update_fusion_numbers()
-    # declared-vs-tape parity is EXACT at the pinned geometry (sgd)
-    assert n["sgd"]["kernel_bytes"] == n["sgd"]["chain_fused_bytes"]
+    # declared-vs-tape parity at the pinned geometry: the kernel reads
+    # 8 bytes the unfused chain never streams — the loss-scale
+    # reciprocal + finite flag in the SMEM scalar block [lr, inv_scale,
+    # ok] (docs/precision.md) — so sgd sits exactly 8 over
+    assert (n["sgd"]["kernel_bytes"]
+            - n["sgd"]["chain_fused_bytes"]) == 8
     assert abs(n["adam"]["kernel_bytes"]
                - n["adam"]["chain_fused_bytes"]) <= 256
     assert n["sgd"]["saved_pct"] > 60 and n["adam"]["saved_pct"] > 70

@@ -747,6 +747,7 @@ ALSO_COVERED = {
     "SparseEmbedding": "test_contrib_proposal.py",
     "_contrib_requantize": "test_linalg_cf_quant.py",
     "_contrib_quantized_fully_connected": "test_linalg_cf_quant.py",
+    "_contrib_quantized_fc_pc": "test_precision.py",
     "_linalg_gemm": "test_linalg_cf_quant.py",
     "_linalg_gelqf": "test_linalg_cf_quant.py",
     "_linalg_syevd": "test_linalg_cf_quant.py",

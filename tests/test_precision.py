@@ -1,0 +1,604 @@
+"""Mixed precision end-to-end (ISSUE 18): bf16 training with f32
+masters inside the ZeRO-1 shard, dynamic loss scaling spelled into the
+fused optimizer kernels, int8 KV-cache serving, and the real PTQ
+pipeline judged by the promotion controller.
+
+Contract points:
+(a) the loss-scale machine: grow after GROWTH_INTERVAL consecutive
+    finite steps (capped), halve on inf/nan (floored), skipped steps
+    are true no-ops with the skipped counter advancing;
+(b) fused-vs-unfused loss-scaled update equivalence at the PR-15
+    tolerance, including the bitwise select-skip;
+(c) bf16 + ZeRO-1 tracks the f32 replicated loss trajectory over >= 20
+    steps while the f32 masters stay PHYSICALLY sharded
+    (addressable_shards-asserted) and the live params are bf16;
+(d) the precision mutation seams fail the unmodified STATIC_BUDGETS
+    gate rc=2 through the real CLI: PRECISION_MASTER_F32 busts the
+    pinned bf16/f32 peak-HBM ratio (COST001), PRECISION_F32_GRAD_REDUCE
+    reduces bf16 on the wire (tightened DST004);
+(e) mixed-precision checkpoints resize: save at k=2, restore at k=4,
+    masters bitwise, params exactly cast(master);
+(f) int8 KV-cache greedy decode agrees with the f32-cache reference at
+    the runner level, with the page bytes actually shrinking;
+(g) the PTQ pipeline: per-channel quantization from a real calibration
+    set holds golden parity, and a deliberately-broken quant (scrambled
+    scales) is auto-rolled-back by the promotion controller with the
+    audit record naming golden_parity;
+(h) tools/capacity.py --tokens --kv-dtype int8 needs fewer replicas
+    than f32 on the pinned scenario.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import precision as prec
+from mxnet_tpu.parallel import DataParallelTrainer, make_mesh
+from mxnet_tpu.resilience import checkpoint as ckpt
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+FEAT = 8
+NCLS = 3
+
+
+def _cpu_env(devices=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if devices:
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=%d" % devices)
+    else:
+        env.pop("XLA_FLAGS", None)
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _trainer(k, zero=1, dtype="bf16", seed=3, hidden=(32,), classes=10,
+             optimizer="sgd"):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    for h in hidden:
+        net.add(gluon.nn.Dense(h, activation="relu"))
+    net.add(gluon.nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    mesh = make_mesh((k,), ("data",), jax.devices()[:k]) if k else None
+    return DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh, zero=zero,
+        dtype=dtype)
+
+
+def _batches(n, batch=24, seed=0, feat=16, classes=10):
+    rng = np.random.RandomState(seed)
+    return [(mx.nd.array(rng.rand(batch, feat).astype(np.float32)),
+             mx.nd.array(rng.randint(0, classes, batch).astype(np.int64)))
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# (a) the loss-scale state machine
+# ---------------------------------------------------------------------------
+def test_loss_scale_grow_after_interval():
+    scale, good = prec.init_loss_scale()
+    assert float(scale) == prec.LOSS_SCALE_INIT
+    for i in range(prec.GROWTH_INTERVAL):
+        scale, good = prec.loss_scale_update(scale, good, True)
+    assert float(scale) == prec.LOSS_SCALE_INIT * prec.GROWTH_FACTOR
+    assert int(good) == 0     # counter resets on growth
+    # growth caps at MAX_SCALE
+    scale = jnp.float32(prec.MAX_SCALE)
+    good = jnp.int32(prec.GROWTH_INTERVAL - 1)
+    scale, good = prec.loss_scale_update(scale, good, True)
+    assert float(scale) == prec.MAX_SCALE
+
+
+def test_loss_scale_backoff_and_floor():
+    scale, good = prec.init_loss_scale()
+    # a run of good steps, then one inf: halve + reset the counter
+    for _ in range(5):
+        scale, good = prec.loss_scale_update(scale, good, True)
+    assert int(good) == 5
+    scale, good = prec.loss_scale_update(scale, good, False)
+    assert float(scale) == prec.LOSS_SCALE_INIT * prec.BACKOFF_FACTOR
+    assert int(good) == 0
+    # backoff floors at MIN_SCALE
+    scale = jnp.float32(prec.MIN_SCALE)
+    scale, good = prec.loss_scale_update(scale, jnp.int32(0), False)
+    assert float(scale) == prec.MIN_SCALE
+
+
+def test_all_finite_probe():
+    ok = prec.all_finite([jnp.ones(4), jnp.zeros(3)])
+    assert bool(ok)
+    bad = prec.all_finite([jnp.ones(4),
+                           jnp.array([1.0, np.inf])])
+    assert not bool(bad)
+    assert bool(prec.all_finite([]))
+
+
+def test_trainer_inf_batch_skips_step_and_books_it():
+    """An inf in the batch poisons the grads: the step is a select-skip
+    (params bitwise-untouched), the scale halves, the skipped counter
+    advances, and training continues on the next finite batch."""
+    tr = _trainer(2, zero=1, dtype="bf16")
+    x, y = _batches(1, seed=5)[0]
+    tr.step(x, y)
+    before = [np.asarray(p.data()._data).copy()
+              for p in tr._params_by_name.values()]
+    master_before = np.asarray(tr._zero_master).copy()
+    scale_before = float(tr._ls_scale)
+
+    xb = np.asarray(x.asnumpy(), np.float32).copy()
+    xb[0, 0] = np.inf
+    tr.step(mx.nd.array(xb), y)
+    after = [np.asarray(p.data()._data)
+             for p in tr._params_by_name.values()]
+    for a, b in zip(before, after):
+        assert a.tobytes() == b.tobytes()
+    assert np.asarray(tr._zero_master).tobytes() \
+        == master_before.tobytes()
+    assert float(tr._ls_scale) == scale_before * prec.BACKOFF_FACTOR
+    assert int(tr._ls_skipped) == 1
+    assert int(tr._ls_good) == 0
+
+    # and the machine keeps training afterwards
+    tr.step(x, y)
+    assert int(tr._ls_skipped) == 1
+    assert int(tr._ls_good) == 1
+
+
+def test_flush_publishes_loss_scale_telemetry():
+    from mxnet_tpu.telemetry.metrics import registry
+    tr = _trainer(2, zero=1, dtype="bf16")
+    x, y = _batches(1, seed=6)[0]
+    tr.step(x, y)
+    tr.flush()
+    text = registry().prometheus_text()
+    assert "mxtpu_loss_scale" in text
+
+
+# ---------------------------------------------------------------------------
+# (b) fused vs unfused loss-scaled update
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("opt_name", ["sgd_momentum", "adam"])
+def test_fused_loss_scaled_update_matches_unfused(opt_name):
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.ops import fused_optimizer as fo
+    from mxnet_tpu.parallel.functional import functional_optimizer_update
+
+    rng = np.random.RandomState(3)
+    n = 4096
+    w = jnp.asarray(rng.randn(n).astype("f"))
+    g = jnp.asarray(rng.randn(n).astype("f"))
+    scale = 1024.0
+    if opt_name == "adam":
+        opt = opt_mod.Adam(learning_rate=0.01, wd=1e-4)
+        state = (jnp.asarray(rng.randn(n).astype("f")),
+                 jnp.asarray(np.abs(rng.randn(n)).astype("f")))
+    else:
+        opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4)
+        state = jnp.asarray(rng.randn(n).astype("f"))
+    lr, t = jnp.float32(0.05), jnp.int32(3)
+    inv = jnp.float32(1.0 / scale)
+
+    fw, fs = fo.fused_optimizer_update(opt, 0, w, g, state, lr, t,
+                                       inv_scale=inv, ok=jnp.float32(1.0),
+                                       interpret=True)
+    uw, us = functional_optimizer_update(opt, 0, w, g * inv, state, lr, t)
+    assert float(jnp.max(jnp.abs(fw - uw))) <= 1e-5
+    for a, b in zip(jax.tree_util.tree_leaves(fs),
+                    jax.tree_util.tree_leaves(us)):
+        assert float(jnp.max(jnp.abs(a - b))) <= 1e-5
+
+
+def test_fused_update_skip_is_bitwise_noop():
+    from mxnet_tpu import optimizer as opt_mod
+    from mxnet_tpu.ops import fused_optimizer as fo
+
+    rng = np.random.RandomState(4)
+    w = jnp.asarray(rng.randn(512).astype("f"))
+    g = jnp.asarray(rng.randn(512).astype("f")).at[7].set(np.nan)
+    m = jnp.asarray(rng.randn(512).astype("f"))
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9)
+    nw, nm = fo.fused_optimizer_update(
+        opt, 0, w, g, m, jnp.float32(0.1), jnp.int32(1),
+        inv_scale=jnp.float32(1.0), ok=jnp.float32(0.0), interpret=True)
+    assert np.asarray(nw).tobytes() == np.asarray(w).tobytes()
+    assert np.asarray(nm).tobytes() == np.asarray(m).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# (c) bf16 + ZeRO-1 convergence with physically sharded f32 masters
+# ---------------------------------------------------------------------------
+def test_bf16_zero1_tracks_f32_replicated_trajectory():
+    """>= 20 steps, same seed/batches: the bf16 ZeRO-1 loss trajectory
+    stays within the documented tolerance of the f32 replicated one
+    (docs/precision.md), and both actually learn."""
+    data = _batches(20, seed=7)
+    tf32 = _trainer(4, zero=0, dtype="float32")
+    l32 = [float(tf32.step(x, y)) for x, y in data]
+    t16 = _trainer(4, zero=1, dtype="bf16")
+    l16 = [float(t16.step(x, y)) for x, y in data]
+    delta = max(abs(a - b) for a, b in zip(l32, l16))
+    assert delta <= 0.05, (delta, l32[-1], l16[-1])
+    assert l16[-1] < l16[0]
+    assert int(t16._ls_skipped) == 0
+
+
+def test_bf16_zero1_masters_physically_sharded():
+    """The f32 masters exist ONLY as the ZeRO-1 shard: k addressable
+    shards of (shard,) each, dtype f32 — while the live params the
+    forward consumes are bf16."""
+    t16 = _trainer(4, zero=1, dtype="bf16")
+    x, y = _batches(1, seed=8)[0]
+    t16.step(x, y)
+    master = t16._zero_master
+    assert master.dtype == jnp.dtype("float32")
+    plan = t16._zero_plan
+    shards = list(master.addressable_shards)
+    assert len(shards) == 4
+    assert {s.data.shape for s in shards} == {(plan.shard,)}
+    assert master.shape == (plan.padded,)
+    for p in t16._params_by_name.values():
+        assert p.data()._data.dtype == jnp.dtype("bfloat16")
+    # param == cast(master): the invariant the checkpoint path keeps
+    full = np.asarray(master)[:plan.total]
+    flat = np.concatenate(
+        [np.asarray(p.data()._data, np.float32).ravel()
+         for p in t16._params_by_name.values()])
+    np.testing.assert_array_equal(
+        flat, np.asarray(jnp.asarray(full).astype(jnp.bfloat16),
+                         np.float32))
+
+
+# ---------------------------------------------------------------------------
+# (d) the mutation seams fail the unmodified gate rc=2 (real CLI)
+# ---------------------------------------------------------------------------
+def _seam_gate(tmp_path, mutation):
+    script = tmp_path / "mutate.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu import precision\n"
+        "%s\n"
+        "from mxnet_tpu.analysis.__main__ import main\n"
+        "sys.exit(main(['--cost', '--budget', %r,\n"
+        "               '--model', 'bf16_zero1_train_step']))\n"
+        % (mutation, os.path.join(_ROOT, "STATIC_BUDGETS.json")))
+    return subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=_ROOT,
+                          env=_cpu_env(), timeout=600)
+
+
+def test_master_f32_seam_fails_gate_rc2(tmp_path):
+    """PRECISION_MASTER_F32=False re-derives the masters from a full
+    per-rank flat f32 vector: the pinned bf16/f32 peak-HBM ratio busts
+    (COST001 naming the row) and the unmodified gate exits 2."""
+    proc = _seam_gate(tmp_path, "precision.PRECISION_MASTER_F32 = False")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "bf16_zero1_train_step.peak_hbm_bytes" in proc.stdout
+    assert "COST001" in proc.stdout
+
+
+def test_f32_grad_reduce_seam_fails_gate_rc2_dst004(tmp_path):
+    """PRECISION_F32_GRAD_REDUCE=False reduces bf16 over the data axis:
+    the tightened DST004 (sub-f32 collective reduce = gate failure)
+    fires through the real CLI."""
+    proc = _seam_gate(tmp_path,
+                      "precision.PRECISION_F32_GRAD_REDUCE = False")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "DST004" in proc.stdout
+
+
+def test_bf16_budget_row_relations():
+    """The clean builder: no findings, and the pinned ratios hold with
+    real margin (the extras the bench stage republishes)."""
+    from mxnet_tpu.analysis import budget_models as bm
+    report, findings, shard = bm.build_model("bf16_zero1_train_step")
+    assert not findings, [str(f) for f in findings]
+    x = shard.extras
+    assert x["bf16_peak_hbm_ratio"] <= bm.BF16_PEAK_HBM_RATIO_CEILING
+    assert x["bf16_collective_ratio"] <= bm.BF16_COLLECTIVE_RATIO_CEILING
+    assert x["bf16_modeled_hbm_drop_pct"] >= 30.0
+
+
+# ---------------------------------------------------------------------------
+# (e) mixed-precision resize-on-resume
+# ---------------------------------------------------------------------------
+def test_bf16_resize_parity_save2_restore4(tmp_path):
+    """Save the bf16/f32-master pair at k=2; restore at k=4: masters
+    bitwise through the reassemble/re-pad path, params exactly
+    cast(master), loss-scale state carried, and training continues
+    deterministically."""
+    d = str(tmp_path / "save2")
+    t2 = _trainer(2, zero=1, dtype="bf16")
+    data = _batches(4, seed=9)
+    for x, y in data[:3]:
+        t2.step(x, y)
+    t2.flush()
+    plan2 = t2._zero_plan
+    ref_master = np.asarray(t2._zero_master)[:plan2.total].copy()
+    ref_params = b"".join(np.asarray(p.data()._data).tobytes()
+                          for p in t2._params_by_name.values())
+    ref_scale = float(t2._ls_scale)
+    t2.save_checkpoint(d, epoch=0, nbatch=2)
+
+    t4 = _trainer(4, zero=1, dtype="bf16", seed=77)  # wrong seed: the
+    cursor = t4.restore_checkpoint(d)                # restore must win
+    assert cursor["step"] == 3
+    plan4 = t4._zero_plan
+    assert np.asarray(t4._zero_master)[:plan4.total].tobytes() \
+        == ref_master.tobytes()
+    got_params = b"".join(np.asarray(p.data()._data).tobytes()
+                          for p in t4._params_by_name.values())
+    assert got_params == ref_params
+    assert float(t4._ls_scale) == ref_scale
+    # params re-derive as the exact bf16 cast of the restored masters
+    flat = np.concatenate(
+        [np.asarray(p.data()._data, np.float32).ravel()
+         for p in t4._params_by_name.values()])
+    np.testing.assert_array_equal(
+        flat, np.asarray(jnp.asarray(ref_master).astype(jnp.bfloat16),
+                         np.float32))
+    # and further training still works at the new size
+    t4.step(*data[3])
+
+
+def test_bf16_checkpoint_refuses_f32_trainer(tmp_path):
+    """A mixed-precision checkpoint (f32 masters) refuses to restore
+    into an f32 trainer — not silently different numerics."""
+    d = str(tmp_path)
+    t2 = _trainer(2, zero=1, dtype="bf16")
+    x, y = _batches(1, seed=10)[0]
+    t2.step(x, y)
+    t2.save_checkpoint(d, epoch=0, nbatch=0)
+    t32 = _trainer(2, zero=1, dtype="float32")
+    with pytest.raises(Exception, match="[Mm]ixed-precision|master"):
+        t32.restore_checkpoint(d)
+
+
+# ---------------------------------------------------------------------------
+# (f) int8 KV-cache at the runner level
+# ---------------------------------------------------------------------------
+def _decode_runner(kv_dtype):
+    from mxnet_tpu.parallel.mesh import MeshPlan
+    from mxnet_tpu.serving.decode import DecodeRunner
+    from mxnet_tpu.transformer import TransformerLMConfig
+    from mxnet_tpu.transformer.decode import DecodeProgram
+
+    cfg = TransformerLMConfig(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=2, d_ff=64, seq_len=64)
+    prog = DecodeProgram(cfg, plan=MeshPlan(data=1), page_size=8,
+                         kv_dtype=kv_dtype)
+    params = prog.program.init_params(0)
+    return DecodeRunner(prog, params, slots=2, prefill_buckets=(8, 16),
+                        warmup=False)
+
+
+def test_int8_kv_decode_matches_f32_reference():
+    """Greedy decode over the int8 KV cache agrees with the f32-cache
+    runner token-for-token on the pinned prompts, and the page bytes
+    actually shrink (codes + per-page scales < f32 rows)."""
+    r8 = _decode_runner("int8")
+    r32 = _decode_runner(None)
+    assert r8.program.bytes_per_page() < r32.program.bytes_per_page()
+    rng = np.random.RandomState(5)
+    agree = total = 0
+    for _ in range(4):
+        p = rng.randint(1, 64, size=rng.randint(3, 12)).astype(np.int32)
+        a = np.asarray(r8.generate(p, 6))
+        b = np.asarray(r32.generate(p, 6))
+        agree += int((a == b).sum())
+        total += len(a)
+    assert agree / total >= 0.9, (agree, total)
+
+
+def test_int8_kv_admission_learns_halved_pages():
+    """SRV004 admission prices the int8 pool at the quantized page
+    bytes: the same geometry admits strictly cheaper."""
+    r8 = _decode_runner("int8")
+    r32 = _decode_runner(None)
+    assert r8.admission_hbm_bytes() < r32.admission_hbm_bytes()
+
+
+# ---------------------------------------------------------------------------
+# (g) the PTQ pipeline + promotion-controller rollback
+# ---------------------------------------------------------------------------
+def _build_net(hidden=16):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(hidden, activation="relu"))
+    net.add(gluon.nn.Dense(NCLS))
+    return net
+
+
+def _train_checkpoint(seed, steps, ckdir, run_id):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = _build_net()
+    net.initialize(mx.init.Xavier())
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05}, run_id=run_id)
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        trainer.step(mx.nd.array(rng.rand(8, FEAT).astype(np.float32)),
+                     mx.nd.array(rng.randint(0, NCLS, 8).astype(np.int64)))
+    trainer.flush()
+    return trainer.save_checkpoint(ckdir, epoch=0, nbatch=steps)
+
+
+_CALIB_RNG = np.random.RandomState(21)
+_CALIB = _CALIB_RNG.rand(64, FEAT).astype(np.float32)
+
+
+def _scramble(model):
+    """Deterministically trash the per-channel scales — the injected
+    quantization regression the controller must roll back."""
+    srng = np.random.RandomState(7)
+    for layer in model.layers:
+        signs = np.where(srng.rand(*layer.scales.shape) < 0.5,
+                         -1.0, 1.0).astype(np.float32)
+        layer.scales = (srng.permutation(layer.scales)
+                        * srng.uniform(4.0, 9.0, layer.scales.shape)
+                        .astype(np.float32) * signs)
+    model._digest = None
+    return model
+
+
+def test_ptq_quantized_net_holds_parity():
+    """The per-channel PTQ twin of a trained net: argmax parity vs the
+    f32 net on fresh data, digest stable across requantization, digest
+    moved by a scale scramble."""
+    from mxnet_tpu.serving.quantize import (build_quantized_net,
+                                            ptq_quantize_net)
+    mx.random.seed(2)
+    np.random.seed(2)
+    net = _build_net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    _ = net(mx.nd.array(_CALIB[:4]))
+    model = ptq_quantize_net(net, _CALIB)
+    model2 = ptq_quantize_net(net, _CALIB)
+    assert model.digest == model2.digest
+    qnet = build_quantized_net(model)
+    rng = np.random.RandomState(33)
+    x = rng.rand(64, FEAT).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    out = qnet(mx.nd.array(x)).asnumpy()
+    assert (out.argmax(1) == ref.argmax(1)).mean() >= 0.9
+    scrambled = _scramble(ptq_quantize_net(net, _CALIB))
+    assert scrambled.digest != model.digest
+
+
+def test_ptq_scrambled_scales_rolled_back_naming_golden_parity(tmp_path):
+    """THE serving acceptance test: a quantized fleet variant whose
+    scales were deliberately scrambled drops golden parity below the
+    threshold; the PR-12 promotion controller auto-rolls it back with
+    the audit record naming golden_parity, and the incumbent keeps
+    serving its original bytes."""
+    from mxnet_tpu.mlops import PromotionController, read_audit_records
+    from mxnet_tpu.serving import ModelFleet, ModelRunner, RequestShed
+    from mxnet_tpu.serving.quantize import (build_quantized_net,
+                                            quantized_runner_from_checkpoint)
+
+    ck_inc = str(tmp_path / "inc")
+    watch = str(tmp_path / "watch")
+    audit = str(tmp_path / "audit")
+    path = _train_checkpoint(0, 3, ck_inc, "ptq-inc")
+
+    def factory(path_, rec):
+        runner, prov, model = quantized_runner_from_checkpoint(
+            rec, _build_net, example_shape=(FEAT,), calib=_CALIB,
+            buckets=(1, 4))
+        _scramble(model)
+        qnet = build_quantized_net(model)
+        prov = dict(prov, quant_digest=model.digest)
+        return ModelRunner(qnet, buckets=(1, 4), example_shape=(FEAT,),
+                           provenance=prov), prov
+
+    inc_runner, inc_prov, _ = quantized_runner_from_checkpoint(
+        ckpt.load_checkpoint(path), _build_net, example_shape=(FEAT,),
+        calib=_CALIB, buckets=(1, 4))
+    fleet = ModelFleet(batch_timeout_ms=0.5)
+    fleet.register("model", inc_runner, tier_slos={"gold": 10000.0},
+                   service_time_hint_ms=5.0)
+    rng = np.random.RandomState(9)
+    golden = rng.rand(16, FEAT).astype(np.float32)
+    ctrl = PromotionController(
+        fleet, "model", watch, factory, golden=golden, audit_dir=audit,
+        schedule=(0.01, 0.05, 0.25), min_stage_requests=8,
+        parity_threshold=0.8,
+        register_kwargs={"service_time_hint_ms": 5.0})
+    _train_checkpoint(0, 5, watch, "ptq-cand")
+    X = rng.rand(64, FEAT).astype(np.float32)
+    rid = [0]
+
+    def pump(t):
+        for _ in range(96):
+            i = rid[0]
+            rid[0] += 1
+            try:
+                fleet.infer(X[i % len(X)], model="model",
+                            tier=("gold", "silver", "bronze")[i % 3],
+                            request_id=i, timeout=60)
+            except RequestShed:
+                continue
+
+    rec = ctrl.run(pump=pump)
+    fleet.drain()
+    assert rec is not None
+    assert rec["decision"]["decision"] == "rollback"
+    assert rec["decision"]["failed_metric"] == "golden_parity"
+    assert rec["evidence"]["golden_parity"] < 0.8
+    # the audit trail persisted the same story
+    records = read_audit_records(audit)
+    assert any(r["decision"].get("failed_metric") == "golden_parity"
+               for r in records)
+    # the incumbent still serves, with its quant digest intact
+    stats = fleet.stats_dict()
+    assert sorted(stats["models"]) == ["model"]
+    assert stats["models"]["model"]["provenance"]["quant_digest"] \
+        == inc_prov["quant_digest"]
+
+
+def test_ptq_good_quant_passes_golden_parity():
+    """The UNscrambled quantized runner is a promotable variant: golden
+    parity against the f32 incumbent sits at/above the threshold."""
+    from mxnet_tpu.mlops.promote import (golden_parity,
+                                         runner_from_trainer_checkpoint)
+    from mxnet_tpu.serving.quantize import quantized_runner_from_checkpoint
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        path = _train_checkpoint(1, 3, d, "ptq-good")
+        rec = ckpt.load_checkpoint(path)
+        f32_runner, _ = runner_from_trainer_checkpoint(
+            rec, _build_net, example_shape=(FEAT,), buckets=(1, 4))
+        q_runner, prov, model = quantized_runner_from_checkpoint(
+            rec, _build_net, example_shape=(FEAT,), calib=_CALIB,
+            buckets=(1, 4))
+        rng = np.random.RandomState(13)
+        golden = rng.rand(32, FEAT).astype(np.float32)
+        assert golden_parity(f32_runner, q_runner, golden) >= 0.8
+        assert prov["quant_digest"] == model.digest
+
+
+# ---------------------------------------------------------------------------
+# (h) capacity: int8 KV needs fewer replicas on the pinned scenario
+# ---------------------------------------------------------------------------
+def _capacity(kv_dtype):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "capacity.py"),
+         "--tokens", "--dau", "6500000", "--slo-ms", "300",
+         "--overhead-ms", "0", "--prefill-ms", "0",
+         "--max-new-tokens", "512", "--window-s", "2",
+         "--kv-dtype", kv_dtype, "--json"],
+        capture_output=True, text=True, cwd=_ROOT, env=_cpu_env(),
+        timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
+    return json.loads(out.stdout)
+
+
+def test_capacity_int8_kv_needs_fewer_replicas():
+    """The pinned replica-drop scenario: same traffic, same SLO — the
+    int8 KV pool halves the modeled per-token step time (the decode
+    roofline is KV-pool-bound at this geometry) and the fleet answer
+    drops a replica.  Deterministic on any host: the token_ms derives
+    from the gated decode_step budget row."""
+    f32 = _capacity("f32")
+    i8 = _capacity("int8")
+    assert f32["replicas"] == 2
+    assert i8["replicas"] == 1
+    assert i8["token_ms"] < f32["token_ms"] * 0.6
+    assert i8["kv_dtype"] == "int8"

@@ -73,6 +73,18 @@ Gated metrics (direction, tolerance)::
     decode_recompiles                  lower, zero slack (steady-state
                                        decode traffic must never grow
                                        the jit cache)
+    fused_loss_scaled_speedup_host     higher, 10% relative (measured
+                                       unscale+clip+update chain vs the
+                                       one-pass fused kernel)
+    bf16_modeled_hbm_ratio             lower, +0.02 abs slack (modeled
+                                       bf16/f32 peak-HBM ratio from the
+                                       budget builder)
+    bf16_convergence_delta             lower, +0.005 abs slack (bf16 vs
+                                       f32 loss-trajectory gap)
+    int8_kv_decode_tokens_per_sec_host higher, 10% relative (greedy
+                                       decode over the int8 KV cache)
+    precision_numerics_ok              higher, zero slack (fused/skip/
+                                       int8-token contracts)
     decode_pages_leaked                lower, zero slack (every retired
                                        sequence returns its KV pages)
 
@@ -166,6 +178,18 @@ GATES = {
     "decode_numerics_ok": ("higher", 0.0),
     "decode_recompiles": ("lower_abs", 0.0),
     "decode_pages_leaked": ("lower_abs", 0.0),
+    # precision stage (r08 onward): the fused loss-scaled update
+    # speedup and int8-KV decode throughput are wall time on the noisy
+    # 1-core host (10% rel); the modeled bf16/f32 peak-HBM ratio is
+    # deterministic (absolute slack covers intentional geometry retunes
+    # shipped with their PR); the bf16-vs-f32 convergence delta and the
+    # fused/skip/int8-token numerics contract are hard — a growing
+    # trajectory gap or any drop from 1.0 is a precision regression
+    "fused_loss_scaled_speedup_host": ("higher", 0.10),
+    "bf16_modeled_hbm_ratio": ("lower_abs", 0.02),
+    "bf16_convergence_delta": ("lower_abs", 0.005),
+    "int8_kv_decode_tokens_per_sec_host": ("higher", 0.10),
+    "precision_numerics_ok": ("higher", 0.0),
 }
 
 _RECORD_KEYS = ("n", "cmd", "rc", "parsed")

@@ -106,25 +106,57 @@ def parse_args(argv=None):
     p.add_argument("--slots", type=int, default=4,
                    help="decode slot width per replica — the coalescing "
                         "bound under --tokens")
+    p.add_argument("--kv-dtype", default="f32", choices=("f32", "int8"),
+                   help="KV-cache dtype the decode tier deploys "
+                        "(--tokens): int8 swaps the modeled f32 pool "
+                        "bytes for quantized codes + per-page scales "
+                        "in the per-token roofline, so the memory-"
+                        "bound answer needs fewer replicas")
+    p.add_argument("--overhead-ms", type=float, default=None,
+                   help="pinned per-step dispatch overhead for the "
+                        "derived token_ms (default: the simulator's "
+                        "capacity-chip constant)")
     p.add_argument("--json", action="store_true", dest="as_json")
     return p.parse_args(argv)
+
+
+def _kv_pool_bytes(kv_dtype):
+    """The decode_step geometry's KV pool size under ``kv_dtype`` —
+    from the same pinned ``DECODE_GEOMETRY`` the budget row traces, so
+    the swap stays deterministic and moves only with the geometry."""
+    from mxnet_tpu.analysis.budget_models import (DECODE_GEOMETRY,
+                                                  _decode_program)
+    prog = _decode_program(DECODE_GEOMETRY["model"])
+    if kv_dtype != "f32":
+        from mxnet_tpu.transformer.decode import DecodeProgram
+        prog = DecodeProgram(prog.cfg, plan=prog.plan,
+                             page_size=prog.page_size, kv_dtype=kv_dtype)
+    n_pages = 1 + DECODE_GEOMETRY["slots"] * prog.pages_per_seq
+    return n_pages * prog.bytes_per_page()
 
 
 def resolve_token_ms(args):
     """The pinned per-token step time: ``--token-ms`` verbatim, else
     derived from the gated ``decode_step`` budget row so the capacity
     answer is byte-identical on any host and moves only when the budget
-    moves."""
+    moves.  ``--kv-dtype int8`` swaps the modeled f32 KV pool for the
+    quantized one (codes + per-page scales) before the roofline."""
     if args.token_ms is not None:
         return float(args.token_ms)
     from mxnet_tpu.mlops.simulator import token_ms_from_decode_step
     with open(os.path.join(_ROOT, "STATIC_BUDGETS.json")) as f:
         row = json.load(f)["models"]["decode_step"]
+    kw = {}
+    if args.overhead_ms is not None:
+        kw["overhead_ms"] = float(args.overhead_ms)
+    if getattr(args, "kv_dtype", "f32") != "f32":
+        kw["kv_pool_bytes_f32"] = _kv_pool_bytes("f32")
+        kw["kv_pool_bytes"] = _kv_pool_bytes(args.kv_dtype)
     # decode is memory-bound: the step streams its resident working set
     # (the budget row's peak HBM) roughly once per token
     return token_ms_from_decode_step(
         {"flops": row["flops"], "bytes_read": row["peak_hbm_bytes"],
-         "bytes_written": 0})
+         "bytes_written": 0}, **kw)
 
 
 def answer(args):
@@ -182,6 +214,7 @@ def main(argv=None):
             out["token_ms"] = resolve_token_ms(args)
             out["max_new_tokens"] = args.max_new_tokens
             out["slots"] = args.slots
+            out["kv_dtype"] = args.kv_dtype
         print(json.dumps(out, indent=1, sort_keys=True, default=str))
     else:
         mean_rps = args.dau * args.requests_per_user_per_day / 86400.0
@@ -190,9 +223,9 @@ def main(argv=None):
         if args.tokens:
             token_ms = resolve_token_ms(args)
             print("decode tier: %.3fms/token x %d tokens + %.1fms "
-                  "prefill per request, %d slots/replica"
+                  "prefill per request, %d slots/replica, %s KV cache"
                   % (token_ms, args.max_new_tokens, args.prefill_ms,
-                     args.slots))
+                     args.slots, args.kv_dtype))
         print("replicas needed for %s p99 <= %.0fms: %d"
               % (args.slo_tier, args.slo_ms, replicas))
         print(report.render())

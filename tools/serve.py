@@ -56,6 +56,20 @@ def parse_args(argv=None):
     p.add_argument("--demo", action="store_true",
                    help="serve a randomly initialized demo MLP instead of "
                         "a checkpoint")
+    p.add_argument("--calib", default=None, metavar="PATH",
+                   help="calibration set for :int8 models — a .npy "
+                        "array of real example rows; routes "
+                        "quantization through the PTQ pipeline "
+                        "(serving.quantize) with the scales digest in "
+                        "provenance.  Without it :int8 falls back to "
+                        "the legacy synthetic-data naive path "
+                        "(deprecated).")
+    p.add_argument("--decode-kv-dtype", default=None,
+                   choices=("f32", "int8"),
+                   help="KV-cache dtype for --decode models (default: "
+                        "the checkpoint's kv_dtype, else f32); int8 "
+                        "stores quantized codes + per-page scales and "
+                        "halves-plus the admission page bytes")
     p.add_argument("--model", action="append", default=[],
                    metavar="NAME=PREFIX[@EPOCH][:int8]",
                    help="register a fleet model from a checkpoint; the "
@@ -149,6 +163,22 @@ def parse_model_spec(spec):
     return name, prefix, epoch, int8
 
 
+def _load_calib(path):
+    """``--calib`` loader: a ``.npy`` array (or first array of a
+    ``.npz``) of real example rows for PTQ activation calibration."""
+    if path is None:
+        return None
+    import numpy as np
+    data = np.load(path)
+    if hasattr(data, "files"):    # npz: take the first array
+        data = data[data.files[0]]
+    arr = np.asarray(data, np.float32)
+    if arr.ndim < 2 or arr.shape[0] < 1:
+        raise SystemExit("--calib %r must hold a (n, ...) example array "
+                         "with n >= 1, got shape %r" % (path, arr.shape))
+    return arr
+
+
 def parse_decode_spec(spec):
     """``NAME=DIR[@STEP]`` -> (name, directory, step or None)."""
     name, sep, rest = str(spec).partition("=")
@@ -166,7 +196,8 @@ def parse_decode_spec(spec):
     return name, directory, step
 
 
-def _load_decode_runner(directory, step, slots, warmup=True):
+def _load_decode_runner(directory, step, slots, warmup=True,
+                        kv_dtype=None):
     """Build a :class:`DecodeRunner` from a resilience checkpoint whose
     payload carries ``{"kind": "transformer_lm_decode", "config":
     cfg.describe(), "params": {name: array}, "page_size": N}`` — the
@@ -197,33 +228,56 @@ def _load_decode_runner(directory, step, slots, warmup=True):
                                payload.get("kind")
                                if isinstance(payload, dict) else None))
     cfg = TransformerLMConfig(**payload["config"])
-    prog = DecodeProgram(cfg, page_size=int(payload.get("page_size", 8)))
+    prog = DecodeProgram(cfg, page_size=int(payload.get("page_size", 8)),
+                         kv_dtype=kv_dtype or payload.get("kv_dtype"))
     return DecodeRunner(prog, payload["params"], slots=slots,
                         warmup=warmup, provenance=provenance(rec))
 
 
 def _load_module(prefix, epoch, data_name, example_shape, buckets,
-                 int8=False):
-    """Load a Module checkpoint bound for bucketed inference; with
-    ``int8``, quantize it first (weights int8, activations calibrated
-    naively over synthetic data — scales only shift accuracy, never the
-    compiled program, so the degraded-mode variant is always buildable
-    without the training data on the serving host)."""
+                 int8=False, calib=None):
+    """Load a Module checkpoint bound for bucketed inference.  With
+    ``int8`` + ``calib`` (a real example array from ``--calib``), the
+    quantization routes through the PTQ pipeline — activation ranges
+    measured over the real set, the scales digest returned for
+    provenance.  ``int8`` WITHOUT a calibration set keeps the legacy
+    naive-over-synthetic numerics but is deprecated: synthetic ranges
+    bound nothing about production activations.  Returns
+    ``(module, quant_report_or_None)``."""
     import numpy as np
 
     import mxnet_tpu as mx
 
     sym, arg, aux = mx.model.load_checkpoint(prefix, epoch)
     max_b = max(buckets)
+    report = None
     if int8:
-        calib_batch = min(max_b, 32)
-        rng = np.random.RandomState(0)
-        calib_it = mx.io.NDArrayIter(
-            rng.rand(calib_batch, *example_shape).astype(np.float32),
-            np.zeros(calib_batch, np.float32), calib_batch)
-        sym, arg, aux = mx.contrib.quantization.quantize_model(
-            sym, arg, aux, data_names=(data_name,), calib_data=calib_it,
-            num_calib_examples=calib_batch, calib_mode="naive")
+        from mxnet_tpu.serving.quantize import ptq_quantize_module
+        if calib is not None:
+            calib = np.asarray(calib, np.float32)
+            n = (len(calib) // max_b) * max_b or len(calib)
+            calib_it = mx.io.NDArrayIter(
+                calib[:n], np.zeros(len(calib[:n]), np.float32),
+                min(max_b, n))
+            sym, arg, aux, report = ptq_quantize_module(
+                sym, arg, aux, calib_it, data_names=(data_name,),
+                num_calib_examples=n)
+        else:
+            import warnings
+            warnings.warn(
+                ":int8 without --calib quantizes against SYNTHETIC "
+                "activation ranges — pass --calib with real example "
+                "rows to route through the PTQ pipeline",
+                DeprecationWarning, stacklevel=2)
+            calib_batch = min(max_b, 32)
+            rng = np.random.RandomState(0)
+            calib_it = mx.io.NDArrayIter(
+                rng.rand(calib_batch, *example_shape).astype(np.float32),
+                np.zeros(calib_batch, np.float32), calib_batch)
+            sym, arg, aux = mx.contrib.quantization.quantize_model(
+                sym, arg, aux, data_names=(data_name,),
+                calib_data=calib_it, num_calib_examples=calib_batch,
+                calib_mode="naive")
     # label slots (…_label by convention) are bound with a batch-matched
     # dummy feed; everything else non-data is a parameter
     label_names = [n for n in sym.list_arguments() if n.endswith("_label")]
@@ -234,7 +288,7 @@ def _load_module(prefix, epoch, data_name, example_shape, buckets,
         label_shapes=[(n, (max_b,)) for n in label_names] or None,
         for_training=False)
     mod.set_params(arg, aux)
-    return mod
+    return mod, report
 
 
 def build_module_runner(args):
@@ -244,8 +298,8 @@ def build_module_runner(args):
         raise SystemExit("--data-shape is required with --prefix")
     example_shape = _shape(args.data_shape)
     buckets = _shape(args.buckets)
-    mod = _load_module(args.prefix, args.epoch, args.data_name,
-                       example_shape, buckets)
+    mod, _ = _load_module(args.prefix, args.epoch, args.data_name,
+                          example_shape, buckets)
     return ModelRunner(mod, buckets=buckets, dtype=args.dtype,
                        warmup=not args.no_warmup)
 
@@ -289,12 +343,17 @@ def build_fleet(args):
                        batch_timeout_ms=args.batch_timeout_ms,
                        max_queue=args.max_queue)
     names = []
+    calib = _load_calib(args.calib)
     for spec in args.model:
         name, prefix, epoch, int8 = parse_model_spec(spec)
-        mod = _load_module(prefix, epoch, args.data_name, example_shape,
-                           buckets, int8=int8)
-        runner = ModelRunner(mod, buckets=buckets, dtype=args.dtype,
-                             warmup=not args.no_warmup)
+        mod, report = _load_module(prefix, epoch, args.data_name,
+                                   example_shape, buckets, int8=int8,
+                                   calib=calib)
+        runner = ModelRunner(
+            mod, buckets=buckets, dtype=args.dtype,
+            warmup=not args.no_warmup,
+            provenance={"quant_digest": report["digest"],
+                        "quant": report["kind"]} if report else None)
         fleet.register(name, runner, fallback=fallbacks.get(name),
                        max_batch=args.max_batch)
         names.append(name)
@@ -311,10 +370,14 @@ def build_fleet(args):
         if name not in names:
             raise SystemExit("--canary names unregistered model %r "
                              "(give --model %s=... too)" % (name, name))
-        mod = _load_module(prefix, epoch, args.data_name, example_shape,
-                           buckets, int8=int8)
-        runner = ModelRunner(mod, buckets=buckets, dtype=args.dtype,
-                             warmup=not args.no_warmup)
+        mod, report = _load_module(prefix, epoch, args.data_name,
+                                   example_shape, buckets, int8=int8,
+                                   calib=calib)
+        runner = ModelRunner(
+            mod, buckets=buckets, dtype=args.dtype,
+            warmup=not args.no_warmup,
+            provenance={"quant_digest": report["digest"],
+                        "quant": report["kind"]} if report else None)
         canary_name = name + "__canary"
         fleet.register(canary_name, runner, max_batch=args.max_batch)
         fleet.set_canary(name, canary_name,
@@ -330,7 +393,8 @@ def build_fleet(args):
             raise SystemExit("--decode name %r collides with a --model "
                              "registration" % name)
         runner = _load_decode_runner(directory, step, args.decode_slots,
-                                     warmup=not args.no_warmup)
+                                     warmup=not args.no_warmup,
+                                     kv_dtype=args.decode_kv_dtype)
         fleet.register_decode(name, runner, max_queue=args.max_queue)
         names.append(name)
     return fleet
