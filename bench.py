@@ -401,6 +401,18 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_FUSION", "1") == "1":
         rec.stage("fusion", 150, _fusion_bench)
 
+    # -- codegen-tier micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): codegen_generated_speedup_host
+    # (measured op-at-a-time unfused chain vs the mxgen generated
+    # Pallas kernel, summed over the shipped chains),
+    # codegen_modeled_bytes_saved_pct (the lowering's deterministic
+    # byte win — the codegen_chains budget rows) and
+    # codegen_numerics_ok (generated == tape reference through the real
+    # pallas path, bitwise rerun) stay live when the TPU is down —
+    # docs/fusion.md "Generated kernels"
+    if os.environ.get("MXTPU_BENCH_CODEGEN", "1") == "1":
+        rec.stage("codegen", 150, _codegen_bench)
+
     # -- decode-tier micro-bench, host-only and BEFORE backend
     # acquisition (r05 pattern): decode_tokens_per_sec_host (continuous
     # batching through the DecodeRunner→DecodeBatcher path under a
@@ -800,6 +812,29 @@ def _fusion_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("fusion bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _codegen_bench():
+    """codegen_generated_speedup_host + codegen_modeled_bytes_saved_pct
+    + codegen_numerics_ok through the codegen-tier harness
+    (mxnet_tpu/codegen_bench.py): the measured unfused-chain vs
+    generated-kernel wall time on the host, the mxgen lowering's
+    deterministic bytes-saved, and the generated-vs-reference numerics
+    contract.  JAX_PLATFORMS=cpu subprocess — same isolation contract
+    as the other host stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual test mesh in the child
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.codegen_bench"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("codegen bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

@@ -43,6 +43,12 @@ from .fusion import (FusionReport, FusionChain, analyze_tape_fusion,
                      fusion_from_jaxpr, fusion_from_fn,
                      fusion_for_symbol, lint_kernel_costs,
                      FUSION_HINT_MIN_PCT)
+from .codegen import (LoweredKernel, lower_chain, LOWERABLE,
+                      lint_generated_kernels, codegen_plans,
+                      render_codegen, equivalence_check_host,
+                      shipped_lowered, shipped_chain_rows,
+                      autotune_block_rows, AUTOTUNE_LADDER,
+                      AUTOTUNE_SEED)
 from .dist_lint import lint_dist_step, lint_trainer, dist_summary
 from .race_lint import (lint_race_source, lint_race_file,
                         lint_threaded_sources, lock_order_findings,
@@ -81,6 +87,10 @@ __all__ = [
     "fusion_from_jaxpr", "fusion_from_fn", "fusion_for_symbol",
     "lint_kernel_costs", "FUSION_HINT_MIN_PCT", "KERNEL_COSTS",
     "declare_kernel_cost",
+    "LoweredKernel", "lower_chain", "LOWERABLE",
+    "lint_generated_kernels", "codegen_plans", "render_codegen",
+    "equivalence_check_host", "shipped_lowered", "shipped_chain_rows",
+    "autotune_block_rows", "AUTOTUNE_LADDER", "AUTOTUNE_SEED",
     "lint_race_source", "lint_race_file", "lint_threaded_sources",
     "lock_order_findings", "parse_hierarchy", "race_summary",
     "threaded_targets",
@@ -97,7 +107,7 @@ def lint_symbol(symbol, shapes=None, type_dict=None, disable=(),
 def self_check(disable=(), with_coverage=True, with_cost=True,
                with_examples=True, with_workers=True, with_serving=True,
                with_telemetry=True, with_shard=True, with_mlops=True,
-               with_race=True):
+               with_race=True, with_codegen=True):
     """Registry lint over the live registry, the rule-table docs sync
     check, the cost-pass determinism check, the SRC004 sweep over the
     shipped training loops, the SRC005 sweep over the shipped worker
@@ -110,7 +120,11 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
     (``shard_self_check``) and the shipped ring/Ulysses attention paths
     must pass the mixed-axis DST rules (``lint_parallel_sources``) —
     and the declared-cost sweep over the shipped Pallas kernels
-    (``lint_kernel_costs``, COST005) — plus the mxrace concurrency
+    (``lint_kernel_costs``, COST005/COST006) and the mxgen sweep over
+    the generated kernels (``lint_generated_kernels``, GEN001/GEN002:
+    every shipped chain lowers provably and every registered generated
+    kernel passed its auto-equivalence check) — plus the mxrace
+    concurrency
     sweep over every threaded host module (``lint_threaded_sources``:
     RACE001-RACE005, the lock-order/hierarchy sync against
     ``docs/concurrency.md``, and race-report determinism) — what CI
@@ -142,9 +156,16 @@ def self_check(disable=(), with_coverage=True, with_cost=True,
         findings += lint_parallel_sources(disable=disable)
     if with_race:
         findings += lint_threaded_sources(disable=disable)
+    if with_codegen:
+        # the mxgen sweep (GEN001/GEN002): every shipped chain lowers
+        # inside the provable set and every registered generated kernel
+        # carries a passing auto-equivalence check
+        findings += lint_generated_kernels(disable=disable)
     if with_cost:
-        # the declared-cost sweep (COST005): every shipped pallas_call
-        # must price itself — an un-annotated kernel fails CI here
+        # the declared-cost sweep (COST005 + the COST006 registry diff
+        # for exec'd mxgen kernels): every shipped pallas_call must
+        # price itself — an un-annotated kernel fails CI here.  Runs
+        # AFTER the codegen sweep so the generated registry is built
         findings += lint_kernel_costs(disable=disable)
     return findings
 

@@ -37,6 +37,10 @@ Options:
                           modeled peak HBM
   ``--model M1,M2``       with --cost: budget models to analyze
                           (default: every non-heavy registered model)
+  ``--codegen``           with --cost: print the mxgen lowered plan per
+                          shipped fusion chain (generated kernel name,
+                          byte contract, emitted Pallas body); adds the
+                          ``codegen`` section to ``--json`` (schema 6)
   ``--budget FILE``       with --cost: gate modeled metrics against the
                           checked-in budgets (exit 2 on COST001/DST001)
 """
@@ -118,6 +122,13 @@ def main(argv=None):
                         "bytes-saved-if-fused over the budget models' "
                         "unfused spellings (docs/fusion.md); adds the "
                         "'fusion' section to --json (schema_version 4)")
+    p.add_argument("--codegen", action="store_true",
+                   help="with --cost: print the mxgen lowered plan per "
+                        "shipped fusion chain — generated kernel name, "
+                        "provable-lowering status, byte contract and the "
+                        "emitted Pallas body (docs/fusion.md \"Generated "
+                        "kernels\"); adds the 'codegen' section to "
+                        "--json (schema_version 6)")
     p.add_argument("--race", action="store_true",
                    help="mxrace concurrency lint: of a .py target, or "
                         "(bare) the whole-repo sweep over the threaded "
@@ -256,6 +267,10 @@ def _run_cost(args, disable):
             frep = build_fusion_report(name)
             if frep is not None:
                 fusion[name] = frep
+    codegen = None
+    if args.codegen:
+        from .codegen import codegen_plans
+        codegen = codegen_plans()
     axis_sizes = {}
     for rep in cost.values():
         axis_sizes.update(rep.axis_sizes)
@@ -265,7 +280,8 @@ def _run_cost(args, disable):
             dist=dist_summary(findings, axis_sizes=axis_sizes),
             shard=shard_summary(shards, findings)
             if (args.shard and shards) else None,
-            fusion=fusion if (args.fusion and fusion) else None))
+            fusion=fusion if (args.fusion and fusion) else None,
+            codegen=codegen))
     else:
         print(render_text(findings, title=title))
         for name, rep in sorted(cost.items()):
@@ -276,6 +292,9 @@ def _run_cost(args, disable):
         if args.fusion:
             for name, rep in sorted(fusion.items()):
                 print(rep.render(title="mxfuse %s" % name))
+        if codegen is not None:
+            from .codegen import render_codegen
+            print(render_codegen(codegen))
     return exit_code(findings, strict=args.strict)
 
 

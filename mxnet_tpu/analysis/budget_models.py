@@ -1164,6 +1164,93 @@ def decode_runtime_checks(max_new=6, tolerance=5e-4):
             runner.pool.free(pages)
 
 
+def codegen_generated_kernels():
+    """The mxgen generated kernels (docs/fusion.md "Generated kernels")
+    as a static proof: build the shipped top-N chains of the transformer
+    train-step and ZeRO-1 tapes into registered Pallas kernels, then
+    gate three invariants through FUS001 — (a) every registered kernel's
+    emitted body must reproduce its tape reference bit-for-exact on the
+    host path (flipping the ``MXGEN_LOWER_EXACT`` seam mislowers one
+    eqn and fails the gate rc=2 naming FUS001), (b) every kernel must
+    keep its auto-declared ``KERNEL_COSTS`` entry and the declared
+    bytes must equal the chain's modeled per-call fused bytes (parity
+    is an identity at registration — a drift means the registration
+    path changed), and (c) the traced all-kernels program must price
+    every pallas_call (no unpriced generated kernel).  Unlowerable
+    shipped chains surface their GEN001s here too, so the budget gate
+    and ``--self-check`` agree.  The budget row pins the metrics of one
+    pass over every generated kernel (``generated_call`` per kernel,
+    whole-array refs)."""
+    import jax
+
+    from ..ops import generated_kernels as gen
+    from . import codegen as cg
+    from .cost import KERNEL_COSTS, analyze_jaxpr, unpriced_findings
+    from .findings import Finding
+
+    findings = []
+    kernels = gen.build_shipped_generated()
+    lowered = {lk.name: lk for lk in cg.shipped_lowered()}
+    for lk in lowered.values():
+        findings += list(lk.findings)       # GEN001: unlowerable chains
+
+    for gk in kernels:
+        subject = "codegen_generated_kernels.%s" % gk.name
+        if not gk.equivalence_ok:
+            findings.append(Finding(
+                "FUS001", subject,
+                "generated kernel diverges from its tape reference "
+                "(max err %s, tolerance %.0e): the emitted body "
+                "mislowers at least one eqn (the MXGEN_LOWER_EXACT "
+                "seam, or a broken _emit_rhs rule) — the auto-declared "
+                "cost prices a kernel that does not compute the chain"
+                % (gk.equivalence_err, cg.EQUIV_TOL)))
+        cost_fn = KERNEL_COSTS.get(gk.name)
+        if cost_fn is None:
+            findings.append(Finding(
+                "FUS001", subject,
+                "generated kernel lost its auto-declared KERNEL_COSTS "
+                "entry — it would trace as an unpriced pallas_call and "
+                "cost zero on every tape (COST006 names the registry "
+                "side; this is the gate side)"))
+            continue
+        c = cost_fn(None)
+        declared = int(c["bytes_read"]) + int(c["bytes_written"])
+        lk = lowered.get(gk.name)
+        per_call = (int(lk.fused_bytes) // max(int(lk.scale), 1)
+                    if lk is not None else declared)
+        if declared != per_call:
+            findings.append(Finding(
+                "FUS001", subject,
+                "declared-vs-tape byte parity broken: the auto-declared "
+                "cost moves %d HBM bytes but one fused pass over the "
+                "chain's external buffers moves %d — parity is an "
+                "identity by construction (register_generated copies "
+                "the chain's split verbatim); the registration path "
+                "changed" % (declared, per_call)))
+
+    # the pinned row: one generated_call per registered kernel, traced
+    # hardware-free — every pallas_call prices through its auto-declared
+    # cost entry, so the row IS the sum of the declared contracts
+    sizes = [len(gk.in_avals) for gk in kernels]
+    specs = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+             for gk in kernels for a in gk.in_avals]
+
+    def _all_generated(*flat):
+        outs, i = [], 0
+        for gk, n in zip(kernels, sizes):
+            outs += gen.generated_call(gk, *flat[i:i + n],
+                                       interpret=True)
+            i += n
+        return tuple(outs)
+
+    closed = jax.make_jaxpr(_all_generated)(*specs)
+    report = analyze_jaxpr(closed)
+    findings += unpriced_findings(report,
+                                  subject="codegen_generated_kernels")
+    return report, findings
+
+
 BUDGET_MODELS = {
     "mlp_train_step": mlp_train_step,
     "mlp_infer": mlp_infer,
@@ -1176,6 +1263,7 @@ BUDGET_MODELS = {
     "tp_transformer_train_step": tp_transformer_train_step,
     "fused_optimizer_update": fused_optimizer_update,
     "decode_step": decode_step,
+    "codegen_generated_kernels": codegen_generated_kernels,
 }
 
 
@@ -1206,6 +1294,13 @@ def build_fusion_report(name):
         step, args = sf.zero1_step_program(k)
         closed = jax.make_jaxpr(step, axis_env=[("data", k)])(*args)
         return fusion_from_jaxpr(closed, axis_sizes={"data": k})
+    if name == "tp_transformer_train_step":
+        # the same trace spelling mxgen lowers (codegen.shipped_tape) —
+        # what --fusion ranks here is exactly what the generated
+        # kernels replace
+        from .codegen import shipped_tape
+        from .fusion import analyze_tape_fusion
+        return analyze_tape_fusion(shipped_tape("tp_transformer"))
     return None
 
 
@@ -1305,4 +1400,52 @@ def check_budgets(budget_path, tolerance_pct=None):
             "COST002", name,
             "budget model %r has no STATIC_BUDGETS.json row — it is "
             "not gated; add it via tools/update_budgets.py" % (name,)))
+    findings += _check_codegen_chains(budget, tol)
     return findings, reports, shards
+
+
+def _check_codegen_chains(budget, tol):
+    """Gate the ``codegen_chains`` section (schema 4): each pinned
+    per-chain bytes-saved must match the live mxgen lowering within
+    tolerance, every pinned chain must still ship, and every shipped
+    chain must be pinned — a mislowered/reordered chain fails COST001
+    here even before its kernel's FUS001 equivalence does."""
+    from .findings import Finding
+
+    pinned = budget.get("codegen_chains")
+    if pinned is None:
+        return []
+    findings = []
+    try:
+        from .codegen import shipped_chain_rows
+        live = shipped_chain_rows()
+    except Exception as e:
+        return [Finding(
+            "COST001", "codegen_chains",
+            "the mxgen shipped-chain lowering no longer builds: %s: %s"
+            % (type(e).__name__, str(e)[:200]))]
+    for name in sorted(pinned):
+        if name not in live:
+            findings.append(Finding(
+                "COST001", "codegen_chains.%s" % name,
+                "STATIC_BUDGETS.json pins generated chain %r but mxgen "
+                "no longer ships it — the tape's chain ranking moved or "
+                "the chain stopped lowering; regenerate via "
+                "tools/update_budgets.py if intentional" % (name,)))
+            continue
+        want, got = float(pinned[name]), float(live[name])
+        if want <= 0 or abs(got - want) > tol * want:
+            findings.append(Finding(
+                "COST001", "codegen_chains.%s" % name,
+                "modeled bytes-saved of generated chain %s is %d vs the "
+                "pinned %d (tolerance %.0f%%) — the chain mined from "
+                "the tape changed shape; a mislowering or an unfused-"
+                "spelling drift" % (name, int(got), int(want),
+                                    tol * 100)))
+    for name in sorted(set(live) - set(pinned)):
+        findings.append(Finding(
+            "COST002", "codegen_chains.%s" % name,
+            "mxgen ships generated chain %r with no codegen_chains "
+            "row — it is not gated; add it via tools/update_budgets.py"
+            % (name,)))
+    return findings

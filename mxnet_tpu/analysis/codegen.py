@@ -1,0 +1,735 @@
+"""mxgen — compile mined fusion chains into generated Pallas kernels.
+
+PR 15's mxfuse *ranks* memory-bound chains by modeled bytes-saved; a
+human still wrote every kernel.  This tier closes ROADMAP item 4 the
+way TVM closes its fusion loop (PAPERS.md arxiv 1802.04799): the top
+chains of the transformer train-step and ZeRO-1 tapes are **lowered
+mechanically** from the tape eqns into Pallas kernel source, their
+``KERNEL_COSTS`` entry is auto-declared from the chain's modeled
+``fused_bytes`` (FUS001 declared-vs-tape parity holds by construction),
+and a GEN-rule lint proves every shipped chain stays inside the
+provable-lowering set.
+
+The lowering has TWO independent implementations of each primitive's
+semantics:
+
+- ``_EMIT``    — prim → kernel-source emitter (what Pallas runs);
+- ``_PRIM_EVAL`` — prim → reference interpreter over the original tape
+  eqns (what the chain meant).
+
+The auto-equivalence check runs both on the same seeded inputs and
+compares at the PR-15 tolerance (1e-5).  Because the paths are
+independent, a mislowered eqn (the ``MXGEN_LOWER_EXACT`` mutation seam
+flips ``sub`` to ``add`` in the EMITTED source only) diverges and fails
+FUS001 through the unmodified STATIC_BUDGETS gate — rc=2, no test
+edits.
+
+Block shapes for the flat-tileable (pure elementwise, single 1-D shape
+family) kernels come from a seeded host-measured autotune over the
+pinned ``AUTOTUNE_LADDER``, cached to disk and replayed bitwise (the
+r05 subprocess-bench discipline: a valid cache is never re-measured or
+rewritten, so two runs sharing a cache produce byte-identical files).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .cost import build_tape
+from .findings import Finding, filter_findings
+from .fusion import analyze_tape_fusion
+
+__all__ = [
+    "LOWERABLE", "MXGEN_LOWER_EXACT", "AUTOTUNE_LADDER", "AUTOTUNE_SEED",
+    "LoweredKernel", "chain_externals", "lower_chain", "seeded_inputs",
+    "exec_kernel_source", "reference_outputs", "equivalence_check_host",
+    "flat_tileable", "autotune_block_rows", "shipped_tape",
+    "shipped_lowered", "shipped_chain_rows", "codegen_plans",
+    "render_codegen", "lint_generated_kernels",
+]
+
+# ---------------------------------------------------------------------------
+# mutation seam (tests only): False makes the EMITTER lower every `sub`
+# eqn as `add` — the reference interpreter is untouched, so the
+# auto-equivalence check diverges and the budget gate fails FUS001
+# ---------------------------------------------------------------------------
+MXGEN_LOWER_EXACT = True
+
+# the provable-lowering set: every prim mxgen knows how to emit AND
+# interpret.  A chain containing anything else is GEN001 (error) — it
+# stays a hand-written-kernel candidate instead of silently miscompiling
+_ELEMENTWISE_BINOPS = {
+    "add": "lax.add", "add_any": "lax.add", "sub": "lax.sub",
+    "mul": "lax.mul", "div": "lax.div", "max": "lax.max",
+    "min": "lax.min", "pow": "lax.pow", "rem": "lax.rem",
+    "gt": "lax.gt", "ge": "lax.ge", "lt": "lax.lt", "le": "lax.le",
+    "eq": "lax.eq", "ne": "lax.ne",
+    "and": "lax.bitwise_and", "or": "lax.bitwise_or",
+    "xor": "lax.bitwise_xor",
+}
+_ELEMENTWISE_UNOPS = {
+    "neg": "lax.neg", "abs": "lax.abs", "sign": "lax.sign",
+    "floor": "lax.floor", "ceil": "lax.ceil",
+    "exp": "lax.exp", "exp2": "lax.exp2", "log": "lax.log",
+    "log1p": "lax.log1p", "tanh": "lax.tanh", "sqrt": "lax.sqrt",
+    "rsqrt": "lax.rsqrt", "logistic": "lax.logistic",
+    "sin": "lax.sin", "cos": "lax.cos", "erf": "lax.erf",
+    "is_finite": "lax.is_finite", "not": "lax.bitwise_not",
+}
+_REDUCES = {"reduce_sum": "jnp.sum", "reduce_max": "jnp.max",
+            "reduce_min": "jnp.min", "reduce_prod": "jnp.prod",
+            "reduce_and": "jnp.all", "reduce_or": "jnp.any"}
+_IDENTITY = {"copy", "stop_gradient"}
+_STRUCTURAL = {"broadcast_in_dim", "convert_element_type", "select_n",
+               "integer_pow", "squeeze", "expand_dims"}
+
+LOWERABLE = frozenset(_ELEMENTWISE_BINOPS) | frozenset(_ELEMENTWISE_UNOPS) \
+    | frozenset(_REDUCES) | _IDENTITY | _STRUCTURAL
+
+# the pinned autotune candidate ladder: block rows × 128 lanes for the
+# flat row-tiled execution path (f32 min tile is (8, 128))
+AUTOTUNE_LADDER = (8, 32, 128, 256)
+AUTOTUNE_SEED = 20260807
+AUTOTUNE_CACHE_SCHEMA = 1
+AUTOTUNE_REPS = 3
+
+# the shipped chains: top-3 of each target tape, replacing hand-written
+# candidates with zero new hand-written kernels
+SHIPPED_TOP_N = 3
+SHIPPED_TAPES = ("tp_transformer", "zero1")
+EQUIV_TOL = 1e-5        # the PR-15 fused-vs-unfused tolerance
+EQUIV_SEED = 0
+
+
+def _dims(params, key):
+    v = params.get(key) or ()
+    return tuple(int(d) for d in v)
+
+
+def _dtype_name(dt):
+    import numpy as np
+    try:
+        return str(np.dtype(dt))
+    except TypeError:
+        return str(dt)
+
+
+# ---------------------------------------------------------------------------
+# path 1: the emitter — prim → kernel source text
+# ---------------------------------------------------------------------------
+def _emit_rhs(prim, args, params):
+    """RHS source for one tape eqn.  ``args`` are operand source
+    expressions (var names or inlined literals), already in eqn order."""
+    if prim in _ELEMENTWISE_BINOPS:
+        fn = _ELEMENTWISE_BINOPS[prim]
+        if not MXGEN_LOWER_EXACT and prim == "sub":
+            fn = "lax.add"          # the mislowering seam (tests only)
+        return "%s(%s, %s)" % (fn, args[0], args[1])
+    if prim in _ELEMENTWISE_UNOPS:
+        return "%s(%s)" % (_ELEMENTWISE_UNOPS[prim], args[0])
+    if prim in _REDUCES:
+        return "%s(%s, axis=%r)" % (_REDUCES[prim], args[0],
+                                    _dims(params, "axes"))
+    if prim in _IDENTITY:
+        return args[0]
+    if prim == "integer_pow":
+        return "lax.integer_pow(%s, %d)" % (args[0], int(params["y"]))
+    if prim == "convert_element_type":
+        return "lax.convert_element_type(%s, _dtype(%r))" \
+            % (args[0], _dtype_name(params["new_dtype"]))
+    if prim == "broadcast_in_dim":
+        return "lax.broadcast_in_dim(%s, %r, %r)" \
+            % (args[0], tuple(int(d) for d in params["shape"]),
+               _dims(params, "broadcast_dimensions"))
+    if prim == "select_n":
+        return "lax.select_n(%s)" % ", ".join(args)
+    if prim == "squeeze":
+        return "lax.squeeze(%s, %r)" % (args[0],
+                                        _dims(params, "dimensions"))
+    if prim == "expand_dims":
+        return "lax.expand_dims(%s, %r)" % (args[0],
+                                            _dims(params, "dimensions"))
+    raise KeyError(prim)
+
+
+# ---------------------------------------------------------------------------
+# path 2: the reference interpreter — prim → callable over arrays.
+# Deliberately a SEPARATE implementation (not exec of emitted text): an
+# emitter bug diverges here instead of reproducing itself.
+# ---------------------------------------------------------------------------
+def _prim_eval(prim, invals, params):
+    import jax
+    import jax.numpy as jnp
+    lax = jax.lax
+
+    if prim in ("add", "add_any"):
+        return lax.add(invals[0], invals[1])
+    if prim in _ELEMENTWISE_BINOPS:
+        name = _ELEMENTWISE_BINOPS[prim].split(".", 1)[1]
+        return getattr(lax, name)(invals[0], invals[1])
+    if prim in _ELEMENTWISE_UNOPS:
+        name = _ELEMENTWISE_UNOPS[prim].split(".", 1)[1]
+        return getattr(lax, name)(invals[0])
+    if prim in _REDUCES:
+        name = _REDUCES[prim].split(".", 1)[1]
+        return getattr(jnp, name)(invals[0], axis=_dims(params, "axes"))
+    if prim in _IDENTITY:
+        return invals[0]
+    if prim == "integer_pow":
+        return lax.integer_pow(invals[0], int(params["y"]))
+    if prim == "convert_element_type":
+        return lax.convert_element_type(invals[0], params["new_dtype"])
+    if prim == "broadcast_in_dim":
+        return lax.broadcast_in_dim(
+            invals[0], tuple(int(d) for d in params["shape"]),
+            _dims(params, "broadcast_dimensions"))
+    if prim == "select_n":
+        return lax.select_n(invals[0], *invals[1:])
+    if prim == "squeeze":
+        return lax.squeeze(invals[0], _dims(params, "dimensions"))
+    if prim == "expand_dims":
+        return lax.expand_dims(invals[0], _dims(params, "dimensions"))
+    raise KeyError(prim)
+
+
+def _literal_src(tape, i):
+    """Inline source for a literal operand (value recorded on the tape
+    by the cost pass).  Scalars stay weak-typed Python literals — the
+    jaxpr spelled them that way; reprs round-trip exactly."""
+    import numpy as np
+
+    v = np.asarray(tape.literal_values[i])
+    if v.ndim == 0:
+        if v.dtype == np.bool_:
+            return repr(bool(v))
+        if np.issubdtype(v.dtype, np.integer):
+            return repr(int(v))
+        return repr(float(v))
+    return "jnp.asarray(%r, _dtype(%r))" % (v.tolist(),
+                                            _dtype_name(v.dtype))
+
+
+def _literal_val(tape, i):
+    import jax.numpy as jnp
+    return jnp.asarray(tape.literal_values[i], tape.avals[i].dtype)
+
+
+def _eqn_avals_consistent(tape, op):
+    """True when abstract-evaluating the reference semantics over the
+    RECORDED operand avals reproduces the recorded output aval — the
+    provability guard against approximate inlining edges (a severed
+    scan slice, a pallas ref connector) masquerading as chain dataflow."""
+    import jax
+
+    try:
+        ins = []
+        for i in op.in_ids:
+            if i in tape.literal_ids:
+                ins.append(_literal_val(tape, i))
+            else:
+                aval = tape.avals[i]
+                ins.append(jax.ShapeDtypeStruct(
+                    tuple(aval.shape), aval.dtype))
+        out = jax.eval_shape(lambda *a: _prim_eval(op.prim, list(a),
+                                                   op.params), *ins)
+        want = tape.avals[op.out_ids[0]]
+        return (tuple(out.shape) == tuple(want.shape)
+                and out.dtype == want.dtype)
+    except Exception:  # noqa: BLE001 — any failure to re-infer is a "no"
+        return False
+
+
+def chain_externals(tape, chain):
+    """(ext_in ids, ext_out ids) of a chain — the _chain_stats buffer
+    sets, in the same sorted order the byte model counts them."""
+    idx_set = set(chain.op_indices)
+    produced = set()
+    for i in chain.op_indices:
+        produced.update(tape.ops[i].out_ids)
+    ext_in = sorted({iid for i in chain.op_indices
+                     for iid in tape.ops[i].in_ids
+                     if iid not in produced
+                     and iid not in tape.literal_ids})
+    prog_outs = set(tape.outvar_ids)
+    consumed = set()
+    for k, op in enumerate(tape.ops):
+        if k in idx_set:
+            continue
+        for iid in op.in_ids:
+            if iid in produced:
+                consumed.add(iid)
+    ext_out = sorted({oid for oid in produced
+                      if oid in consumed or oid in prog_outs})
+    return ext_in, ext_out
+
+
+class LoweredKernel:
+    """One chain lowered to Pallas kernel source + its cost contract.
+
+    ``src`` is None when the chain is not provably lowerable — the
+    GEN001 findings say why; everything byte-modeled still carries over
+    so callers can report the chain either way."""
+
+    __slots__ = ("name", "tag", "rank", "src", "ext_in", "ext_out",
+                 "in_avals", "out_avals", "kind", "prims", "n_ops",
+                 "scale", "unfused_bytes", "fused_bytes", "bytes_saved",
+                 "bytes_read", "bytes_written", "flops",
+                 "transcendentals", "findings", "tape", "chain")
+
+    def as_plan(self):
+        return {
+            "name": self.name,
+            "tape": self.tag,
+            "rank": int(self.rank),
+            "kind": self.kind,
+            "n_ops": int(self.n_ops),
+            "prims": sorted(set(self.prims)),
+            "n_inputs": len(self.ext_in),
+            "n_outputs": len(self.ext_out),
+            "unfused_bytes": int(self.unfused_bytes),
+            "fused_bytes": int(self.fused_bytes),
+            "bytes_saved": int(self.bytes_saved),
+            "lowerable": self.src is not None,
+            "findings": [f.rule_id for f in self.findings],
+            "src": self.src,
+        }
+
+
+def lower_chain(tape, chain, name, tag="chain", rank=0):
+    """Lower one FusionChain from the tape into a LoweredKernel.
+
+    The emitted body is deterministic in the tape: ops in tape order,
+    external buffers in sorted-id order, literals inlined.  Scalar
+    ``()`` externals ride as ``(1,)`` buffers (Pallas refs want rank);
+    the body reshapes them back."""
+    lk = LoweredKernel()
+    lk.name = name
+    lk.tag = tag
+    lk.rank = rank
+    lk.kind = chain.kind
+    lk.prims = list(chain.prims)
+    lk.n_ops = len(chain.op_indices)
+    lk.scale = int(chain.scale) or 1
+    lk.unfused_bytes = int(chain.unfused_bytes)
+    lk.fused_bytes = int(chain.fused_bytes)
+    lk.bytes_saved = int(chain.bytes_saved)
+    lk.tape = tape
+    lk.chain = chain
+    lk.findings = []
+
+    ops = [tape.ops[i] for i in chain.op_indices]
+    for idx, op in zip(chain.op_indices, ops):
+        if op.prim in LOWERABLE and len(op.out_ids) == 1 \
+                and not _eqn_avals_consistent(tape, op):
+            lk.findings.append(Finding(
+                "GEN001", "%s#%d" % (name, chain.first_op),
+                "chain eqn %d (%r) has tape dataflow the lowering "
+                "cannot prove: the recorded operand/result avals do "
+                "not re-infer (an approximate inlining edge) — the "
+                "chain stays a hand-written-kernel candidate"
+                % (idx, op.prim)))
+    for op in ops:
+        if op.prim not in LOWERABLE:
+            lk.findings.append(Finding(
+                "GEN001", "%s#%d" % (name, chain.first_op),
+                "chain op %r (eqn %d) is outside the provable-lowering "
+                "set — mxgen refuses to guess its semantics; the chain "
+                "stays a hand-written-kernel candidate"
+                % (op.prim, chain.op_indices[ops.index(op)])))
+        elif len(op.out_ids) != 1:
+            lk.findings.append(Finding(
+                "GEN001", "%s#%d" % (name, chain.first_op),
+                "chain op %r has %d outputs — the lowering only proves "
+                "single-output eqns" % (op.prim, len(op.out_ids))))
+
+    ext_in, ext_out = chain_externals(tape, chain)
+    lk.ext_in = list(ext_in)
+    lk.ext_out = list(ext_out)
+    lk.in_avals = [tape.avals[i] for i in ext_in]
+    lk.out_avals = [tape.avals[i] for i in ext_out]
+    # the auto-declared cost contract: one fused pass reads each
+    # external buffer once, writes each output once — EXACTLY the byte
+    # model's fused_bytes split (per call; the tape re-applies scale),
+    # so declared-vs-tape parity cannot drift
+    fused_per_call = lk.fused_bytes // lk.scale
+    lk.bytes_written = min(int(chain.external_out_bytes) // lk.scale,
+                           fused_per_call)
+    lk.bytes_read = fused_per_call - lk.bytes_written
+    lk.flops = sum(op.flops for op in ops) // lk.scale
+    lk.transcendentals = sum(op.transcendentals for op in ops) // lk.scale
+
+    if lk.findings:
+        lk.src = None
+        return lk
+
+    var = {}
+    for k, iid in enumerate(ext_in):
+        var[iid] = "v%d" % iid
+    in_params = ["in%d_ref" % k for k in range(len(ext_in))]
+    out_params = ["out%d_ref" % k for k in range(len(ext_out))]
+    lines = ["def %s(%s):" % (name, ", ".join(in_params + out_params))]
+    lines.append('    """mxgen: %s chain of %d eqns (tape %s, rank %d) '
+                 "— %d B fused vs %d B unfused.\"\"\""
+                 % (chain.kind, lk.n_ops, tag, rank, lk.fused_bytes,
+                    lk.unfused_bytes))
+    for k, iid in enumerate(ext_in):
+        aval = tape.avals[iid]
+        shape = tuple(getattr(aval, "shape", ()))
+        load = "in%d_ref[...]" % k
+        if len(shape) == 0:
+            load += ".reshape(())"
+        lines.append("    %s = %s  # %s%r" % (var[iid], load,
+                                              _dtype_name(aval.dtype),
+                                              shape))
+    for idx, op in zip(chain.op_indices, ops):
+        args = []
+        for iid in op.in_ids:
+            if iid in var:
+                args.append(var[iid])
+            else:
+                args.append(_literal_src(tape, iid))
+        oid = op.out_ids[0]
+        var[oid] = "v%d" % oid
+        lines.append("    %s = %s" % (var[oid],
+                                      _emit_rhs(op.prim, args, op.params)))
+    for k, oid in enumerate(ext_out):
+        shape = tuple(getattr(tape.avals[oid], "shape", ()))
+        store = var[oid]
+        if len(shape) == 0:
+            store += ".reshape((1,))"
+        lines.append("    out%d_ref[...] = %s" % (k, store))
+    lk.src = "\n".join(lines) + "\n"
+    return lk
+
+
+# ---------------------------------------------------------------------------
+# seeded inputs + the two execution paths
+# ---------------------------------------------------------------------------
+def seeded_inputs(avals, seed):
+    """Deterministic host arrays for a list of avals (the autotune and
+    equivalence harness share this)."""
+    import numpy as np
+
+    rs = np.random.RandomState(seed)
+    out = []
+    for aval in avals:
+        shape = tuple(getattr(aval, "shape", ()))
+        dt = np.dtype(aval.dtype)
+        if dt == np.bool_:
+            out.append(rs.rand(*shape) > 0.5)
+        elif np.issubdtype(dt, np.integer):
+            out.append(rs.randint(0, 5, size=shape).astype(dt))
+        else:
+            out.append(rs.standard_normal(shape).astype(dt))
+    return out
+
+
+class _HostRef:
+    """Array stand-in for a Pallas ref so the emitted source can run
+    directly on the host (no pallas_call) — the cheap equivalence path
+    the budget gate uses."""
+
+    def __init__(self, value=None):
+        self.value = value
+
+    def __getitem__(self, _):
+        return self.value
+
+    def __setitem__(self, _, val):
+        self.value = val
+
+
+def _exec_namespace():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _dtype(name):
+        return jnp.zeros((), dtype=np.dtype(name)).dtype
+
+    return {"jnp": jnp, "lax": jax.lax, "np": np, "_dtype": _dtype}
+
+
+def compile_kernel_source(lk):
+    """exec the emitted source → the kernel function object."""
+    ns = _exec_namespace()
+    code = compile(lk.src, "<mxgen:%s>" % lk.name, "exec")
+    exec(code, ns)
+    return ns[lk.name]
+
+
+def exec_kernel_source(lk, inputs):
+    """Run the EMITTED source on host arrays via _HostRef — evaluates
+    the very text Pallas would run, without a pallas_call."""
+    import jax.numpy as jnp
+
+    fn = compile_kernel_source(lk)
+    in_refs = []
+    for aval, x in zip(lk.in_avals, inputs):
+        x = jnp.asarray(x)
+        if x.ndim == 0:
+            x = x.reshape((1,))
+        in_refs.append(_HostRef(x))
+    out_refs = [_HostRef() for _ in lk.ext_out]
+    fn(*in_refs, *out_refs)
+    outs = []
+    for aval, ref in zip(lk.out_avals, out_refs):
+        shape = tuple(getattr(aval, "shape", ()))
+        v = ref.value
+        if len(shape) == 0:
+            v = v.reshape(())
+        outs.append(v)
+    return outs
+
+
+def reference_outputs(lk, inputs):
+    """Interpret the ORIGINAL tape eqns of the chain (path 2)."""
+    env = dict(zip(lk.ext_in, inputs))
+    tape = lk.tape
+    for idx in lk.chain.op_indices:
+        op = tape.ops[idx]
+        invals = [env[i] if i in env else _literal_val(tape, i)
+                  for i in op.in_ids]
+        env[op.out_ids[0]] = _prim_eval(op.prim, invals, op.params)
+    return [env[i] for i in lk.ext_out]
+
+
+def equivalence_check_host(lk, seed=EQUIV_SEED, tol=EQUIV_TOL):
+    """(ok, max_abs_err): emitted source vs tape interpreter on the same
+    seeded inputs.  Float outputs compare at ``tol`` (1e-5, the PR-15
+    fused-vs-unfused tolerance); integer/bool outputs compare exactly."""
+    import numpy as np
+
+    if lk.src is None:
+        return False, float("inf")
+    inputs = seeded_inputs(lk.in_avals, seed)
+    got = exec_kernel_source(lk, inputs)
+    want = reference_outputs(lk, inputs)
+    max_err = 0.0
+    ok = True
+    for g, w in zip(got, want):
+        g = np.asarray(g)
+        w = np.asarray(w)
+        if g.shape != w.shape or g.dtype != w.dtype:
+            return False, float("inf")
+        if np.issubdtype(g.dtype, np.floating):
+            err = float(np.max(np.abs(g - w))) if g.size else 0.0
+            max_err = max(max_err, err)
+            if not np.allclose(g, w, rtol=tol, atol=tol):
+                ok = False
+        elif not np.array_equal(g, w):
+            ok = False
+            max_err = float("inf")
+    return ok, max_err
+
+
+# ---------------------------------------------------------------------------
+# autotune: seeded, host-measured, disk-cached, replayed bitwise
+# ---------------------------------------------------------------------------
+def flat_tileable(lk):
+    """True when the kernel can run row-tiled over a (rows, 128) grid:
+    a pure elementwise chain whose externals all share one 1-D shape —
+    every block sees the same eqns, padding rows are discarded."""
+    if lk.src is None or lk.kind != "elementwise":
+        return False
+    avals = list(lk.in_avals) + list(lk.out_avals)
+    shapes = {tuple(getattr(a, "shape", ())) for a in avals}
+    if len(shapes) != 1:
+        return False
+    (shape,) = shapes
+    if len(shape) != 1:
+        return False
+    allowed = set(_ELEMENTWISE_BINOPS) | set(_ELEMENTWISE_UNOPS) \
+        | _IDENTITY | {"convert_element_type"}
+    return all(p in allowed for p in lk.prims)
+
+
+def _cache_valid(obj, seed, ladder):
+    return (isinstance(obj, dict)
+            and obj.get("schema") == AUTOTUNE_CACHE_SCHEMA
+            and obj.get("seed") == seed
+            and obj.get("ladder") == list(ladder)
+            and isinstance(obj.get("kernels"), dict))
+
+
+def _load_cache(path, seed, ladder):
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return obj if _cache_valid(obj, seed, ladder) else None
+
+
+def autotune_block_rows(gk, cache_path=None, seed=AUTOTUNE_SEED,
+                        ladder=AUTOTUNE_LADDER, reps=AUTOTUNE_REPS):
+    """Pick block rows for a flat-tileable generated kernel.
+
+    A valid cache (schema + seed + ladder match, choice on the ladder)
+    is REPLAYED — no re-measurement, no rewrite, so two runs sharing a
+    cache file agree bitwise.  A corrupt or mismatched cache is rebuilt
+    from fresh measurements, never trusted.  Returns the chosen block
+    rows (smallest-ladder winner on ties — perf_counter medians over
+    ``reps`` runs of the real tiled pallas_call on seeded inputs)."""
+    import time
+
+    from ..ops import generated_kernels as gen
+
+    cache_path = cache_path or os.environ.get("MXTPU_MXGEN_CACHE")
+    cached = _load_cache(cache_path, seed, ladder) if cache_path else None
+    if cached is not None:
+        entry = cached["kernels"].get(gk.name)
+        if isinstance(entry, dict) and entry.get("block_rows") in ladder:
+            return int(entry["block_rows"])
+
+    inputs = seeded_inputs(gk.in_avals, seed)
+    times = []
+    for br in ladder:
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter_ns()
+            outs = gen.generated_call(gk, *inputs, block_rows=br)
+            for o in outs:
+                o.block_until_ready()
+            samples.append(time.perf_counter_ns() - t0)
+        samples.sort()
+        times.append(samples[len(samples) // 2])
+    best = ladder[times.index(min(times))]
+
+    if cache_path:
+        obj = cached or {"schema": AUTOTUNE_CACHE_SCHEMA, "seed": seed,
+                         "ladder": list(ladder), "kernels": {}}
+        obj["kernels"][gk.name] = {"block_rows": int(best),
+                                   "t_ns": [int(t) for t in times]}
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(obj, indent=2, sort_keys=True) + "\n")
+        os.replace(tmp, cache_path)
+    return int(best)
+
+
+# ---------------------------------------------------------------------------
+# the shipped chains: top-3 of the transformer train-step and ZeRO-1
+# tapes (the budget models' exact pinned geometries)
+# ---------------------------------------------------------------------------
+_TAPE_MEMO = {}
+
+
+def shipped_tape(tag):
+    """The flat tape of one target program (memoized per process)."""
+    if tag in _TAPE_MEMO:
+        return _TAPE_MEMO[tag]
+    import jax
+    import jax.numpy as jnp
+
+    from . import budget_models as bm
+
+    if tag == "zero1":
+        from . import shard_fixtures as sf
+
+        k = bm.DECLARED_AXIS
+        step, args = sf.zero1_step_program(k)
+        closed = jax.make_jaxpr(step, axis_env=[("data", k)])(*args)
+        tape = build_tape(closed, axis_sizes={"data": k})
+    elif tag == "tp_transformer":
+        from ..transformer import step as tstep
+
+        g = bm.TP_GEOMETRY
+        plan, program, _ = bm._tp_plan_and_program()
+        n = len(program.param_names)
+        step = tstep.build_replica_step(
+            program, tstep.sgd_momentum_update(g["momentum"]), [1] * n)
+        train_avals = tuple(
+            jax.ShapeDtypeStruct(program.local_shape(nm), jnp.float32)
+            for nm in program.param_names)
+        b_local, t_local = program.local_batch_shape(g["batch"])
+        closed = jax.make_jaxpr(step, axis_env=plan.axis_env())(
+            train_avals, train_avals,
+            jax.ShapeDtypeStruct((b_local, t_local), jnp.int32),
+            jax.ShapeDtypeStruct((b_local, t_local), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jnp.float32(g["lr"]), jnp.int32(1))
+        tape = build_tape(closed, axis_sizes=plan.axis_sizes())
+    else:
+        raise KeyError(tag)
+    _TAPE_MEMO[tag] = tape
+    return tape
+
+
+_LOWERED_MEMO = {}
+
+
+def shipped_lowered():
+    """LoweredKernels for the top-N chains of every shipped tape, in
+    (tape, rank) order — deterministic names ``_gen_<tape>_top<rank>``."""
+    if "all" in _LOWERED_MEMO:
+        return _LOWERED_MEMO["all"]
+    out = []
+    for tag in SHIPPED_TAPES:
+        tape = shipped_tape(tag)
+        report = analyze_tape_fusion(tape)
+        for rank, chain in enumerate(report.chains[:SHIPPED_TOP_N], 1):
+            name = "_gen_%s_top%d" % (tag, rank)
+            out.append(lower_chain(tape, chain, name, tag=tag, rank=rank))
+    _LOWERED_MEMO["all"] = out
+    return out
+
+
+def shipped_chain_rows():
+    """{kernel name: bytes_saved} — the per-chain rows STATIC_BUDGETS.json
+    pins (``codegen_chains``) and tools/update_budgets.py regenerates."""
+    return {lk.name: int(lk.bytes_saved) for lk in shipped_lowered()}
+
+
+def codegen_plans():
+    """Deterministic lowered plan per shipped chain (``--codegen``)."""
+    return [lk.as_plan() for lk in shipped_lowered()]
+
+
+def render_codegen(plans=None):
+    plans = codegen_plans() if plans is None else plans
+    lines = ["mxgen: %d shipped chain(s) lowered" % len(plans)]
+    for p in plans:
+        lines.append(
+            "  %-28s %-18s %4d ops  %2d in /%2d out  saves %10d B  %s"
+            % (p["name"], "%s#%d:%s" % (p["tape"], p["rank"], p["kind"]),
+               p["n_ops"], p["n_inputs"], p["n_outputs"],
+               p["bytes_saved"],
+               "ok" if p["lowerable"] else ",".join(p["findings"])))
+        if p["src"]:
+            for ln in p["src"].rstrip("\n").splitlines():
+                lines.append("    | " + ln)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the GEN-rule lint (--self-check)
+# ---------------------------------------------------------------------------
+def lint_generated_kernels(disable=()):
+    """GEN sweep: every shipped chain must lower inside the provable
+    set (GEN001), and every REGISTERED generated kernel must carry a
+    passing auto-equivalence check (GEN002) — a kernel exec'd into the
+    registry without proving itself is an error, not a skip."""
+    from ..ops import generated_kernels as gen
+
+    findings = []
+    try:
+        gen.build_shipped_generated()
+    except Exception as e:  # noqa: BLE001 — a broken build IS the finding
+        findings.append(Finding(
+            "GEN001", "codegen",
+            "building the shipped generated kernels failed: %r — the "
+            "top chains cannot be proven lowerable" % (e,)))
+        return filter_findings(findings, disable)
+    for lk in shipped_lowered():
+        findings.extend(lk.findings)
+    for name in sorted(gen.GENERATED_KERNELS):
+        gk = gen.GENERATED_KERNELS[name]
+        if not gk.equivalence_ok:
+            findings.append(Finding(
+                "GEN002", name,
+                "generated kernel %r is registered without a passing "
+                "auto-equivalence check (emitted source vs tape "
+                "interpreter at %g) — an unproven lowering must not "
+                "ship" % (name, EQUIV_TOL)))
+    return filter_findings(findings, disable)

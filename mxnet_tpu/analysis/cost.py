@@ -310,6 +310,7 @@ class Tape:
         self.outvar_ids = []     # program outputs, in order
         self.const_ids = []      # closure constants
         self.literal_ids = set()  # inline literals (e.g. the 1 in psum(1))
+        self.literal_values = {}  # id -> literal value (mxgen emits these)
         self.unpriced = []       # [(prim, axis, reason)] — COST004 feed
         self.unpriced_kernels = []  # [kernel name] — COST005 feed
         self.unbounded_loops = False
@@ -350,6 +351,7 @@ def build_tape(closed_jaxpr, axis_sizes=None):
     def read(env, atom):
         if isinstance(atom, jax.core.Literal):
             i = tape.fresh(atom.aval, literal=True)
+            tape.literal_values[i] = atom.val
             return i
         return env[atom]
 
@@ -462,9 +464,26 @@ def build_tape(closed_jaxpr, axis_sizes=None):
             else:
                 atoms = operand_atoms[:n] \
                     if len(operand_atoms) >= n else ()
+            def _same_aval(a, b):
+                return (getattr(a, "shape", None) == getattr(b, "shape",
+                                                             None)
+                        and getattr(a, "dtype", None) == getattr(
+                            b, "dtype", None))
+
             if len(atoms) == n:
                 for var, atom in zip(sj.invars, atoms):
-                    inner_env[var] = read(env, atom)
+                    if _same_aval(var.aval, getattr(atom, "aval", None)):
+                        inner_env[var] = read(env, atom)
+                    else:
+                        # aval mismatch (scan's full-xs operand vs the
+                        # body's per-iteration slice var): binding them
+                        # to ONE id would fake dataflow — e.g. a chain
+                        # "reading" the stacked array inside the body.
+                        # Sever the edge; the connector op below keeps
+                        # liveness sound
+                        inner_env[var] = tape.fresh(var.aval)
+                        if si == 0:
+                            connected = False
             else:
                 for var in sj.invars:
                     inner_env[var] = tape.fresh(var.aval)
@@ -473,8 +492,13 @@ def build_tape(closed_jaxpr, axis_sizes=None):
             walk(sj, list(sc), inner_env, sub_scale)
             if si == 0 and len(sj.outvars) == len(eqn.outvars):
                 for outer, inner in zip(eqn.outvars, sj.outvars):
-                    if isinstance(inner, jax.core.Literal):
-                        env[outer] = tape.fresh(inner.aval)
+                    if isinstance(inner, jax.core.Literal) or \
+                            not _same_aval(outer.aval, inner.aval):
+                        # stacked scan output vs the body's slice var:
+                        # same severing rule as the operands above
+                        env[outer] = tape.fresh(outer.aval)
+                        if not isinstance(inner, jax.core.Literal):
+                            connected = False
                     else:
                         env[outer] = inner_env.get(
                             inner, tape.fresh(inner.aval))

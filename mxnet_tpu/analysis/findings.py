@@ -192,6 +192,11 @@ RULES = {
                        "body walk (wrong in both directions) behind a "
                        "zero-cost connector — register a "
                        "declare_kernel_cost model"),
+    "COST006": (ERROR, "generated kernel lost its auto-declared "
+                       "KERNEL_COSTS entry: the registry names a mxgen "
+                       "kernel the cost pass cannot price — the AST "
+                       "sweep (COST005) cannot see exec'd sources, so "
+                       "the gap would otherwise be a silent skip"),
     # race pass (mxnet_tpu/analysis/race_lint.py, "mxrace")
     "RACE001": (ERROR, "lock-guard violation: an attribute mutated under "
                        "a lock in one method is read/iterated/written "
@@ -220,6 +225,17 @@ RULES = {
                       "fusion pass's bytes-saved-if-fused for the chain "
                       "it replaces, or the kernel's declared bytes "
                       "differ from one pass over its operands/results"),
+    # codegen pass (mxnet_tpu/analysis/codegen.py, "mxgen")
+    "GEN001": (ERROR, "fusion chain contains an op outside the "
+                      "provable-lowering set: mxgen cannot emit a "
+                      "kernel whose semantics it can prove against the "
+                      "tape interpreter — the chain stays a "
+                      "hand-written-kernel candidate"),
+    "GEN002": (ERROR, "generated kernel registered without a passing "
+                      "auto-equivalence check: emitted source and tape "
+                      "interpreter were never compared at the 1e-5 "
+                      "fused-vs-unfused tolerance — an unproven "
+                      "lowering must not ship"),
 }
 
 

@@ -392,6 +392,11 @@ def pallas_kernels_used(root=None):
         return local
 
     for path in sorted(glob.glob(os.path.join(root, "*.py"))):
+        if os.path.basename(path) == "generated_kernels.py":
+            # mxgen kernels are exec'd from generated source — the AST
+            # sweep cannot see them; the registry-driven COST006 check
+            # in lint_kernel_costs covers that module instead
+            continue
         rel = os.path.join("ops", os.path.basename(path))
         try:
             with open(path) as f:
@@ -492,4 +497,17 @@ def lint_kernel_costs(disable=(), root=None):
             "function name — the declared-cost registry cannot be "
             "checked for it; pass the kernel fn (or a functools."
             "partial of it) directly"))
+    # generated kernels (ops/generated_kernels.py) are exec'd source the
+    # AST sweep above cannot see: check the REGISTRY instead — a mxgen
+    # kernel that lost its auto-declared cost entry is a gate error
+    # (COST006), not a silent skip
+    from ..ops import generated_kernels as _gen
+    for name in sorted(set(_gen.GENERATED_KERNELS) - set(KERNEL_COSTS)):
+        findings.append(Finding(
+            "COST006", name,
+            "generated kernel %r is in GENERATED_KERNELS but has no "
+            "KERNEL_COSTS entry — register_generated auto-declares one; "
+            "something deleted or bypassed it, so the cost pass would "
+            "price the kernel off the once-per-trace body walk"
+            % (name,)))
     return filter_findings(findings, disable)

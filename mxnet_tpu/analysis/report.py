@@ -26,8 +26,9 @@ __all__ = ["render_text", "render_json", "worst_severity", "exit_code",
 # shard section (mxshard collective schedules) and the
 # unpriced_collectives row inside each cost report; 4 adds the fusion
 # section (mxfuse chain rankings) and the unpriced_kernels row; 5 adds
-# the race section (mxrace lock inventory/guards/edges/hierarchy)
-SCHEMA_VERSION = 5
+# the race section (mxrace lock inventory/guards/edges/hierarchy);
+# 6 adds the codegen section (mxgen lowered plans per shipped chain)
+SCHEMA_VERSION = 6
 
 
 def _sorted(findings):
@@ -48,12 +49,12 @@ def render_text(findings, title="mxlint"):
 
 
 def render_json(findings, cost=None, dist=None, shard=None, fusion=None,
-                race=None):
+                race=None, codegen=None):
     """``cost``: {target_name: CostReport-or-dict}; ``dist``: the
     dist_summary dict; ``shard``: the shard_summary dict; ``fusion``:
     {target_name: FusionReport-or-dict} (schema 4); ``race``: the
-    race_summary dict (schema 5).  Sections appear only when
-    provided."""
+    race_summary dict (schema 5); ``codegen``: the mxgen lowered-plan
+    list (schema 6).  Sections appear only when provided."""
     counts = Counter(f.severity for f in findings)
     payload = {
         "version": 1,
@@ -75,6 +76,8 @@ def render_json(findings, cost=None, dist=None, shard=None, fusion=None,
             for name, rep in sorted(fusion.items())}
     if race is not None:
         payload["race"] = race
+    if codegen is not None:
+        payload["codegen"] = codegen
     return json.dumps(payload, indent=2)
 
 

@@ -1,0 +1,125 @@
+"""Host-only codegen-tier bench (the r05 subprocess pattern).
+
+Run as ``python -m mxnet_tpu.codegen_bench`` under ``JAX_PLATFORMS=cpu``
+(bench.py's ``codegen`` stage does, BEFORE backend acquisition, so the
+keys stay live when the TPU is down).  Emits one JSON line:
+
+- ``codegen_generated_speedup_host``: REAL measured wall-time ratio of
+  the unfused chain execution (op-at-a-time over the mined tape eqns —
+  every intermediate materializes, one dispatch per op: exactly the
+  semantics the fusion pass prices as "unfused") vs the generated
+  Pallas kernel (``ops/generated_kernels.py``, interpret on the host —
+  one pass, one dispatch), summed over every shipped generated kernel.
+  Gated ``higher`` in tools/bench_compare.py from its first two live
+  rounds.
+- ``codegen_modeled_bytes_saved_pct``: the deterministic modeled win of
+  the shipped chains — ``sum(bytes_saved) / sum(unfused_bytes)`` over
+  the mxgen lowering (``analysis/codegen.py``), the same numbers the
+  ``codegen_chains`` STATIC_BUDGETS.json rows pin.
+- ``codegen_numerics_ok``: 1.0 iff every registered generated kernel
+  passes its host auto-equivalence check AND the real
+  ``pl.pallas_call`` interpret path matches the tape reference within
+  EQUIV_TOL (1e-5) AND the pallas path bitwise-repeats — gated at zero
+  slack.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BENCH_REPS = 20       # timing samples per arm (median)
+
+
+def _bench(fn, reps=BENCH_REPS):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def main():
+    import jax
+
+    from mxnet_tpu.analysis import codegen as cg
+    from mxnet_tpu.ops import generated_kernels as gen
+
+    out = {}
+    kernels = gen.build_shipped_generated()
+    lowered = {lk.name: lk for lk in cg.shipped_lowered()}
+
+    # modeled (deterministic, device-free): the mxgen lowering's own
+    # byte contract — what the codegen_chains budget rows pin
+    unfused = sum(lk.unfused_bytes for lk in lowered.values())
+    saved = sum(lk.bytes_saved for lk in lowered.values())
+    out["codegen_modeled_bytes_saved_pct"] = round(
+        100.0 * saved / unfused, 2) if unfused else 0.0
+
+    # measured + numerics, per shipped kernel
+    t_unfused_total, t_fused_total = 0.0, 0.0
+    numerics_ok = True
+    max_err = 0.0
+    for gk in kernels:
+        lk = lowered[gk.name]
+        inputs = cg.seeded_inputs(lk.in_avals, cg.EQUIV_SEED)
+        ref = cg.reference_outputs(lk, inputs)
+        dev_inputs = [jax.device_put(x) for x in inputs]
+
+        def run_unfused(lk=lk, xs=dev_inputs):
+            # op-at-a-time: each tape eqn dispatches and materializes
+            # separately — the unfused spelling the chain replaces
+            outs = cg.reference_outputs(lk, xs)
+            jax.block_until_ready(outs)
+            return outs
+
+        fused = jax.jit(lambda *xs, gk=gk: tuple(
+            gen.generated_call(gk, *xs, interpret=True)))
+
+        got = fused(*dev_inputs)          # warm (compile)
+        jax.block_until_ready(got)
+        run_unfused()
+
+        # numerics: pallas interpret vs the tape reference, and the
+        # pallas path must bitwise-repeat
+        for r, g, aval in zip(ref, got, lk.out_avals):
+            r, g = np.asarray(r), np.asarray(g)
+            if np.issubdtype(r.dtype, np.floating):
+                err = float(np.max(np.abs(r.astype("f8") - g.astype("f8")))) \
+                    if r.size else 0.0
+                max_err = max(max_err, err)
+                if not np.allclose(r, g, rtol=cg.EQUIV_TOL,
+                                   atol=cg.EQUIV_TOL):
+                    numerics_ok = False
+            elif not (r == g).all():
+                numerics_ok = False
+        got2 = fused(*dev_inputs)
+        jax.block_until_ready(got2)
+        if not all((np.asarray(a) == np.asarray(b)).all()
+                   for a, b in zip(got, got2)):
+            numerics_ok = False
+        if not gk.equivalence_ok:
+            numerics_ok = False
+
+        t_unfused_total += _bench(run_unfused)
+        t_fused_total += _bench(
+            lambda fused=fused, xs=dev_inputs:
+            jax.block_until_ready(fused(*xs)))
+
+    out["codegen_n_kernels"] = len(kernels)
+    out["codegen_unfused_ms"] = round(t_unfused_total * 1e3, 4)
+    out["codegen_fused_ms"] = round(t_fused_total * 1e3, 4)
+    out["codegen_generated_speedup_host"] = round(
+        t_unfused_total / t_fused_total, 3) if t_fused_total else 0.0
+    out["codegen_numerics_max_err"] = float(max_err)
+    out["codegen_numerics_ok"] = 1.0 if numerics_ok else 0.0
+
+    print(json.dumps(out))
+    return 0 if out["codegen_numerics_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

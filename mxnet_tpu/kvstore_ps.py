@@ -706,12 +706,15 @@ class PSServer:
             self.monitor.beat(rank, step)
             # straggler detection rides the same beat stream the
             # monitor's step clocks come from: the optional tail fields
-            # carry the worker's dominant phase and its send time on the
+            # carry the worker's dominant phase, its send time on the
             # SERVER clock (client perf_counter + PR-9 clock offset)
+            # and its self-measured step p50 (preferred over arrival
+            # deltas — deterministic under host contention)
             self.straggler.observe(
                 rank, step,
                 t_ns=msg[4] if len(msg) > 4 else None,
-                phase=msg[3] if len(msg) > 3 else None)
+                phase=msg[3] if len(msg) > 3 else None,
+                p50_s=msg[5] if len(msg) > 5 else None)
             # read the monitor's view first: its dead() takes the
             # monitor's own lock, which must never nest inside ours
             monitor_dead = self.monitor.dead()
@@ -1044,7 +1047,8 @@ class PSClient:
             offset, rank=str(self._rank))
         return offset, rtt
 
-    def start_heartbeat(self, interval_s=2.0, step_fn=None, phase_fn=None):
+    def start_heartbeat(self, interval_s=2.0, step_fn=None, phase_fn=None,
+                        p50_fn=None):
         """Start the worker-side beat loop (``resilience.heartbeat``):
         every ``interval_s`` the client reports liveness (and its step,
         via ``step_fn``) so the server's watchdog can tell silence from
@@ -1054,14 +1058,20 @@ class PSClient:
         client stamps each beat with its send time shifted onto the
         *server's* monotonic clock — what lets the server-side straggler
         detector measure per-rank step time free of arrival jitter.
-        Idempotent; stopped by :meth:`close`."""
+        ``p50_fn`` (e.g. ``telemetry.step_p50_or_none``) carries the
+        worker's SELF-MEASURED step-time p50 — the detector prefers it
+        over arrival-delta derivation entirely, so the fleet verdict is
+        deterministic under host contention.  Idempotent; stopped by
+        :meth:`close`."""
         if self._hb is None:
             def beat():
                 step = step_fn() if step_fn is not None else None
                 phase = phase_fn() if phase_fn is not None else None
                 ts = (time.perf_counter_ns() + self.clock_offset_ns
                       if self.clock_offset_ns is not None else None)
-                self.request("heartbeat", self._rank, step, phase, ts)
+                p50 = p50_fn() if p50_fn is not None else None
+                self.request("heartbeat", self._rank, step, phase, ts,
+                             p50)
             self._hb = HeartbeatSender(beat, interval_s).start()
         return self._hb
 

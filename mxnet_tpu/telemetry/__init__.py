@@ -53,7 +53,8 @@ from . import attribution as attribution_mod
 from .attribution import (PHASES, HINTS, StepAttribution,
                           StragglerDetector, attribution,
                           reset_attribution, dominant_phase_or_none,
-                          doctor_report, render_doctor)
+                          step_p50_or_none, doctor_report,
+                          render_doctor)
 
 __all__ = ["enable", "disable", "enabled", "maybe_enable_from_env",
            "record", "cursor", "recorder", "telemetry_dir", "dump_metrics",
@@ -63,7 +64,7 @@ __all__ = ["enable", "disable", "enabled", "maybe_enable_from_env",
            "render_postmortem", "trace", "fault_event",
            "PHASES", "HINTS", "StepAttribution", "StragglerDetector",
            "attribution", "reset_attribution", "dominant_phase_or_none",
-           "doctor_report", "render_doctor"]
+           "step_p50_or_none", "doctor_report", "render_doctor"]
 
 # the one-bool-check hot-path flag (profiler._PROFILING discipline):
 # instrumented sites read this module global and bail before touching

@@ -62,7 +62,8 @@ from collections import deque
 
 __all__ = ["PHASES", "HINTS", "CONTEXT_HINTS", "StepAttribution",
            "StragglerDetector", "attribution", "reset_attribution",
-           "dominant_phase_or_none", "doctor_report", "render_doctor"]
+           "dominant_phase_or_none", "step_p50_or_none",
+           "doctor_report", "render_doctor"]
 
 # The step wall-clock decomposition.  Every name here must (a) be used
 # by an ``add_phase`` call somewhere in the shipped sources, (b) have a
@@ -480,6 +481,19 @@ class StepAttribution:
             self._drain_locked()
             return self._dominant_locked()
 
+    def step_p50(self):
+        """The rank's SELF-MEASURED per-step wall p50 over the recent
+        window, or None before any step completed — what the worker's
+        heartbeat ``p50_fn`` reports (kvstore_ps.py) so the server-side
+        straggler verdict rides the worker's own step clock instead of
+        beat-arrival deltas (which jitter with host load)."""
+        with self._lock:
+            self._drain_locked()
+            recent = list(self._recent_wall)
+        if not recent:
+            return None
+        return _percentile(recent, 50)
+
     def snapshot(self):
         """Aggregate view (what ``fit``'s metrics dump embeds and the
         doctor reads): lifetime totals, per-step p50/p99, dominant phase,
@@ -561,6 +575,20 @@ def dominant_phase_or_none():
     return a.dominant_phase()
 
 
+def step_p50_or_none():
+    """The self-measured step-time p50 when telemetry is armed, else
+    None — the worker-side ``p50_fn`` heartbeats report so the server's
+    :class:`StragglerDetector` judges measured step time, not arrival
+    jitter."""
+    from . import enabled as _enabled
+    # one GIL-atomic read of the singleton ref (the heartbeat hot
+    # path); a concurrent reset simply means this beat reports None
+    a = _ATTR  # mxlint: disable=RACE001
+    if not _enabled() or a is None:
+        return None
+    return a.step_p50()
+
+
 class StragglerDetector:
     """Server-side per-rank step-time skew detector.
 
@@ -573,13 +601,35 @@ class StragglerDetector:
     ``(t, step)`` observations yield per-step durations; when one rank's
     p50 exceeds the fleet median by ``factor``, a ``perf.straggler``
     flight event (rank, lag, dominant phase) + counter fire — re-emitted
-    at most once per ``cooldown_s`` while the skew persists.
+    at most once per ``cooldown_s`` while the skew persists, except that
+    a CHANGED dominant phase re-emits immediately (the verdict's named
+    bottleneck moved — e.g. the warmup window's jit compile giving way
+    to input wait — and the stale event would name the wrong knob).
+    ``min_gap_s`` (``MXTPU_STRAGGLER_MIN_GAP_S``, default 0) adds an
+    absolute-gap floor on top of the ratio — see ``__init__``.
+
+    A beat that carries the worker's SELF-MEASURED step-time p50
+    (``p50_s``, from :func:`step_p50_or_none` — the rank's own
+    ``StepAttribution`` clock) takes precedence over the arrival-delta
+    derivation for that rank: the worker's clock sees exactly the step
+    wall the doctor reconciles, so the verdict is deterministic under
+    host contention where beat scheduling jitters.  The min-samples
+    discipline still applies, gated on the rank's reported step count.
     """
 
     def __init__(self, factor=None, window=64, min_samples=None,
-                 cooldown_s=5.0, now_ns=None):
+                 cooldown_s=5.0, now_ns=None, min_gap_s=None):
         self.factor = float(factor or os.environ.get(
             "MXTPU_STRAGGLER_FACTOR", "2.0"))
+        # absolute-gap floor: a verdict needs p50 - med > min_gap_s ON
+        # TOP of the ratio.  Ratio alone misfires on millisecond-scale
+        # steps, where scheduler jitter yields large RATIOS over tiny
+        # absolute skew (two workers time-slicing one CI core hit 2-3x
+        # on a ~3ms step with no fault anywhere); a real straggler's
+        # gap is orders of magnitude above it.  Default 0: ratio-only.
+        self.min_gap_s = float(min_gap_s if min_gap_s is not None
+                               else os.environ.get(
+                                   "MXTPU_STRAGGLER_MIN_GAP_S", "0"))
         self.min_samples = int(min_samples or os.environ.get(
             "MXTPU_STRAGGLER_MIN_SAMPLES", "5"))
         self.cooldown_s = float(cooldown_s)
@@ -587,14 +637,18 @@ class StragglerDetector:
         self._lock = threading.Lock()
         self._last = {}       # rank -> (t_ns, step)
         self._durs = {}       # rank -> deque of per-step seconds
+        self._self_p50 = {}   # rank -> self-measured step p50 (beats)
         self._phase = {}      # rank -> last reported dominant phase
         self._window = int(window)
-        self._flagged = {}    # rank -> last emit t_ns
+        self._flagged = {}    # rank -> (last emit t_ns, emitted phase)
         self.events = []      # (rank, lag, phase) — for assertions
 
-    def observe(self, rank, step, t_ns=None, phase=None):
+    def observe(self, rank, step, t_ns=None, phase=None, p50_s=None):
         """Record one step-clock observation; runs a scan and returns
-        newly-emitted straggler events (possibly empty)."""
+        newly-emitted straggler events (possibly empty).  ``p50_s``:
+        the worker's self-measured step p50 — preferred over deriving
+        from beat-arrival deltas once the rank has stepped
+        ``min_samples`` times."""
         if step is None:
             return []
         now = self._now_ns()
@@ -602,6 +656,9 @@ class StragglerDetector:
         with self._lock:
             if phase is not None:
                 self._phase[rank] = phase
+            if p50_s is not None and float(p50_s) > 0 \
+                    and int(step) >= self.min_samples:
+                self._self_p50[rank] = float(p50_s)
             prev = self._last.get(rank)
             # the reference point moves only when the step clock moves:
             # a rank stepping SLOWER than the beat interval must bill the
@@ -625,9 +682,12 @@ class StragglerDetector:
             return self._scan_locked(now)
 
     def _p50s_locked(self):
-        return {r: _percentile(list(d), 50)
-                for r, d in self._durs.items()
-                if len(d) >= self.min_samples}
+        out = {r: _percentile(list(d), 50)
+               for r, d in self._durs.items()
+               if len(d) >= self.min_samples}
+        # a rank's own measurement wins over the arrival-delta estimate
+        out.update(self._self_p50)
+        return out
 
     def _scan_locked(self, now_ns):
         p50s = self._p50s_locked()
@@ -638,16 +698,18 @@ class StragglerDetector:
             return []
         emitted = []
         for rank, p50 in p50s.items():
-            if p50 > self.factor * med:
+            if p50 > self.factor * med and p50 - med > self.min_gap_s:
+                phase = self._phase.get(rank)
                 last = self._flagged.get(rank)
                 if last is not None and \
-                        (now_ns - last) / 1e9 < self.cooldown_s:
+                        (now_ns - last[0]) / 1e9 < self.cooldown_s \
+                        and phase == last[1]:
                     continue
-                self._flagged[rank] = now_ns
+                self._flagged[rank] = (now_ns, phase)
                 ev = {"rank": rank, "lag": round(p50 / med, 3),
                       "p50_s": round(p50, 6),
                       "fleet_p50_s": round(med, 6),
-                      "phase": self._phase.get(rank)}
+                      "phase": phase}
                 self.events.append(ev)
                 emitted.append(ev)
             else:

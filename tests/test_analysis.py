@@ -936,7 +936,7 @@ def test_cost_json_schema_version():
     proc = _run_cli("--cost", "--json", "--model", "mlp_infer")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
-    assert payload["schema_version"] == 5    # 5: the race section
+    assert payload["schema_version"] == 6    # 6: the codegen section
     assert payload["version"] == 1
     assert "mlp_infer" in payload["cost"]
     assert payload["cost"]["mlp_infer"]["flops"] > 0

@@ -1,0 +1,398 @@
+"""Codegen tier (mxnet_tpu/analysis/codegen.py "mxgen" +
+ops/generated_kernels.py; docs/fusion.md "Generated kernels"): the
+shipped top-3 chains of the transformer train-step and ZeRO-1 tapes
+lower deterministically into registered Pallas kernels with
+auto-declared costs, every generated kernel equals its tape reference
+through the REAL pallas path (interpret, whole-array AND row-tiled),
+GEN001 names unlowerable chains, GEN002 names unproven registrations,
+COST006 names a lost auto-declared cost entry, the MXGEN_LOWER_EXACT
+mislowering seam is killed through the unmodified STATIC_BUDGETS.json
+gate (subprocess rc=2, FUS001 named), the seeded autotune cache
+replays bitwise across subprocess runs (and rebuilds from corruption),
+and the `--codegen` CLI/schema-6 JSON section round-trips through
+tools/parse_log.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.analysis import codegen as cg
+from mxnet_tpu.analysis.cost import KERNEL_COSTS, build_tape
+from mxnet_tpu.analysis.fusion import analyze_tape_fusion
+from mxnet_tpu.ops import generated_kernels as gen
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FLOAT_TOL = 1e-5
+
+SHIPPED_NAMES = [
+    "_gen_tp_transformer_top1", "_gen_tp_transformer_top2",
+    "_gen_tp_transformer_top3", "_gen_zero1_top1", "_gen_zero1_top2",
+    "_gen_zero1_top3",
+]
+
+
+def _cpu_env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("MXTPU_CHAOS", None)
+    env.pop("MXTPU_MXGEN_CACHE", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+# ---------------------------------------------------------------------------
+# the shipped lowering: top-3 per tape, proven, zero hand-written code
+# ---------------------------------------------------------------------------
+def test_shipped_chains_lower_and_prove():
+    kernels = {gk.name: gk for gk in gen.build_shipped_generated()}
+    assert sorted(kernels) == sorted(SHIPPED_NAMES)
+    for gk in kernels.values():
+        assert gk.src is not None
+        assert gk.equivalence_ok, (gk.name, gk.equivalence_err)
+        assert gk.bytes_saved > 0
+        assert gk.bytes_saved == gk.unfused_bytes - gk.fused_bytes
+    # registration == registry == cost table
+    assert set(SHIPPED_NAMES) <= set(gen.GENERATED_KERNELS)
+    assert set(SHIPPED_NAMES) <= set(KERNEL_COSTS)
+
+
+def test_generated_cost_entry_is_chain_parity_by_construction():
+    """The auto-declared KERNEL_COSTS entry copies the chain's per-call
+    fused-byte split verbatim — FUS001 parity is an identity."""
+    gen.build_shipped_generated()
+    lowered = {lk.name: lk for lk in cg.shipped_lowered()}
+    for name in SHIPPED_NAMES:
+        gk = gen.GENERATED_KERNELS[name]
+        c = KERNEL_COSTS[name](None)
+        assert c["bytes_read"] == gk.bytes_read
+        assert c["bytes_written"] == gk.bytes_written
+        lk = lowered[name]
+        per_call = int(lk.fused_bytes) // max(int(lk.scale), 1)
+        assert c["bytes_read"] + c["bytes_written"] == per_call
+        assert c["flops"] == gk.flops
+        assert c["transcendentals"] == gk.transcendentals
+
+
+def test_lowering_is_deterministic():
+    """Same tape + chain -> byte-identical emitted source and external
+    ordering (the plan the CLI prints is reproducible)."""
+    tape = cg.shipped_tape("zero1")
+    report = analyze_tape_fusion(tape)
+    chain = report.chains[0]
+    a = cg.lower_chain(tape, chain, "_det_probe", tag="zero1", rank=1)
+    b = cg.lower_chain(tape, chain, "_det_probe", tag="zero1", rank=1)
+    assert a.src == b.src
+    assert a.ext_in == b.ext_in and a.ext_out == b.ext_out
+    assert a.fused_bytes == b.fused_bytes
+    assert a.bytes_saved == b.bytes_saved
+
+
+def test_pallas_path_matches_tape_reference_per_kernel():
+    """The REAL pl.pallas_call path (interpret on the host) equals the
+    independent tape interpreter within the PR-15 tolerance, for every
+    shipped generated kernel."""
+    kernels = gen.build_shipped_generated()
+    lowered = {lk.name: lk for lk in cg.shipped_lowered()}
+    for gk in kernels:
+        lk = lowered[gk.name]
+        inputs = cg.seeded_inputs(lk.in_avals, cg.EQUIV_SEED)
+        want = cg.reference_outputs(lk, inputs)
+        got = gen.generated_call(gk, *inputs, interpret=True)
+        for w, g in zip(want, got):
+            w, g = np.asarray(w), np.asarray(g)
+            assert w.shape == g.shape and w.dtype == g.dtype
+            if np.issubdtype(w.dtype, np.floating):
+                assert np.allclose(w, g, rtol=FLOAT_TOL,
+                                   atol=FLOAT_TOL), gk.name
+            else:
+                assert np.array_equal(w, g), gk.name
+
+
+def test_tiled_path_matches_whole_array_at_every_rung():
+    """The flat-tileable kernel's row-tiled grid agrees with the
+    whole-array call at every autotune-ladder rung (padding rows are
+    computed then discarded, never observed)."""
+    kernels = gen.build_shipped_generated()
+    lowered = {lk.name: lk for lk in cg.shipped_lowered()}
+    tileable = [gk for gk in kernels
+                if cg.flat_tileable(lowered[gk.name])]
+    assert tileable, "no flat-tileable shipped kernel"
+    for gk in tileable:
+        lk = lowered[gk.name]
+        inputs = cg.seeded_inputs(lk.in_avals, cg.EQUIV_SEED)
+        whole = gen.generated_call(gk, *inputs, interpret=True)
+        for br in cg.AUTOTUNE_LADDER:
+            tiled = gen.generated_call(gk, *inputs, interpret=True,
+                                       block_rows=br)
+            for w, t in zip(whole, tiled):
+                assert np.allclose(np.asarray(w), np.asarray(t),
+                                   rtol=FLOAT_TOL, atol=FLOAT_TOL), \
+                    (gk.name, br)
+
+
+# ---------------------------------------------------------------------------
+# GEN001 / GEN002 / COST006: the static gates around the registry
+# ---------------------------------------------------------------------------
+def test_gen001_chain_outside_provable_set():
+    """A chain carrying an op outside LOWERABLE (argmax epilogue — the
+    fusion pass fuses it, mxgen refuses to prove it) does not lower:
+    src None + a GEN001 finding naming the prim."""
+    def f(x):
+        return jnp.argmax(x * 2.0 + 1.0)
+
+    closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((256,), jnp.float32))
+    tape = build_tape(closed)
+    report = analyze_tape_fusion(tape)
+    chains = [c for c in report.chains
+              if any(p.startswith("argmax") or p.startswith("reduce_and")
+                     or p.startswith("argmin") for p in c.prims)]
+    assert chains, "fusion pass no longer chains the argmax epilogue"
+    lk = cg.lower_chain(tape, chains[0], "_gen001_probe")
+    assert lk.src is None
+    assert any(f_.rule_id == "GEN001" for f_ in lk.findings)
+
+
+def test_gen002_unproven_registration_flagged():
+    """A registered kernel whose equivalence flag dropped is a GEN002
+    error in the lint sweep — and the clean registry stays clean."""
+    gen.build_shipped_generated()
+    assert cg.lint_generated_kernels() == []
+    gk = gen.GENERATED_KERNELS[SHIPPED_NAMES[0]]
+    try:
+        gk.equivalence_ok = False
+        findings = cg.lint_generated_kernels()
+        assert any(f.rule_id == "GEN002" and f.subject == gk.name
+                   for f in findings)
+        # and the rule is mutable via --disable like every other rule
+        assert cg.lint_generated_kernels(disable=("GEN002",)) == []
+    finally:
+        gk.equivalence_ok = True
+    assert cg.lint_generated_kernels() == []
+
+
+def test_cost006_lost_auto_declared_cost_entry():
+    """Deleting a generated kernel's KERNEL_COSTS entry is a COST006
+    gate error (the fusion.py registry diff), not a silent skip."""
+    from mxnet_tpu.analysis import lint_kernel_costs
+
+    gen.build_shipped_generated()
+    assert lint_kernel_costs() == []
+    name = SHIPPED_NAMES[-1]
+    saved = KERNEL_COSTS.pop(name)
+    try:
+        findings = lint_kernel_costs()
+        assert any(f.rule_id == "COST006" and f.subject == name
+                   for f in findings), findings
+    finally:
+        KERNEL_COSTS[name] = saved
+    assert lint_kernel_costs() == []
+
+
+# ---------------------------------------------------------------------------
+# the mislowering mutation seam through the UNMODIFIED budget gate
+# ---------------------------------------------------------------------------
+def test_mislowering_seam_kills_budget_gate(tmp_path):
+    """Acceptance: MXGEN_LOWER_EXACT=False (the emitter lowers `sub`
+    as `add` in the emitted text only) fails the unmodified
+    STATIC_BUDGETS.json gate rc=2 naming FUS001 — from a subprocess."""
+    script = tmp_path / "mutate.py"
+    script.write_text(
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu.analysis import codegen\n"
+        "codegen.MXGEN_LOWER_EXACT = False\n"
+        "from mxnet_tpu.analysis.__main__ import main\n"
+        "sys.exit(main(['--cost', '--budget', %r]))\n"
+        % os.path.join(REPO, "STATIC_BUDGETS.json"))
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=_cpu_env(), timeout=600)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "FUS001" in proc.stdout
+    assert "codegen_generated_kernels" in proc.stdout
+
+
+def test_codegen_chains_rows_pinned_in_budget_file():
+    """Every shipped chain's bytes-saved is pinned in the checked-in
+    STATIC_BUDGETS.json codegen_chains section, and matches the live
+    lowering exactly."""
+    with open(os.path.join(REPO, "STATIC_BUDGETS.json")) as f:
+        budget = json.load(f)
+    assert budget["schema_version"] >= 4
+    rows = budget["codegen_chains"]
+    assert rows == cg.shipped_chain_rows()
+    assert sorted(rows) == sorted(SHIPPED_NAMES)
+    assert all(v > 0 for v in rows.values())
+
+
+# ---------------------------------------------------------------------------
+# the autotune cache: seeded, replayed bitwise, rebuilt from corruption
+# ---------------------------------------------------------------------------
+_AUTOTUNE_SRC = """\
+import json, sys
+from mxnet_tpu.ops import generated_kernels as gen
+kernels = gen.build_shipped_generated(autotune=True)
+print(json.dumps({k.name: k.block_rows for k in kernels},
+                 sort_keys=True))
+"""
+
+
+def _run_autotune(cache_path):
+    proc = subprocess.run(
+        [sys.executable, "-c", _AUTOTUNE_SRC],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+        env=_cpu_env(MXTPU_MXGEN_CACHE=cache_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_autotune_cache_replayed_bitwise_across_runs(tmp_path):
+    """Same seed + same ladder: run 1 measures and writes the cache;
+    run 2 REPLAYS it — byte-identical cache file (no rewrite) and the
+    same block choice.  A corrupt cache file is rebuilt, not trusted."""
+    cache = str(tmp_path / "mxgen_cache.json")
+    picks1 = _run_autotune(cache)
+    tiled1 = {k: v for k, v in picks1.items() if v is not None}
+    assert tiled1, "no kernel was autotuned"
+    assert all(v in cg.AUTOTUNE_LADDER for v in tiled1.values())
+    with open(cache, "rb") as f:
+        bytes1 = f.read()
+    obj = json.loads(bytes1)
+    assert obj["schema"] == cg.AUTOTUNE_CACHE_SCHEMA
+    assert obj["seed"] == cg.AUTOTUNE_SEED
+    assert obj["ladder"] == list(cg.AUTOTUNE_LADDER)
+    assert set(tiled1) <= set(obj["kernels"])
+
+    picks2 = _run_autotune(cache)
+    assert picks2 == picks1
+    with open(cache, "rb") as f:
+        assert f.read() == bytes1     # replayed, never rewritten
+
+    # corruption is rebuilt from fresh measurements, never trusted
+    with open(cache, "w") as f:
+        f.write("{not json")
+    picks3 = _run_autotune(cache)
+    assert set(k for k, v in picks3.items() if v is not None) \
+        == set(tiled1)
+    with open(cache) as f:
+        rebuilt = json.load(f)
+    assert rebuilt["schema"] == cg.AUTOTUNE_CACHE_SCHEMA
+    assert all(rebuilt["kernels"][k]["block_rows"]
+               in list(cg.AUTOTUNE_LADDER) for k in tiled1)
+
+
+def test_autotune_mismatched_seed_cache_not_trusted(tmp_path):
+    """A cache written under a different seed/ladder is invalid — the
+    loader refuses it rather than replaying stale choices."""
+    cache = str(tmp_path / "stale.json")
+    with open(cache, "w") as f:
+        json.dump({"schema": cg.AUTOTUNE_CACHE_SCHEMA, "seed": 1,
+                   "ladder": [2, 4], "kernels": {"x": {
+                       "block_rows": 2, "t_ns": [1]}}}, f)
+    assert cg._load_cache(cache, cg.AUTOTUNE_SEED,
+                          cg.AUTOTUNE_LADDER) is None
+    assert cg._load_cache(cache, 1, (2, 4)) is not None
+
+
+# ---------------------------------------------------------------------------
+# CLI / schema-6 JSON / parse_log wiring
+# ---------------------------------------------------------------------------
+def test_codegen_cli_plan_and_json_section():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--cost",
+         "--codegen", "--model", "mlp_infer"],
+        capture_output=True, text=True, cwd=REPO, env=_cpu_env(),
+        timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "mxgen: 6 shipped chain(s) lowered" in proc.stdout
+    for name in SHIPPED_NAMES:
+        assert name in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--cost",
+         "--codegen", "--json", "--model", "mlp_infer"],
+        capture_output=True, text=True, cwd=REPO, env=_cpu_env(),
+        timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["schema_version"] == 6
+    plans = payload["codegen"]
+    assert [p["name"] for p in plans] == SHIPPED_NAMES
+    for p in plans:
+        assert p["lowerable"] and p["findings"] == []
+        assert p["bytes_saved"] > 0 and p["src"]
+    # without --codegen the section is absent (pre-6 consumers
+    # unaffected)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", "--cost", "--json",
+         "--model", "mlp_infer"],
+        capture_output=True, text=True, cwd=REPO, env=_cpu_env(),
+        timeout=600)
+    assert "codegen" not in json.loads(proc.stdout)
+
+
+def test_parse_log_reads_codegen_rows():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import parse_log
+    finally:
+        sys.path.pop(0)
+    doc = {"version": 1, "schema_version": 6, "findings": [],
+           "codegen": [{"name": "_gen_zero1_top2", "bytes_saved": 9,
+                        "lowerable": True}]}
+    rows = dict(parse_log.parse_analysis_json(doc))
+    assert rows["codegen.n_kernels"] == 1
+    assert rows["codegen._gen_zero1_top2.bytes_saved"] == 9
+    assert rows["codegen._gen_zero1_top2.lowerable"] == 1
+
+
+def test_bench_compare_gates_codegen_keys(tmp_path):
+    """The three codegen bench keys gate from their first two live
+    rounds: a collapsing speedup, shrinking modeled win, or numerics
+    drop all regress."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_compare
+    finally:
+        sys.path.pop(0)
+
+    def rec(n, **parsed):
+        path = tmp_path / ("BENCH_r%02d.json" % n)
+        path.write_text(json.dumps(
+            {"n": n, "cmd": "x", "rc": 0, "parsed": parsed}))
+        return str(path)
+
+    files = [
+        rec(1, codegen_generated_speedup_host=40.0,
+            codegen_modeled_bytes_saved_pct=84.0,
+            codegen_numerics_ok=1.0),
+        rec(2, codegen_generated_speedup_host=41.0,
+            codegen_modeled_bytes_saved_pct=84.2,
+            codegen_numerics_ok=1.0),
+    ]
+    ok = rec(3, codegen_generated_speedup_host=39.0,
+             codegen_modeled_bytes_saved_pct=84.1,
+             codegen_numerics_ok=1.0)
+    report = bench_compare.compare(files + [ok])
+    assert report["regressions"] == []
+    assert report["gates"]["codegen_generated_speedup_host"][
+        "verdict"] == "ok"
+    bad = rec(4, codegen_generated_speedup_host=20.0,
+              codegen_modeled_bytes_saved_pct=84.1,
+              codegen_numerics_ok=0.0)
+    report = bench_compare.compare(files + [ok, bad])
+    assert "codegen_generated_speedup_host" in report["regressions"]
+    assert "codegen_numerics_ok" in report["regressions"]
+    assert "codegen_modeled_bytes_saved_pct" not in report["regressions"]

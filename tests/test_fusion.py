@@ -572,7 +572,7 @@ def test_cli_fusion_json_schema4():
         capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
-    assert payload["schema_version"] == 5
+    assert payload["schema_version"] == 6
     fus = payload["fusion"]["fused_optimizer_update"]
     assert fus["n_chains"] >= 1 and fus["total_bytes_saved"] > 0
     assert fus["chains"][0]["kind"] == "elementwise"

@@ -757,7 +757,7 @@ def test_race_cli_json_section_schema5():
     proc = _run_cli("--race", "--json")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
-    assert payload["schema_version"] == 5
+    assert payload["schema_version"] == 6
     race = payload["race"]
     assert race["n_files"] >= 40
     assert race["hierarchy"] == sorted(race["hierarchy"])
@@ -789,10 +789,10 @@ def test_parse_log_reads_race_section_and_refuses_newer(tmp_path):
     assert rows['race.guard{attr="A._heap"}'] == "A._lock"
     assert rows['race.edge{outer="A._lock",inner="B._lock"}'] == "a.py:7"
     with pytest.raises(ValueError, match="newer"):
-        parse_log.parse_analysis_json(dict(doc, schema_version=6))
-    # end to end: a schema-6 document is refused through the CLI
+        parse_log.parse_analysis_json(dict(doc, schema_version=7))
+    # end to end: a schema-7 document is refused through the CLI
     newer = tmp_path / "newer.json"
-    newer.write_text(json.dumps(dict(doc, schema_version=6)))
+    newer.write_text(json.dumps(dict(doc, schema_version=7)))
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
          str(newer)], capture_output=True, text=True, timeout=60)

@@ -474,7 +474,7 @@ def test_shard_cli_json_section():
                     "zero1_mlp_train_step,ring_attention_fwd")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
-    assert payload["schema_version"] == 5
+    assert payload["schema_version"] == 6
     shard = payload["shard"]
     assert shard["rules"] == ["DST006", "DST007", "DST008", "DST009",
                               "DST010", "COST004"]
@@ -511,13 +511,13 @@ def test_parse_log_reads_and_refuses_analysis_schema(tmp_path):
     names = [n for n, _ in rows]
     assert 'finding.DST007{subject="w1"}' in names
     assert "cost.m.flops" in names and "shard.m.x" in names
-    # v5 (the mxrace race section) is understood...
-    parse_log.parse_analysis_json(dict(doc, schema_version=5))
+    # v6 (the mxgen codegen section) is understood...
+    parse_log.parse_analysis_json(dict(doc, schema_version=6))
     with pytest.raises(ValueError, match="newer"):
         parse_log.parse_analysis_json(dict(doc, schema_version=99))
-    # end to end through the CLI: a v6 document is refused (rc != 0)
+    # end to end through the CLI: a v7 document is refused (rc != 0)
     newer = tmp_path / "newer.json"
-    newer.write_text(json.dumps(dict(doc, schema_version=6)))
+    newer.write_text(json.dumps(dict(doc, schema_version=7)))
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
          str(newer)], capture_output=True, text=True, timeout=60)

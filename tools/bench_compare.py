@@ -63,6 +63,15 @@ Gated metrics (direction, tolerance)::
     fusion_numerics_ok                 higher, zero slack (fused must
                                        equal unfused Optimizer.update:
                                        1.0 or regression)
+    codegen_generated_speedup_host     higher, 10% relative (measured
+                                       op-at-a-time unfused chain vs
+                                       the mxgen generated kernel)
+    codegen_modeled_bytes_saved_pct    higher, 2% relative (modeled:
+                                       deterministic byte win of the
+                                       shipped generated chains)
+    codegen_numerics_ok                higher, zero slack (generated
+                                       kernel must equal the tape
+                                       reference: 1.0 or regression)
     decode_tokens_per_sec_host         higher, 10% relative (continuous
                                        batching through the paged KV
                                        cache on the 1-core host)
@@ -169,6 +178,16 @@ GATES = {
     "fused_optimizer_speedup_host": ("higher", 0.10),
     "modeled_fusion_bytes_saved_pct": ("higher", 0.02),
     "fusion_numerics_ok": ("higher", 0.0),
+    # codegen stage (r09 onward): the measured unfused-chain vs
+    # generated-kernel speedup on the 1-core host (10% rel — wall time
+    # on a noisy host); the mxgen lowering's modeled bytes-saved is
+    # deterministic (2% covers intentional chain retunes shipped with
+    # their PR, in lockstep with the codegen_chains budget rows); the
+    # generated-vs-tape-reference numerics contract is hard — any drop
+    # from 1.0 is a mislowering, zero slack
+    "codegen_generated_speedup_host": ("higher", 0.10),
+    "codegen_modeled_bytes_saved_pct": ("higher", 0.02),
+    "codegen_numerics_ok": ("higher", 0.0),
     # decode stage (r07 onward): continuous-batching token throughput is
     # wall time on the noisy 1-core host (10% rel); the cached-vs-full-
     # forward numerics contract and the zero-recompile/zero-page-leak

@@ -28,8 +28,9 @@ import sys
 METRICS_SCHEMA_VERSION = 1
 # the newest analysis-CLI (--json) schema this parser understands
 # (3 = the mxshard "shard" section, 4 = the mxfuse "fusion" section,
-# 5 = the mxrace "race" section; see docs/analysis.md)
-ANALYSIS_SCHEMA_VERSION = 5
+# 5 = the mxrace "race" section, 6 = the mxgen "codegen" section;
+# see docs/analysis.md)
+ANALYSIS_SCHEMA_VERSION = 6
 
 
 def parse(lines):
@@ -139,6 +140,14 @@ def parse_analysis_json(doc):
             rows.append(("race.edge{outer=\"%s\",inner=\"%s\"}"
                          % (edge.get("outer"), edge.get("inner")),
                          edge.get("site", "")))
+    codegen = doc.get("codegen")
+    if codegen:
+        rows.append(("codegen.n_kernels", len(codegen)))
+        for plan in codegen:
+            rows.append(("codegen.%s.bytes_saved" % plan.get("name"),
+                         plan.get("bytes_saved", 0)))
+            rows.append(("codegen.%s.lowerable" % plan.get("name"),
+                         int(bool(plan.get("lowerable")))))
     return rows
 
 

@@ -63,21 +63,28 @@ def main(argv=None):
                           title="update_budgets --check %s" % args.path))
         return 1 if findings else 0
 
+    from mxnet_tpu.analysis.codegen import shipped_chain_rows
+
     budgets = compute_budgets()
+    chains = shipped_chain_rows()
     payload = {
         "comment": "modeled static budgets (mxcost) — regenerate with "
                    "tools/update_budgets.py; gated in CI by "
                    "python -m mxnet_tpu.analysis --cost --budget",
         # 3: the sharded budget models (zero1_mlp_train_step,
-        # ring_attention_fwd) joined the gate
-        "schema_version": 3,
+        # ring_attention_fwd) joined the gate; 4: the mxgen
+        # codegen_chains section (per-chain modeled bytes-saved of the
+        # shipped generated kernels)
+        "schema_version": 4,
         "tolerance_pct": args.tolerance_pct,
         "models": budgets,
+        "codegen_chains": chains,
     }
     with open(args.path, "w") as f:
         json.dump(payload, f, indent=2, sort_keys=True)
         f.write("\n")
-    print("wrote %s (%d models)" % (args.path, len(budgets)))
+    print("wrote %s (%d models, %d generated chains)"
+          % (args.path, len(budgets), len(chains)))
     for name, row in sorted(budgets.items()):
         print("  %-18s flops=%d peak_hbm=%d transfer=%d collective=%d"
               % (name, row["flops"], row["peak_hbm_bytes"],
