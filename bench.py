@@ -391,6 +391,16 @@ def _run_benches(rec):
     if os.environ.get("MXTPU_BENCH_TRANSFORMER", "1") == "1":
         rec.stage("transformer", 150, _transformer_bench)
 
+    # -- pipeline-parallel micro-bench, host-only and BEFORE backend
+    # acquisition (r05 pattern): pp_modeled_bubble_frac +
+    # pp_modeled_pipe_axis_bytes (the pinned pp_transformer_train_step
+    # fixture's 1F1B schedule geometry), pp_tokens_per_sec_host (a real
+    # pipe=2 x model=2 x data=2 train loop on the virtual mesh) and
+    # pp_numerics_ok (pipelined losses == replicated baseline) stay
+    # live when the TPU is down — docs/pipeline.md
+    if os.environ.get("MXTPU_BENCH_PP", "1") == "1":
+        rec.stage("pipeline_parallel", 150, _pp_bench)
+
     # -- fusion-tier micro-bench, host-only and BEFORE backend
     # acquisition (r05 pattern): fused_optimizer_speedup_host (measured
     # unfused per-param update vs the fused flat Pallas kernel on the
@@ -789,6 +799,31 @@ def _transformer_bench():
         cwd=_REPO_DIR)
     if out.returncode != 0 or not out.stdout.strip():
         raise RuntimeError("transformer bench rc=%d: %s" % (
+            out.returncode, (out.stderr or out.stdout).strip()[-200:]))
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _pp_bench():
+    """pp_modeled_bubble_frac + pp_modeled_pipe_axis_bytes +
+    pp_tokens_per_sec_host + pp_numerics_ok through the pipeline-tier
+    harness (mxnet_tpu/transformer/pp_bench.py): the pinned
+    pp_transformer_train_step fixture's modeled 1F1B schedule, a real
+    pipe=2 x model=2 x data=2 train loop on an 8-device virtual host
+    mesh, and the pipelined-vs-replicated loss-parity contract.
+    JAX_PLATFORMS=cpu subprocess — same isolation contract as the
+    other host stages."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the pipe=2 x model=2 x data=2 mesh needs an 8-way virtual pool
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("MXTPU_CHAOS", None)
+    env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.transformer.pp_bench"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=_REPO_DIR)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError("pipeline bench rc=%d: %s" % (
             out.returncode, (out.stderr or out.stdout).strip()[-200:]))
     return json.loads(out.stdout.strip().splitlines()[-1])
 

@@ -777,6 +777,241 @@ def tp_runtime_checks(fixture_report, fixture_shard,
     return findings, extras
 
 
+# the pinned pp_transformer_train_step geometry: a 4-layer transformer
+# LM stage-partitioned over pipe=2 (2 blocks per stage), each stage
+# TP-sharded over model=2, batch-replicated over data=2 (8 declared
+# devices), running the microbatched 1F1B schedule at M=4 — modeled
+# bubble fraction (K-1)/(K-1+M) = 1/5, per-hop ppermute payload one
+# microbatch's residual activations (mb x t x d_model x 4 bytes)
+PP_GEOMETRY = {
+    "vocab_size": 64, "d_model": 32, "n_heads": 4, "n_layers": 4,
+    "d_ff": 64, "seq_len": 64, "microbatches": 4,
+    "batch": 8, "data": 2, "model": 2, "pipeline": 2,
+    "momentum": 0.9, "lr": 0.1,
+}
+
+
+def _pp_plan_and_program():
+    from ..parallel.mesh import MeshPlan
+    from ..transformer import TransformerLM, TransformerLMConfig
+
+    g = PP_GEOMETRY
+    cfg = TransformerLMConfig(
+        vocab_size=g["vocab_size"], d_model=g["d_model"],
+        n_heads=g["n_heads"], n_layers=g["n_layers"], d_ff=g["d_ff"],
+        seq_len=g["seq_len"], microbatches=g["microbatches"])
+    plan = MeshPlan(data=g["data"], model=g["model"],
+                    pipeline=g["pipeline"])
+    return plan, TransformerLM(cfg).mesh_program(plan), TransformerLM(cfg)
+
+
+def pp_transformer_train_step():
+    """The pipeline-parallel transformer train step (docs/pipeline.md)
+    as a static proof: the one ``parallel/pipeline.py`` spelling of the
+    1F1B schedule at the pinned ``PP_GEOMETRY``, traced hardware-free
+    over the declared ``pipe=2 x model=2 x data=2`` mesh.  The budget
+    row pins its metrics; the builder runs the mixed-axis DST lint plus
+    the two pipeline-specific rules — DST011 proves the schedule shape
+    (two full single-cycle rings over ``pipe`` scanned exactly
+    ``M + K - 1`` ticks, per-hop bytes equal to one microbatch's
+    activations, peak HBM holding the in-flight stash) and DST012
+    proves stage-local gradients are never reduced over ``pipe``
+    (flipping ``parallel/pipeline.py``'s ``PP_GRAD_ACCUM`` seam fails
+    the gate rc=2 with every stacked block parameter named) — and
+    gates the REAL ``DataParallelTrainer(mesh_plan=...)`` runtime tape
+    against the fixture (``pp_runtime_checks``)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import pipeline as pp
+    from ..transformer import step as tstep
+    from . import shard_prop as sp
+    from .cost import analyze_jaxpr, build_tape, unpriced_findings
+    from .findings import Finding
+
+    g = PP_GEOMETRY
+    plan, program, _ = _pp_plan_and_program()
+    mesh = sp.MeshSpec(plan.axis_sizes())
+    n = len(program.param_names)
+    counts = [1] * n     # one momentum leaf per parameter
+    step = tstep.build_replica_step(
+        program, tstep.sgd_momentum_update(g["momentum"]), counts)
+    train_avals = tuple(
+        jax.ShapeDtypeStruct(program.local_shape(nm), jnp.float32)
+        for nm in program.param_names)
+    state_avals = train_avals       # momentum mirrors each param shard
+    b_local, t_local = program.local_batch_shape(g["batch"])
+    xs = jax.ShapeDtypeStruct((b_local, t_local), jnp.int32)
+    ys = jax.ShapeDtypeStruct((b_local, t_local), jnp.int32)
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    closed = jax.make_jaxpr(step, axis_env=plan.axis_env())(
+        train_avals, state_avals, xs, ys, key,
+        jnp.float32(g["lr"]), jnp.int32(1))
+
+    host = [2 * n, 2 * n + 1]
+    report = analyze_jaxpr(closed, axis_sizes=plan.axis_sizes(),
+                           donated_invars=list(range(2 * n)),
+                           host_invars=host)
+    report.transfer_d2h_bytes = 4    # only the loss comes back
+
+    shard_dims = {}
+    for i, nm in enumerate(program.param_names):
+        spec = program.partition_spec(nm)
+        dims = {d: (e,) if isinstance(e, str) else tuple(e)
+                for d, e in enumerate(spec) if e is not None}
+        if dims:
+            shard_dims[i] = dims
+            shard_dims[n + i] = dims
+    findings = sp.lint_sharded_step(
+        closed, mesh, data_axes=plan.batch_axes(),
+        varying_invars=host, shard_dims=shard_dims,
+        param_outvars=list(range(1, 1 + n)),
+        param_names=list(program.param_names),
+        subject="pp_transformer_train_step")
+
+    k, m = g["pipeline"], g["microbatches"]
+    ticks = pp.pipeline_ticks(k, m)
+    hop_bytes = (b_local // m) * t_local * g["d_model"] * 4
+    stash_bytes = b_local * t_local * g["d_model"] * 4
+    pipe_sharded = [
+        i for i, nm in enumerate(program.param_names)
+        if any(e == "pipe" or (isinstance(e, tuple) and "pipe" in e)
+               for e in program.partition_spec(nm))]
+    findings += sp.lint_pipeline_step(
+        closed, plan.axis_sizes(), m,
+        stash_bytes=stash_bytes, peak_hbm_bytes=report.peak_hbm_bytes,
+        param_outvars=list(range(1, 1 + n)),
+        param_names=list(program.param_names),
+        pipe_sharded=pipe_sharded,
+        subject="pp_transformer_train_step")
+    findings += unpriced_findings(report,
+                                  subject="pp_transformer_train_step")
+
+    # the per-hop byte pin: every scanned stage-boundary ppermute must
+    # carry EXACTLY one microbatch's activations — a widened carry
+    # (stashing extra state in the ring) silently multiplies the wire
+    # traffic every tick
+    tape = build_tape(closed, axis_sizes=plan.axis_sizes())
+    for op in tape.ops:
+        if op.prim != "ppermute" or "pipe" not in op.axes:
+            continue
+        payload = sum(
+            int(np.prod(tape.avals[i].shape))
+            * tape.avals[i].dtype.itemsize for i in op.in_ids)
+        if payload != hop_bytes:
+            findings.append(Finding(
+                "DST011", "pp_transformer_train_step",
+                "stage-boundary ppermute carries %d bytes per hop but "
+                "the pinned per-hop payload is %d (= one microbatch's "
+                "activations, mb x t x d_model x 4): the ring carry "
+                "has widened and the modeled pipe-axis traffic no "
+                "longer matches the schedule" % (payload, hop_bytes)))
+
+    shard = sp.collective_schedule(closed, mesh,
+                                   subject="pp_transformer_train_step")
+    per_axis = shard.collective_bytes_per_axis
+    shard.extras.update({
+        "pp_geometry": dict(PP_GEOMETRY),
+        "pp_modeled_bubble_frac": pp.bubble_fraction(k, m),
+        "pp_microbatches": int(m),
+        "pp_ticks": int(ticks),
+        "pp_hop_bytes": int(hop_bytes),
+        "pp_stash_bytes": int(stash_bytes),
+        "pp_modeled_pipe_axis_bytes": int(per_axis.get("pipe", 0)),
+        "pp_modeled_model_axis_bytes": int(per_axis.get("model", 0)),
+        "pp_modeled_data_axis_bytes": int(per_axis.get("data", 0)),
+    })
+    # the RUNTIME half: the real DataParallelTrainer(mesh_plan=...)
+    # tape must satisfy the same budget
+    rt_findings, rt_extras = pp_runtime_checks(report, shard)
+    findings += rt_findings
+    shard.extras.update(rt_extras)
+    return report, findings, shard
+
+
+def pp_runtime_checks(fixture_report, fixture_shard,
+                      tolerance_pct=10.0):
+    """Gate the ``DataParallelTrainer(mesh_plan=...)`` REAL pipelined
+    step tape against the ``pp_transformer_train_step`` fixture: the
+    trainer's ``mesh_report`` must match the pinned metrics within
+    tolerance, carry the same DST-clean 1F1B schedule, move EXACTLY
+    the fixture's per-axis collective bytes over ``pipe`` and
+    ``model``, and report the same per-hop payload and bubble fraction
+    — the runtime and the proven spelling can never drift."""
+    from ..parallel.mesh import MeshPlan
+    from ..parallel.trainer import DataParallelTrainer
+    from .findings import Finding
+
+    g = PP_GEOMETRY
+    tol = float(tolerance_pct) / 100.0
+    plan, _, block = _pp_plan_and_program()
+    findings = []
+    try:
+        trainer = DataParallelTrainer(
+            block, None, "sgd",
+            {"learning_rate": g["lr"], "momentum": g["momentum"]},
+            mesh_plan=MeshPlan(data=g["data"], model=g["model"],
+                               pipeline=g["pipeline"]))
+        rt_report, rt_findings, rt_shard = trainer.mesh_report(
+            data_shape=(g["batch"], g["seq_len"]))
+    except Exception as e:
+        findings.append(Finding(
+            "COST001", "pp_transformer_train_step.runtime",
+            "the pipelined mesh-tier trainer no longer traces: %s: %s"
+            % (type(e).__name__, str(e)[:200])))
+        return findings, {}
+    findings += rt_findings
+
+    fx = fixture_report.as_dict()
+    rt = rt_report.as_dict()
+    for metric in ("flops", "transcendentals", "transfer_bytes",
+                   "collective_bytes"):
+        want, got = float(fx[metric]), float(rt[metric])
+        if want and abs(got - want) > tol * want:
+            findings.append(Finding(
+                "COST001", "pp_transformer_train_step.runtime.%s"
+                % metric,
+                "the pipelined trainer's REAL step tape models %s = %d "
+                "but the budgeted fixture pins %d (tolerance %.0f%%): "
+                "the runtime and the proven spelling have drifted "
+                "apart" % (metric, int(got), int(want), tol * 100)))
+    if rt["peak_hbm_bytes"] > fx["peak_hbm_bytes"] * (1 + tol):
+        findings.append(Finding(
+            "COST001", "pp_transformer_train_step.runtime.peak_hbm_bytes",
+            "the pipelined trainer's REAL step models peak HBM %d, "
+            "over the budgeted fixture's %d (tolerance %.0f%%)"
+            % (int(rt["peak_hbm_bytes"]), int(fx["peak_hbm_bytes"]),
+               tol * 100)))
+
+    fx_axis = fixture_shard.collective_bytes_per_axis
+    rt_axis = rt_shard.collective_bytes_per_axis
+    for axis in ("pipe", "model"):
+        if fx_axis.get(axis, 0) != rt_axis.get(axis, 0):
+            findings.append(Finding(
+                "COST001",
+                "pp_transformer_train_step.runtime.%s_axis_bytes" % axis,
+                "runtime %s-axis collective bytes (%d) differ from the "
+                "fixture's (%d): the pipelined trainer's step moves "
+                "different wire traffic than the proven 1F1B schedule"
+                % (axis, rt_axis.get(axis, 0), fx_axis.get(axis, 0))))
+    for key in ("pp_hop_bytes", "pp_modeled_bubble_frac"):
+        if rt_shard.extras.get(key) != fixture_shard.extras.get(key):
+            findings.append(Finding(
+                "COST001", "pp_transformer_train_step.runtime.%s" % key,
+                "runtime %s (%r) differs from the fixture's (%r): the "
+                "trainer no longer runs the pinned schedule geometry"
+                % (key, rt_shard.extras.get(key),
+                   fixture_shard.extras.get(key))))
+    extras = {
+        "runtime_peak_hbm_bytes": int(rt["peak_hbm_bytes"]),
+        "runtime_collective_bytes": int(rt["collective_bytes"]),
+        "runtime_pipe_axis_bytes": int(rt_axis.get("pipe", 0)),
+        "runtime_model_axis_bytes": int(rt_axis.get("model", 0)),
+    }
+    return findings, extras
+
+
 # the pinned fused-optimizer geometry (docs/fusion.md): parameter
 # shapes summing to exactly 32768 f32 elements — a whole number of
 # (256, 128) kernel tiles, so the flat space pads by ZERO and the
@@ -1261,6 +1496,7 @@ BUDGET_MODELS = {
     "ring_attention_fwd": ring_attention_fwd,
     "ulysses_attention": ulysses_attention,
     "tp_transformer_train_step": tp_transformer_train_step,
+    "pp_transformer_train_step": pp_transformer_train_step,
     "fused_optimizer_update": fused_optimizer_update,
     "decode_step": decode_step,
     "codegen_generated_kernels": codegen_generated_kernels,

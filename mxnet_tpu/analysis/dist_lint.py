@@ -354,7 +354,8 @@ def dist_summary(findings, axis_sizes=None, params_checked=0):
     """Machine-readable ``dist`` section for the CLI ``--json`` output."""
     return {
         "rules": ["DST001", "DST002", "DST003", "DST004", "DST005",
-                  "DST006", "DST007", "DST008", "DST009", "DST010"],
+                  "DST006", "DST007", "DST008", "DST009", "DST010",
+                  "DST011", "DST012"],
         "axis_sizes": {k: int(v)
                        for k, v in sorted((axis_sizes or {}).items())},
         "params_checked": int(params_checked),

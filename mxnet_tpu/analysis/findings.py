@@ -172,6 +172,22 @@ RULES = {
                       "body: operand shardings disagree, so GSPMD "
                       "inserts a hidden all_to_all/all_gather every "
                       "step that no budget accounts for"),
+    # pipeline-parallel rules (mxnet_tpu/analysis/shard_prop.py,
+    # lint_pipeline_step — docs/pipeline.md)
+    "DST011": (ERROR, "pipeline schedule shape broken: the step must "
+                      "ppermute activations forward and cotangents "
+                      "backward over 'pipe' as full single-cycle rings "
+                      "scanned exactly M+K-1 ticks, and modeled peak "
+                      "HBM must hold the in-flight microbatch "
+                      "activation stash (M x microbatch activations) — "
+                      "otherwise the modeled bubble/memory story "
+                      "misstates the schedule"),
+    "DST012": (ERROR, "gradient of a stage-local (pipe-sharded) "
+                      "parameter flows through a reduction over the "
+                      "'pipe' axis: stages hold DIFFERENT layers, so "
+                      "the update mixes gradients of unrelated "
+                      "parameters — reduce pipeline gradients over the "
+                      "batch axes only"),
     # cost pass / budget gate (mxnet_tpu/analysis/cost.py, __main__)
     "COST001": (ERROR, "modeled cost metric exceeds its STATIC_BUDGETS "
                        "entry beyond tolerance (or a budgeted model no "

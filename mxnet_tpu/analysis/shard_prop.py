@@ -534,6 +534,22 @@ def _replica_collect(tape, mesh, init_states, data_axes, on_reduce=None):
                     src.dims[p] for p in perm))
             else:
                 new = merged
+        elif op.prim == "squeeze" and in_states:
+            # the stage-stacked parameter access pattern
+            # (``blk_wq[j]`` -> slice + squeeze of the leading pipe
+            # dim): surviving dims keep their layout, or the model-axis
+            # sharding of every stacked layer would degrade to unknown
+            # and the row-parallel psums would look like duplicate
+            # reductions (DST008)
+            src = in_states[0]
+            sq = set(op.params.get("dimensions", ()))
+            dims = tuple(axs for d, axs in enumerate(src.dims)
+                         if d not in sq)
+            if len(src.dims) == _rank_of(tape.avals.get(op.in_ids[0])) \
+                    and len(dims) == out_rank:
+                new = merged.clone(dims=dims)
+            else:
+                new = merged
         elif op.prim == "broadcast_in_dim" and in_states:
             src = in_states[0]
             bdims = op.params.get("broadcast_dimensions", ())
@@ -722,12 +738,18 @@ def lint_sharded_step(closed_jaxpr, mesh, data_axes=("data",),
 
 
 def lint_ring_schedule(closed_jaxpr, axis, axis_size, disable=(),
-                       subject="<ring>"):
+                       subject="<ring>", outer_scale=1):
     """DST009: every scanned ``ppermute`` over ``axis`` must be a full
     single-cycle ring whose hop count equals the axis size — that is
     exactly when the modeled bytes (hops × chunk) match the ring formula
-    (K × chunk) and every chunk visits every rank once."""
+    (K × chunk) and every chunk visits every rank once.
+
+    ``outer_scale``: how many times an ENCLOSING scan replays the whole
+    ring (the pipeline schedule runs one full attention ring per tick —
+    ``M + K_pipe - 1`` of them), so the expected hop count is
+    ``axis_size × outer_scale``."""
     k = int(axis_size)
+    outer = int(outer_scale)
     tape = build_tape(closed_jaxpr, axis_sizes={axis: k})
     findings = []
     for op in tape.ops:
@@ -763,16 +785,133 @@ def lint_ring_schedule(closed_jaxpr, axis, axis_size, disable=(),
                 "chunk never reaches some rank, so the attention output "
                 "silently drops context" % (axis, k, perm, k)))
             continue
-        if op.scale != k:
+        if op.scale != k * outer:
             findings.append(Finding(
                 "DST009", subject,
                 "ring over %r scans %d hop(s) but the axis has %d "
-                "members: modeled collective bytes %d do not match the "
-                "ring formula %d (= K x %d-byte chunk) — the ring never "
-                "completes (or over-rotates) and the modeled budget "
-                "misstates the wire traffic"
-                % (axis, op.scale, k, op.scale * chunk, k * chunk,
-                   chunk)))
+                "members (x%d outer replays): modeled collective bytes "
+                "%d do not match the ring formula %d (= K x %d-byte "
+                "chunk) — the ring never completes (or over-rotates) "
+                "and the modeled budget misstates the wire traffic"
+                % (axis, op.scale, k, outer, op.scale * chunk,
+                   k * outer * chunk, chunk)))
+    return filter_findings(findings, disable)
+
+
+def lint_pipeline_step(closed_jaxpr, axis_sizes, n_micro,
+                       stash_bytes=None, peak_hbm_bytes=None,
+                       param_outvars=(), param_names=(),
+                       pipe_sharded=(), disable=(),
+                       subject="<pipeline>"):
+    """The two pipeline-specific bug classes (docs/pipeline.md).
+
+    **DST011 — schedule shape / activation-stash liveness.**  The 1F1B
+    step must move activations forward and cotangents backward over
+    ``pipe`` as full single-cycle rings scanned exactly ``M + K - 1``
+    ticks (one hop per tick; the wrap-around edge carries masked
+    warm-up garbage) — any other shape means the modeled per-hop bytes
+    and bubble fraction ``(K-1)/(K-1+M)`` describe a schedule the
+    program does not run.  And the modeled peak HBM must hold the
+    in-flight microbatch stash (``stash_bytes``, nominally M x one
+    microbatch's residual activations): a tape that frees activations
+    between ticks is under-modeling exactly the memory pipelining
+    exists to spend.
+
+    **DST012 — gradients reduced over ``pipe``.**  Stages hold
+    DIFFERENT layers, so ``pipe`` is never a batch axis for stage-local
+    parameters: any reduction over ``pipe`` (psum/pmean/pmax/
+    reduce-scatter) whose result flows into a pipe-sharded parameter's
+    new value mixes gradients of unrelated layers.  Found by taint
+    propagation over the inlined tape: seed at every reduction over
+    ``pipe``, flow forward through op outputs, flag tainted
+    pipe-sharded param outvars.  (Pipe-REPLICATED params — embeddings,
+    final norm, head — legitimately complete partial grads with one
+    psum over ``pipe``; they are not pipe-sharded, so they never
+    flag.)  Only meaningful on the per-param (non-ZeRO) spelling: the
+    ZeRO-1 flat concat mixes every parameter into one vector, where
+    the replicated params' legitimate psum would taint all of it."""
+    k = int(axis_sizes.get("pipe", 1))
+    m = int(n_micro)
+    ticks = m + k - 1
+    tape = build_tape(closed_jaxpr, axis_sizes=axis_sizes)
+    findings = []
+
+    pp_ops = [op for op in tape.ops
+              if op.prim == "ppermute" and "pipe" in op.axes]
+    if len(pp_ops) < 2:
+        findings.append(Finding(
+            "DST011", subject,
+            "pipeline step has %d ppermute(s) over 'pipe' — the 1F1B "
+            "schedule needs at least two scanned rings (activations "
+            "forward, cotangents backward); the stage boundaries are "
+            "not being crossed the modeled way" % len(pp_ops)))
+    for op in pp_ops:
+        perm = tuple(tuple(p) for p in op.params.get("perm", ()))
+        mapping = dict(perm)
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        covered = (len(set(srcs)) == len(srcs)
+                   and len(set(dsts)) == len(dsts)
+                   and set(srcs) == set(range(k)) == set(dsts))
+        single_cycle = False
+        if covered:
+            seen, cur = set(), 0
+            while cur not in seen:
+                seen.add(cur)
+                cur = mapping.get(cur, cur)
+            single_cycle = len(seen) == k
+        if not covered or not single_cycle:
+            findings.append(Finding(
+                "DST011", subject,
+                "pipeline ppermute over 'pipe' (size %d) has perm %r "
+                "which is not one full single-cycle ring: some stage's "
+                "activation never reaches its successor" % (k, perm)))
+            continue
+        if op.scale != ticks:
+            findings.append(Finding(
+                "DST011", subject,
+                "pipeline ppermute over 'pipe' scans %d tick(s) but "
+                "the 1F1B schedule of %d microbatches over %d stages "
+                "runs %d (= M + K - 1): the modeled per-hop bytes and "
+                "the bubble fraction (K-1)/(K-1+M) describe a "
+                "different schedule" % (op.scale, m, k, ticks)))
+
+    if stash_bytes and peak_hbm_bytes is not None \
+            and int(peak_hbm_bytes) < int(stash_bytes):
+        findings.append(Finding(
+            "DST011", subject,
+            "modeled peak HBM %d bytes is below the in-flight "
+            "activation stash %d bytes (%d microbatches x one "
+            "microbatch's residual activations): the memory story "
+            "does not reflect the microbatches the schedule keeps "
+            "live for the backward pass"
+            % (int(peak_hbm_bytes), int(stash_bytes), m)))
+
+    if param_outvars:
+        reducers = ("psum", "pmean", "pmax", "pmin", "psum_scatter",
+                    "reduce_scatter", "all_to_all")
+        tainted = set()
+        for op in tape.ops:
+            seeded = (op.prim in reducers and "pipe" in op.axes)
+            if seeded or any(i in tainted for i in op.in_ids):
+                tainted.update(op.out_ids)
+        pipe_sharded = set(pipe_sharded)
+        names = list(param_names) or [
+            "param[%d]" % i for i in range(len(param_outvars))]
+        for pi, ov in enumerate(param_outvars):
+            if pi not in pipe_sharded:
+                continue
+            if 0 <= ov < len(tape.outvar_ids) \
+                    and tape.outvar_ids[ov] in tainted:
+                findings.append(Finding(
+                    "DST012", names[pi],
+                    "new value of %r (stage-local, sharded over "
+                    "'pipe') is downstream of a reduction over the "
+                    "'pipe' axis: stages hold DIFFERENT layers, so "
+                    "this update mixes gradients of unrelated "
+                    "parameters across stages — reduce stage-local "
+                    "gradients over the batch axes only"
+                    % (names[pi],)))
     return filter_findings(findings, disable)
 
 
@@ -1058,7 +1197,7 @@ def shard_summary(reports, findings=()):
     shard-rule findings."""
     return {
         "rules": ["DST006", "DST007", "DST008", "DST009", "DST010",
-                  "COST004"],
+                  "DST011", "DST012", "COST004"],
         "reports": {name: (rep.as_dict() if hasattr(rep, "as_dict")
                            else rep)
                     for name, rep in sorted((reports or {}).items())},

@@ -16,12 +16,15 @@ distribution stack (SURVEY.md §2.2):
   (``jax.distributed``) with the same mesh spanning DCN.
 
 New-capability axes the reference lacks (documented in SURVEY.md §2.2):
-tensor parallelism (shard params on a ``model`` axis) and sequence
+tensor parallelism (shard params on a ``model`` axis), sequence
 parallelism — ring attention over ``ppermute`` and Ulysses all-to-all
-(``ring_attention.py``) — and the ZeRO-1 sharded optimizer runtime
-(``zero.py``, ``DataParallelTrainer(zero=1)``, docs/elastic.md).
+(``ring_attention.py``) — the ZeRO-1 sharded optimizer runtime
+(``zero.py``, ``DataParallelTrainer(zero=1)``, docs/elastic.md), and
+pipeline parallelism — stage-partitioned blocks over a ``pipe`` axis
+running the microbatched 1F1B schedule (``pipeline.py``,
+``MeshPlan(pipeline=K)``, docs/pipeline.md).
 """
-from . import zero
+from . import pipeline, zero
 from .mesh import (make_mesh, data_parallel_mesh, local_device_count,
                    MeshPlan)
 from .trainer import DataParallelTrainer
@@ -31,7 +34,8 @@ from .ring_attention import (ring_attention, ulysses_attention,
                              ulysses_attention_sharded)
 
 __all__ = [
-    "zero", "make_mesh", "data_parallel_mesh", "local_device_count",
+    "pipeline", "zero", "make_mesh", "data_parallel_mesh",
+    "local_device_count",
     "MeshPlan", "DataParallelTrainer", "functionalize_forward",
     "functional_optimizer_update", "ring_attention", "ulysses_attention",
     "local_attention", "ring_attention_sharded", "ulysses_attention_sharded",

@@ -19,7 +19,7 @@ from ..ndarray import NDArray
 from ..ndarray import ndarray as _ndmod
 
 __all__ = ["functionalize_forward", "functional_optimizer_update",
-           "state_to_raw", "tree_raw"]
+           "accumulate_grads", "state_to_raw", "tree_raw"]
 
 
 def tree_raw(x):
@@ -112,6 +112,51 @@ def functionalize_forward(run, params_by_name, train_names, aux_names,
     pure.mut_names = None
     pure.single = True
     return pure
+
+
+def accumulate_grads(grad_of, train_vals, x, y, n_acc):
+    """Left-fold microbatch gradient accumulation — the ONE spelling
+    behind ``DataParallelTrainer(grad_accum=N)`` (docs/distributed.md),
+    shared by the replicated jitted step, its per-replica analysis twin,
+    and the ZeRO-1 grads half so runtime and analyzed tape cannot drift.
+
+    ``grad_of(train_vals, x_micro, y_micro) -> ((loss, muts), grads)``
+    is the per-microbatch ``value_and_grad`` closure.  The batch's
+    leading dim splits into ``n_acc`` equal microbatches scanned in
+    order, gradients summed left-to-right: the accumulated gradient is
+    bitwise equal to summing independently computed per-microbatch
+    gradients in the same order (fp addition is deterministic — only
+    the grouping is pinned; it is NOT bitwise vs the large-batch step,
+    whose loss mean reassociates the sum).
+
+    Returns ``(grads_sum, loss_sum, muts_stack)``: the caller divides
+    by ``n_acc`` for the batch mean and reduces the ``(n_acc,)``-stacked
+    mutation leaves (the trainer averages them, the batch-stat analogue
+    of the loss mean).
+    """
+    n = int(n_acc)
+    b = x.shape[0]
+    if n <= 1:
+        (loss_val, muts), grads = grad_of(train_vals, x, y)
+        return grads, loss_val, tuple(m[None] for m in muts)
+    if b % n:
+        raise ValueError(
+            "grad_accum=%d does not divide the (per-replica) batch %d: "
+            "microbatches must be equal-sized for the accumulated mean "
+            "to equal the batch mean" % (n, b))
+    xm = x.reshape((n, b // n) + tuple(x.shape[1:]))
+    ym = y.reshape((n, b // n) + tuple(y.shape[1:]))
+
+    def body(carry, xy):
+        acc, loss_sum = carry
+        (loss_val, muts), grads = grad_of(train_vals, xy[0], xy[1])
+        acc = tuple(a + g for a, g in zip(acc, grads))
+        return (acc, loss_sum + loss_val), muts
+
+    zeros = tuple(jnp.zeros_like(w) for w in train_vals)
+    (grads_sum, loss_sum), muts_stack = jax.lax.scan(
+        body, (zeros, jnp.zeros((), jnp.float32)), (xm, ym))
+    return grads_sum, loss_sum, muts_stack
 
 
 def functional_optimizer_update(opt, index, weight_val, grad_val, state_raw,

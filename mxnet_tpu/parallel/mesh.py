@@ -45,7 +45,8 @@ def data_parallel_mesh(num=None):
 
 
 class MeshPlan:
-    """A 2-3D mesh as pure declaration: ``data × model × sequence``.
+    """A 2-4D mesh as pure declaration:
+    ``data × model × sequence × pipe``.
 
     The multi-axis tier's single source of truth (docs/transformer.md):
     the same plan drives the runtime ``Mesh`` construction, the
@@ -56,19 +57,27 @@ class MeshPlan:
     collective — a ``MeshPlan(model=2)`` program contains no sequence
     collectives at all, not degenerate 1-member ones.
 
+    ``pipeline=K`` arms the fourth axis (docs/pipeline.md): transformer
+    blocks are stage-partitioned over ``pipe`` and the step runs the
+    microbatched 1F1B schedule of ``parallel/pipeline.py`` with
+    ``ppermute`` stage-boundary activation transfers.  ``pipe`` is
+    never a batch axis: gradients of stage-local parameters are
+    reduced over ``data``/``sequence`` only (DST012).
+
     ``data=None`` defers the data-axis size to :meth:`resolve` (fill
-    with whatever devices remain after ``model × sequence``), so a plan
-    can be declared before a backend exists — the analysis path never
-    needs devices.
+    with whatever devices remain after ``model × sequence × pipe``), so
+    a plan can be declared before a backend exists — the analysis path
+    never needs devices.
     """
 
-    AXES = ("data", "model", "sequence")
+    AXES = ("data", "model", "sequence", "pipe")
 
-    def __init__(self, data=None, model=1, sequence=1):
+    def __init__(self, data=None, model=1, sequence=1, pipeline=1):
         self.data = None if data is None else int(data)
         self.model = int(model)
         self.sequence = int(sequence)
-        for name in ("data", "model", "sequence"):
+        self.pipe = int(pipeline)
+        for name in ("data", "model", "sequence", "pipe"):
             v = getattr(self, name)
             if v is not None and v < 1:
                 raise ValueError("MeshPlan axis %r must be >= 1, got %r"
@@ -76,37 +85,45 @@ class MeshPlan:
 
     @classmethod
     def coerce(cls, plan):
-        """A MeshPlan from a MeshPlan / dict / (data, model, sequence)
-        tuple — the ``DataParallelTrainer(mesh_plan=...)`` accessor."""
+        """A MeshPlan from a MeshPlan / dict /
+        (data, model, sequence[, pipeline]) tuple — the
+        ``DataParallelTrainer(mesh_plan=...)`` accessor.  Dicts accept
+        ``pipeline`` (the constructor kwarg) or ``pipe`` (the axis
+        name) interchangeably."""
         if plan is None or isinstance(plan, cls):
             return plan
         if isinstance(plan, dict):
-            bad = set(plan) - set(cls.AXES)
+            plan = dict(plan)
+            if "pipe" in plan:
+                plan["pipeline"] = plan.pop("pipe")
+            bad = set(plan) - {"data", "model", "sequence", "pipeline"}
             if bad:
                 raise ValueError("MeshPlan axes are %r, got unknown %r"
                                  % (cls.AXES, sorted(bad)))
             return cls(**plan)
-        if isinstance(plan, (tuple, list)) and len(plan) == 3:
+        if isinstance(plan, (tuple, list)) and len(plan) in (3, 4):
             return cls(*plan)
         raise ValueError("mesh_plan must be a MeshPlan, a "
-                         "{data/model/sequence: size} dict or a "
-                         "(data, model, sequence) tuple, got %r" % (plan,))
+                         "{data/model/sequence/pipeline: size} dict or "
+                         "a (data, model, sequence[, pipeline]) tuple, "
+                         "got %r" % (plan,))
 
     # -- declaration ------------------------------------------------------
     def resolve(self, n_devices):
         """Fill a deferred data-axis size from the device count.  Returns
         a fully-specified plan; raises when the device pool does not
         factor."""
-        ms = self.model * self.sequence
+        ms = self.model * self.sequence * self.pipe
         if self.data is not None:
             return self
         if n_devices % ms:
             raise ValueError(
-                "cannot resolve MeshPlan(model=%d, sequence=%d) over %d "
-                "devices: model*sequence=%d does not divide the pool"
-                % (self.model, self.sequence, n_devices, ms))
+                "cannot resolve MeshPlan(model=%d, sequence=%d, "
+                "pipeline=%d) over %d devices: model*sequence*pipe=%d "
+                "does not divide the pool"
+                % (self.model, self.sequence, self.pipe, n_devices, ms))
         return MeshPlan(data=n_devices // ms, model=self.model,
-                        sequence=self.sequence)
+                        sequence=self.sequence, pipeline=self.pipe)
 
     def size(self, axis):
         v = getattr(self, axis)
@@ -114,7 +131,8 @@ class MeshPlan:
 
     @property
     def total(self):
-        return self.size("data") * self.model * self.sequence
+        return (self.size("data") * self.model * self.sequence
+                * self.pipe)
 
     def present(self, axis):
         """True when ``axis`` survives collapse (size > 1)."""
@@ -159,17 +177,18 @@ class MeshPlan:
 
     def describe(self):
         return {"data": self.size("data"), "model": self.model,
-                "sequence": self.sequence,
+                "sequence": self.sequence, "pipeline": self.pipe,
                 "axes": list(self.axis_names())}
 
     def __repr__(self):
-        return "MeshPlan(data=%r, model=%d, sequence=%d)" % (
-            self.data, self.model, self.sequence)
+        return "MeshPlan(data=%r, model=%d, sequence=%d, pipeline=%d)" % (
+            self.data, self.model, self.sequence, self.pipe)
 
     def __eq__(self, other):
         return (isinstance(other, MeshPlan) and self.data == other.data
                 and self.model == other.model
-                and self.sequence == other.sequence)
+                and self.sequence == other.sequence
+                and self.pipe == other.pipe)
 
 
 def replicated(mesh):

@@ -1,0 +1,155 @@
+"""Microbatched 1F1B pipeline schedule over the ``pipe`` mesh axis.
+
+The fourth ``MeshPlan`` axis (docs/pipeline.md): transformer blocks are
+stage-partitioned over ``pipe`` — each stage holds ``n_layers / K``
+blocks as the leading dim of stacked ``blk_*`` parameters — and one
+train step runs the batch as ``M`` microbatches through a scanned
+schedule whose only cross-stage traffic is one ``ppermute`` hop of the
+activation per tick.
+
+The schedule is spelled ONCE here, as :func:`pipeline_loss`, and
+consumed by both the jitted ``shard_map`` runtime and the
+``make_jaxpr(axis_env)`` analysis (the ``parallel/zero.py``
+discipline), so the executed schedule and the modeled one — per-hop
+ppermute bytes, tick count ``M + K - 1``, bubble fraction
+``(K-1)/(K-1+M)`` — can never drift.
+
+How the single-program spelling works: every stage runs the SAME
+scanned loop for ``M + K - 1`` ticks.  Stage 0 ingests microbatch
+``min(t, M-1)`` through ``embed_fn`` at tick ``t`` (masked by
+``axis_index == 0``); every other stage takes the activation its
+predecessor ``ppermute``'d last tick; the last stage scores microbatch
+``t - (K-1)`` through ``head_fn`` once ``t >= K-1`` (masked likewise).
+Warm-up/drain ticks run on zero activations and are masked out of the
+loss — that wasted work is exactly the pipeline bubble, and because the
+mask is data-independent the modeled fraction is the classic
+``(K-1)/(K-1+M)``.  Autodiff of the scan yields the reverse schedule
+for free: the backward pass replays the ticks with the inverse
+``ppermute`` ring carrying cotangents upstream, and the stacked scan
+residuals ARE the activation stash — peak HBM grows with the in-flight
+microbatch count, which is what the DST011 liveness rule pins.
+
+Gradients: stage-local (``blk_*``) parameter gradients are complete per
+stage and are reduced over the batch axes ONLY — a reduction over
+``pipe`` would mix gradients of DIFFERENT layers (DST012).  The few
+pipe-replicated parameters (embedding, final norm, output head) get
+partial gradients on the stages that touch them and exact zeros
+elsewhere, so their one ``psum`` over ``pipe`` in
+:func:`reduce_replicated_grads` completes them.
+"""
+from __future__ import annotations
+
+__all__ = ["PP_GRAD_ACCUM", "bubble_fraction", "pipeline_ticks",
+           "pipeline_loss", "reduce_replicated_grads"]
+
+# Mutation seam (docs/analysis.md): the classic broken pipeline "sync"
+# — treating ``pipe`` as one more data axis and averaging stage-local
+# gradients over it, which mixes gradients of DIFFERENT layers into
+# every stage's update.  False swaps in that spelling; the DST012
+# taint lint and the pp numerics gate must both catch it.
+PP_GRAD_ACCUM = True
+
+
+def bubble_fraction(k, m):
+    """Modeled idle fraction of the 1F1B schedule: ``K - 1`` of the
+    ``M + K - 1`` ticks are warm-up/drain on any given stage."""
+    k, m = int(k), int(m)
+    return float(k - 1) / float(k - 1 + m)
+
+
+def pipeline_ticks(k, m):
+    """Scan length of the schedule: every microbatch plus the fill."""
+    return int(m) + int(k) - 1
+
+
+def pipeline_loss(embed_fn, stage_fn, head_fn, x, y, plan, n_micro,
+                  act_dtype, axis="pipe"):
+    """Mean causal-LM loss of the LOCAL batch, computed by the 1F1B
+    schedule (module docstring).  ``embed_fn(x_mb) -> (mb, t, d)``
+    lifts a microbatch of tokens onto the residual stream (stage 0
+    only); ``stage_fn(h) -> h`` applies this stage's blocks;
+    ``head_fn(h, y_mb) -> scalar`` scores the last stage's output.
+    All three close over this replica's local parameter shards, so the
+    model/sequence collectives they contain ride along unchanged —
+    pipeline composes with TP/SP by construction.
+
+    Returns the full-batch mean loss, identical on every stage (the
+    forward ``psum`` over ``pipe`` is a ``custom_vjp`` completion with
+    identity backward, the ``complete_psum`` idiom of
+    ``transformer/layers.py``)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..transformer.layers import complete_psum
+
+    k = plan.size(axis)
+    n_micro = int(n_micro)
+    b, t_local = x.shape[0], x.shape[1]
+    if n_micro < 1:
+        raise ValueError("n_micro must be >= 1, got %d" % n_micro)
+    if b % n_micro:
+        raise ValueError(
+            "local batch %d must divide into %d microbatches" %
+            (b, n_micro))
+    mb = b // n_micro
+    ticks = pipeline_ticks(k, n_micro)
+    xm = x.reshape(n_micro, mb, t_local)
+    ym = y.reshape(n_micro, mb, t_local)
+    r = lax.axis_index(axis)
+    # one full single-cycle ring: stage i hands its activation to i+1;
+    # the wrap-around edge only ever carries masked-out garbage
+    perm = [(i, (i + 1) % k) for i in range(k)]
+
+    def tick(carry, t):
+        recv = carry
+        in_idx = jnp.clip(t, 0, n_micro - 1)
+        emb = embed_fn(xm[in_idx])
+        inp = jnp.where(r == 0, emb, recv)
+        out = stage_fn(inp)
+        out_idx = t - (k - 1)
+        mb_loss = head_fn(out, ym[jnp.clip(out_idx, 0, n_micro - 1)])
+        valid = (r == k - 1) & (out_idx >= 0)
+        loss_inc = jnp.where(valid, mb_loss, jnp.zeros_like(mb_loss))
+        nxt = lax.ppermute(out, axis, perm)
+        return nxt, loss_inc
+
+    init = jnp.zeros((mb, t_local, _embed_width(embed_fn, xm)),
+                     act_dtype)
+    _, losses = lax.scan(tick, init, jnp.arange(ticks))
+    # each microbatch contributes its own mean; microbatches are equal
+    # sized, so the mean of means is the full local-batch mean
+    loss_local = losses.sum() / n_micro
+    return complete_psum(loss_local, plan, axis=axis)
+
+
+def _embed_width(embed_fn, xm):
+    """Residual width of ``embed_fn``'s output, resolved at trace time
+    so the scan carry matches without running the embedding twice."""
+    import jax
+
+    shape = jax.eval_shape(embed_fn, xm[0]).shape
+    return shape[-1]
+
+
+def reduce_replicated_grads(grads, param_names, replicated_names,
+                            axis="pipe"):
+    """The step's ONE ``pipe``-axis gradient exchange: complete the
+    pipe-replicated parameters' partial gradients (each stage
+    contributed its own term or exact zeros) with a ``psum``.
+    Stage-local ``blk_*`` gradients pass through untouched — reducing
+    them over ``pipe`` would mix gradients of different layers
+    (DST012), which is exactly what the ``PP_GRAD_ACCUM=False`` broken
+    spelling below does."""
+    from jax import lax
+
+    out = []
+    for name, g in zip(param_names, grads):
+        if name in replicated_names:
+            g = lax.psum(g, axis)
+        elif not PP_GRAD_ACCUM:
+            # classic broken spelling (tests only): "synchronize" the
+            # stage-local gradients like a data axis — every stage now
+            # updates its blocks with an average over DIFFERENT layers
+            g = lax.pmean(g, axis)
+        out.append(g)
+    return tuple(out)

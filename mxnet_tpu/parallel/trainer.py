@@ -34,7 +34,7 @@ from ..ndarray import NDArray
 from ..resilience import chaos as _chaos
 from . import mesh as mesh_mod
 from .functional import (functionalize_forward, functional_optimizer_update,
-                         tree_raw)
+                         accumulate_grads, tree_raw)
 
 __all__ = ["DataParallelTrainer", "DEFAULT_CHECKPOINT_EVERY"]
 
@@ -102,7 +102,7 @@ class DataParallelTrainer:
                  mesh=None, param_spec_fn=None, data_axis="data",
                  kvstore=None, input_transform=None, run_id=None,
                  zero=0, mesh_plan=None, model_parallel=None,
-                 sequence_parallel=None, dtype=None):
+                 sequence_parallel=None, dtype=None, grad_accum=1):
         from .. import kvstore as kvs
         from .. import optimizer as opt_mod
         from .. import precision as _precision
@@ -247,6 +247,34 @@ class DataParallelTrainer:
                     "(%s); got %s"
                     % (", ".join(sorted(_ELEMENTWISE_OPTIMIZERS)),
                        type(self._opt).__name__))
+        # gradient accumulation (docs/distributed.md): the step splits
+        # its (per-replica) batch into ``grad_accum`` microbatches and
+        # left-fold sums their gradients before the ONE optimizer
+        # update — the ``parallel/functional.accumulate_grads``
+        # spelling, shared with the analysis twin.  Collective count is
+        # unchanged (grads reduce once, after accumulation).
+        self._grad_accum = 1 if grad_accum is None else int(grad_accum)
+        if self._grad_accum < 1:
+            raise ValueError("grad_accum must be >= 1, got %r"
+                             % (grad_accum,))
+        if self._grad_accum > 1:
+            if self._plan is not None:
+                raise ValueError(
+                    "grad_accum does not apply to the mesh tier: a "
+                    "pipelined plan microbatches through the 1F1B "
+                    "schedule (TransformerLMConfig(microbatches=...), "
+                    "docs/pipeline.md)")
+            if self._kv is not None:
+                raise ValueError(
+                    "grad_accum with a multi-process kvstore is not "
+                    "supported: the split-step protocol pushes one "
+                    "flat gradient per step")
+            if self._reduced:
+                raise ValueError(
+                    "grad_accum with dtype='bf16' is not supported: "
+                    "the loss-scale finite check is defined over one "
+                    "backward pass (accumulate in f32, or use the "
+                    "pipelined mesh tier for bf16 microbatching)")
         self._zero_plan = None
         self._zero_treedef = None
         self._zero_grad_fn = None
@@ -559,9 +587,12 @@ class DataParallelTrainer:
                 _zero.build_runtime_fns(
                     self._fwd, self._opt, self._zero_plan,
                     self._zero_treedef, self._mesh,
-                    compute_dtype=self._dtype if self._reduced else None)
+                    compute_dtype=self._dtype if self._reduced else None,
+                    grad_accum=self._grad_accum)
             if tele_on:
                 attr.set_context("collective_or_ps", "zero1")
+                if self._grad_accum > 1:
+                    attr.set_context("dispatch", "grad_accum")
         if self._reduced:
             g_sh, loss_val, muts, fin = self._zero_grad_fn(
                 train_vals, aux_vals, x, y, rng, self._ls_scale)
@@ -607,7 +638,8 @@ class DataParallelTrainer:
             self._data_axis, k)
         return _zero.build_replica_step(
             self._fwd, self._opt, plan, self._zero_treedef,
-            compute_dtype=self._dtype if self._reduced else None), plan
+            compute_dtype=self._dtype if self._reduced else None,
+            grad_accum=self._grad_accum), plan
 
     def zero_report(self, data_shape=None, label_shape=None,
                     data_dtype="float32", label_dtype="int32",
@@ -768,6 +800,15 @@ class DataParallelTrainer:
             raise ValueError(
                 "global batch %d must divide by the data axis %d "
                 "(plan %r)" % (dshape[0], plan.size("data"), plan))
+        if program.pipelined:
+            b_local = dshape[0] // plan.size("data")
+            if b_local % program.n_micro:
+                raise ValueError(
+                    "pipeline=%d runs %d microbatches: the per-replica "
+                    "batch %d must divide by them (global batch %d, "
+                    "data axis %d)"
+                    % (plan.size("pipe"), program.n_micro, b_local,
+                       dshape[0], plan.size("data")))
         params = program.init_params()
         self._mesh_param_names = list(program.param_names)
         self._mesh_params = {
@@ -792,13 +833,13 @@ class DataParallelTrainer:
                         % (li, type(self._opt).__name__,
                            tuple(getattr(leaf, "shape", ()))))
             self._mesh_state_treedefs = [treedef]
-            flat_axes = tuple(a for a in ("model", "data")
+            flat_axes = tuple(a for a in ("pipe", "model", "data")
                               if plan.present(a))
             spec = P(flat_axes) if flat_axes else P()
             self._mesh_state_specs = [spec] * len(leaves)
-            km = plan.size("model")
+            kpm = plan.size("pipe") * plan.size("model")
             self._mesh_state_leaves = tuple(
-                jax.device_put(jnp.zeros((km * zp.padded,), jnp.float32),
+                jax.device_put(jnp.zeros((kpm * zp.padded,), jnp.float32),
                                NamedSharding(mesh, spec))
                 for _ in leaves)
             self._mesh_leaf_counts = None
@@ -842,18 +883,20 @@ class DataParallelTrainer:
         step's priced schedule (docs/transformer.md; the CONTEXT_HINTS
         entries in telemetry/attribution.py)."""
         plan = self._plan
-        if plan.present("model") and not plan.present("sequence"):
-            return "tp_model"
-        if plan.present("sequence") and not plan.present("model"):
-            return "tp_sequence"
+        tags = {"model": "tp_model", "sequence": "tp_sequence",
+                "pipe": "pp_pipeline"}
+        armed = [a for a in ("model", "sequence", "pipe")
+                 if plan.present(a)]
+        if len(armed) == 1:
+            return tags[armed[0]]
         try:
             desc = self._setup_desc["data"][0]
             _, _, shard = self.mesh_report(
                 data_shape=tuple(desc), declared_plan=plan)
             per_axis = shard.collective_bytes_per_axis
-            return ("tp_model"
-                    if per_axis.get("model", 0)
-                    >= per_axis.get("sequence", 0) else "tp_sequence")
+            best = max(armed or ["model"],
+                       key=lambda a: per_axis.get(a, 0))
+            return tags[best]
         except Exception:
             return "tp_model"
 
@@ -921,6 +964,7 @@ class DataParallelTrainer:
         from ..analysis import cost as _cost
         from ..analysis import shard_prop as _sp
         from ..transformer import step as _tstep
+        from . import pipeline as _pp
 
         if self._plan is None:
             raise ValueError("mesh_report needs a mesh_plan trainer")
@@ -947,7 +991,7 @@ class DataParallelTrainer:
             state_avals = tuple(
                 jax.ShapeDtypeStruct((zp.shard,), _onp.float32)
                 for _ in leaves)
-            flat_axes = tuple(a for a in ("model", "data")
+            flat_axes = tuple(a for a in ("pipe", "model", "data")
                               if plan.present(a))
             state_dims = {0: flat_axes} if flat_axes else {}
             state_shard_dims = [state_dims] * len(leaves)
@@ -1015,9 +1059,35 @@ class DataParallelTrainer:
                     % (plan.describe()["axes"],))
         if plan.present("sequence") and \
                 program.attention_mode == "ring":
+            # under pipeline=K the block (and its attention ring) runs
+            # inside the tick scan: one full ring per tick
+            ring_outer = (_pp.pipeline_ticks(plan.size("pipe"),
+                                             program.n_micro)
+                          if program.pipelined else 1)
             findings += _sp.lint_ring_schedule(
                 closed, "sequence", plan.size("sequence"),
-                subject="DataParallelTrainer.mesh ring attention")
+                subject="DataParallelTrainer.mesh ring attention",
+                outer_scale=ring_outer)
+        if program.pipelined:
+            act_itemsize = 2 if self._reduced else 4
+            stash_bytes = (b_local * t_local
+                           * program.cfg.d_model * act_itemsize)
+            pipe_sharded = [
+                i for i, name in enumerate(program.param_names)
+                if "pipe" in {e for e in program.partition_spec(name)
+                              if e is not None}]
+            findings += _sp.lint_pipeline_step(
+                closed, plan.axis_sizes(), program.n_micro,
+                stash_bytes=stash_bytes,
+                peak_hbm_bytes=report.peak_hbm_bytes,
+                # the ZeRO-1 flat concat mixes every param into one
+                # vector — the taint half only proves the per-param
+                # spelling (lint_pipeline_step docstring)
+                param_outvars=([] if self._zero
+                               else list(range(1, 1 + n_train))),
+                param_names=list(program.param_names),
+                pipe_sharded=pipe_sharded,
+                subject="DataParallelTrainer(mesh_plan pipeline)")
         findings += _cost.unpriced_findings(
             report, subject="DataParallelTrainer(mesh_plan)")
         shard = _sp.collective_schedule(
@@ -1033,6 +1103,23 @@ class DataParallelTrainer:
                 per_axis.get("sequence", 0)),
             "runtime_peak_hbm_bytes": int(report.peak_hbm_bytes),
         })
+        if program.pipelined:
+            kp, m = plan.size("pipe"), program.n_micro
+            ticks = _pp.pipeline_ticks(kp, m)
+            act_itemsize = 2 if self._reduced else 4
+            hop = ((b_local // m) * t_local * program.cfg.d_model
+                   * act_itemsize)
+            shard.extras.update({
+                "pp_modeled_pipe_axis_bytes": int(
+                    per_axis.get("pipe", 0)),
+                "pp_modeled_bubble_frac": _pp.bubble_fraction(kp, m),
+                "pp_microbatches": int(m),
+                "pp_ticks": int(ticks),
+                "pp_hop_bytes": int(hop),
+                "pp_stash_bytes": int(b_local * t_local
+                                      * program.cfg.d_model
+                                      * act_itemsize),
+            })
         if zp is not None:
             shard.extras["tp_zero1_plan"] = zp.describe()
         # traced program + axis sizes for fusion_report (private)
@@ -1200,6 +1287,31 @@ class DataParallelTrainer:
             return jax.jit(self._reduced_pure_step(),
                            donate_argnums=(0, 1))
 
+        n_acc = self._grad_accum
+        if n_acc > 1:
+            # microbatched spelling (grad_accum): left-fold sum of
+            # per-microbatch grads (functional.accumulate_grads), ONE
+            # optimizer update on the mean — the n_acc=1 spelling below
+            # stays byte-identical to the historical traced program
+            def pure_step(train_vals, states, aux_vals, x, y, key, lr,
+                          t):
+                def grad_of(tv, xi, yi):
+                    def loss_of(t_):
+                        outs, muts = fwd(t_, aux_vals, (xi, yi), key)
+                        return outs[0], muts
+                    return jax.value_and_grad(loss_of, has_aux=True)(tv)
+
+                grads_sum, loss_sum, muts_stack = \
+                    accumulate_grads(grad_of, train_vals, x, y, n_acc)
+                grads = tuple(g / n_acc for g in grads_sum)
+                loss_val = loss_sum / n_acc
+                muts = tuple(m.mean(axis=0) for m in muts_stack)
+                new_vals, new_states = self._apply_groups(
+                    train_vals, states, grads, lr, t)
+                return loss_val, new_vals, new_states, muts
+
+            return jax.jit(pure_step, donate_argnums=(0, 1))
+
         def pure_step(train_vals, states, aux_vals, x, y, key, lr, t):
             def loss_of(tv):
                 outs, muts = fwd(tv, aux_vals, (x, y), key)
@@ -1319,6 +1431,35 @@ class DataParallelTrainer:
                 new_vals, new_states = self._apply_groups(
                     train_vals, states, grads, lr, t,
                     inv_scale=inv, ok=fin.astype(jnp.float32))
+                return loss_val, new_vals, new_states, muts
+
+            return replica_step
+
+        n_acc = self._grad_accum
+        if n_acc > 1:
+            # analysis twin of the grad_accum jitted step: the SAME
+            # accumulate_grads spelling, then the step's ONE gradient
+            # reduction — accumulation happens per replica, the
+            # collective count is unchanged (DST001 still counts one
+            # pmean per trainable)
+            def replica_step(train_vals, states, aux_vals, x, y, key,
+                             lr, t):
+                def grad_of(tv, xi, yi):
+                    def loss_of(t_):
+                        outs, muts = fwd(t_, aux_vals, (xi, yi), key)
+                        return outs[0], muts
+                    return jax.value_and_grad(loss_of, has_aux=True)(tv)
+
+                grads_sum, loss_sum, muts_stack = \
+                    accumulate_grads(grad_of, train_vals, x, y, n_acc)
+                grads = tuple(g / n_acc for g in grads_sum)
+                loss_val = loss_sum / n_acc
+                muts = tuple(m.mean(axis=0) for m in muts_stack)
+                grads = self._reduce_grads(grads)
+                loss_val = jax.lax.pmean(loss_val, axis)
+                muts = tuple(jax.lax.pmean(m, axis) for m in muts)
+                new_vals, new_states = self._apply_groups(
+                    train_vals, states, grads, lr, t)
                 return loss_val, new_vals, new_states, muts
 
             return replica_step
@@ -1811,6 +1952,8 @@ class DataParallelTrainer:
             # jax.jit itself retraces and caches per input shape/dtype
             if self._step_fn is None:
                 self._step_fn = self._build_step()
+                if tele_on and self._grad_accum > 1:
+                    attr.set_context("dispatch", "grad_accum")
             if self._reduced:
                 (loss_val, new_vals, new_states, muts, self._ls_scale,
                  self._ls_good, self._ls_skipped) = self._step_fn(

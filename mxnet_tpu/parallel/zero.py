@@ -104,7 +104,8 @@ def _unflatten(flat, plan, jnp):
     return tuple(out)
 
 
-def build_parts(fwd, opt, plan, state_treedef, compute_dtype=None):
+def build_parts(fwd, opt, plan, state_treedef, compute_dtype=None,
+                grad_accum=1):
     """``(grads_part, update_part)`` — the per-replica halves of the
     ZeRO-1 step.  Both are pure jax functions over LOCAL shards (the
     ``shard_map`` / ``axis_env`` view):
@@ -147,25 +148,57 @@ def build_parts(fwd, opt, plan, state_treedef, compute_dtype=None):
 
     if compute_dtype is not None and \
             jnp.dtype(compute_dtype) != jnp.float32:
+        if int(grad_accum or 1) > 1:
+            raise ValueError("grad_accum is not supported with a "
+                             "reduced compute dtype (see "
+                             "DataParallelTrainer)")
         return _build_parts_reduced(fwd, opt, plan, state_treedef,
                                     jnp.dtype(compute_dtype))
 
-    def grads_part(train_vals, aux_vals, x, y, key):
-        def loss_of(tv):
-            outs, muts = fwd(tv, aux_vals, (x, y), key)
-            return outs[0], muts
+    n_acc = int(grad_accum or 1)
+    if n_acc > 1:
+        # grad_accum spelling (docs/distributed.md): the shard-local
+        # batch splits into microbatches accumulated left-to-right
+        # (functional.accumulate_grads — the SAME helper the replicated
+        # trainer jits), then ONE reduce-scatter of the summed flat
+        # gradient: the collective count and wire bytes are unchanged
+        # vs n_acc=1, which keeps DST006's one-reduction contract
+        from .functional import accumulate_grads
 
-        (loss_val, muts), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(train_vals)
-        flat_g = _flatten_pad(grads, plan, jnp)
-        # reduce-scatter lands exactly this rank's owned gradient shard;
-        # /k turns the psum semantics into the gradient mean every
-        # replicated spelling uses
-        g_sh = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
-                                tiled=True) / k
-        loss_val = lax.pmean(loss_val, axis)
-        muts = tuple(lax.pmean(m, axis) for m in muts)
-        return g_sh, loss_val, muts
+        def grads_part(train_vals, aux_vals, x, y, key):
+            def grad_of(tv, xi, yi):
+                def loss_of(t_):
+                    outs, muts = fwd(t_, aux_vals, (xi, yi), key)
+                    return outs[0], muts
+                return jax.value_and_grad(loss_of, has_aux=True)(tv)
+
+            grads_sum, loss_sum, muts_stack = accumulate_grads(
+                grad_of, train_vals, x, y, n_acc)
+            grads = tuple(g / n_acc for g in grads_sum)
+            flat_g = _flatten_pad(grads, plan, jnp)
+            g_sh = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
+                                    tiled=True) / k
+            loss_val = lax.pmean(loss_sum / n_acc, axis)
+            muts = tuple(lax.pmean(m.mean(axis=0), axis)
+                         for m in muts_stack)
+            return g_sh, loss_val, muts
+    else:
+        def grads_part(train_vals, aux_vals, x, y, key):
+            def loss_of(tv):
+                outs, muts = fwd(tv, aux_vals, (x, y), key)
+                return outs[0], muts
+
+            (loss_val, muts), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            flat_g = _flatten_pad(grads, plan, jnp)
+            # reduce-scatter lands exactly this rank's owned gradient
+            # shard; /k turns the psum semantics into the gradient mean
+            # every replicated spelling uses
+            g_sh = lax.psum_scatter(flat_g, axis, scatter_dimension=0,
+                                    tiled=True) / k
+            loss_val = lax.pmean(loss_val, axis)
+            muts = tuple(lax.pmean(m, axis) for m in muts)
+            return g_sh, loss_val, muts
 
     def update_part(train_vals, state_leaves, g_sh, lr, t):
         from ..ops import fused_optimizer as _fused
@@ -312,7 +345,7 @@ def _build_parts_reduced(fwd, opt, plan, state_treedef, compute_dtype):
 
 
 def build_replica_step(fwd, opt, plan, state_treedef,
-                       compute_dtype=None):
+                       compute_dtype=None, grad_accum=1):
     """One per-replica function composing both halves — the analysis
     spelling.  ``step(train_vals, state_leaves, aux_vals, x, y, key,
     lr, t) -> (loss, new_vals, new_state_leaves, muts)``; trace with
@@ -327,7 +360,8 @@ def build_replica_step(fwd, opt, plan, state_treedef,
     import jax.numpy as jnp
 
     grads_part, update_part = build_parts(fwd, opt, plan, state_treedef,
-                                          compute_dtype=compute_dtype)
+                                          compute_dtype=compute_dtype,
+                                          grad_accum=grad_accum)
     if compute_dtype is not None and \
             jnp.dtype(compute_dtype) != jnp.float32:
         def replica_step(train_vals, master_sh, state_leaves, aux_vals,
@@ -355,7 +389,7 @@ def build_replica_step(fwd, opt, plan, state_treedef,
 
 
 def build_runtime_fns(fwd, opt, plan, state_treedef, mesh,
-                      compute_dtype=None):
+                      compute_dtype=None, grad_accum=1):
     """``(grad_fn, update_fn)`` — the jitted ``shard_map`` programs the
     trainer dispatches each step.  ``grad_fn``'s flat-gradient output
     and the optimizer-state leaves are GLOBAL ``(padded,)`` arrays
@@ -375,7 +409,8 @@ def build_runtime_fns(fwd, opt, plan, state_treedef, mesh,
     from .ring_attention import _shard_map
 
     grads_part, update_part = build_parts(fwd, opt, plan, state_treedef,
-                                          compute_dtype=compute_dtype)
+                                          compute_dtype=compute_dtype,
+                                          grad_accum=grad_accum)
     axis = plan.axis
     if compute_dtype is not None and \
             jnp.dtype(compute_dtype) != jnp.float32:

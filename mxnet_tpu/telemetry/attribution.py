@@ -126,6 +126,16 @@ CONTEXT_HINTS = {
         "heads divide the sequence axis (2 all_to_alls vs a K-hop "
         "ppermute ring), or lower sequence_parallel "
         "(docs/transformer.md)",
+    ("collective_or_ps", "pp_pipeline"):
+        "the pipe-axis activation ppermutes dominate the mesh step's "
+        "modeled schedule: raise microbatches so compute amortizes "
+        "the per-tick hop (and shrinks the (K-1)/(K-1+M) bubble), or "
+        "lower pipeline stages (docs/pipeline.md)",
+    ("dispatch", "grad_accum"):
+        "the step runs grad_accum microbatches back-to-back before "
+        "its one optimizer update: lower grad_accum if HBM allows the "
+        "full batch in one pass, or grow the microbatch so compute "
+        "amortizes the per-microbatch dispatch (docs/distributed.md)",
     # tagged by trainer.fusion_report() when the top fusable chain
     # covers > FUSION_HINT_MIN_PCT of step bytes (docs/fusion.md)
     ("dispatch", "fusable"):

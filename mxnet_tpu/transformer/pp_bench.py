@@ -1,0 +1,115 @@
+"""Host-only pipeline-tier bench (the r05 subprocess pattern).
+
+Run as ``python -m mxnet_tpu.transformer.pp_bench`` under
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``
+(bench.py's ``pipeline`` stage does, BEFORE backend acquisition, so the
+keys stay live when the TPU is down).  Emits one JSON line:
+
+- ``pp_modeled_bubble_frac``: the pinned ``pp_transformer_train_step``
+  fixture's modeled 1F1B bubble fraction ``(K-1)/(K-1+M)``
+  (deterministic — gated lower_rel in tools/bench_compare.py: a grown
+  bubble means the schedule geometry regressed);
+- ``pp_modeled_pipe_axis_bytes``: the fixture's pipe-axis wire bytes
+  per step (deterministic — growing stage-boundary traffic is the
+  regression);
+- ``pp_tokens_per_sec_host``: real tokens/sec of a
+  ``pipe=2 x model=2 x data=2`` train loop on the virtual host mesh
+  (throughput gate);
+- ``pp_numerics_ok``: 1.0 iff the pipelined run's losses match the
+  replicated single-axis baseline to tolerance over several steps —
+  the end-to-end 1F1B numerics contract, gated at zero slack.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+TOL = 2e-3          # loss match tolerance vs the replicated baseline
+TRAIN_STEPS = 10
+MEASURE_FROM = 4    # skip compile steps in the throughput window
+
+
+def _corpus(vocab, length, seed=7):
+    rng = np.random.RandomState(seed)
+    succ = rng.permutation(vocab)
+    out = np.empty(length, np.int32)
+    tok = 0
+    for i in range(length):
+        out[i] = tok
+        tok = int(succ[tok]) if rng.rand() < 0.8 \
+            else int(rng.randint(vocab))
+    return out
+
+
+def _run(plan, cfg_kw, batch, steps, seed=0):
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from ..ndarray import NDArray
+    from ..parallel import DataParallelTrainer
+    from .model import TransformerLM, TransformerLMConfig
+
+    mx.random.seed(seed)
+    cfg = TransformerLMConfig(**cfg_kw)
+    trainer = DataParallelTrainer(
+        TransformerLM(cfg), None, "sgd",
+        {"learning_rate": 0.2, "momentum": 0.9}, mesh_plan=plan)
+    corpus = _corpus(cfg.vocab_size, 2048, seed=seed + 7)
+    rng = np.random.RandomState(seed + 11)
+    hi = len(corpus) - cfg.seq_len - 1
+    losses, times = [], []
+    for step in range(steps):
+        starts = rng.randint(0, hi, size=batch)
+        x = np.stack([corpus[s:s + cfg.seq_len] for s in starts])
+        y = np.stack([corpus[s + 1:s + cfg.seq_len + 1] for s in starts])
+        t0 = time.perf_counter()
+        loss = trainer.step(NDArray(jnp.asarray(x)),
+                            NDArray(jnp.asarray(y)))
+        losses.append(float(loss.asnumpy()))   # sync: per-step timing
+        times.append(time.perf_counter() - t0)
+    return losses, times
+
+
+def main():
+    from ..analysis.budget_models import build_model
+    from ..parallel.mesh import MeshPlan
+
+    out = {}
+
+    # modeled (deterministic, device-free): the budget fixture's 1F1B
+    # schedule geometry and pipe-axis wire traffic
+    _, findings, shard = build_model("pp_transformer_train_step")
+    out["pp_modeled_bubble_frac"] = round(
+        float(shard.extras["pp_modeled_bubble_frac"]), 4)
+    out["pp_modeled_pipe_axis_bytes"] = int(
+        shard.extras["pp_modeled_pipe_axis_bytes"])
+    out["pp_hop_bytes"] = int(shard.extras["pp_hop_bytes"])
+    out["pp_budget_findings"] = len(findings)
+
+    cfg_kw = dict(vocab_size=64, d_model=64, n_heads=4, n_layers=2,
+                  d_ff=128, seq_len=128)
+    batch = 8
+
+    pp_losses, times = _run(
+        MeshPlan(data=2, model=2, pipeline=2), cfg_kw, batch,
+        TRAIN_STEPS)
+    window = times[MEASURE_FROM:]
+    tokens = batch * cfg_kw["seq_len"]
+    out["pp_tokens_per_sec_host"] = round(
+        tokens / (sum(window) / len(window)), 1)
+
+    base_losses, _ = _run(MeshPlan(data=1), cfg_kw, batch, TRAIN_STEPS)
+    err = max(abs(a - b) for a, b in zip(pp_losses, base_losses))
+    out["pp_numerics_max_loss_err"] = round(err, 6)
+    out["pp_numerics_ok"] = 1.0 if err <= TOL else 0.0
+
+    print(json.dumps(out))
+    return 0 if out["pp_numerics_ok"] and not out["pp_budget_findings"] \
+        else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

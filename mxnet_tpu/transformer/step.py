@@ -1,7 +1,7 @@
 """The multi-axis train step, spelled ONCE per replica.
 
 ``build_parts`` produces the two halves of the
-``data × model × sequence`` training step over any
+``data × model × sequence × pipe`` training step over any
 :class:`~mxnet_tpu.transformer.model.MeshProgram`:
 
 - ``grads_part``: forward + backward on the local (batch, token) chunk
@@ -10,7 +10,10 @@
   parameter gradient is ``pmean``'d over the plan's **batch axes**
   (``data`` and ``sequence``; model-sharded params keep their per-shard
   gradients — reducing them over ``model`` would mix unrelated shard
-  coordinates, DST006), and under ``zero=1`` the flat LOCAL gradient is
+  coordinates, DST006; under ``pipeline=K`` only the pipe-replicated
+  params are additionally psum-completed over ``pipe``, never the
+  stage-local stacks — DST012), and under ``zero=1`` the flat LOCAL
+  gradient is
   additionally reduce-scattered over ``data`` (arxiv 2004.13336 composed
   multiplicatively with the tensor/sequence sharding).
 - ``update_part``: the optimizer applied shard-locally through a
@@ -137,6 +140,13 @@ def build_parts(program, apply_update, state_leaf_counts, zero=0,
         else:
             loss, grads = jax.value_and_grad(program.loss_replica)(
                 tuple(train_vals), x, y, key)
+        if plan.present("pipe"):
+            # the ONE pipe-axis exchange: complete the pipe-replicated
+            # params' partial grads; stage-local blk_* grads pass
+            # through (reducing them over pipe mixes layers — DST012)
+            from ..parallel.pipeline import reduce_replicated_grads
+            grads = reduce_replicated_grads(
+                grads, program.param_names, program.pipe_replicated)
         if batch_axes:
             loss = lax.pmean(loss, batch_axes)
         if zero:
@@ -228,7 +238,8 @@ def build_runtime_fns(program, apply_update, state_leaf_counts, mesh,
                         for n in program.param_names)
     batch_spec = plan.batch_spec()
     if zero:
-        flat_axes = tuple(a for a in ("model", "data") if plan.present(a))
+        flat_axes = tuple(a for a in ("pipe", "model", "data")
+                          if plan.present(a))
         grad_out = P(flat_axes) if flat_axes else P()
     else:
         grad_out = param_specs

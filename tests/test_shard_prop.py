@@ -477,7 +477,7 @@ def test_shard_cli_json_section():
     assert payload["schema_version"] == 6
     shard = payload["shard"]
     assert shard["rules"] == ["DST006", "DST007", "DST008", "DST009",
-                              "DST010", "COST004"]
+                              "DST010", "DST011", "DST012", "COST004"]
     z = shard["reports"]["zero1_mlp_train_step"]
     assert z["mesh"] == {"data": 8}
     assert [e["prim"] for e in z["schedule"]][:2] == \

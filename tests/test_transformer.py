@@ -324,6 +324,7 @@ def test_example_trains_end_to_end():
     stats = mod.train(ns, logger=lambda *a: None)
     assert stats["final_loss"] < stats["head_loss"]
     assert stats["plan"] == {"data": 2, "model": 2, "sequence": 2,
+                             "pipeline": 1,
                              "axes": ["data", "model", "sequence"]}
     assert stats["collective_bytes_per_axis"]["model"] > 0
     assert stats["tokens_per_sec"] > 0

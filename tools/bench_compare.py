@@ -54,6 +54,17 @@ Gated metrics (direction, tolerance)::
     tp_numerics_ok                     higher, zero slack (mesh losses
                                        must equal the replicated
                                        baseline: 1.0 or regression)
+    pp_modeled_bubble_frac             lower, 2% relative (modeled 1F1B
+                                       bubble (K-1)/(K-1+M); up is the
+                                       regression)
+    pp_modeled_pipe_axis_bytes         lower, 2% relative (modeled
+                                       stage-boundary wire bytes)
+    pp_tokens_per_sec_host             higher, 10% relative (pipe=2 x
+                                       model=2 x data=2 train loop on
+                                       the virtual host mesh)
+    pp_numerics_ok                     higher, zero slack (pipelined
+                                       losses must equal the replicated
+                                       baseline: 1.0 or regression)
     fused_optimizer_speedup_host       higher, 10% relative (measured
                                        unfused vs fused update on the
                                        1-core host, >= 1.2x expected)
@@ -169,6 +180,16 @@ GATES = {
     "tp_modeled_model_axis_bytes": ("lower_rel", 0.02),
     "seqpar_tokens_per_sec_host": ("higher", 0.10),
     "tp_numerics_ok": ("higher", 0.0),
+    # pipeline-parallel stage: the modeled 1F1B bubble fraction and
+    # pipe-axis wire bytes are deterministic (2% covers intentional
+    # schedule-geometry retunes shipped with their PR); tokens/sec is
+    # wall time on the noisy 1-core host (10% rel); the pipelined-vs-
+    # replicated loss parity is a hard contract — any drop from 1.0 is
+    # a numerics regression, zero slack
+    "pp_modeled_bubble_frac": ("lower_rel", 0.02),
+    "pp_modeled_pipe_axis_bytes": ("lower_rel", 0.02),
+    "pp_tokens_per_sec_host": ("higher", 0.10),
+    "pp_numerics_ok": ("higher", 0.0),
     # fusion stage (r06 onward): the measured fused-vs-unfused optimizer
     # update speedup on the 1-core host (10% rel — wall time on a noisy
     # host); the modeled bytes-saved of the optimizer chain is
